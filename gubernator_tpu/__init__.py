@@ -1,0 +1,59 @@
+"""gubernator-tpu: a TPU-native distributed rate-limiting framework.
+
+A brand-new implementation of the capabilities of Mailgun's Gubernator
+(reference: github.com/mailgun/gubernator v0.5.0), re-designed TPU-first:
+
+- Rate-limit bucket state lives as dense integer arrays in TPU HBM (a d-way
+  set-associative fingerprint "slot store", a counting-sketch relative of the
+  reference's LRU hash map, /root/reference/cache/lru.go).
+- Token-bucket / leaky-bucket decisions (reference algorithms.go:24,88) are a
+  single branch-free, vmapped, jitted XLA kernel evaluated over request
+  batches; duplicate keys within a batch are made associative with a
+  sort + segmented-prefix-sum pass.
+- The consistent-hash peer ring (reference hash.go) maps onto mesh axes of a
+  `jax.sharding.Mesh`; cross-shard combination and the GLOBAL gossip loop
+  (reference global.go) become `jax.lax.psum` collectives over ICI.
+- The serving edge keeps the reference's public contract: gRPC `V1` and
+  `PeersV1` services, HTTP JSON gateway, Prometheus `/metrics`, `GUBER_*`
+  env config, micro-batched peer forwarding.
+
+Integer time/counter math is int64 end to end (matching the reference's
+wire types), so x64 mode is enabled at import.
+"""
+
+import jax
+
+# Rate-limit math is int64 on the wire (proto int64 hits/limit/duration and
+# unix-millisecond timestamps); enable x64 so device state matches exactly.
+jax.config.update("jax_enable_x64", True)
+
+from gubernator_tpu.api.types import (  # noqa: E402
+    Algorithm,
+    Behavior,
+    Status,
+    RateLimitReq,
+    RateLimitResp,
+    HealthCheckResp,
+    hash_key,
+    MILLISECOND,
+    SECOND,
+    MINUTE,
+    HOUR,
+)
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "Status",
+    "RateLimitReq",
+    "RateLimitResp",
+    "HealthCheckResp",
+    "hash_key",
+    "MILLISECOND",
+    "SECOND",
+    "MINUTE",
+    "HOUR",
+    "__version__",
+]

@@ -1,0 +1,19 @@
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    Status,
+    RateLimitReq,
+    RateLimitResp,
+    HealthCheckResp,
+    hash_key,
+)
+
+__all__ = [
+    "Algorithm",
+    "Behavior",
+    "Status",
+    "RateLimitReq",
+    "RateLimitResp",
+    "HealthCheckResp",
+    "hash_key",
+]

@@ -1,0 +1,126 @@
+"""Core data model: the wire-level types of the rate-limit API.
+
+Mirrors the reference proto contract (reference proto/gubernator.proto:57-153,
+proto/peers.proto:36-56) as plain Python dataclasses used throughout the
+host-side code. The actual protobuf classes (for gRPC) are generated from our
+own .proto files and converted to/from these types at the serving edge.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+# Duration constants in milliseconds (reference client.go:27-31).
+MILLISECOND = 1
+SECOND = 1000 * MILLISECOND
+MINUTE = 60 * SECOND
+HOUR = 60 * MINUTE
+
+
+class Algorithm(enum.IntEnum):
+    """Rate-limit algorithm (reference proto/gubernator.proto:57-62)."""
+
+    TOKEN_BUCKET = 0
+    LEAKY_BUCKET = 1
+
+
+class Behavior(enum.IntEnum):
+    """Request routing behavior (reference proto/gubernator.proto:64-95).
+
+    BATCHING    — forward to the owning peer through the micro-batching queue.
+    NO_BATCHING — forward with a direct unary RPC (lowest latency).
+    GLOBAL      — answer from the local replica cache; hits are aggregated and
+                  pushed to the owner asynchronously, and the owner broadcasts
+                  authoritative status back to all peers.
+    """
+
+    BATCHING = 0
+    NO_BATCHING = 1
+    GLOBAL = 2
+
+
+class Status(enum.IntEnum):
+    """Decision status (reference proto/gubernator.proto:125-128)."""
+
+    UNDER_LIMIT = 0
+    OVER_LIMIT = 1
+
+
+@dataclass
+class RateLimitReq:
+    """One rate-limit request (reference proto/gubernator.proto:97-123).
+
+    duration is in milliseconds. hits == 0 is a read-only peek.
+    """
+
+    name: str = ""
+    unique_key: str = ""
+    hits: int = 0
+    limit: int = 0
+    duration: int = 0
+    algorithm: Algorithm = Algorithm.TOKEN_BUCKET
+    behavior: Behavior = Behavior.BATCHING
+
+    def hash_key(self) -> str:
+        return hash_key(self.name, self.unique_key)
+
+
+@dataclass
+class RateLimitResp:
+    """One rate-limit decision (reference proto/gubernator.proto:130-143)."""
+
+    status: Status = Status.UNDER_LIMIT
+    limit: int = 0
+    remaining: int = 0
+    reset_time: int = 0
+    error: str = ""
+    metadata: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class HealthCheckResp:
+    """Server health (reference proto/gubernator.proto:146-153)."""
+
+    status: str = ""
+    message: str = ""
+    peer_count: int = 0
+
+
+@dataclass
+class GetRateLimitsReq:
+    requests: List[RateLimitReq] = field(default_factory=list)
+
+
+@dataclass
+class GetRateLimitsResp:
+    responses: List[RateLimitResp] = field(default_factory=list)
+
+
+@dataclass
+class UpdatePeerGlobal:
+    """One GLOBAL status broadcast entry (reference proto/peers.proto:52-55)."""
+
+    key: str = ""
+    status: Optional[RateLimitResp] = None
+
+
+@dataclass
+class PeerInfo:
+    """Cluster membership entry (reference etcd.go:34 usage / cluster.go)."""
+
+    address: str = ""
+    is_owner: bool = False
+
+
+def hash_key(name: str, unique_key: str) -> str:
+    """The canonical cache/ring key: `name + "_" + unique_key`
+    (reference client.go:33-35)."""
+    return name + "_" + unique_key
+
+
+def millisecond_now() -> int:
+    """Wall clock in unix milliseconds (reference cache/lru.go MillisecondNow)."""
+    return time.time_ns() // 1_000_000

@@ -1,0 +1,78 @@
+"""Key hashing.
+
+Two independent hash roles, mirroring the reference's split:
+
+1. Ring hash — maps a key (or peer address) to a point on the consistent-hash
+   ring used for peer ownership. The reference uses crc32.ChecksumIEEE
+   (reference hash.go:40-42); we use the same function (zlib.crc32) so that
+   ownership distribution characteristics match.
+
+2. Slot hash — a 64-bit hash used to derive the d row-slot indices and the
+   32-bit fingerprint tag of the device slot store. This hash is local to an
+   instance (peers never need to agree on it), but must be stable across runs
+   for debuggability. Batch hashing of many keys is on the serving hot path,
+   so there is a C++ fast path (native/libguberhash) with a pure-Python
+   fallback (blake2b).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import zlib
+from typing import Iterable, List
+
+import numpy as np
+
+_FNV64_OFFSET = 0xCBF29CE484222325
+_FNV64_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def ring_hash(key: str) -> int:
+    """crc32 point on the ring, matching reference hash.go:40-42."""
+    return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
+
+
+def slot_hash(key: str) -> int:
+    """Stable 64-bit hash of a key (Python fallback path)."""
+    return int.from_bytes(
+        hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "little"
+    )
+
+
+def _slot_hash_batch_py(keys: Iterable[str]) -> np.ndarray:
+    return np.array([slot_hash(k) for k in keys], dtype=np.uint64)
+
+
+# The native batch hasher is loaded lazily; see gubernator_tpu.native.
+_native_batch = None
+_native_checked = False
+
+
+def _load_native():
+    global _native_batch, _native_checked
+    if _native_checked:
+        return
+    _native_checked = True
+    try:
+        from gubernator_tpu.native import hashlib_native
+
+        _native_batch = hashlib_native.blake2b64_batch
+    except Exception:
+        _native_batch = None
+
+
+def slot_hash_batch(keys: List[str]) -> np.ndarray:
+    """uint64[len(keys)] of slot hashes; uses the native extension if built."""
+    _load_native()
+    if _native_batch is not None:
+        return _native_batch(keys)
+    return _slot_hash_batch_py(keys)
+
+
+def mix64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer — derives independent row hashes from one hash."""
+    x = x.astype(np.uint64)
+    x = (x ^ (x >> np.uint64(30))) * np.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> np.uint64(27))) * np.uint64(0x94D049BB133111EB)
+    return x ^ (x >> np.uint64(31))

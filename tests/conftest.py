@@ -1,0 +1,15 @@
+"""Test configuration.
+
+Tests run on CPU with 8 virtual XLA devices so that mesh-sharded code paths
+(the v5e-8 story) are exercised without TPU hardware. This must be set before
+jax is imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,201 @@
+"""Algorithm-level unit tests for the exact oracle.
+
+The reference only tests algorithms through its gRPC surface
+(reference functional_test.go); these tests encode the same behavioral
+contracts directly at the algorithm layer, plus the quirk semantics the
+survey calls out.
+"""
+
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    RateLimitReq,
+    Status,
+    SECOND,
+    MILLISECOND,
+)
+from gubernator_tpu.core.cache import LRUCache
+from gubernator_tpu.core.oracle import get_rate_limit, leaky_bucket, token_bucket
+
+
+def req(**kw):
+    kw.setdefault("name", "test")
+    kw.setdefault("unique_key", "account:1234")
+    return RateLimitReq(**kw)
+
+
+def test_over_the_limit():
+    # Mirrors reference functional_test.go:51-95.
+    cache = LRUCache()
+    expects = [
+        (1, Status.UNDER_LIMIT),
+        (0, Status.UNDER_LIMIT),
+        (0, Status.OVER_LIMIT),
+    ]
+    for remaining, status in expects:
+        rl = token_bucket(
+            cache, req(hits=1, limit=2, duration=SECOND), now=1_000_000
+        )
+        assert rl.remaining == remaining
+        assert rl.status == status
+        assert rl.limit == 2
+        assert rl.reset_time != 0
+
+
+def test_token_bucket_window_reset():
+    # Mirrors reference functional_test.go:97-146 with explicit clocks.
+    cache = LRUCache()
+    now = 1_000_000
+    r = req(hits=1, limit=2, duration=5 * MILLISECOND)
+    rl = token_bucket(cache, r, now=now)
+    assert (rl.remaining, rl.status) == (1, Status.UNDER_LIMIT)
+    rl = token_bucket(cache, r, now=now)
+    assert (rl.remaining, rl.status) == (0, Status.UNDER_LIMIT)
+    # Past the 5ms window the entry lazily expires and is recreated.
+    rl = token_bucket(cache, r, now=now + 6)
+    assert (rl.remaining, rl.status) == (1, Status.UNDER_LIMIT)
+
+
+def test_leaky_bucket_drain():
+    # Mirrors reference functional_test.go:148-206 with explicit clocks.
+    cache = LRUCache()
+    now = 1_000_000
+    steps = [
+        # (hits, advance_ms_before, want_remaining, want_status)
+        (5, 0, 0, Status.UNDER_LIMIT),
+        (1, 0, 0, Status.OVER_LIMIT),
+        (1, 10, 0, Status.UNDER_LIMIT),
+        (1, 20, 1, Status.UNDER_LIMIT),
+    ]
+    t = now
+    for hits, advance, want_rem, want_status in steps:
+        t += advance
+        rl = leaky_bucket(
+            cache, req(hits=hits, limit=5, duration=50 * MILLISECOND), now=t
+        )
+        assert rl.status == want_status, (hits, advance)
+        assert rl.remaining == want_rem, (hits, advance)
+        assert rl.limit == 5
+
+
+def test_zero_duration_and_zero_limit():
+    # Mirrors reference functional_test.go:208-269 items 1-2.
+    cache = LRUCache()
+    rl = token_bucket(cache, req(hits=1, limit=10, duration=0), now=1_000_000)
+    assert rl.status == Status.UNDER_LIMIT
+    rl = token_bucket(
+        cache, req(unique_key="account:12345", hits=1, limit=0, duration=10000),
+        now=1_000_000,
+    )
+    assert rl.status == Status.OVER_LIMIT
+
+
+def test_token_peek_does_not_charge():
+    cache = LRUCache()
+    r = req(hits=1, limit=5, duration=SECOND)
+    token_bucket(cache, r, now=1_000_000)
+    peek = req(hits=0, limit=5, duration=SECOND)
+    rl = token_bucket(cache, peek, now=1_000_000)
+    assert rl.remaining == 4
+    rl = token_bucket(cache, peek, now=1_000_000)
+    assert rl.remaining == 4
+
+
+def test_token_over_limit_not_persisted():
+    # algorithms.go:27-31: a refused over-sized request does not consume.
+    cache = LRUCache()
+    token_bucket(cache, req(hits=1, limit=100, duration=SECOND), now=1_000_000)
+    rl = token_bucket(cache, req(hits=1000, limit=100, duration=SECOND), now=1_000_000)
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.remaining == 99
+    rl = token_bucket(cache, req(hits=99, limit=100, duration=SECOND), now=1_000_000)
+    assert rl.status == Status.UNDER_LIMIT
+    assert rl.remaining == 0
+
+
+def test_token_sticky_over_on_oversized_creation():
+    # algorithms.go:77-81: creation with hits > limit persists OVER_LIMIT
+    # with remaining = limit.
+    cache = LRUCache()
+    rl = token_bucket(cache, req(hits=10, limit=5, duration=SECOND), now=1_000_000)
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.remaining == 5
+    # Subsequent charge succeeds numerically but still reports the persisted
+    # OVER_LIMIT status (cached-status reuse at algorithms.go:64-65).
+    rl = token_bucket(cache, req(hits=2, limit=5, duration=SECOND), now=1_000_000)
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.remaining == 3
+
+
+def test_leaky_peek_at_empty_reports_over():
+    # algorithms.go:129-151: the empty-bucket check precedes the peek check.
+    cache = LRUCache()
+    leaky_bucket(cache, req(hits=5, limit=5, duration=SECOND), now=1_000_000)
+    rl = leaky_bucket(cache, req(hits=0, limit=5, duration=SECOND), now=1_000_000)
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.reset_time != 0
+
+
+def test_leaky_reset_time_zero_under_limit():
+    cache = LRUCache()
+    rl = leaky_bucket(cache, req(hits=1, limit=5, duration=SECOND), now=1_000_000)
+    assert rl.status == Status.UNDER_LIMIT
+    assert rl.reset_time == 0
+
+
+def test_leaky_refused_request_advances_timestamp():
+    # algorithms.go:118-121: refused hits still reset the leak clock.
+    cache = LRUCache()
+    now = 1_000_000
+    leaky_bucket(cache, req(hits=5, limit=5, duration=50), now=now)  # empty
+    # rate = 10ms; after 9ms nothing has leaked yet.
+    rl = leaky_bucket(cache, req(hits=1, limit=5, duration=50), now=now + 9)
+    assert rl.status == Status.OVER_LIMIT
+    # The refused request at +9 reset the timestamp, so at +18 only 9ms have
+    # "elapsed" since then — still nothing leaked.
+    rl = leaky_bucket(cache, req(hits=1, limit=5, duration=50), now=now + 18)
+    assert rl.status == Status.OVER_LIMIT
+    # At +29 (11ms after the last), one token has leaked back.
+    rl = leaky_bucket(cache, req(hits=1, limit=5, duration=50), now=now + 29)
+    assert rl.status == Status.UNDER_LIMIT
+    assert rl.remaining == 0
+
+
+def test_algorithm_switch_recreates_as_token():
+    # algorithms.go:33-38,100-105: both mismatch directions recreate as a
+    # fresh token bucket.
+    cache = LRUCache()
+    leaky_bucket(cache, req(hits=1, limit=5, duration=SECOND), now=1_000_000)
+    rl = token_bucket(cache, req(hits=1, limit=5, duration=SECOND), now=1_000_000)
+    assert rl.remaining == 4  # fresh window, not remaining from leaky
+
+    cache = LRUCache()
+    token_bucket(cache, req(hits=3, limit=5, duration=SECOND), now=1_000_000)
+    rl = leaky_bucket(cache, req(hits=1, limit=5, duration=SECOND), now=1_000_000)
+    # Fresh *token* bucket: remaining = limit - hits.
+    assert rl.remaining == 4
+    assert rl.reset_time != 0  # token creation sets reset_time
+
+
+def test_dispatch_invalid_algorithm():
+    cache = LRUCache()
+    r = req(hits=1, limit=5, duration=SECOND)
+    r.algorithm = 7
+    with pytest.raises(ValueError):
+        get_rate_limit(cache, r)
+
+
+def test_lru_eviction():
+    cache = LRUCache(max_size=3)
+    now = 1_000_000
+    for i in range(4):
+        token_bucket(
+            cache, req(unique_key=f"k{i}", hits=1, limit=5, duration=SECOND), now=now
+        )
+    assert len(cache) == 3
+    # k0 was evicted: a new request recreates the window.
+    rl = token_bucket(
+        cache, req(unique_key="k0", hits=1, limit=5, duration=SECOND), now=now
+    )
+    assert rl.remaining == 4
