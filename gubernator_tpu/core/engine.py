@@ -1,0 +1,201 @@
+"""Single-device engine: host glue around the decide kernel.
+
+Converts request objects to dense device arrays (strings are hashed
+host-side — no strings ever reach the TPU), pads batches to a small set of
+fixed bucket sizes so XLA compiles a handful of programs once, runs the
+jitted kernel with the store donated (in-place HBM update, no copies), and
+converts decisions back.
+
+Thread model: not thread-safe by design; all access is funneled through one
+serving thread/event loop, the same discipline the reference imposes with
+its cache mutex (reference gubernator.go:237-238) but without per-request
+lock traffic.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    millisecond_now,
+)
+from gubernator_tpu.core.hashing import slot_hash_batch
+from gubernator_tpu.core.kernels import (
+    BatchRequest,
+    decide_jit,
+    upsert_globals_jit,
+)
+from gubernator_tpu.core.store import Store, StoreConfig, new_store
+
+DEFAULT_BUCKETS = (64, 256, 1024, 4096)
+
+
+class EngineStats:
+    def __init__(self):
+        self.hits = 0
+        self.misses = 0
+        self.batches = 0
+
+    def snapshot(self):
+        return dict(hits=self.hits, misses=self.misses, batches=self.batches)
+
+
+class TpuEngine:
+    """Owns the device-resident slot store for one shard/instance."""
+
+    def __init__(
+        self,
+        config: StoreConfig = StoreConfig(),
+        buckets: Sequence[int] = DEFAULT_BUCKETS,
+        device: Optional[jax.Device] = None,
+    ):
+        self.config = config
+        self.buckets = sorted(buckets)
+        self.device = device
+        store = new_store(config)
+        if device is not None:
+            store = jax.device_put(store, device)
+        self.store: Store = store
+        self.stats = EngineStats()
+
+    # -- public API ---------------------------------------------------------
+
+    def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        now: Optional[int] = None,
+        gnp: Optional[Sequence[bool]] = None,
+    ) -> List[RateLimitResp]:
+        """Decide a batch. `gnp[i]` marks GLOBAL non-owner replica reads."""
+        n = len(reqs)
+        if n == 0:
+            return []
+        if now is None:
+            now = millisecond_now()
+
+        keys = [r.hash_key() for r in reqs]
+        hashes = slot_hash_batch(keys)
+        hits = np.fromiter((r.hits for r in reqs), np.int64, n)
+        limit = np.fromiter((r.limit for r in reqs), np.int64, n)
+        duration = np.fromiter((r.duration for r in reqs), np.int64, n)
+        algo = np.fromiter((int(r.algorithm) for r in reqs), np.int32, n)
+        gnp_arr = (
+            np.asarray(gnp, bool) if gnp is not None else np.zeros(n, bool)
+        )
+
+        status, rlimit, remaining, reset = self.decide_arrays(
+            hashes, hits, limit, duration, algo, gnp_arr, now
+        )
+        return [
+            RateLimitResp(
+                status=Status(int(status[i])),
+                limit=int(rlimit[i]),
+                remaining=int(remaining[i]),
+                reset_time=int(reset[i]),
+            )
+            for i in range(n)
+        ]
+
+    def decide_arrays(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        algo: np.ndarray,
+        gnp: np.ndarray,
+        now: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Array-level entry point (also used by the benchmark harness)."""
+        n = key_hash.shape[0]
+        B = self._bucket(n)
+
+        def pad(x, dtype):
+            out = np.zeros(B, dtype)
+            out[:n] = x
+            return out
+
+        valid = np.zeros(B, bool)
+        valid[:n] = True
+        req = BatchRequest(
+            key_hash=pad(key_hash, np.uint64),
+            hits=pad(hits, np.int64),
+            limit=pad(limit, np.int64),
+            duration=pad(duration, np.int64),
+            algo=pad(algo, np.int32),
+            gnp=pad(gnp, bool),
+            valid=valid,
+        )
+        self.store, resp, bstats = decide_jit(
+            self.store, req, np.int64(now)
+        )
+        self.stats.hits += int(bstats.hits)
+        self.stats.misses += int(bstats.misses)
+        self.stats.batches += 1
+        status, rlimit, remaining, reset = jax.device_get(
+            (resp.status, resp.limit, resp.remaining, resp.reset_time)
+        )
+        return status[:n], rlimit[:n], remaining[:n], reset[:n]
+
+    def update_globals(
+        self, updates: Sequence[Tuple[str, RateLimitResp]]
+    ) -> None:
+        """Install owner-broadcast GLOBAL statuses (UpdatePeerGlobals
+        receive path, reference gubernator.go:199-207)."""
+        n = len(updates)
+        if n == 0:
+            return
+        B = self._bucket(n)
+        hashes = np.zeros(B, np.uint64)
+        hashes[:n] = slot_hash_batch([k for k, _ in updates])
+        limit = np.zeros(B, np.int64)
+        remaining = np.zeros(B, np.int64)
+        reset = np.zeros(B, np.int64)
+        over = np.zeros(B, bool)
+        valid = np.zeros(B, bool)
+        for i, (_, st) in enumerate(updates):
+            limit[i] = st.limit
+            remaining[i] = st.remaining
+            reset[i] = st.reset_time
+            over[i] = st.status == Status.OVER_LIMIT
+            valid[i] = True
+        self.store = upsert_globals_jit(
+            self.store, hashes, limit, remaining, reset, over, valid
+        )
+
+    def warmup(self, now: Optional[int] = None) -> None:
+        """Pre-compile all bucket sizes (first TPU jit is ~20-40s)."""
+        if now is None:
+            now = millisecond_now()
+        for b in self.buckets:
+            k = np.arange(1, b + 1, dtype=np.uint64)
+            ones = np.ones(b, np.int64)
+            self.decide_arrays(
+                k, ones, ones * 10, ones * 1000,
+                np.zeros(b, np.int32), np.zeros(b, bool), now,
+            )
+        # reset state and counters dirtied by warmup traffic
+        self.reset()
+        self.stats = EngineStats()
+
+    def reset(self) -> None:
+        store = new_store(self.config)
+        if self.device is not None:
+            store = jax.device_put(store, self.device)
+        self.store = store
+
+    def _bucket(self, n: int) -> int:
+        i = bisect.bisect_left(self.buckets, n)
+        if i == len(self.buckets):
+            raise ValueError(
+                f"batch of {n} exceeds max bucket {self.buckets[-1]}"
+            )
+        return self.buckets[i]

@@ -23,11 +23,6 @@ from typing import Iterable, List
 
 import numpy as np
 
-_FNV64_OFFSET = 0xCBF29CE484222325
-_FNV64_PRIME = 0x100000001B3
-_MASK64 = 0xFFFFFFFFFFFFFFFF
-
-
 def ring_hash(key: str) -> int:
     """crc32 point on the ring, matching reference hash.go:40-42."""
     return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF

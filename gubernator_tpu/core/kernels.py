@@ -1,0 +1,404 @@
+"""The batched rate-limit decision kernel.
+
+One jitted, branch-free function evaluates a whole batch of rate-limit
+requests against the slot store: the TPU-native rewrite of the reference's
+per-request, mutex-serialized algorithm dispatch
+(reference gubernator.go:236-251 -> algorithms.go:24-186). Control flow is
+data flow: every reference branch becomes a mask, the LRU hash map becomes
+`rows` gathers + one scatter, and the whole cluster-hot-path lock
+(reference gubernator.go:237) disappears — a batch is one XLA program.
+
+Intra-batch duplicate keys
+--------------------------
+The reference handles concurrent same-key requests by serializing them on
+the cache mutex in arbitrary goroutine order (gubernator.go:90-160). Here a
+batch is sorted by key hash, each group of same-key requests shares one
+state read and one state write, and requests within a group are applied in
+batch order under a *cumulative-attempt* rule:
+
+    request j is admitted iff (sum of same-key hits earlier in the batch
+    that could ever fit the window) + hits_j <= remaining_at_batch_start
+
+Hits larger than the whole starting budget are excluded from the prefix so
+an oversized refused request does not starve later small ones. This matches
+sequential-greedy exactly when all duplicate hits are equal (the common
+hot-key case) and is conservative otherwise; since the reference's own
+ordering is scheduler-dependent, any such consistent order is within its
+observable envelope.
+
+Same-batch duplicates with *different* algorithms or behaviors resolve with
+group-leader (first in batch order) semantics.
+
+Time enters as one scalar `now` per batch; all requests in a batch share it.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from gubernator_tpu.core.store import (
+    FLAG_ALGO_LEAKY,
+    FLAG_STICKY_OVER,
+    Store,
+    fingerprints,
+    slot_indices,
+)
+
+UNDER = 0
+OVER = 1
+
+_I64_MIN = jnp.iinfo(jnp.int64).min
+_U64_MAX = (1 << 64) - 1
+
+
+class BatchRequest(NamedTuple):
+    """Device-side request batch; all arrays are [B]."""
+
+    key_hash: jax.Array  # uint64
+    hits: jax.Array  # int64
+    limit: jax.Array  # int64
+    duration: jax.Array  # int64 (ms)
+    algo: jax.Array  # int32: 0 token, 1 leaky
+    gnp: jax.Array  # bool: GLOBAL non-owner replica read (gubernator.go:173-195)
+    valid: jax.Array  # bool: padding mask
+
+
+class BatchResponse(NamedTuple):
+    """Device-side response batch; all arrays are [B]."""
+
+    status: jax.Array  # int32
+    limit: jax.Array  # int64
+    remaining: jax.Array  # int64
+    reset_time: jax.Array  # int64
+
+
+class BatchStats(NamedTuple):
+    hits: jax.Array  # int64 scalar: groups answered from live state
+    misses: jax.Array  # int64 scalar: groups created/recreated
+
+
+def _shift1(x: jax.Array, fill) -> jax.Array:
+    """x shifted right by one along axis 0, with `fill` at position 0."""
+    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+
+
+def decide(
+    store: Store, req: BatchRequest, now: jax.Array
+) -> Tuple[Store, BatchResponse, BatchStats]:
+    """Evaluate one padded batch. Pure; jit with donate_argnums=(0,)."""
+    rows, slots = store.tag.shape
+    B = req.key_hash.shape[0]
+    ar = jnp.arange(B)
+
+    # ---- sort into same-key groups (padding last) -------------------------
+    sort_key = jnp.where(req.valid, req.key_hash, jnp.uint64(_U64_MAX))
+    order = jnp.argsort(sort_key, stable=True)
+    kh = req.key_hash[order]
+    h = req.hits[order]
+    lim_q = req.limit[order]
+    dur_q = req.duration[order]
+    algo = req.algo[order]
+    gnp = req.gnp[order]
+    valid = req.valid[order]
+
+    same_prev = jnp.concatenate([jnp.array([False]), kh[1:] == kh[:-1]])
+    is_leader = valid & ~same_prev
+    leader_pos = lax.cummax(jnp.where(is_leader, ar, 0))
+    seg = jnp.cumsum(is_leader.astype(jnp.int32)) - 1  # group id, -1 before 1st
+    seg = jnp.maximum(seg, 0)
+
+    def lead(x):  # broadcast a per-position value from the group leader
+        return x[leader_pos]
+
+    def seg_any(mask):  # per-position: does any group member satisfy mask?
+        s = jax.ops.segment_sum(mask.astype(jnp.int32), seg, num_segments=B)
+        return s[seg] > 0
+
+    def seg_sum(x):  # per-position group total
+        s = jax.ops.segment_sum(x, seg, num_segments=B)
+        return s[seg]
+
+    # ---- slot lookup ------------------------------------------------------
+    idx = slot_indices(kh, rows, slots)  # [rows, B]
+    fp = fingerprints(kh)  # [B]
+    rix = jnp.arange(rows)[:, None]
+    tag_rows = store.tag[rix, idx]  # [rows, B]
+    match = tag_rows == fp[None, :]
+    found = match.any(axis=0)
+    frow = jnp.argmax(match, axis=0)  # first matching row
+    fcol = jnp.take_along_axis(idx, frow[None, :], axis=0)[0]
+
+    exp_f = store.expire[frow, fcol]
+    rem_f = store.remaining[frow, fcol]
+    ts_f = store.ts[frow, fcol]
+    lim_f = store.limit[frow, fcol]
+    dur_f = store.duration[frow, fcol]
+    flg_f = store.flags[frow, fcol]
+
+    live = found & (exp_f >= now)  # lazy expiry (reference cache/lru.go:109)
+
+    # eviction candidate among the `rows` choices: empty first, else earliest
+    # expiry (the rate-limit analogue of LRU-oldest, see store.py docstring)
+    exp_rows = store.expire[rix, idx]
+    evict_key = jnp.where(tag_rows == 0, _I64_MIN, exp_rows)
+    erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
+    ecol = jnp.take_along_axis(idx, erow[None, :], axis=0)[0]
+
+    # ---- group-level state resolution (leader values) ---------------------
+    g_live = lead(live)
+    g_exp = lead(exp_f)
+    g_rem = lead(rem_f)
+    g_ts = lead(ts_f)
+    g_limS = lead(lim_f)
+    g_durS = lead(dur_f)
+    g_flg = lead(flg_f)
+    g_algo = lead(algo)  # leader's requested algorithm
+    g_hits = lead(h)
+    g_limQ = lead(lim_q)
+    g_durQ = lead(dur_q)
+
+    stored_leaky = (g_flg & FLAG_ALGO_LEAKY) != 0
+    req_leaky = g_algo == 1
+    # Algorithm switch recreates as a fresh *token* bucket in both
+    # directions (reference algorithms.go:33-38,100-105).
+    mismatch = g_live & (stored_leaky != req_leaky)
+    existing = g_live & ~mismatch
+    eff_leaky = jnp.where(existing, stored_leaky, ~mismatch & req_leaky)
+
+    # GLOBAL non-owner replica read: answer straight from the live entry,
+    # no mutation (reference gubernator.go:178-187). On a miss the request
+    # is processed as if owned (gubernator.go:189-194).
+    gnp_served = gnp & existing & ~stored_leaky
+
+    # leaky guard (documented divergence: reference div-by-zero,
+    # algorithms.go:107): existing leaky group with request limit <= 0
+    leaky_zero = existing & eff_leaky & (g_limQ <= 0)
+
+    # effective duration: stored for existing entries, request's for groups
+    # being (re)created in this batch
+    g_durE = jnp.where(g_live, g_durS, g_durQ)
+    rate = jnp.maximum(g_durE // jnp.maximum(g_limQ, 1), 1)
+    leak = jnp.maximum(now - g_ts, 0) // rate
+    leaky_R0 = jnp.minimum(g_rem + leak, g_limS)
+
+    # group budget at batch start
+    R0_exist = jnp.where(eff_leaky, leaky_R0, g_rem)
+
+    # creation by the group leader (reference algorithms.go:68-84,161-186)
+    over_c = g_hits > g_limQ
+    charged_ldr = ~over_c & (g_hits > 0)
+    R0_create = g_limQ - jnp.where(charged_ldr, g_hits, 0)
+    # token creation with hits > limit stores remaining = limit ("sticky
+    # over", algorithms.go:78-81); leaky stores an empty bucket (:180).
+    R0_create = jnp.where(over_c & eff_leaky, 0, R0_create)
+
+    R0 = jnp.where(existing, R0_exist, R0_create)
+    sticky0 = jnp.where(
+        existing, (g_flg & FLAG_STICKY_OVER) != 0, ~eff_leaky & over_c
+    )
+
+    is_creation_leader = is_leader & ~existing
+
+    # ---- cumulative-attempt prefix within groups --------------------------
+    viable = valid & ~gnp_served & ~leaky_zero
+    eligible = viable & (h > 0) & (h <= R0)
+    inc = jnp.where(eligible & ~is_creation_leader, h, 0)
+    c = jnp.cumsum(inc)
+    S = (c - inc) - lead(c - inc)  # same-key hits attempted before j
+    charged = eligible & ~is_creation_leader & (S + h <= R0)
+    charged = charged | (is_creation_leader & charged_ldr)
+    rem_b = jnp.maximum(R0 - S, 0)  # budget visible to j
+
+    # Real (charged-only) depletion prefix: refused duplicates inflate S but
+    # consume nothing, so persistence decisions must not use S.
+    inc_chg = jnp.where(charged & ~is_creation_leader, h, 0)
+    c_chg = jnp.cumsum(inc_chg)
+    S_chg = (c_chg - inc_chg) - lead(c_chg - inc_chg)
+
+    # sticky status observed by j: a request that arrives when remaining is
+    # actually 0 flips the cached token status to OVER_LIMIT persistently
+    # (algorithms.go:41-44)
+    z = viable & ~eff_leaky & (R0 - S_chg == 0) & ~is_creation_leader
+    sticky_live = sticky0 | (same_prev & _shift1(z, False))
+
+    # ---- responses --------------------------------------------------------
+    st_cached = jnp.where(sticky_live, OVER, UNDER)
+
+    # token, existing-style position (incl. followers of a creation)
+    tok_status = jnp.where(
+        rem_b == 0,
+        OVER,
+        jnp.where(charged | (h == 0), st_cached, OVER),
+    )
+    tok_remaining = jnp.where(
+        rem_b == 0, 0, jnp.where(charged, rem_b - h, rem_b)
+    )
+    g_expire_new = jnp.where(existing, g_exp, now + g_durQ)
+    tok_reset = g_expire_new
+
+    # leaky, existing-style position: status is computed fresh each call and
+    # reset_time only appears on OVER paths (algorithms.go:123-160)
+    lk_over = (rem_b == 0) | (~charged & (h != 0))
+    lk_status = jnp.where(lk_over, OVER, UNDER)
+    lk_remaining = jnp.where(
+        rem_b == 0, 0, jnp.where(charged, rem_b - h, rem_b)
+    )
+    lk_reset = jnp.where(lk_over, now + rate, 0)
+
+    g_lim_resp = jnp.where(existing, g_limS, g_limQ)
+    status = jnp.where(eff_leaky, lk_status, tok_status)
+    remaining = jnp.where(eff_leaky, lk_remaining, tok_remaining)
+    reset = jnp.where(eff_leaky, lk_reset, tok_reset)
+
+    # creation leader overrides (the branchy creation responses)
+    cl_status = jnp.where(over_c, OVER, UNDER)
+    cl_remaining = jnp.where(
+        over_c, jnp.where(eff_leaky, 0, g_limQ), g_limQ - g_hits
+    )
+    cl_reset = jnp.where(eff_leaky, 0, now + g_durQ)
+    status = jnp.where(is_creation_leader, cl_status, status)
+    remaining = jnp.where(is_creation_leader, cl_remaining, remaining)
+    reset = jnp.where(is_creation_leader, cl_reset, reset)
+
+    # GLOBAL replica reads return the stored status verbatim
+    status = jnp.where(
+        gnp_served, jnp.where(sticky0, OVER, UNDER), status
+    )
+    remaining = jnp.where(gnp_served, g_rem, remaining)
+    reset = jnp.where(gnp_served, g_exp, reset)
+
+    # leaky zero-limit guard (documented divergence)
+    status = jnp.where(leaky_zero, OVER, status)
+    remaining = jnp.where(leaky_zero, 0, remaining)
+    reset = jnp.where(leaky_zero, now + g_durS, reset)
+    resp_limit = jnp.where(leaky_zero, lim_q, g_lim_resp)
+
+    # ---- state writeback (one scatter per plane, leaders only) ------------
+    total_charged = seg_sum(jnp.where(charged & ~is_creation_leader, h, 0))
+    rem_final = R0 - total_charged
+
+    any_hits = seg_any(viable & (h != 0))
+    # leaky expiry refresh only on a strict-decrement charge (matches the
+    # oracle's divergence-1 rule; reference algorithms.go:157)
+    any_decr = seg_any(charged & ~is_creation_leader & (rem_b - h > 0))
+
+    sticky_final = sticky0 | seg_any(z)
+
+    w_leaky = eff_leaky
+    new_expire = jnp.where(
+        w_leaky,
+        jnp.where(
+            existing,
+            jnp.where(any_decr, now + g_durS, g_exp),
+            now + g_durQ,
+        ),
+        g_expire_new,
+    )
+    new_ts = jnp.where(
+        existing & w_leaky & ~any_hits, g_ts, now
+    )
+    new_limit = jnp.where(existing, g_limS, g_limQ)
+    new_duration = jnp.where(existing, g_durS, g_durQ)
+    new_flags = jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0).astype(jnp.int32) | (
+        jnp.where(~w_leaky & sticky_final, FLAG_STICKY_OVER, 0).astype(jnp.int32)
+    )
+
+    # Groups served entirely from a replica write back identical values
+    # (harmless); only invalid/zero-guard groups skip the write.
+    w_mask = is_leader & ~leaky_zero
+
+    wrow = jnp.where(found, frow, erow)
+    wcol = jnp.where(found, fcol, ecol)
+    sc_row = jnp.where(w_mask, wrow, 0)
+    sc_col = jnp.where(w_mask, wcol, slots)  # out-of-range -> dropped
+
+    def scat(plane, val):
+        return plane.at[sc_row, sc_col].set(val, mode="drop")
+
+    new_store = Store(
+        tag=scat(store.tag, fp),
+        expire=scat(store.expire, new_expire),
+        remaining=scat(store.remaining, rem_final),
+        ts=scat(store.ts, new_ts),
+        limit=scat(store.limit, new_limit),
+        duration=scat(store.duration, new_duration),
+        flags=scat(store.flags, new_flags),
+    )
+
+    # ---- unsort -----------------------------------------------------------
+    def unsort(x):
+        return jnp.zeros_like(x).at[order].set(x)
+
+    resp = BatchResponse(
+        status=unsort(status.astype(jnp.int32)),
+        limit=unsort(resp_limit.astype(jnp.int64)),
+        remaining=unsort(remaining.astype(jnp.int64)),
+        reset_time=unsort(reset.astype(jnp.int64)),
+    )
+    stats = BatchStats(
+        hits=jnp.sum(jnp.where(is_leader & g_live, 1, 0)).astype(jnp.int64),
+        misses=jnp.sum(jnp.where(is_leader & ~g_live, 1, 0)).astype(jnp.int64),
+    )
+    return new_store, resp, stats
+
+
+def upsert_globals(
+    store: Store,
+    key_hash: jax.Array,  # uint64[B]
+    limit: jax.Array,  # int64[B]
+    remaining: jax.Array,  # int64[B]
+    reset_time: jax.Array,  # int64[B]
+    is_over: jax.Array,  # bool[B]
+    valid: jax.Array,  # bool[B]
+) -> Store:
+    """Install owner-broadcast GLOBAL statuses as local replica entries —
+    the receive side of UpdatePeerGlobals (reference gubernator.go:199-207,
+    cache.Add of a token-typed status with expiry = reset_time)."""
+    rows, slots = store.tag.shape
+
+    idx = slot_indices(key_hash, rows, slots)
+    fp = fingerprints(key_hash)
+    rix = jnp.arange(rows)[:, None]
+    tag_rows = store.tag[rix, idx]
+    match = tag_rows == fp[None, :]
+    found = match.any(axis=0)
+    frow = jnp.argmax(match, axis=0)
+
+    exp_rows = store.expire[rix, idx]
+    evict_key = jnp.where(tag_rows == 0, _I64_MIN, exp_rows)
+    erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
+
+    wrow = jnp.where(found, frow, erow)
+    wcol = jnp.take_along_axis(idx, wrow[None, :], axis=0)[0]
+    sc_row = jnp.where(valid, wrow, 0)
+    sc_col = jnp.where(valid, wcol, slots)
+
+    def scat(plane, val):
+        return plane.at[sc_row, sc_col].set(val, mode="drop")
+
+    zero = jnp.zeros_like(limit)
+    flags = jnp.where(is_over, FLAG_STICKY_OVER, 0).astype(jnp.int32)
+    return Store(
+        tag=scat(store.tag, fp),
+        expire=scat(store.expire, reset_time),
+        remaining=scat(store.remaining, remaining),
+        ts=scat(store.ts, zero),
+        limit=scat(store.limit, limit),
+        duration=scat(store.duration, zero),
+        flags=scat(store.flags, flags),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def decide_jit(store, req, now):
+    return decide(store, req, now)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def upsert_globals_jit(store, key_hash, limit, remaining, reset_time, is_over, valid):
+    return upsert_globals(store, key_hash, limit, remaining, reset_time, is_over, valid)

@@ -1,0 +1,116 @@
+"""Device-resident rate-limit state: the slot store.
+
+The TPU-native replacement for the reference's per-key LRU hash map
+(reference cache/lru.go). State is a set of dense planes of shape
+[rows, slots] living in HBM:
+
+- Each key hashes to one candidate slot per row (`rows` independent
+  choices) plus a 32-bit fingerprint tag.
+- A key occupies exactly one of its candidate slots; lookup compares the
+  tag across the `rows` candidates (a handful of vectorized gathers — no
+  probing loops, no host hash map, fixed shapes for XLA).
+- On insert, an empty candidate is preferred, otherwise the candidate with
+  the earliest expiry is evicted. For rate-limit state, expiry time is the
+  natural recency metric (an entry past its reset is worthless), so
+  evict-earliest-expiry plays the role of the reference's LRU eviction
+  (cache/lru.go:92-94) with the same "state loss => brief over-admission"
+  contract (reference architecture.md:5-11).
+
+This is the "exact" sibling of a count-min sketch: same dense-array,
+gather/scatter compute shape, but tags make collisions explicit (evictions)
+rather than silent over-counts, which preserves the reference's observable
+semantics. All planes are int64/int32/uint32; decisions never leave the
+device during a batch.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# flags plane bits
+FLAG_STICKY_OVER = 1  # token window created over-limit: status persists OVER
+FLAG_ALGO_LEAKY = 2  # slot holds leaky-bucket state (else token bucket)
+
+# Per-row salts for deriving independent slot indices from one 64-bit hash.
+_ROW_SALTS = np.array(
+    [
+        0x9E3779B97F4A7C15,
+        0xC2B2AE3D27D4EB4F,
+        0x165667B19E3779F9,
+        0x27D4EB2F165667C5,
+        0x85EBCA77C2B2AE63,
+        0xFF51AFD7ED558CCD,
+        0xC4CEB9FE1A85EC53,
+        0x2545F4914F6CDD1D,
+    ],
+    dtype=np.uint64,
+)
+
+MAX_ROWS = len(_ROW_SALTS)
+
+
+@dataclass(frozen=True)
+class StoreConfig:
+    """Capacity knobs. Total capacity ~= rows * slots entries; keep load
+    factor under ~50% of that for negligible eviction of live entries."""
+
+    rows: int = 4
+    slots: int = 1 << 17  # 524,288 entries at rows=4 (~25 MiB of planes)
+
+    def __post_init__(self):
+        assert 1 <= self.rows <= MAX_ROWS, f"rows must be in [1,{MAX_ROWS}]"
+        assert self.slots > 0 and (self.slots & (self.slots - 1)) == 0, (
+            "slots must be a power of two"
+        )
+
+
+class Store(NamedTuple):
+    """State planes, each [rows, slots]. A NamedTuple so the whole store is
+    a jit-friendly pytree and can be donated batch-over-batch."""
+
+    tag: jax.Array  # uint32, fingerprint; 0 = empty slot
+    expire: jax.Array  # int64, entry expiry (unix ms); miss if < now
+    remaining: jax.Array  # int64, tokens remaining in window / bucket
+    ts: jax.Array  # int64, leaky last-leak timestamp (token: creation time)
+    limit: jax.Array  # int64, stored limit
+    duration: jax.Array  # int64, stored duration ms
+    flags: jax.Array  # int32, FLAG_* bits
+
+
+def new_store(config: StoreConfig = StoreConfig()) -> Store:
+    shape = (config.rows, config.slots)
+    return Store(
+        tag=jnp.zeros(shape, jnp.uint32),
+        expire=jnp.zeros(shape, jnp.int64),
+        remaining=jnp.zeros(shape, jnp.int64),
+        ts=jnp.zeros(shape, jnp.int64),
+        limit=jnp.zeros(shape, jnp.int64),
+        duration=jnp.zeros(shape, jnp.int64),
+        flags=jnp.zeros(shape, jnp.int32),
+    )
+
+
+def mix64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer (device-side twin of core.hashing.mix64)."""
+    x = x.astype(jnp.uint64)
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def slot_indices(key_hash: jax.Array, rows: int, slots: int) -> jax.Array:
+    """[rows, B] candidate slot index per row for each key hash [B]."""
+    salts = jnp.asarray(_ROW_SALTS[:rows])  # [rows]
+    mixed = mix64(key_hash[None, :] ^ salts[:, None])  # [rows, B]
+    return (mixed & jnp.uint64(slots - 1)).astype(jnp.int32)
+
+
+def fingerprints(key_hash: jax.Array) -> jax.Array:
+    """Nonzero 32-bit tags [B] from key hashes [B]."""
+    fp = (key_hash >> jnp.uint64(32)).astype(jnp.uint32)
+    return jnp.where(fp == 0, jnp.uint32(1), fp)

@@ -1,0 +1,314 @@
+"""Differential tests: the TPU decide kernel vs the exact oracle.
+
+Every behavioral contract the oracle encodes must hold identically on the
+device path (same status, remaining, reset_time), batch after batch, under
+a synthetic clock. Intra-batch duplicate-key semantics follow the
+documented cumulative-attempt rule (kernels.py module docstring) and match
+sequential-greedy for uniform hits.
+"""
+
+import random
+
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    SECOND,
+)
+from gubernator_tpu.core.cache import LRUCache
+from gubernator_tpu.core.engine import TpuEngine
+from gubernator_tpu.core.oracle import get_rate_limit
+from gubernator_tpu.core.store import StoreConfig
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture(scope="module")
+def engine():
+    return TpuEngine(StoreConfig(rows=4, slots=1 << 12), buckets=(16, 64, 256))
+
+
+@pytest.fixture(autouse=True)
+def _reset(engine):
+    engine.reset()
+    yield
+
+
+def req(**kw):
+    kw.setdefault("name", "test")
+    kw.setdefault("unique_key", "account:1234")
+    return RateLimitReq(**kw)
+
+
+def one(engine, r, now, gnp=False):
+    return engine.get_rate_limits([r], now=now, gnp=[gnp])[0]
+
+
+def check_same(resp: RateLimitResp, want: RateLimitResp, ctx=""):
+    assert resp.status == want.status, ctx
+    assert resp.limit == want.limit, ctx
+    assert resp.remaining == want.remaining, ctx
+    assert resp.reset_time == want.reset_time, ctx
+
+
+# ---------------------------------------------------------------- behavioral
+
+
+def test_over_the_limit(engine):
+    expects = [(1, Status.UNDER_LIMIT), (0, Status.UNDER_LIMIT), (0, Status.OVER_LIMIT)]
+    for remaining, status in expects:
+        rl = one(engine, req(hits=1, limit=2, duration=SECOND), now=T0)
+        assert (rl.remaining, rl.status) == (remaining, status)
+        assert rl.limit == 2
+        assert rl.reset_time == T0 + SECOND
+
+
+def test_token_window_reset(engine):
+    r = req(hits=1, limit=2, duration=5)
+    assert one(engine, r, now=T0).remaining == 1
+    assert one(engine, r, now=T0).remaining == 0
+    rl = one(engine, r, now=T0 + 6)
+    assert (rl.remaining, rl.status) == (1, Status.UNDER_LIMIT)
+
+
+def test_leaky_drain(engine):
+    steps = [
+        (5, 0, 0, Status.UNDER_LIMIT),
+        (1, 0, 0, Status.OVER_LIMIT),
+        (1, 10, 0, Status.UNDER_LIMIT),
+        (1, 20, 1, Status.UNDER_LIMIT),
+    ]
+    t = T0
+    for hits, advance, want_rem, want_status in steps:
+        t += advance
+        rl = one(
+            engine,
+            req(hits=hits, limit=5, duration=50, algorithm=Algorithm.LEAKY_BUCKET),
+            now=t,
+        )
+        assert rl.status == want_status, (hits, advance)
+        assert rl.remaining == want_rem, (hits, advance)
+        assert rl.limit == 5
+
+
+def test_sticky_over_on_oversized_creation(engine):
+    rl = one(engine, req(hits=10, limit=5, duration=SECOND), now=T0)
+    assert (rl.status, rl.remaining) == (Status.OVER_LIMIT, 5)
+    rl = one(engine, req(hits=2, limit=5, duration=SECOND), now=T0)
+    assert (rl.status, rl.remaining) == (Status.OVER_LIMIT, 3)
+
+
+def test_leaky_peek_at_empty_reports_over(engine):
+    lk = dict(limit=5, duration=SECOND, algorithm=Algorithm.LEAKY_BUCKET)
+    one(engine, req(hits=5, **lk), now=T0)
+    rl = one(engine, req(hits=0, **lk), now=T0)
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.reset_time != 0
+
+
+def test_algorithm_switch_recreates_as_token(engine):
+    one(
+        engine,
+        req(hits=1, limit=5, duration=SECOND, algorithm=Algorithm.LEAKY_BUCKET),
+        now=T0,
+    )
+    rl = one(engine, req(hits=1, limit=5, duration=SECOND), now=T0)
+    assert rl.remaining == 4  # fresh token window
+
+    engine.reset()
+    one(engine, req(hits=3, limit=5, duration=SECOND), now=T0)
+    rl = one(
+        engine,
+        req(hits=1, limit=5, duration=SECOND, algorithm=Algorithm.LEAKY_BUCKET),
+        now=T0,
+    )
+    assert rl.remaining == 4  # recreated as fresh *token* bucket
+    assert rl.reset_time == T0 + SECOND
+
+
+def test_zero_limit_token(engine):
+    rl = one(engine, req(hits=1, limit=0, duration=10_000), now=T0)
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.remaining == 0
+
+
+def test_global_replica_read(engine):
+    # owner broadcast installs a replica; gnp reads serve it verbatim
+    engine.update_globals(
+        [
+            (
+                "test_account:g1",
+                RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=5, remaining=4,
+                    reset_time=T0 + 3000,
+                ),
+            )
+        ]
+    )
+    r = RateLimitReq(
+        name="test", unique_key="account:g1", hits=1, limit=5, duration=3000
+    )
+    r_key = r.hash_key()
+    assert r_key == "test_account:g1"
+    rl = one(engine, r, now=T0, gnp=True)
+    check_same(
+        rl,
+        RateLimitResp(
+            status=Status.UNDER_LIMIT, limit=5, remaining=4, reset_time=T0 + 3000
+        ),
+    )
+    # replica unchanged by the read
+    rl = one(engine, r, now=T0, gnp=True)
+    assert rl.remaining == 4
+
+
+def test_global_replica_miss_processes_locally(engine):
+    r = req(unique_key="account:g2", hits=1, limit=5, duration=3000)
+    rl = one(engine, r, now=T0, gnp=True)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 4)
+    # the local (owned-style) entry now serves as the replica
+    rl = one(engine, r, now=T0, gnp=True)
+    assert rl.remaining == 4
+
+
+# ------------------------------------------------------ intra-batch semantics
+
+
+def test_batch_duplicate_uniform_hits(engine):
+    rs = [req(hits=1, limit=3, duration=SECOND) for _ in range(5)]
+    resp = engine.get_rate_limits(rs, now=T0)
+    got = [(r.status, r.remaining) for r in resp]
+    assert got == [
+        (Status.UNDER_LIMIT, 2),
+        (Status.UNDER_LIMIT, 1),
+        (Status.UNDER_LIMIT, 0),
+        (Status.OVER_LIMIT, 0),
+        (Status.OVER_LIMIT, 0),
+    ]
+
+
+def test_batch_duplicate_oversized_does_not_starve(engine):
+    rs = [
+        req(hits=2, limit=5, duration=SECOND),
+        req(hits=100, limit=5, duration=SECOND),  # refused outright
+        req(hits=3, limit=5, duration=SECOND),  # still admitted
+    ]
+    resp = engine.get_rate_limits(rs, now=T0)
+    assert resp[0].status == Status.UNDER_LIMIT
+    assert resp[1].status == Status.OVER_LIMIT
+    assert resp[2].status == Status.UNDER_LIMIT
+    assert resp[2].remaining == 0
+
+
+def test_batch_refused_duplicates_do_not_poison_sticky(engine):
+    # Refused duplicates inflate the attempted prefix but consume nothing;
+    # the persisted sticky-OVER flag must track *real* depletion only.
+    rs = [req(hits=3, limit=5, duration=SECOND) for _ in range(3)]
+    resp = engine.get_rate_limits(rs, now=T0)
+    assert [r.status for r in resp] == [
+        Status.UNDER_LIMIT, Status.OVER_LIMIT, Status.OVER_LIMIT,
+    ]
+    # Store remaining is 2; a later small request must succeed UNDER_LIMIT.
+    rl = one(engine, req(hits=1, limit=5, duration=SECOND), now=T0 + 1)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 1)
+
+
+def test_batch_leaky_refused_follower_reset_uses_request_duration(engine):
+    lk = dict(limit=1, duration=60_000, algorithm=Algorithm.LEAKY_BUCKET)
+    rs = [req(hits=1, **lk), req(hits=1, **lk)]
+    resp = engine.get_rate_limits(rs, now=T0)
+    assert resp[0].status == Status.UNDER_LIMIT
+    assert resp[1].status == Status.OVER_LIMIT
+    # retry hint is one leak interval (duration/limit), not a stale slot's
+    assert resp[1].reset_time == T0 + 60_000
+
+
+def test_batch_distinct_keys_independent(engine):
+    rs = [
+        req(unique_key=f"k{i}", hits=1, limit=2, duration=SECOND)
+        for i in range(10)
+    ]
+    resp = engine.get_rate_limits(rs, now=T0)
+    assert all(r.remaining == 1 for r in resp)
+    resp = engine.get_rate_limits(rs, now=T0)
+    assert all(r.remaining == 0 for r in resp)
+
+
+# ------------------------------------------------------------- differential
+
+
+def _random_req(rng, keys):
+    algo = rng.choice([Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET])
+    return RateLimitReq(
+        name="fuzz",
+        unique_key=rng.choice(keys),
+        hits=rng.choice([0, 1, 1, 1, 2, 3, 7, 50]),
+        limit=rng.choice([1, 2, 5, 20]),
+        duration=rng.choice([10, 100, 1000]),
+        algorithm=algo,
+    )
+
+
+def test_differential_fuzz_vs_oracle(engine):
+    """Random single-key-per-batch workload over an advancing clock: the
+    device path must match the oracle decision-for-decision."""
+    rng = random.Random(1234)
+    keys = [f"acct:{i}" for i in range(40)]
+    cache = LRUCache()
+    now = T0
+    for step in range(400):
+        now += rng.choice([0, 1, 3, 7, 15, 40, 200])
+        # unique keys within the batch so oracle sequencing matches exactly
+        batch_keys = rng.sample(keys, rng.randint(1, 12))
+        rs = []
+        for k in batch_keys:
+            r = _random_req(rng, [k])
+            rs.append(r)
+        got = engine.get_rate_limits(rs, now=now)
+        for r, g in zip(rs, got):
+            want = get_rate_limit(cache, r, now=now)
+            check_same(g, want, ctx=f"step={step} key={r.unique_key} req={r}")
+
+
+def test_differential_sequential_same_key(engine):
+    """Long same-key request sequences (one per batch) across both
+    algorithms and window resets."""
+    rng = random.Random(99)
+    cache = LRUCache()
+    now = T0
+    for algo in (Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET):
+        engine.reset()
+        cache = LRUCache()
+        for step in range(200):
+            now += rng.choice([0, 1, 2, 5, 11, 37])
+            r = RateLimitReq(
+                name="seq",
+                unique_key="only",
+                hits=rng.choice([0, 1, 1, 2, 4, 9]),
+                limit=8,
+                duration=60,
+                algorithm=algo,
+            )
+            got = one(engine, r, now=now)
+            want = get_rate_limit(cache, r, now=now)
+            check_same(got, want, ctx=f"algo={algo} step={step} now={now}")
+
+
+def test_eviction_recreates_window(engine):
+    """Overfilling the store evicts oldest-expiry entries; evicted keys are
+    simply recreated (the reference's accepted over-admission contract)."""
+    small = TpuEngine(StoreConfig(rows=2, slots=16), buckets=(64,))
+    rs = [
+        req(unique_key=f"spill:{i}", hits=1, limit=5, duration=SECOND)
+        for i in range(32)
+    ]
+    resp = small.get_rate_limits(rs, now=T0)
+    assert all(r.remaining == 4 for r in resp)
+    # 32 keys in a 2x16 store: many were evicted; recreated windows give
+    # remaining == 4 again instead of 3 (over-admission, never a crash)
+    resp = small.get_rate_limits(rs, now=T0 + 1)
+    assert all(r.remaining in (3, 4) for r in resp)
+    assert any(r.remaining == 4 for r in resp)
