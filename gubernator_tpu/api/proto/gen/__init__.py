@@ -1,0 +1,19 @@
+"""Generated protobuf modules.
+
+protoc emits flat imports (`import gubernator_pb2`), so this package puts
+its own directory on sys.path before importing them; consumers should use
+`from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2`.
+Regenerate with scripts/gen_protos.sh.
+"""
+
+import pathlib
+import sys
+
+_here = str(pathlib.Path(__file__).resolve().parent)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import gubernator_pb2  # noqa: E402
+import peers_pb2  # noqa: E402
+
+__all__ = ["gubernator_pb2", "peers_pb2"]

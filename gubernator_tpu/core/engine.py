@@ -38,6 +38,46 @@ from gubernator_tpu.core.store import Store, StoreConfig, new_store
 DEFAULT_BUCKETS = (64, 256, 1024, 4096)
 
 
+def choose_bucket(buckets: Sequence[int], n: int) -> int:
+    """Smallest configured batch bucket holding n requests."""
+    i = bisect.bisect_left(buckets, n)
+    if i == len(buckets):
+        raise ValueError(f"batch of {n} exceeds max bucket {buckets[-1]}")
+    return buckets[i]
+
+
+def pad_request(
+    buckets: Sequence[int],
+    key_hash: np.ndarray,
+    hits: np.ndarray,
+    limit: np.ndarray,
+    duration: np.ndarray,
+    algo: np.ndarray,
+    gnp: np.ndarray,
+) -> BatchRequest:
+    """Pad request arrays to a fixed bucket size with a validity mask, so
+    XLA compiles one program per bucket instead of one per batch size."""
+    n = key_hash.shape[0]
+    B = choose_bucket(buckets, n)
+
+    def pad(x, dtype):
+        out = np.zeros(B, dtype)
+        out[:n] = x
+        return out
+
+    valid = np.zeros(B, bool)
+    valid[:n] = True
+    return BatchRequest(
+        key_hash=pad(key_hash, np.uint64),
+        hits=pad(hits, np.int64),
+        limit=pad(limit, np.int64),
+        duration=pad(duration, np.int64),
+        algo=pad(algo, np.int32),
+        gnp=pad(gnp, bool),
+        valid=valid,
+    )
+
+
 class EngineStats:
     def __init__(self):
         self.hits = 0
@@ -116,23 +156,8 @@ class TpuEngine:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Array-level entry point (also used by the benchmark harness)."""
         n = key_hash.shape[0]
-        B = self._bucket(n)
-
-        def pad(x, dtype):
-            out = np.zeros(B, dtype)
-            out[:n] = x
-            return out
-
-        valid = np.zeros(B, bool)
-        valid[:n] = True
-        req = BatchRequest(
-            key_hash=pad(key_hash, np.uint64),
-            hits=pad(hits, np.int64),
-            limit=pad(limit, np.int64),
-            duration=pad(duration, np.int64),
-            algo=pad(algo, np.int32),
-            gnp=pad(gnp, bool),
-            valid=valid,
+        req = pad_request(
+            self.buckets, key_hash, hits, limit, duration, algo, gnp
         )
         self.store, resp, bstats = decide_jit(
             self.store, req, np.int64(now)
@@ -153,7 +178,7 @@ class TpuEngine:
         n = len(updates)
         if n == 0:
             return
-        B = self._bucket(n)
+        B = choose_bucket(self.buckets, n)
         hashes = np.zeros(B, np.uint64)
         hashes[:n] = slot_hash_batch([k for k, _ in updates])
         limit = np.zeros(B, np.int64)
@@ -193,9 +218,4 @@ class TpuEngine:
         self.store = store
 
     def _bucket(self, n: int) -> int:
-        i = bisect.bisect_left(self.buckets, n)
-        if i == len(self.buckets):
-            raise ValueError(
-                f"batch of {n} exceeds max bucket {self.buckets[-1]}"
-            )
-        return self.buckets[i]
+        return choose_bucket(self.buckets, n)

@@ -1,0 +1,259 @@
+"""Mesh-sharded rate limiting: the consistent-hash ring mapped onto a
+`jax.sharding.Mesh`.
+
+The reference distributes keys across peers with a consistent-hash ring and
+forwards requests over gRPC (reference hash.go:80-96, peers.go:111-127).
+Inside one host, this framework distributes keys across TPU chips instead:
+
+- The slot store gains a leading `shard` axis, laid out over the mesh's
+  "shard" axis — every chip owns `1/n` of the key space, the moral
+  equivalent of one ring peer per chip, with ownership decided by a cheap
+  hash (`owner = mix64(key_hash) mod n`) instead of a sorted ring search:
+  with homogeneous chips there is no reason to pay the ring's lookup cost
+  or its imbalance (the reference places one point per peer, hash.go:62-67).
+- A request batch is replicated to all chips (`shard_map`); each chip
+  evaluates the full batch against its own store shard with non-owned rows
+  masked invalid, and the per-chip decisions are combined with one
+  `jax.lax.psum` over ICI — the collective plays the role of the
+  peer-to-peer forwarding RPCs (reference peers.go) with zero host hops.
+- GLOBAL mode's owner->replica broadcast (reference global.go:158-232)
+  becomes `sync_globals`: owners peek authoritative status, one psum
+  replicates it mesh-wide, and every non-owner installs replica entries —
+  the async gossip loop collapsed into a single collective step.
+
+Multi-host scaling composes: each host runs one mesh-sharded engine over
+its chips, and hosts peer with each other over gRPC exactly like reference
+nodes (serve/peers.py), so ICI carries intra-host traffic and DCN only
+carries the host-level ring's.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from gubernator_tpu.core.engine import pad_request
+from gubernator_tpu.core.kernels import (
+    BatchRequest,
+    BatchResponse,
+    BatchStats,
+    decide,
+    upsert_globals,
+)
+from gubernator_tpu.core.store import Store, StoreConfig, mix64, new_store
+
+_SHARD_SALT = np.uint64(0xA24BAED4963EE407)
+
+
+def owner_of(key_hash: jax.Array, n_shards: int) -> jax.Array:
+    """Owning shard index for each key hash (device-side)."""
+    return (mix64(key_hash ^ _SHARD_SALT) % jnp.uint64(n_shards)).astype(
+        jnp.int32
+    )
+
+
+def owner_of_np(key_hash: np.ndarray, n_shards: int) -> np.ndarray:
+    """Host-side twin of owner_of (numpy)."""
+    from gubernator_tpu.core import hashing
+
+    return (hashing.mix64(key_hash ^ _SHARD_SALT) % np.uint64(n_shards)).astype(
+        np.int32
+    )
+
+
+def _shard_decide(store: Store, req: BatchRequest, now, n_shards: int):
+    """Per-device body under shard_map: store is this device's shard."""
+    me = jax.lax.axis_index("shard")
+    store = jax.tree.map(lambda x: x[0], store)  # [1, r, s] -> [r, s]
+    mine = owner_of(req.key_hash, n_shards) == me
+    local_req = req._replace(valid=req.valid & mine)
+    new_store_shard, resp, stats = decide(store, local_req, now)
+
+    # Non-owners contribute zeros; one psum combines the mesh's answers.
+    mask = mine & req.valid
+
+    def combine(x):
+        return jax.lax.psum(jnp.where(mask, x, 0), "shard")
+
+    resp = BatchResponse(
+        status=combine(resp.status),
+        limit=combine(resp.limit),
+        remaining=combine(resp.remaining),
+        reset_time=combine(resp.reset_time),
+    )
+    stats = BatchStats(
+        hits=jax.lax.psum(stats.hits, "shard"),
+        misses=jax.lax.psum(stats.misses, "shard"),
+    )
+    return jax.tree.map(lambda x: x[None], new_store_shard), resp, stats
+
+
+def _shard_sync_globals(
+    store: Store,
+    key_hash: jax.Array,  # uint64[B] global keys to broadcast
+    limit: jax.Array,  # int64[B] request limit (for owner-side peek of misses)
+    duration: jax.Array,
+    algo: jax.Array,  # int32[B]: must match the stored algorithm, or the
+    # peek would take the mismatch-recreate path and wipe owner state
+    valid: jax.Array,
+    now,
+    n_shards: int,
+):
+    """Owner peeks authoritative status; psum replicates; others upsert."""
+    me = jax.lax.axis_index("shard")
+    store = jax.tree.map(lambda x: x[0], store)
+    mine = owner_of(key_hash, n_shards) == me
+
+    B = key_hash.shape[0]
+    peek = BatchRequest(
+        key_hash=key_hash,
+        hits=jnp.zeros(B, jnp.int64),
+        limit=limit,
+        duration=duration,
+        algo=algo,
+        gnp=jnp.zeros(B, bool),
+        valid=valid & mine,
+    )
+    store2, resp, _ = decide(store, peek, now)
+
+    mask = mine & valid
+
+    def combine(x):
+        return jax.lax.psum(jnp.where(mask, x, 0), "shard")
+
+    status = combine(resp.status)
+    r_limit = combine(resp.limit)
+    remaining = combine(resp.remaining)
+    reset = combine(resp.reset_time)
+
+    # install replicas everywhere except the owner shard
+    store3 = upsert_globals(
+        store2,
+        key_hash,
+        r_limit,
+        remaining,
+        reset,
+        status == 1,
+        valid & ~mine,
+    )
+    return jax.tree.map(lambda x: x[None], store3), BatchResponse(
+        status=status, limit=r_limit, remaining=remaining, reset_time=reset
+    )
+
+
+class MeshEngine:
+    """Drop-in sibling of core.engine.TpuEngine, sharded over a mesh.
+
+    decide_arrays() has the same contract; GLOBAL requests served on
+    non-owner shards never leave the mesh — replicas answer locally after
+    each sync_globals() collective.
+    """
+
+    def __init__(
+        self,
+        config: StoreConfig = StoreConfig(),
+        devices: Optional[Sequence[jax.Device]] = None,
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+    ):
+        if devices is None:
+            devices = jax.devices()
+        self.mesh = Mesh(np.asarray(devices), ("shard",))
+        self.n = len(devices)
+        self.config = config
+        self.buckets = sorted(buckets)
+
+        sharding = NamedSharding(self.mesh, P("shard"))
+        self.store_sharding = sharding
+        self.store = self._fresh_store()
+
+        decide_fn = functools.partial(_shard_decide, n_shards=self.n)
+        self._step = jax.jit(
+            jax.shard_map(
+                decide_fn,
+                mesh=self.mesh,
+                in_specs=(P("shard"), P(), P()),
+                out_specs=(P("shard"), P(), P()),
+            ),
+            donate_argnums=(0,),
+        )
+        sync_fn = functools.partial(_shard_sync_globals, n_shards=self.n)
+        self._sync = jax.jit(
+            jax.shard_map(
+                sync_fn,
+                mesh=self.mesh,
+                in_specs=(P("shard"), P(), P(), P(), P(), P(), P()),
+                out_specs=(P("shard"), P()),
+            ),
+            donate_argnums=(0,),
+        )
+
+    def _fresh_store(self) -> Store:
+        base = new_store(self.config)
+
+        def rep(x):
+            stacked = jnp.broadcast_to(x[None], (self.n,) + x.shape)
+            return jax.device_put(stacked, self.store_sharding)
+
+        return jax.tree.map(rep, base)
+
+    def reset(self) -> None:
+        self.store = self._fresh_store()
+
+    def decide_arrays(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        algo: np.ndarray,
+        gnp: np.ndarray,
+        now: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        n = key_hash.shape[0]
+        req = pad_request(
+            self.buckets, key_hash, hits, limit, duration, algo, gnp
+        )
+        self.store, resp, _stats = self._step(self.store, req, np.int64(now))
+        status, rlimit, remaining, reset = jax.device_get(
+            (resp.status, resp.limit, resp.remaining, resp.reset_time)
+        )
+        return status[:n], rlimit[:n], remaining[:n], reset[:n]
+
+    def sync_globals(
+        self,
+        key_hash: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        now: int,
+        algo: Optional[np.ndarray] = None,
+    ) -> None:
+        """One collective gossip step for the given GLOBAL keys. `algo`
+        must carry each key's algorithm (defaults to token bucket)."""
+        n = key_hash.shape[0]
+        if n == 0:
+            return
+        if algo is None:
+            algo = np.zeros(n, np.int32)
+        req = pad_request(
+            self.buckets,
+            key_hash,
+            np.zeros(n, np.int64),
+            limit,
+            duration,
+            algo,
+            np.zeros(n, bool),
+        )
+        self.store, _resp = self._sync(
+            self.store,
+            req.key_hash,
+            req.limit,
+            req.duration,
+            req.algo,
+            req.valid,
+            np.int64(now),
+        )

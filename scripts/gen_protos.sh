@@ -1,0 +1,8 @@
+#!/usr/bin/env bash
+# Regenerate Python protobuf stubs (messages only; the gRPC service glue is
+# hand-written in gubernator_tpu/api/grpc_glue.py since grpc_tools is not
+# available in this image).
+set -euo pipefail
+cd "$(dirname "$0")/../gubernator_tpu/api/proto"
+protoc --python_out=gen gubernator.proto peers.proto
+echo "generated: $(ls gen/*_pb2.py)"

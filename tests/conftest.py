@@ -1,15 +1,22 @@
 """Test configuration.
 
 Tests run on CPU with 8 virtual XLA devices so that mesh-sharded code paths
-(the v5e-8 story) are exercised without TPU hardware. This must be set before
-jax is imported anywhere in the test process.
+(the v5e-8 story) are exercised without TPU hardware.
+
+Note: this environment pre-imports jax at interpreter start (sitecustomize)
+with JAX_PLATFORMS pointing at the TPU tunnel, so setting the env var here
+is too late — the platform must be forced through jax.config before any
+backend initializes. XLA_FLAGS is still read lazily at CPU-client init.
 """
 
 import os
 
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+).strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

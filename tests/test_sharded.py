@@ -1,0 +1,154 @@
+"""Mesh-sharded engine tests on the 8-virtual-device CPU mesh.
+
+The mesh plays the role of the reference's peer cluster: each device owns a
+key-space shard (the consistent-hash ring mapped onto the mesh axis), and
+one psum combines per-shard decisions (reference peers.go forwarding
+collapsed into a collective).
+"""
+
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq, Status, SECOND
+from gubernator_tpu.core.cache import LRUCache
+from gubernator_tpu.core.engine import TpuEngine
+from gubernator_tpu.core.hashing import slot_hash_batch
+from gubernator_tpu.core.oracle import get_rate_limit
+from gubernator_tpu.core.store import StoreConfig, fingerprints
+from gubernator_tpu.parallel.sharded import MeshEngine, owner_of_np
+
+T0 = 1_700_000_000_000
+
+
+@pytest.fixture(scope="module")
+def mesh_engine():
+    assert len(jax.devices()) == 8, "conftest should provide 8 cpu devices"
+    return MeshEngine(
+        StoreConfig(rows=4, slots=1 << 10), buckets=(64, 256)
+    )
+
+
+@pytest.fixture(autouse=True)
+def _reset(mesh_engine):
+    mesh_engine.reset()
+    yield
+
+
+def arrays_for(reqs):
+    n = len(reqs)
+    return dict(
+        key_hash=slot_hash_batch([r.hash_key() for r in reqs]),
+        hits=np.array([r.hits for r in reqs], np.int64),
+        limit=np.array([r.limit for r in reqs], np.int64),
+        duration=np.array([r.duration for r in reqs], np.int64),
+        algo=np.array([int(r.algorithm) for r in reqs], np.int32),
+        gnp=np.zeros(n, bool),
+    )
+
+
+def test_mesh_matches_oracle(mesh_engine):
+    """Sharded decisions must equal the exact oracle, key by key."""
+    rng = random.Random(7)
+    cache = LRUCache()
+    keys = [f"acct:{i}" for i in range(64)]
+    now = T0
+    for step in range(40):
+        now += rng.choice([0, 3, 17, 120])
+        batch_keys = rng.sample(keys, rng.randint(1, 32))
+        reqs = [
+            RateLimitReq(
+                name="mesh",
+                unique_key=k,
+                hits=rng.choice([0, 1, 1, 2, 5]),
+                limit=rng.choice([2, 5, 10]),
+                duration=rng.choice([50, 1000]),
+                algorithm=rng.choice(
+                    [Algorithm.TOKEN_BUCKET, Algorithm.LEAKY_BUCKET]
+                ),
+            )
+            for k in batch_keys
+        ]
+        a = arrays_for(reqs)
+        status, limit, remaining, reset = mesh_engine.decide_arrays(
+            now=now, **a
+        )
+        for i, r in enumerate(reqs):
+            want = get_rate_limit(cache, r, now=now)
+            got = (status[i], limit[i], remaining[i], reset[i])
+            expect = (
+                int(want.status), want.limit, want.remaining, want.reset_time
+            )
+            assert got == expect, f"step={step} i={i} req={r}"
+
+
+def test_keys_spread_across_shards(mesh_engine):
+    hashes = slot_hash_batch([f"spread:{i}" for i in range(512)])
+    owners = owner_of_np(hashes, mesh_engine.n)
+    counts = np.bincount(owners, minlength=8)
+    assert (counts > 20).all(), counts  # roughly uniform ownership
+
+
+def test_sync_globals_installs_replicas_on_all_shards(mesh_engine):
+    reqs = [
+        RateLimitReq(
+            name="glob", unique_key="account:42", hits=1, limit=5,
+            duration=3 * SECOND,
+        )
+    ]
+    a = arrays_for(reqs)
+    # two hits against the owner shard
+    mesh_engine.decide_arrays(now=T0, **a)
+    mesh_engine.decide_arrays(now=T0, **a)
+
+    mesh_engine.sync_globals(
+        a["key_hash"], a["limit"], a["duration"], now=T0
+    )
+
+    # the key's fingerprint must now exist on every shard (owner holds the
+    # authoritative entry; others hold replicas of the broadcast status)
+    kh = a["key_hash"]
+    fp = int(np.asarray(jax.device_get(fingerprints(kh)))[0])
+    tags = np.asarray(jax.device_get(mesh_engine.store.tag))  # [n, rows, slots]
+    rem = np.asarray(jax.device_get(mesh_engine.store.remaining))
+    per_shard = (tags == fp).any(axis=(1, 2))
+    assert per_shard.all(), per_shard
+    # every replica carries the authoritative remaining (5 - 2 hits = 3)
+    for s in range(mesh_engine.n):
+        vals = rem[s][tags[s] == fp]
+        assert (vals == 3).all(), (s, vals)
+
+
+def test_sync_globals_leaky_preserves_owner_state(mesh_engine):
+    # Regression: a sync peek with the wrong algorithm would take the
+    # mismatch-recreate path and refill the owner's depleted leaky bucket.
+    reqs = [
+        RateLimitReq(
+            name="glk", unique_key="u", hits=5, limit=5, duration=5000,
+            algorithm=Algorithm.LEAKY_BUCKET,
+        )
+    ]
+    a = arrays_for(reqs)
+    mesh_engine.decide_arrays(now=T0, **a)  # drain to 0
+    mesh_engine.sync_globals(
+        a["key_hash"], a["limit"], a["duration"], now=T0,
+        algo=np.full(1, 1, np.int32),
+    )
+    # bucket still empty after the sync
+    status, _, remaining, _ = mesh_engine.decide_arrays(now=T0, **a)
+    assert (int(status[0]), int(remaining[0])) == (int(Status.OVER_LIMIT), 0)
+
+
+def test_mesh_duplicate_keys_one_batch(mesh_engine):
+    reqs = [
+        RateLimitReq(
+            name="dup", unique_key="k", hits=1, limit=3, duration=SECOND
+        )
+        for _ in range(5)
+    ]
+    a = arrays_for(reqs)
+    status, _, remaining, _ = mesh_engine.decide_arrays(now=T0, **a)
+    assert list(remaining) == [2, 1, 0, 0, 0]
+    assert list(status) == [0, 0, 0, 1, 1]
