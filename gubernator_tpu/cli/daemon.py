@@ -1,0 +1,40 @@
+"""Server daemon entry point.
+
+`python -m gubernator_tpu.cli.daemon [--config FILE]` — configuration from
+GUBER_* env vars with an optional KEY=value config file injected first
+(the reference daemon's surface, cmd/gubernator/main.go + config.go).
+"""
+
+import argparse
+import asyncio
+import logging
+import sys
+
+from gubernator_tpu.serve.config import config_from_env, load_config_file
+from gubernator_tpu.serve.server import run_daemon
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="gubernator-tpu daemon")
+    parser.add_argument(
+        "--config",
+        default="",
+        help="environment config file of KEY=value lines",
+    )
+    args = parser.parse_args(argv)
+
+    env = None
+    if args.config:
+        env = load_config_file(args.config)
+    conf = config_from_env(env)
+
+    logging.basicConfig(
+        level=logging.DEBUG if conf.debug else logging.INFO,
+        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    )
+    asyncio.run(run_daemon(conf))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

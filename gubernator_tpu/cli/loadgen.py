@@ -1,0 +1,86 @@
+"""CLI load generator.
+
+`python -m gubernator_tpu.cli.loadgen <address>` replays a pool of random
+token-bucket limits through a concurrent fan-out forever, dumping
+OVER_LIMIT responses (the reference's cmd/gubernator-cli).
+"""
+
+import argparse
+import asyncio
+import sys
+import time
+
+from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, Status
+from gubernator_tpu.client import AsyncV1Client, random_string
+
+
+async def run(
+    address: str, keys: int, concurrency: int, batch: int, duration: float
+) -> None:
+    client = AsyncV1Client(address)
+    pool = [
+        RateLimitReq(
+            name=f"ID-{i:04d}",
+            unique_key=random_string("id-"),
+            hits=1,
+            limit=(i % 100) + 1,
+            duration=((i % 50) + 1) * 1000,
+            algorithm=Algorithm.TOKEN_BUCKET,
+            behavior=Behavior.BATCHING,
+        )
+        for i in range(keys)
+    ]
+
+    stats = {"sent": 0, "over": 0, "errors": 0}
+    stop_at = time.monotonic() + duration if duration > 0 else None
+
+    async def worker(wid: int):
+        i = wid
+        while stop_at is None or time.monotonic() < stop_at:
+            reqs = [pool[(i + j) % len(pool)] for j in range(batch)]
+            i += batch * concurrency
+            try:
+                resps = await client.get_rate_limits(reqs, timeout=5)
+            except Exception as e:
+                stats["errors"] += 1
+                print(f"error: {e}", file=sys.stderr)
+                await asyncio.sleep(0.1)
+                continue
+            stats["sent"] += len(resps)
+            for r in resps:
+                if r.status == Status.OVER_LIMIT:
+                    stats["over"] += 1
+                    print(f"over the limit: {r}")
+
+    started = time.monotonic()
+    try:
+        await asyncio.gather(*(worker(w) for w in range(concurrency)))
+    finally:
+        elapsed = time.monotonic() - started
+        rate = stats["sent"] / elapsed if elapsed > 0 else 0.0
+        print(
+            f"sent={stats['sent']} over_limit={stats['over']} "
+            f"errors={stats['errors']} rate={rate:.0f}/s",
+            file=sys.stderr,
+        )
+        await client.close()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="gubernator-tpu load generator")
+    parser.add_argument("address", nargs="?", default="127.0.0.1:9090")
+    parser.add_argument("--keys", type=int, default=2000)
+    parser.add_argument("--concurrency", type=int, default=10)
+    parser.add_argument("--batch", type=int, default=32)
+    parser.add_argument(
+        "--duration", type=float, default=0.0, help="seconds; 0 = forever"
+    )
+    args = parser.parse_args(argv)
+    asyncio.run(
+        run(args.address, args.keys, args.concurrency, args.batch, args.duration)
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -46,6 +46,20 @@ def choose_bucket(buckets: Sequence[int], n: int) -> int:
     return buckets[i]
 
 
+def pad_to_bucket(buckets: Sequence[int], n: int, *arrs):
+    """Pad (array, dtype) pairs to the chosen bucket; returns
+    (padded_arrays..., valid_mask)."""
+    B = choose_bucket(buckets, n)
+    out = []
+    for x, dtype in arrs:
+        p = np.zeros(B, dtype)
+        p[:n] = x
+        out.append(p)
+    valid = np.zeros(B, bool)
+    valid[:n] = True
+    return (*out, valid)
+
+
 def pad_request(
     buckets: Sequence[int],
     key_hash: np.ndarray,
@@ -178,20 +192,28 @@ class TpuEngine:
         n = len(updates)
         if n == 0:
             return
-        B = choose_bucket(self.buckets, n)
-        hashes = np.zeros(B, np.uint64)
-        hashes[:n] = slot_hash_batch([k for k, _ in updates])
-        limit = np.zeros(B, np.int64)
-        remaining = np.zeros(B, np.int64)
-        reset = np.zeros(B, np.int64)
-        over = np.zeros(B, bool)
-        valid = np.zeros(B, bool)
-        for i, (_, st) in enumerate(updates):
-            limit[i] = st.limit
-            remaining[i] = st.remaining
-            reset[i] = st.reset_time
-            over[i] = st.status == Status.OVER_LIMIT
-            valid[i] = True
+        hashes, limit, remaining, reset, over, valid = pad_to_bucket(
+            self.buckets,
+            n,
+            (slot_hash_batch([k for k, _ in updates]), np.uint64),
+            (np.fromiter((s.limit for _, s in updates), np.int64, n), np.int64),
+            (
+                np.fromiter((s.remaining for _, s in updates), np.int64, n),
+                np.int64,
+            ),
+            (
+                np.fromiter((s.reset_time for _, s in updates), np.int64, n),
+                np.int64,
+            ),
+            (
+                np.fromiter(
+                    (s.status == Status.OVER_LIMIT for _, s in updates),
+                    bool,
+                    n,
+                ),
+                bool,
+            ),
+        )
         self.store = upsert_globals_jit(
             self.store, hashes, limit, remaining, reset, over, valid
         )
@@ -206,6 +228,11 @@ class TpuEngine:
             self.decide_arrays(
                 k, ones, ones * 10, ones * 1000,
                 np.zeros(b, np.int32), np.zeros(b, bool), now,
+            )
+            # the GLOBAL replica-install path is a separate XLA program and
+            # must not pay jit time inside a broadcast RPC deadline either
+            self.update_globals(
+                [(f"warmup:{i}", RateLimitResp(limit=1)) for i in range(b)]
             )
         # reset state and counters dirtied by warmup traffic
         self.reset()

@@ -37,7 +37,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.core.engine import pad_request
+from gubernator_tpu.core.engine import pad_request, pad_to_bucket
 from gubernator_tpu.core.kernels import (
     BatchRequest,
     BatchResponse,
@@ -146,6 +146,26 @@ def _shard_sync_globals(
     )
 
 
+def _shard_upsert(
+    store: Store,
+    key_hash: jax.Array,
+    limit: jax.Array,
+    remaining: jax.Array,
+    reset_time: jax.Array,
+    is_over: jax.Array,
+    valid: jax.Array,
+    n_shards: int,
+):
+    """Install GLOBAL replica statuses on each key's owning shard."""
+    me = jax.lax.axis_index("shard")
+    store = jax.tree.map(lambda x: x[0], store)
+    mine = owner_of(key_hash, n_shards) == me
+    out = upsert_globals(
+        store, key_hash, limit, remaining, reset_time, is_over, valid & mine
+    )
+    return jax.tree.map(lambda x: x[None], out)
+
+
 class MeshEngine:
     """Drop-in sibling of core.engine.TpuEngine, sharded over a mesh.
 
@@ -191,6 +211,16 @@ class MeshEngine:
             ),
             donate_argnums=(0,),
         )
+        upsert_fn = functools.partial(_shard_upsert, n_shards=self.n)
+        self._upsert = jax.jit(
+            jax.shard_map(
+                upsert_fn,
+                mesh=self.mesh,
+                in_specs=(P("shard"),) + (P(),) * 6,
+                out_specs=P("shard"),
+            ),
+            donate_argnums=(0,),
+        )
 
     def _fresh_store(self) -> Store:
         base = new_store(self.config)
@@ -223,6 +253,33 @@ class MeshEngine:
             (resp.status, resp.limit, resp.remaining, resp.reset_time)
         )
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
+
+    def update_globals(
+        self,
+        key_hash: np.ndarray,
+        limit: np.ndarray,
+        remaining: np.ndarray,
+        reset_time: np.ndarray,
+        is_over: np.ndarray,
+    ) -> None:
+        """Install broadcast GLOBAL statuses on their owning shards — the
+        receive side of UpdatePeerGlobals (reference gubernator.go:199-207)
+        for a mesh-backed host."""
+        n = key_hash.shape[0]
+        if n == 0:
+            return
+        kh, lim, rem, rst, over, valid = pad_to_bucket(
+            self.buckets,
+            n,
+            (key_hash, np.uint64),
+            (limit, np.int64),
+            (remaining, np.int64),
+            (reset_time, np.int64),
+            (is_over, bool),
+        )
+        self.store = self._upsert(
+            self.store, kh, lim, rem, rst, over, valid
+        )
 
     def sync_globals(
         self,

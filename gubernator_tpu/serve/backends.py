@@ -1,0 +1,179 @@
+"""Decision backends behind the serving tier.
+
+The serving layer (instance.py) speaks one small interface; three backends
+implement it:
+
+- ExactBackend — host LRU + exact oracle algorithms. The semantics
+  reference and a sensible choice for tiny deployments.
+- TpuBackend — single-device slot store + jitted decide kernel.
+- MeshBackend — multi-device mesh-sharded store (key-space sharding with
+  psum combine); the scale-up backend for one host with a TPU slice.
+
+All three are driven from the single serving event loop / batcher task, so
+none of them need internal locking (the reference instead serializes on a
+cache mutex, gubernator.go:237).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import List, Optional, Sequence, Tuple
+
+from gubernator_tpu.api.types import RateLimitReq, RateLimitResp, Status
+from gubernator_tpu.core.cache import LRUCache
+from gubernator_tpu.core.engine import TpuEngine
+from gubernator_tpu.core.oracle import get_rate_limit
+from gubernator_tpu.core.store import StoreConfig
+
+
+class ExactBackend:
+    """Host-memory exact semantics (reference algorithms over an LRU)."""
+
+    def __init__(self, cache_size: int = 50_000):
+        self.cache = LRUCache(cache_size)
+
+    def decide(
+        self,
+        reqs: Sequence[RateLimitReq],
+        gnp: Sequence[bool],
+        now: Optional[int] = None,
+    ) -> List[RateLimitResp]:
+        out = []
+        for r, is_gnp in zip(reqs, gnp):
+            if is_gnp:
+                item, ok = self.cache.get(r.hash_key(), now)
+                if ok and isinstance(item, RateLimitResp):
+                    out.append(replace(item, metadata=dict(item.metadata)))
+                    continue
+                if ok:
+                    # algorithm switched under a GLOBAL key: drop the stale
+                    # entry and reprocess (gubernator.go:181-185)
+                    self.cache.remove(r.hash_key())
+                # miss: process locally as if owned (gubernator.go:189-194)
+            out.append(get_rate_limit(self.cache, r, now))
+        return out
+
+    def update_globals(
+        self, updates: Sequence[Tuple[str, RateLimitResp]]
+    ) -> None:
+        # cache.Add(key, status, status.reset_time) — gubernator.go:199-207
+        for key, status in updates:
+            self.cache.add(key, status, status.reset_time)
+
+    def stats(self) -> dict:
+        s = self.cache.stats()
+        return dict(size=s.size, hit=s.hit, miss=s.miss)
+
+
+class TpuBackend:
+    """Single-chip slot-store backend."""
+
+    def __init__(
+        self,
+        store: StoreConfig = StoreConfig(),
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+    ):
+        self.engine = TpuEngine(store, buckets=buckets)
+
+    def decide(self, reqs, gnp, now=None):
+        return self.engine.get_rate_limits(reqs, now=now, gnp=list(gnp))
+
+    def update_globals(self, updates):
+        self.engine.update_globals(list(updates))
+
+    def warmup(self) -> None:
+        """Compile all batch buckets at boot so no request pays jit time."""
+        self.engine.warmup()
+
+    def stats(self) -> dict:
+        return self.engine.stats.snapshot()
+
+
+class MeshBackend:
+    """Mesh-sharded slot-store backend (all local devices by default)."""
+
+    def __init__(
+        self,
+        store: StoreConfig = StoreConfig(),
+        devices=None,
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+    ):
+        import numpy as np
+
+        from gubernator_tpu.core.hashing import slot_hash_batch
+        from gubernator_tpu.parallel.sharded import MeshEngine
+
+        self._np = np
+        self._hash = slot_hash_batch
+        self.engine = MeshEngine(store, devices=devices, buckets=buckets)
+
+    def decide(self, reqs, gnp, now=None):
+        import numpy as np
+
+        from gubernator_tpu.api.types import millisecond_now
+
+        n = len(reqs)
+        if n == 0:
+            return []
+        if now is None:
+            now = millisecond_now()
+        status, limit, remaining, reset = self.engine.decide_arrays(
+            key_hash=self._hash([r.hash_key() for r in reqs]),
+            hits=np.fromiter((r.hits for r in reqs), np.int64, n),
+            limit=np.fromiter((r.limit for r in reqs), np.int64, n),
+            duration=np.fromiter((r.duration for r in reqs), np.int64, n),
+            algo=np.fromiter((int(r.algorithm) for r in reqs), np.int32, n),
+            gnp=np.asarray(list(gnp), bool),
+            now=now,
+        )
+        return [
+            RateLimitResp(
+                status=Status(int(status[i])),
+                limit=int(limit[i]),
+                remaining=int(remaining[i]),
+                reset_time=int(reset[i]),
+            )
+            for i in range(n)
+        ]
+
+    def update_globals(self, updates):
+        np = self._np
+        n = len(updates)
+        if n == 0:
+            return
+        self.engine.update_globals(
+            key_hash=self._hash([k for k, _ in updates]),
+            limit=np.fromiter((s.limit for _, s in updates), np.int64, n),
+            remaining=np.fromiter(
+                (s.remaining for _, s in updates), np.int64, n
+            ),
+            reset_time=np.fromiter(
+                (s.reset_time for _, s in updates), np.int64, n
+            ),
+            is_over=np.fromiter(
+                (s.status == Status.OVER_LIMIT for _, s in updates), bool, n
+            ),
+        )
+
+    def warmup(self) -> None:
+        np = self._np
+        for b in self.engine.buckets:
+            k = np.arange(1, b + 1, dtype=np.uint64)
+            ones = np.ones(b, np.int64)
+            self.engine.decide_arrays(
+                key_hash=k, hits=ones, limit=ones * 10, duration=ones * 1000,
+                algo=np.zeros(b, np.int32), gnp=np.zeros(b, bool),
+                now=1,
+            )
+            self.engine.update_globals(
+                key_hash=k,
+                limit=ones,
+                remaining=ones,
+                reset_time=ones,
+                is_over=np.zeros(b, bool),
+            )
+            self.engine.sync_globals(k, ones, ones * 1000, now=1)
+        self.engine.reset()
+
+    def stats(self) -> dict:
+        return {}

@@ -1,0 +1,162 @@
+"""Server configuration: library config + GUBER_* environment parsing.
+
+Two tiers like the reference: a library-level config consumed by the
+Instance (reference config.go:28-75), and a daemon-level env-var surface
+(GUBER_* variables with an optional KEY=value config file injected into
+the environment — reference cmd/gubernator/config.go:59-147). Defaults
+mirror the reference's (config.go:59-75).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+MAX_BATCH_SIZE = 1000  # hard request-list cap (reference gubernator.go:34)
+
+
+@dataclass
+class BehaviorConfig:
+    """Batching/gossip knobs; times in seconds (float)."""
+
+    batch_timeout: float = 0.5  # peer batch RPC deadline
+    batch_wait: float = 0.0005  # micro-batch window (500us)
+    batch_limit: int = MAX_BATCH_SIZE
+
+    global_timeout: float = 0.5  # GLOBAL gossip RPC deadline
+    global_sync_wait: float = 0.0005  # GLOBAL gossip window
+    global_batch_limit: int = MAX_BATCH_SIZE
+
+    def validate(self) -> None:
+        if self.batch_limit > MAX_BATCH_SIZE:
+            raise ValueError(
+                f"behaviors.batch_limit cannot exceed '{MAX_BATCH_SIZE}'"
+            )
+
+
+@dataclass
+class ServerConfig:
+    """One daemon's full configuration."""
+
+    grpc_address: str = "localhost:81"
+    http_address: str = "localhost:80"
+    advertise_address: str = ""  # address peers should dial; default grpc
+    behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
+
+    backend: str = "tpu"  # tpu | exact | mesh
+    cache_size: int = 50_000  # exact backend capacity
+    store_rows: int = 4  # slot-store geometry (tpu/mesh backends)
+    store_slots: int = 1 << 17
+
+    # device micro-batcher (host-side window before a device batch launches)
+    device_batch_wait: float = 0.0005
+    device_batch_limit: int = MAX_BATCH_SIZE
+
+    # static peers: list of gRPC addresses; advertise address must appear
+    peers: List[str] = field(default_factory=list)
+
+    # discovery
+    etcd_endpoints: List[str] = field(default_factory=list)
+    etcd_prefix: str = "/gubernator-tpu/peers/"
+    k8s_namespace: str = ""
+    k8s_pod_ip: str = ""
+    k8s_pod_port: str = ""
+    k8s_endpoints_selector: str = ""
+
+    debug: bool = False
+
+    def resolved_advertise(self) -> str:
+        return self.advertise_address or self.grpc_address
+
+    def validate(self) -> None:
+        self.behaviors.validate()
+        if self.etcd_endpoints and self.k8s_endpoints_selector:
+            raise ValueError(
+                "choose either etcd or kubernetes discovery, not both"
+            )
+
+
+def _get(env, key: str, default: str = "") -> str:
+    return env.get(key, default)
+
+
+def _get_int(env, key: str, default: int) -> int:
+    v = env.get(key)
+    return int(v) if v not in (None, "") else default
+
+
+def _get_float_ms(env, key: str, default: float) -> float:
+    """Env values are milliseconds (matching GUBER_* conventions); config
+    stores seconds."""
+    v = env.get(key)
+    return float(v) / 1000.0 if v not in (None, "") else default
+
+
+def load_config_file(path: str, env: Optional[dict] = None) -> dict:
+    """Inject KEY=value lines from a config file into the environment map
+    (reference cmd/gubernator/config.go:239-267)."""
+    env = dict(os.environ if env is None else env)
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            if "=" not in line:
+                raise ValueError(f"malformed config line: {line!r}")
+            k, _, v = line.partition("=")
+            env[k.strip()] = v.strip()
+    return env
+
+
+def config_from_env(env: Optional[dict] = None) -> ServerConfig:
+    """Build a ServerConfig from GUBER_* variables."""
+    env = os.environ if env is None else env
+    b = BehaviorConfig(
+        batch_timeout=_get_float_ms(env, "GUBER_BATCH_TIMEOUT_MS", 0.5),
+        batch_wait=_get_float_ms(env, "GUBER_BATCH_WAIT_MS", 0.0005),
+        batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", MAX_BATCH_SIZE),
+        global_timeout=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT_MS", 0.5),
+        global_sync_wait=_get_float_ms(
+            env, "GUBER_GLOBAL_SYNC_WAIT_MS", 0.0005
+        ),
+        global_batch_limit=_get_int(
+            env, "GUBER_GLOBAL_BATCH_LIMIT", MAX_BATCH_SIZE
+        ),
+    )
+    peers = [
+        p.strip()
+        for p in _get(env, "GUBER_PEERS").split(",")
+        if p.strip()
+    ]
+    etcd = [
+        p.strip()
+        for p in _get(env, "GUBER_ETCD_ENDPOINTS").split(",")
+        if p.strip()
+    ]
+    conf = ServerConfig(
+        grpc_address=_get(env, "GUBER_GRPC_ADDRESS", "localhost:81"),
+        http_address=_get(env, "GUBER_HTTP_ADDRESS", "localhost:80"),
+        advertise_address=_get(env, "GUBER_ADVERTISE_ADDRESS"),
+        behaviors=b,
+        backend=_get(env, "GUBER_BACKEND", "tpu"),
+        cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
+        store_rows=_get_int(env, "GUBER_STORE_ROWS", 4),
+        store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 17),
+        device_batch_wait=_get_float_ms(
+            env, "GUBER_DEVICE_BATCH_WAIT_MS", 0.0005
+        ),
+        device_batch_limit=_get_int(
+            env, "GUBER_DEVICE_BATCH_LIMIT", MAX_BATCH_SIZE
+        ),
+        peers=peers,
+        etcd_endpoints=etcd,
+        etcd_prefix=_get(env, "GUBER_ETCD_PREFIX", "/gubernator-tpu/peers/"),
+        k8s_namespace=_get(env, "GUBER_K8S_NAMESPACE"),
+        k8s_pod_ip=_get(env, "GUBER_K8S_POD_IP"),
+        k8s_pod_port=_get(env, "GUBER_K8S_POD_PORT"),
+        k8s_endpoints_selector=_get(env, "GUBER_K8S_ENDPOINTS_SELECTOR"),
+        debug=_get(env, "GUBER_DEBUG") in ("1", "true", "yes"),
+    )
+    conf.validate()
+    return conf

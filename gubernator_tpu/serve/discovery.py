@@ -1,0 +1,234 @@
+"""Cluster membership discovery.
+
+Three pools, mirroring the reference's discovery layer:
+
+- StaticPool — fixed peer list (the reference's GUBER_PEERS-style wiring
+  and test-cluster path, cluster/cluster.go:36-46).
+- EtcdPool — registers this node under `<prefix><advertise>` with a TTL
+  lease + keepalive and watches the prefix for peer changes (reference
+  etcd.go:36-316). Requires an etcd3 client library; gated import, raises
+  a clear error when unavailable in this image.
+- K8sPool — watches the Endpoints API filtered by a label selector and
+  marks self by pod IP (reference kubernetes.go:56-157). Uses the
+  kubernetes client when present; gated likewise.
+
+All pools push full `[]PeerInfo` snapshots through `on_update`, and the
+instance rebuilds its ring (reference etcd.go:308-316 -> SetPeers).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Awaitable, Callable, List, Sequence
+
+from gubernator_tpu.api.types import PeerInfo
+
+log = logging.getLogger("gubernator_tpu.discovery")
+
+OnUpdate = Callable[[List[PeerInfo]], Awaitable[None]]
+
+
+class StaticPool:
+    """Fixed membership; fires one update at start."""
+
+    def __init__(
+        self, peers: Sequence[str], advertise: str, on_update: OnUpdate
+    ):
+        self.peers = list(peers)
+        self.advertise = advertise
+        self.on_update = on_update
+
+    async def start(self) -> None:
+        await self.on_update(
+            [
+                PeerInfo(address=p, is_owner=(p == self.advertise))
+                for p in self.peers
+            ]
+        )
+
+    async def close(self) -> None:
+        pass
+
+
+class EtcdPool:
+    """etcd-backed membership (lease TTL 30s + keepalive + prefix watch)."""
+
+    LEASE_TTL_S = 30  # matches the reference's lease TTL (etcd.go:39)
+
+    def __init__(
+        self,
+        endpoints: Sequence[str],
+        prefix: str,
+        advertise: str,
+        on_update: OnUpdate,
+    ):
+        try:
+            import etcd3  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "etcd discovery requires the 'etcd3' package, which is not "
+                "available in this image; use GUBER_PEERS (static) or "
+                "kubernetes discovery"
+            ) from e
+        import etcd3
+
+        self._etcd3 = etcd3
+        host, _, port = endpoints[0].rpartition(":")
+        self.client = etcd3.client(host=host, port=int(port or 2379))
+        self.prefix = prefix
+        self.advertise = advertise
+        self.on_update = on_update
+        self._lease = None
+        self._tasks: list = []
+
+    async def start(self) -> None:
+        await asyncio.to_thread(self._register)
+        await self._push_peers()
+        self._tasks = [
+            asyncio.ensure_future(self._keepalive_loop()),
+            asyncio.ensure_future(self._watch_loop()),
+        ]
+
+    def _register(self) -> None:
+        self._lease = self.client.lease(self.LEASE_TTL_S)
+        self.client.put(
+            self.prefix + self.advertise, self.advertise, lease=self._lease
+        )
+
+    async def _keepalive_loop(self) -> None:
+        # refresh at 1/3 TTL; on lease loss re-register (etcd.go:247-301)
+        while True:
+            await asyncio.sleep(self.LEASE_TTL_S / 3)
+            try:
+                await asyncio.to_thread(self._lease.refresh)
+            except Exception as e:
+                log.warning("etcd lease lost (%s); re-registering", e)
+                try:
+                    await asyncio.to_thread(self._register)
+                except Exception as e2:
+                    log.error("etcd re-register failed: %s", e2)
+
+    async def _watch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                # the watch iterator blocks between events, so it must be
+                # consumed on a worker thread — never on the serving loop
+                await asyncio.to_thread(self._consume_watch, loop)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.error("etcd watch error: %s; retrying", e)
+                await asyncio.sleep(1)
+
+    def _consume_watch(self, loop) -> None:
+        events, cancel = self.client.watch_prefix(self.prefix)
+        self._cancel_watch = cancel
+        for _ in events:
+            asyncio.run_coroutine_threadsafe(self._push_peers(), loop).result()
+
+    async def _push_peers(self) -> None:
+        kvs = await asyncio.to_thread(
+            lambda: list(self.client.get_prefix(self.prefix))
+        )
+        peers = [
+            PeerInfo(
+                address=v.decode(), is_owner=(v.decode() == self.advertise)
+            )
+            for v, _ in kvs
+        ]
+        await self.on_update(peers)
+
+    async def close(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        try:
+            await asyncio.to_thread(
+                self.client.delete, self.prefix + self.advertise
+            )
+        except Exception:
+            pass
+
+
+class K8sPool:
+    """Kubernetes Endpoints watcher."""
+
+    def __init__(
+        self,
+        namespace: str,
+        selector: str,
+        pod_ip: str,
+        pod_port: str,
+        on_update: OnUpdate,
+    ):
+        try:
+            import kubernetes  # noqa: F401
+        except ImportError as e:
+            raise RuntimeError(
+                "kubernetes discovery requires the 'kubernetes' package, "
+                "which is not available in this image; use GUBER_PEERS "
+                "(static) or etcd discovery"
+            ) from e
+        import kubernetes
+
+        kubernetes.config.load_incluster_config()
+        self.api = kubernetes.client.CoreV1Api()
+        self.watch = kubernetes.watch.Watch()
+        self.namespace = namespace
+        self.selector = selector
+        self.pod_ip = pod_ip
+        self.pod_port = pod_port
+        self.on_update = on_update
+        self._task = None
+
+    async def start(self) -> None:
+        self._task = asyncio.ensure_future(self._watch_loop())
+
+    async def _watch_loop(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            try:
+                # blocking HTTP watch stream consumed on a worker thread
+                await asyncio.to_thread(self._consume_stream, loop)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.error("k8s watch error: %s; retrying", e)
+                await asyncio.sleep(1)
+
+    def _consume_stream(self, loop) -> None:
+        stream = self.watch.stream(
+            self.api.list_namespaced_endpoints,
+            self.namespace,
+            label_selector=self.selector,
+        )
+        for event in stream:
+            asyncio.run_coroutine_threadsafe(
+                self._push(event["object"]), loop
+            ).result()
+
+    async def _push(self, endpoints) -> None:
+        peers = []
+        for subset in endpoints.subsets or []:
+            for addr in subset.addresses or []:
+                address = f"{addr.ip}:{self.pod_port}"
+                peers.append(
+                    PeerInfo(
+                        address=address, is_owner=(addr.ip == self.pod_ip)
+                    )
+                )
+        await self.on_update(peers)
+
+    async def close(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass

@@ -1,0 +1,183 @@
+"""GLOBAL behavior gossip: async hit forwarding + owner status broadcasts.
+
+The host-level twin of the reference's globalManager (reference
+global.go:29-232), on asyncio instead of goroutines:
+
+- Non-owners answer GLOBAL requests from their local replica and queue the
+  hits here; hits aggregate per key and flush to owning peers every
+  `global_sync_wait` or at `global_batch_limit` (global.go:72-111).
+- Owners queue every GLOBAL key they decide; the broadcast loop dedups,
+  peeks current status (a zero-hit decide), and pushes UpdatePeerGlobals to
+  every other peer (global.go:158-232).
+
+When the peers are TPU shards of one mesh rather than remote hosts, the
+same aggregate->apply->broadcast cycle runs as collectives instead
+(parallel/sharded.py sync_globals); this module is the DCN/gRPC edge of
+the gossip.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import replace
+from typing import Dict, Optional
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq
+from gubernator_tpu.serve.config import BehaviorConfig
+from gubernator_tpu.serve.metrics import (
+    GLOBAL_ASYNC_DURATIONS,
+    GLOBAL_BROADCAST_DURATIONS,
+)
+
+log = logging.getLogger("gubernator_tpu.global")
+
+
+def _log_task_death(task: asyncio.Task) -> None:
+    if task.cancelled():
+        return
+    exc = task.exception()
+    if exc is not None:
+        log.error("global manager loop died: %r", exc, exc_info=exc)
+
+
+class GlobalManager:
+    def __init__(self, conf: BehaviorConfig, instance):
+        self.conf = conf
+        self.instance = instance
+        self._hits: Dict[str, RateLimitReq] = {}
+        self._updates: Dict[str, RateLimitReq] = {}
+        self._hits_event = asyncio.Event()
+        self._updates_event = asyncio.Event()
+        self._tasks = []
+
+    def start(self) -> None:
+        if not self._tasks:
+            self._tasks = [
+                asyncio.ensure_future(self._run_async_hits()),
+                asyncio.ensure_future(self._run_broadcasts()),
+            ]
+            for t in self._tasks:
+                t.add_done_callback(_log_task_death)
+
+    async def stop(self) -> None:
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+
+    # -- queue entry points (non-blocking, called on the serving loop) ------
+
+    def queue_hit(self, r: RateLimitReq) -> None:
+        """Aggregate a non-owner hit for async forwarding
+        (global.go:62-64,78-86)."""
+        key = r.hash_key()
+        cur = self._hits.get(key)
+        if cur is not None:
+            cur.hits += r.hits
+        else:
+            self._hits[key] = replace(r)
+        self._hits_event.set()
+
+    def queue_update(self, r: RateLimitReq) -> None:
+        """Mark an owned GLOBAL key for status broadcast
+        (global.go:66-68,164-165)."""
+        self._updates[r.hash_key()] = replace(r)
+        self._updates_event.set()
+
+    # -- loops --------------------------------------------------------------
+
+    async def _run_async_hits(self) -> None:
+        while True:
+            await self._hits_event.wait()
+            # batch-limit flush happens immediately; otherwise wait out the
+            # sync window to coalesce (global.go:88-104)
+            if len(self._hits) < self.conf.global_batch_limit:
+                await asyncio.sleep(self.conf.global_sync_wait)
+            hits, self._hits = self._hits, {}
+            self._hits_event.clear()
+            if hits:
+                await self._send_hits(hits)
+
+    async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
+        """Group aggregated hits by owning peer and forward
+        (global.go:115-155)."""
+        start = time.monotonic()
+        by_peer: Dict[str, list] = {}
+        clients = {}
+        for key, r in hits.items():
+            try:
+                peer = self.instance.get_peer(key)
+            except Exception as e:
+                log.error("while getting peer for hash key '%s': %s", key, e)
+                continue
+            by_peer.setdefault(peer.host, []).append(r)
+            clients[peer.host] = peer
+        for host, reqs in by_peer.items():
+            # a flush can have aggregated more keys than one peer RPC may
+            # carry (the owner hard-rejects >MAX_BATCH_SIZE); chunk it
+            for i in range(0, len(reqs), self.conf.global_batch_limit):
+                chunk = reqs[i : i + self.conf.global_batch_limit]
+                try:
+                    await asyncio.wait_for(
+                        clients[host].get_peer_rate_limits(chunk),
+                        timeout=self.conf.global_timeout,
+                    )
+                except Exception as e:
+                    log.error(
+                        "error sending global hits to '%s': %s", host, e
+                    )
+        GLOBAL_ASYNC_DURATIONS.observe(time.monotonic() - start)
+
+    async def _run_broadcasts(self) -> None:
+        while True:
+            await self._updates_event.wait()
+            if len(self._updates) < self.conf.global_batch_limit:
+                await asyncio.sleep(self.conf.global_sync_wait)
+            updates, self._updates = self._updates, {}
+            self._updates_event.clear()
+            if updates:
+                await self._update_peers(updates)
+
+    async def _update_peers(self, updates: Dict[str, RateLimitReq]) -> None:
+        """Peek authoritative status for each updated key and broadcast to
+        all other peers (global.go:193-232)."""
+        start = time.monotonic()
+        globals_batch = []
+        peek_reqs = []
+        keys = []
+        for key, r in updates.items():
+            peek = replace(r, hits=0, behavior=Behavior.BATCHING)
+            peek_reqs.append(peek)
+            keys.append(key)
+        try:
+            statuses = await self.instance.decide_local(
+                peek_reqs, gnp=[False] * len(peek_reqs)
+            )
+            globals_batch = list(zip(keys, statuses))
+        except Exception as e:
+            log.error("while peeking global statuses: %s", e)
+
+        if globals_batch:
+            for peer in self.instance.peer_list():
+                if peer.is_owner:
+                    continue  # never broadcast to ourselves
+                for i in range(0, len(globals_batch), self.conf.global_batch_limit):
+                    chunk = globals_batch[i : i + self.conf.global_batch_limit]
+                    try:
+                        await asyncio.wait_for(
+                            peer.update_peer_globals(chunk),
+                            timeout=self.conf.global_timeout,
+                        )
+                    except Exception as e:
+                        log.error(
+                            "error sending global updates to '%s': %s",
+                            peer.host,
+                            e,
+                        )
+        GLOBAL_BROADCAST_DURATIONS.observe(time.monotonic() - start)

@@ -1,0 +1,234 @@
+"""The serving instance: validation, owner routing, and peer fan-out.
+
+The engine-room of one server process, mirroring the reference Instance's
+contract (reference gubernator.go:41-322) with an asyncio + batched-device
+execution model:
+
+- GetRateLimits validates each entry, decides key ownership on the ring,
+  and splits the batch three ways: locally-owned requests coalesce into
+  device batches; GLOBAL non-owned requests answer from local replicas
+  (with hits queued to the gossip manager); other non-owned requests
+  forward to their owner peer (micro-batched per peer unless NO_BATCHING).
+  Responses reassemble in request order (gubernator.go:75-169).
+- GetPeerRateLimits serves owner-side batches for other peers
+  (gubernator.go:210-227).
+- UpdatePeerGlobals installs owner-broadcast GLOBAL replicas
+  (gubernator.go:199-207).
+- set_peers rebuilds the picker on membership change, reusing existing
+  connections, and recomputes health (gubernator.go:254-292).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import List, Optional, Sequence, Tuple
+
+from gubernator_tpu.api.types import (
+    Behavior,
+    HealthCheckResp,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+)
+from gubernator_tpu.serve.batcher import DeviceBatcher
+from gubernator_tpu.serve.config import MAX_BATCH_SIZE, ServerConfig
+from gubernator_tpu.serve.global_mgr import GlobalManager
+from gubernator_tpu.serve.peers import ConsistentHashPicker, PeerClient
+
+log = logging.getLogger("gubernator_tpu.instance")
+
+HEALTHY = "healthy"
+UNHEALTHY = "unhealthy"
+
+
+class BatchTooLargeError(ValueError):
+    pass
+
+
+class Instance:
+    def __init__(self, conf: ServerConfig, backend):
+        self.conf = conf
+        self.backend = backend
+        self.batcher = DeviceBatcher(
+            backend,
+            batch_wait=conf.device_batch_wait,
+            batch_limit=conf.device_batch_limit,
+        )
+        self.global_mgr = GlobalManager(conf.behaviors, self)
+        self.picker = ConsistentHashPicker()
+        self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
+
+    def start(self) -> None:
+        self.batcher.start()
+        self.global_mgr.start()
+
+    async def stop(self) -> None:
+        await self.global_mgr.stop()
+        await self.batcher.stop()
+        for peer in self.picker.peers():
+            await peer.close()
+
+    # -- public API (gubernator.go:75-169) ----------------------------------
+
+    async def get_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        if len(reqs) > MAX_BATCH_SIZE:
+            raise BatchTooLargeError(
+                f"Requests.RateLimits list too large; max size is "
+                f"'{MAX_BATCH_SIZE}'"
+            )
+
+        out: List[Optional[RateLimitResp]] = [None] * len(reqs)
+        local: List[Tuple[int, RateLimitReq, bool]] = []  # idx, req, gnp
+        forwards: List[Tuple[int, RateLimitReq, PeerClient]] = []
+
+        for i, r in enumerate(reqs):
+            if not r.unique_key:
+                out[i] = RateLimitResp(
+                    error="field 'unique_key' cannot be empty"
+                )
+                continue
+            if not r.name:
+                out[i] = RateLimitResp(
+                    error="field 'namespace' cannot be empty"
+                )
+                continue
+            key = r.hash_key()
+            try:
+                peer = self.get_peer(key)
+            except Exception as e:
+                out[i] = RateLimitResp(
+                    error=(
+                        f"while finding peer that owns rate limit "
+                        f"'{key}' - '{e}'"
+                    )
+                )
+                continue
+            if peer.is_owner:
+                local.append((i, r, False))
+            elif r.behavior == Behavior.GLOBAL:
+                # replica answer + async hit forward (gubernator.go:133-140)
+                self.global_mgr.queue_hit(r)
+                local.append((i, r, True))
+            else:
+                forwards.append((i, r, peer))
+
+        async def forward(i, r, peer):
+            key = r.hash_key()
+            try:
+                resp = await peer.get_peer_rate_limit(r)
+                resp.metadata["owner"] = peer.host
+            except Exception as e:
+                resp = RateLimitResp(
+                    error=(
+                        f"while fetching rate limit '{key}' from peer - '{e}'"
+                    )
+                )
+            out[i] = resp
+
+        # schedule forwards immediately so their RPCs overlap the local
+        # device batch instead of queueing behind it
+        tasks = [
+            asyncio.ensure_future(forward(i, r, p)) for i, r, p in forwards
+        ]
+
+        if local:
+            local_reqs = [r for _, r, _ in local]
+            gnp = [g for _, _, g in local]
+            try:
+                resps = await self.decide_local(local_reqs, gnp)
+                for (i, _, _), resp in zip(local, resps):
+                    out[i] = resp
+            except Exception as e:
+                for i, r, _ in local:
+                    out[i] = RateLimitResp(
+                        error=(
+                            f"while applying rate limit for "
+                            f"'{r.hash_key()}' - '{e}'"
+                        )
+                    )
+        if tasks:
+            await asyncio.gather(*tasks)
+        return [r if r is not None else RateLimitResp() for r in out]
+
+    async def decide_local(
+        self, reqs: Sequence[RateLimitReq], gnp: Sequence[bool]
+    ) -> List[RateLimitResp]:
+        """Run requests through the device batcher; owned GLOBAL keys are
+        queued for status broadcast (gubernator.go:240-242)."""
+        for r, is_gnp in zip(reqs, gnp):
+            if r.behavior == Behavior.GLOBAL and not is_gnp:
+                self.global_mgr.queue_update(r)
+        return await self.batcher.decide(reqs, gnp)
+
+    # -- peer-facing API ----------------------------------------------------
+
+    async def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        if len(reqs) > MAX_BATCH_SIZE:
+            raise BatchTooLargeError(
+                f"'PeerRequest.rate_limits' list too large; max size is "
+                f"'{MAX_BATCH_SIZE}'"
+            )
+        try:
+            return await self.decide_local(reqs, [False] * len(reqs))
+        except Exception as e:
+            return [RateLimitResp(error=str(e)) for _ in reqs]
+
+    async def update_peer_globals(
+        self, updates: Sequence[Tuple[str, RateLimitResp]]
+    ) -> None:
+        await self.batcher.update_globals(list(updates))
+
+    def health_check(self) -> HealthCheckResp:
+        return self.health
+
+    # -- membership (gubernator.go:254-310) ---------------------------------
+
+    async def set_peers(self, peers: Sequence[PeerInfo]) -> None:
+        picker = self.picker.new()
+        errs = []
+        for info in peers:
+            existing = self.picker.get_peer_by_host(info.address)
+            if existing is not None:
+                peer = existing
+            else:
+                peer = PeerClient(self.conf.behaviors, info.address)
+            peer.is_owner = info.is_owner
+            try:
+                peer.connect()
+            except Exception:
+                errs.append(
+                    f"failed to connect to peer '{info.address}'; "
+                    f"consistent hash is incomplete"
+                )
+                continue
+            picker.add(peer)
+
+        old_hosts = {p.host for p in self.picker.peers()}
+        new_hosts = {p.host for p in picker.peers()}
+        removed = [
+            self.picker.get_peer_by_host(h) for h in old_hosts - new_hosts
+        ]
+
+        self.picker = picker
+        self.health = HealthCheckResp(
+            status=UNHEALTHY if errs else HEALTHY,
+            message="|".join(errs),
+            peer_count=picker.size(),
+        )
+        # Unlike the reference (which leaks old clients, gubernator.go:276),
+        # departed peers' channels are closed once replaced.
+        for peer in removed:
+            if peer is not None:
+                await peer.close()
+        log.info("peers updated: %s", [p.address for p in peers])
+
+    def get_peer(self, key: str) -> PeerClient:
+        return self.picker.get(key)
+
+    def peer_list(self) -> List[PeerClient]:
+        return self.picker.peers()

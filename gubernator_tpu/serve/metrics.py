@@ -1,0 +1,73 @@
+"""Prometheus metrics, name-compatible with the reference's collectors.
+
+- grpc_request_counts{status,method} and
+  grpc_request_duration_milliseconds{method} (reference prometheus.go:50-63)
+- cache_size, cache_access_count{type} (reference cache/lru.go:56-59,164-176)
+- async_durations / broadcast_durations GLOBAL histograms
+  (reference global.go:44-51)
+- plus TPU-specific gauges: device batch sizes and kernel launch latency.
+"""
+
+from __future__ import annotations
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+REGISTRY = CollectorRegistry()
+
+GRPC_REQUEST_COUNTS = Counter(
+    "grpc_request_counts",
+    "The count of gRPC requests",
+    ["status", "method"],
+    registry=REGISTRY,
+)
+GRPC_REQUEST_DURATION = Histogram(
+    "grpc_request_duration_milliseconds",
+    "The duration of gRPC requests in milliseconds",
+    ["method"],
+    buckets=(0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 500, 1000),
+    registry=REGISTRY,
+)
+CACHE_SIZE = Gauge(
+    "cache_size",
+    "The number of rate-limit entries in the store",
+    registry=REGISTRY,
+)
+CACHE_ACCESS_COUNT = Counter(
+    "cache_access_count",
+    "Store access counts",
+    ["type"],  # hit | miss
+    registry=REGISTRY,
+)
+GLOBAL_ASYNC_DURATIONS = Histogram(
+    "async_durations",
+    "The duration of GLOBAL async sends in seconds",
+    registry=REGISTRY,
+)
+GLOBAL_BROADCAST_DURATIONS = Histogram(
+    "broadcast_durations",
+    "The duration of GLOBAL broadcasts to peers in seconds",
+    registry=REGISTRY,
+)
+DEVICE_BATCH_SIZE = Histogram(
+    "device_batch_size",
+    "Requests coalesced per device kernel launch",
+    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096),
+    registry=REGISTRY,
+)
+DEVICE_LAUNCH_MS = Histogram(
+    "device_launch_milliseconds",
+    "Wall time of one decide kernel launch (host-observed)",
+    buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 25, 100),
+    registry=REGISTRY,
+)
+
+
+def render() -> bytes:
+    """Text exposition for the /metrics endpoint."""
+    return generate_latest(REGISTRY)

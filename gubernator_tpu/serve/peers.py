@@ -1,0 +1,187 @@
+"""Peer routing: consistent-hash ownership + batching peer RPC client.
+
+The host-level ring is wire- and placement-compatible with the reference
+(crc32 point per peer, sorted ring, binary-search successor with
+wraparound — reference hash.go:62-96), so a mixed cluster of reference
+nodes and gubernator-tpu nodes would agree on key ownership. Within one
+host, keys further shard across TPU chips (parallel/sharded.py); this ring
+only decides which *host* coordinates a key.
+
+PeerClient mirrors the reference's forwarding semantics (peers.go):
+BATCHING/GLOBAL requests coalesce into micro-batches flushed every
+`batch_wait` or at `batch_limit`; NO_BATCHING goes out as a direct unary
+call. Implemented on asyncio instead of goroutines+channels: one flusher
+task per peer, futures instead of response channels.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import grpc
+
+from gubernator_tpu.api import convert
+from gubernator_tpu.api.grpc_glue import PeersV1Stub
+from gubernator_tpu.api.proto.gen import peers_pb2
+from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
+from gubernator_tpu.core.hashing import ring_hash
+from gubernator_tpu.serve.config import BehaviorConfig
+
+
+class PeerClient:
+    """Connection to one peer (possibly this server itself)."""
+
+    def __init__(
+        self,
+        conf: BehaviorConfig,
+        host: str,
+        is_owner: bool = False,
+    ):
+        self.conf = conf
+        self.host = host
+        self.is_owner = is_owner  # true when this peer is this server
+        self.channel: Optional[grpc.aio.Channel] = None
+        self.stub: Optional[PeersV1Stub] = None
+        self._queue: "asyncio.Queue[Tuple[RateLimitReq, asyncio.Future]]" = (
+            asyncio.Queue()
+        )
+        self._flusher: Optional[asyncio.Task] = None
+
+    def connect(self) -> None:
+        if self.channel is None:
+            self.channel = grpc.aio.insecure_channel(self.host)
+            self.stub = PeersV1Stub(self.channel)
+        if self._flusher is None:
+            self._flusher = asyncio.ensure_future(self._run())
+
+    async def close(self) -> None:
+        if self._flusher is not None:
+            self._flusher.cancel()
+            try:
+                await self._flusher
+            except asyncio.CancelledError:
+                pass
+            self._flusher = None
+        if self.channel is not None:
+            await self.channel.close()
+            self.channel = None
+
+    # -- forwarding ---------------------------------------------------------
+
+    async def get_peer_rate_limit(self, r: RateLimitReq) -> RateLimitResp:
+        """Forward one request; batches unless NO_BATCHING
+        (reference peers.go:73-90)."""
+        if r.behavior in (Behavior.BATCHING, Behavior.GLOBAL):
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._queue.put_nowait((r, fut))
+            return await fut
+        resp = await self.get_peer_rate_limits([r])
+        return resp[0]
+
+    async def get_peer_rate_limits(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        pb_req = peers_pb2.GetPeerRateLimitsReq(
+            requests=[convert.req_to_pb(r) for r in reqs]
+        )
+        pb_resp = await self.stub.GetPeerRateLimits(
+            pb_req, timeout=self.conf.batch_timeout
+        )
+        if len(pb_resp.rate_limits) != len(reqs):
+            raise RuntimeError(
+                "peer responded with mismatched rate limit list size"
+            )
+        return [convert.resp_from_pb(p) for p in pb_resp.rate_limits]
+
+    async def update_peer_globals(self, updates) -> None:
+        """updates: sequence of (key, RateLimitResp)."""
+        pb_req = peers_pb2.UpdatePeerGlobalsReq(
+            globals=[
+                peers_pb2.UpdatePeerGlobal(
+                    key=k, status=convert.resp_to_pb(s)
+                )
+                for k, s in updates
+            ]
+        )
+        await self.stub.UpdatePeerGlobals(
+            pb_req, timeout=self.conf.global_timeout
+        )
+
+    # -- micro-batch flusher ------------------------------------------------
+
+    async def _run(self) -> None:
+        """Coalesce queued requests; flush at batch_limit or after
+        batch_wait from the first enqueue (reference peers.go:143-172)."""
+        while True:
+            batch: List[Tuple[RateLimitReq, asyncio.Future]] = []
+            item = await self._queue.get()
+            batch.append(item)
+            deadline = asyncio.get_running_loop().time() + self.conf.batch_wait
+            while len(batch) < self.conf.batch_limit:
+                timeout = deadline - asyncio.get_running_loop().time()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(
+                        self._queue.get(), timeout=timeout
+                    )
+                except asyncio.TimeoutError:
+                    break
+                batch.append(item)
+            await self._send_batch(batch)
+
+    async def _send_batch(self, batch) -> None:
+        reqs = [r for r, _ in batch]
+        try:
+            resps = await self.get_peer_rate_limits(reqs)
+        except Exception as e:  # entire batch failed (peers.go:186-192)
+            for _, fut in batch:
+                if not fut.done():
+                    fut.set_exception(
+                        RuntimeError(f"while fetching from peer - '{e}'")
+                    )
+            return
+        for (_, fut), resp in zip(batch, resps):
+            if not fut.done():
+                fut.set_result(resp)
+
+
+class ConsistentHashPicker:
+    """Ring-placement-compatible peer picker (reference hash.go)."""
+
+    def __init__(self, hash_fn=ring_hash):
+        self._hash = hash_fn
+        self._keys: List[int] = []
+        self._by_point: Dict[int, PeerClient] = {}
+        self._by_host: Dict[str, PeerClient] = {}
+
+    def new(self) -> "ConsistentHashPicker":
+        return ConsistentHashPicker(self._hash)
+
+    def add(self, peer: PeerClient) -> None:
+        point = self._hash(peer.host)
+        bisect.insort(self._keys, point)
+        self._by_point[point] = peer
+        self._by_host[peer.host] = peer
+
+    def size(self) -> int:
+        return len(self._keys)
+
+    def peers(self) -> List[PeerClient]:
+        return list(self._by_host.values())
+
+    def get_peer_by_host(self, host: str) -> Optional[PeerClient]:
+        return self._by_host.get(host)
+
+    def get(self, key: str) -> PeerClient:
+        """Successor peer on the ring for this key's point, wrapping
+        (reference hash.go:80-96)."""
+        if not self._keys:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        point = self._hash(key)
+        i = bisect.bisect_left(self._keys, point)
+        if i == len(self._keys):
+            i = 0
+        return self._by_point[self._keys[i]]

@@ -1,0 +1,304 @@
+"""Server daemon: gRPC V1 + PeersV1 services, HTTP JSON gateway, /metrics.
+
+Wires config -> backend -> Instance -> servers, mirroring the reference
+daemon's shape (reference cmd/gubernator/main.go:40-147): gRPC on one
+listener, an HTTP gateway exposing POST /v1/GetRateLimits and
+GET /v1/HealthCheck as JSON plus GET /metrics for Prometheus, discovery
+(static peers, etcd, or kubernetes) pushing peer updates into
+Instance.set_peers, and graceful shutdown.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+from typing import Optional
+
+import grpc
+from aiohttp import web
+
+from gubernator_tpu.api import convert
+from gubernator_tpu.api.grpc_glue import add_peers_servicer, add_v1_servicer
+from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2
+from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve.backends import (
+    ExactBackend,
+    MeshBackend,
+    TpuBackend,
+)
+from gubernator_tpu.serve.config import ServerConfig
+from gubernator_tpu.serve.instance import BatchTooLargeError, Instance
+
+log = logging.getLogger("gubernator_tpu.server")
+
+
+def make_backend(conf: ServerConfig):
+    from gubernator_tpu.core.store import StoreConfig
+
+    store = StoreConfig(rows=conf.store_rows, slots=conf.store_slots)
+    if conf.backend == "exact":
+        return ExactBackend(conf.cache_size)
+    if conf.backend == "tpu":
+        return TpuBackend(store)
+    if conf.backend == "mesh":
+        return MeshBackend(store)
+    raise ValueError(f"unknown backend '{conf.backend}'")
+
+
+class _Timed:
+    """Method timing -> grpc_request_counts / duration histograms
+    (the stats-handler role, reference prometheus.go:104-127)."""
+
+    def __init__(self, method: str):
+        self.method = method
+
+    def __enter__(self):
+        self.start = time.monotonic()
+        return self
+
+    def __exit__(self, exc_type, *_):
+        ms = (time.monotonic() - self.start) * 1000.0
+        metrics.GRPC_REQUEST_DURATION.labels(self.method).observe(ms)
+        metrics.GRPC_REQUEST_COUNTS.labels(
+            "failed" if exc_type else "success", self.method
+        ).inc()
+        return False
+
+
+class V1Servicer:
+    def __init__(self, instance: Instance):
+        self.instance = instance
+
+    async def GetRateLimits(self, request, context):
+        with _Timed("/pb.gubernator.V1/GetRateLimits"):
+            reqs = [convert.req_from_pb(p) for p in request.requests]
+            try:
+                resps = await self.instance.get_rate_limits(reqs)
+            except BatchTooLargeError as e:
+                await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+            return gubernator_pb2.GetRateLimitsResp(
+                responses=[convert.resp_to_pb(r) for r in resps]
+            )
+
+    async def HealthCheck(self, request, context):
+        with _Timed("/pb.gubernator.V1/HealthCheck"):
+            h = self.instance.health_check()
+            return gubernator_pb2.HealthCheckResp(
+                status=h.status, message=h.message, peer_count=h.peer_count
+            )
+
+
+class PeersV1Servicer:
+    def __init__(self, instance: Instance):
+        self.instance = instance
+
+    async def GetPeerRateLimits(self, request, context):
+        with _Timed("/pb.gubernator.PeersV1/GetPeerRateLimits"):
+            reqs = [convert.req_from_pb(p) for p in request.requests]
+            try:
+                resps = await self.instance.get_peer_rate_limits(reqs)
+            except BatchTooLargeError as e:
+                await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
+            return peers_pb2.GetPeerRateLimitsResp(
+                rate_limits=[convert.resp_to_pb(r) for r in resps]
+            )
+
+    async def UpdatePeerGlobals(self, request, context):
+        with _Timed("/pb.gubernator.PeersV1/UpdatePeerGlobals"):
+            updates = [
+                (g.key, convert.resp_from_pb(g.status))
+                for g in request.globals
+            ]
+            await self.instance.update_peer_globals(updates)
+            return peers_pb2.UpdatePeerGlobalsResp()
+
+
+class Server:
+    """One daemon: gRPC + HTTP, an Instance, and discovery."""
+
+    def __init__(self, conf: ServerConfig, backend=None):
+        self.conf = conf
+        self.backend = backend if backend is not None else make_backend(conf)
+        self.instance = Instance(conf, self.backend)
+        self.grpc_server: Optional[grpc.aio.Server] = None
+        self._http_runner: Optional[web.AppRunner] = None
+        self._pool = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self) -> None:
+        warmup = getattr(self.backend, "warmup", None)
+        if warmup is not None:
+            # compile every device-batch bucket before accepting traffic;
+            # first jit on a TPU can take tens of seconds and must never be
+            # paid inside a request deadline
+            await asyncio.to_thread(warmup)
+        self.instance.start()
+
+        self.grpc_server = grpc.aio.server(
+            options=[("grpc.max_receive_message_length", 1 << 20)]
+        )
+        add_v1_servicer(self.grpc_server, V1Servicer(self.instance))
+        add_peers_servicer(self.grpc_server, PeersV1Servicer(self.instance))
+        bound = self.grpc_server.add_insecure_port(self.conf.grpc_address)
+        if bound == 0:
+            raise RuntimeError(
+                f"failed to bind gRPC address {self.conf.grpc_address}"
+            )
+        await self.grpc_server.start()
+        log.info("gRPC listening on %s", self.conf.grpc_address)
+
+        if self.conf.http_address:
+            await self._start_http()
+
+        await self._start_discovery()
+
+    async def stop(self) -> None:
+        if self._pool is not None:
+            await self._pool.close()
+            self._pool = None
+        if self._http_runner is not None:
+            await self._http_runner.cleanup()
+            self._http_runner = None
+        if self.grpc_server is not None:
+            await self.grpc_server.stop(grace=1.0)
+            self.grpc_server = None
+        await self.instance.stop()
+
+    # -- HTTP gateway -------------------------------------------------------
+
+    async def _start_http(self) -> None:
+        app = web.Application()
+        app.router.add_post("/v1/GetRateLimits", self._http_get_rate_limits)
+        app.router.add_get("/v1/HealthCheck", self._http_health)
+        app.router.add_get("/metrics", self._http_metrics)
+        self._http_runner = web.AppRunner(app)
+        await self._http_runner.setup()
+        host, _, port = self.conf.http_address.rpartition(":")
+        site = web.TCPSite(self._http_runner, host or "0.0.0.0", int(port))
+        await site.start()
+        log.info("HTTP listening on %s", self.conf.http_address)
+
+    async def _http_get_rate_limits(self, request: web.Request):
+        try:
+            body = await request.json()
+        except json.JSONDecodeError:
+            return web.json_response({"error": "invalid json"}, status=400)
+        reqs = []
+        for item in body.get("requests", []):
+            pb = gubernator_pb2.RateLimitReq(
+                name=item.get("name", ""),
+                unique_key=item.get("uniqueKey", item.get("unique_key", "")),
+                hits=int(item.get("hits", 0)),
+                limit=int(item.get("limit", 0)),
+                duration=int(item.get("duration", 0)),
+                algorithm=_enum_val(
+                    gubernator_pb2.Algorithm, item.get("algorithm", 0)
+                ),
+                behavior=_enum_val(
+                    gubernator_pb2.Behavior, item.get("behavior", 0)
+                ),
+            )
+            reqs.append(convert.req_from_pb(pb))
+        try:
+            resps = await self.instance.get_rate_limits(reqs)
+        except BatchTooLargeError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.json_response(
+            {
+                "responses": [
+                    {
+                        "status": r.status.name,
+                        "limit": str(r.limit),
+                        "remaining": str(r.remaining),
+                        "resetTime": str(r.reset_time),
+                        "error": r.error,
+                        "metadata": r.metadata,
+                    }
+                    for r in resps
+                ]
+            }
+        )
+
+    async def _http_health(self, request: web.Request):
+        h = self.instance.health_check()
+        return web.json_response(
+            {
+                "status": h.status,
+                "message": h.message,
+                "peerCount": h.peer_count,
+            }
+        )
+
+    async def _http_metrics(self, request: web.Request):
+        self._refresh_store_metrics()
+        return web.Response(
+            body=metrics.render(), content_type="text/plain", charset="utf-8"
+        )
+
+    def _refresh_store_metrics(self) -> None:
+        stats = self.backend.stats()
+        if "size" in stats:
+            metrics.CACHE_SIZE.set(stats["size"])
+
+    # -- discovery ----------------------------------------------------------
+
+    async def _start_discovery(self) -> None:
+        advertise = self.conf.resolved_advertise()
+        if self.conf.etcd_endpoints:
+            from gubernator_tpu.serve.discovery import EtcdPool
+
+            self._pool = EtcdPool(
+                endpoints=self.conf.etcd_endpoints,
+                prefix=self.conf.etcd_prefix,
+                advertise=advertise,
+                on_update=self._on_peers,
+            )
+            await self._pool.start()
+        elif self.conf.k8s_endpoints_selector:
+            from gubernator_tpu.serve.discovery import K8sPool
+
+            self._pool = K8sPool(
+                namespace=self.conf.k8s_namespace,
+                selector=self.conf.k8s_endpoints_selector,
+                pod_ip=self.conf.k8s_pod_ip,
+                pod_port=self.conf.k8s_pod_port,
+                on_update=self._on_peers,
+            )
+            await self._pool.start()
+        else:
+            from gubernator_tpu.serve.discovery import StaticPool
+
+            self._pool = StaticPool(
+                peers=self.conf.peers or [advertise],
+                advertise=advertise,
+                on_update=self._on_peers,
+            )
+            await self._pool.start()
+
+    async def _on_peers(self, peers) -> None:
+        await self.instance.set_peers(peers)
+
+
+def _enum_val(enum_pb, v):
+    if isinstance(v, str):
+        return enum_pb.Value(v)
+    return int(v)
+
+
+async def run_daemon(conf: ServerConfig) -> None:
+    """Start a server and run until SIGINT/SIGTERM
+    (reference cmd/gubernator/main.go:127-139)."""
+    import signal
+
+    server = Server(conf)
+    await server.start()
+    stop = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    log.info("shutting down")
+    await server.stop()
