@@ -1,0 +1,123 @@
+"""Benchmark: rate-limit decisions/sec on one chip.
+
+Prints exactly ONE JSON line to stdout:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+Everything else goes to stderr.
+
+Config mirrors BASELINE.md's flagship single-chip target (config 2: mixed
+token+leaky traffic over 100k keys against the slot store in HBM). The
+measured program is the production decide kernel (core/kernels.py) stepped
+S times inside one lax.fori_loop — the store threads through the loop carry
+exactly as it does batch-over-batch in serving, with zero host involvement,
+so the number is pure device decision throughput. vs_baseline compares
+against the reference's published single-node client-facing rate of
+~2,000 req/s (reference README.md:94-99; BASELINE.md).
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import gubernator_tpu  # noqa: F401  (enables x64)
+    from gubernator_tpu.core.kernels import BatchRequest, decide
+    from gubernator_tpu.core.store import StoreConfig, new_store
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+
+    B = 4096  # requests per batch (reference hard cap is 1000/RPC; the
+    # device batch coalesces multiple RPCs, serve/batcher.py)
+    R = 8  # distinct pre-staged batches cycled through
+    S = 200  # decide steps fused into one device program
+    KEYS = 100_000
+    # 2 hash choices x 512k slots: ~1M entries capacity, 10% load at 100k
+    # keys; rows=2 measured ~19% faster than rows=4 on v5e (fewer candidate
+    # reads) with ample headroom against eviction at this load factor
+    ROWS, SLOTS = 2, 1 << 19
+
+    rng = np.random.default_rng(42)
+    store = new_store(StoreConfig(rows=ROWS, slots=SLOTS))
+
+    # mixed token+leaky traffic, zipf-ish key popularity over 100k keys
+    zipf = rng.zipf(1.2, size=(R, B)) % KEYS
+    key_hash = (
+        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+        ^ np.uint64(0xDEADBEEFCAFEF00D)
+    )
+    reqs = BatchRequest(
+        key_hash=jnp.asarray(key_hash),
+        hits=jnp.ones((R, B), jnp.int64),
+        limit=jnp.asarray(rng.integers(10, 10_000, (R, B)), jnp.int64),
+        duration=jnp.full((R, B), 60_000, jnp.int64),
+        algo=jnp.asarray(zipf % 2, jnp.int32),  # per-key stable algorithm
+        gnp=jnp.zeros((R, B), bool),
+        valid=jnp.ones((R, B), bool),
+    )
+    t0 = jnp.int64(1_700_000_000_000)
+
+    def steps(store, reqs):
+        def body(i, carry):
+            store, acc = carry
+            r = jax.tree.map(lambda x: x[i % R], reqs)
+            now = t0 + i.astype(jnp.int64)  # clock advances 1ms per batch
+            store, resp, _ = decide(store, r, now)
+            return store, acc + jnp.sum(resp.status)
+
+        return lax.fori_loop(
+            0, S, body, (store, jnp.zeros((), jnp.int64))
+        )
+
+    stepped = jax.jit(steps, donate_argnums=(0,))
+
+    log("compiling...")
+    t = time.monotonic()
+    store, acc = stepped(store, reqs)
+    jax.block_until_ready(acc)
+    log(f"compile+first run: {time.monotonic() - t:.1f}s")
+
+    times = []
+    for rep in range(5):
+        t = time.monotonic()
+        store, acc = stepped(store, reqs)
+        jax.block_until_ready(acc)
+        dt = time.monotonic() - t
+        times.append(dt)
+        log(
+            f"rep {rep}: {dt*1000:.1f} ms for {S} batches of {B} "
+            f"-> {S*B/dt/1e6:.2f} M decisions/s "
+            f"(over_limit={int(acc)})"
+        )
+
+    best = min(times)
+    value = S * B / best
+    per_batch_us = best / S * 1e6
+    log(f"best: {value/1e6:.2f} M decisions/s, {per_batch_us:.0f} us/batch")
+
+    baseline = 2000.0  # reference production node: >2,000 req/s
+    print(
+        json.dumps(
+            {
+                "metric": "rate_limit_decisions_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "decisions/s",
+                "vs_baseline": round(value / baseline, 1),
+            }
+        ),
+        flush=True,
+    )
+
+
+if __name__ == "__main__":
+    main()

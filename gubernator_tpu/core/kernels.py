@@ -5,8 +5,20 @@ requests against the slot store: the TPU-native rewrite of the reference's
 per-request, mutex-serialized algorithm dispatch
 (reference gubernator.go:236-251 -> algorithms.go:24-186). Control flow is
 data flow: every reference branch becomes a mask, the LRU hash map becomes
-`rows` gathers + one scatter, and the whole cluster-hot-path lock
-(reference gubernator.go:237) disappears — a batch is one XLA program.
+one wide gather + one wide scatter on the packed store, and the whole
+cluster-hot-path lock (reference gubernator.go:237) disappears — a batch
+is one XLA program.
+
+Data-movement design (the performance core):
+- Lookup is a two-stage gather on the packed store: tag+expire lanes of
+  all row candidates ([rows, B, 2], for matching and eviction scoring),
+  then full lanes of the one selected slot ([B, LANES]); ONE scatter of
+  [B, LANES] writes back. Measured ~6-9x faster on v5e than per-field
+  planes.
+- All per-group reductions (prefix sums, group totals, any-flags) are
+  cumsum + two small gathers over the sort-contiguous groups — no
+  segment_sum scatters.
+- Leader-broadcast values ride a single stacked [B, K] gather.
 
 Intra-batch duplicate keys
 --------------------------
@@ -24,10 +36,8 @@ an oversized refused request does not starve later small ones. This matches
 sequential-greedy exactly when all duplicate hits are equal (the common
 hot-key case) and is conservative otherwise; since the reference's own
 ordering is scheduler-dependent, any such consistent order is within its
-observable envelope.
-
-Same-batch duplicates with *different* algorithms or behaviors resolve with
-group-leader (first in batch order) semantics.
+observable envelope. Same-batch duplicates with *different* algorithms or
+behaviors resolve with group-leader (first in batch order) semantics.
 
 Time enters as one scalar `now` per batch; all requests in a batch share it.
 """
@@ -44,6 +54,14 @@ from jax import lax
 from gubernator_tpu.core.store import (
     FLAG_ALGO_LEAKY,
     FLAG_STICKY_OVER,
+    L_DURATION,
+    L_EXPIRE,
+    L_FLAGS,
+    L_LIMIT,
+    L_REMAINING,
+    L_TAG,
+    L_TS,
+    LANES,
     Store,
     fingerprints,
     slot_indices,
@@ -91,7 +109,7 @@ def decide(
     store: Store, req: BatchRequest, now: jax.Array
 ) -> Tuple[Store, BatchResponse, BatchStats]:
     """Evaluate one padded batch. Pure; jit with donate_argnums=(0,)."""
-    rows, slots = store.tag.shape
+    rows, slots, _ = store.data.shape
     B = req.key_hash.shape[0]
     ar = jnp.arange(B)
 
@@ -99,68 +117,110 @@ def decide(
     sort_key = jnp.where(req.valid, req.key_hash, jnp.uint64(_U64_MAX))
     order = jnp.argsort(sort_key, stable=True)
     kh = req.key_hash[order]
-    h = req.hits[order]
-    lim_q = req.limit[order]
-    dur_q = req.duration[order]
-    algo = req.algo[order]
-    gnp = req.gnp[order]
-    valid = req.valid[order]
+    # one packed gather reorders all non-key request fields
+    req_stack = jnp.stack(
+        [
+            req.hits,
+            req.limit,
+            req.duration,
+            req.algo.astype(jnp.int64),
+            req.gnp.astype(jnp.int64),
+            req.valid.astype(jnp.int64),
+        ],
+        axis=-1,
+    )[order]
+    h = req_stack[:, 0]
+    lim_q = req_stack[:, 1]
+    dur_q = req_stack[:, 2]
+    algo = req_stack[:, 3]
+    gnp = req_stack[:, 4] != 0
+    valid = req_stack[:, 5] != 0
 
     same_prev = jnp.concatenate([jnp.array([False]), kh[1:] == kh[:-1]])
     is_leader = valid & ~same_prev
     leader_pos = lax.cummax(jnp.where(is_leader, ar, 0))
-    seg = jnp.cumsum(is_leader.astype(jnp.int32)) - 1  # group id, -1 before 1st
-    seg = jnp.maximum(seg, 0)
+    # last position of each group: predecessor of the next leader
+    lead_idx = jnp.where(is_leader, ar, B)
+    next_leader_incl = lax.associative_scan(
+        jnp.minimum, lead_idx, reverse=True
+    )
+    end_pos = (
+        jnp.concatenate([next_leader_incl[1:], jnp.full((1,), B, ar.dtype)])
+        - 1
+    )
 
-    def lead(x):  # broadcast a per-position value from the group leader
-        return x[leader_pos]
+    def group_reduce(*quantities):
+        """For contiguous sorted groups: per-quantity (prefix_before_j,
+        group_total) via one stacked cumsum + two gathers."""
+        m = jnp.stack([q.astype(jnp.int64) for q in quantities], axis=-1)
+        c = jnp.cumsum(m, axis=0)
+        before = c - m  # cumsum strictly before j
+        start_excl = before[leader_pos]
+        prefix = before - start_excl
+        totals = c[end_pos] - start_excl
+        return prefix, totals
 
-    def seg_any(mask):  # per-position: does any group member satisfy mask?
-        s = jax.ops.segment_sum(mask.astype(jnp.int32), seg, num_segments=B)
-        return s[seg] > 0
-
-    def seg_sum(x):  # per-position group total
-        s = jax.ops.segment_sum(x, seg, num_segments=B)
-        return s[seg]
-
-    # ---- slot lookup ------------------------------------------------------
+    # ---- slot lookup: two-stage gather ------------------------------------
+    # Stage 1 reads only the tag+expire lanes of all row candidates (match
+    # + eviction scoring); stage 2 reads full lanes for the one selected
+    # slot. Halves gather volume vs a full [rows, B, LANES] read.
     idx = slot_indices(kh, rows, slots)  # [rows, B]
-    fp = fingerprints(kh)  # [B]
+    fp = fingerprints(kh)  # [B] uint32
+    fp64 = fp.astype(jnp.int64)
     rix = jnp.arange(rows)[:, None]
-    tag_rows = store.tag[rix, idx]  # [rows, B]
-    match = tag_rows == fp[None, :]
+    g2 = store.data[..., : L_EXPIRE + 1][rix, idx]  # [rows, B, 2]
+
+    match = g2[..., L_TAG] == fp64[None, :]
     found = match.any(axis=0)
     frow = jnp.argmax(match, axis=0)  # first matching row
     fcol = jnp.take_along_axis(idx, frow[None, :], axis=0)[0]
 
-    exp_f = store.expire[frow, fcol]
-    rem_f = store.remaining[frow, fcol]
-    ts_f = store.ts[frow, fcol]
-    lim_f = store.limit[frow, fcol]
-    dur_f = store.duration[frow, fcol]
-    flg_f = store.flags[frow, fcol]
-
-    live = found & (exp_f >= now)  # lazy expiry (reference cache/lru.go:109)
-
     # eviction candidate among the `rows` choices: empty first, else earliest
     # expiry (the rate-limit analogue of LRU-oldest, see store.py docstring)
-    exp_rows = store.expire[rix, idx]
-    evict_key = jnp.where(tag_rows == 0, _I64_MIN, exp_rows)
+    evict_key = jnp.where(
+        g2[..., L_TAG] == 0, _I64_MIN, g2[..., L_EXPIRE]
+    )
     erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
     ecol = jnp.take_along_axis(idx, erow[None, :], axis=0)[0]
 
-    # ---- group-level state resolution (leader values) ---------------------
-    g_live = lead(live)
-    g_exp = lead(exp_f)
-    g_rem = lead(rem_f)
-    g_ts = lead(ts_f)
-    g_limS = lead(lim_f)
-    g_durS = lead(dur_f)
-    g_flg = lead(flg_f)
-    g_algo = lead(algo)  # leader's requested algorithm
-    g_hits = lead(h)
-    g_limQ = lead(lim_q)
-    g_durQ = lead(dur_q)
+    sel = store.data[frow, fcol]  # [B, LANES]
+    exp_f = sel[:, L_EXPIRE]
+    rem_f = sel[:, L_REMAINING]
+    ts_f = sel[:, L_TS]
+    lim_f = sel[:, L_LIMIT]
+    dur_f = sel[:, L_DURATION]
+    flg_f = sel[:, L_FLAGS]
+
+    live = found & (exp_f >= now)  # lazy expiry (reference cache/lru.go:109)
+
+    # ---- group-level state resolution: one stacked leader gather ----------
+    lead_stack = jnp.stack(
+        [
+            live.astype(jnp.int64),
+            exp_f,
+            rem_f,
+            ts_f,
+            lim_f,
+            dur_f,
+            flg_f,
+            algo.astype(jnp.int64),
+            h,
+            lim_q,
+            dur_q,
+        ],
+        axis=-1,
+    )[leader_pos]
+    g_live = lead_stack[:, 0] != 0
+    g_exp = lead_stack[:, 1]
+    g_rem = lead_stack[:, 2]
+    g_ts = lead_stack[:, 3]
+    g_limS = lead_stack[:, 4]
+    g_durS = lead_stack[:, 5]
+    g_flg = lead_stack[:, 6]
+    g_algo = lead_stack[:, 7]
+    g_hits = lead_stack[:, 8]
+    g_limQ = lead_stack[:, 9]
+    g_durQ = lead_stack[:, 10]
 
     stored_leaky = (g_flg & FLAG_ALGO_LEAKY) != 0
     req_leaky = g_algo == 1
@@ -208,8 +268,10 @@ def decide(
     viable = valid & ~gnp_served & ~leaky_zero
     eligible = viable & (h > 0) & (h <= R0)
     inc = jnp.where(eligible & ~is_creation_leader, h, 0)
-    c = jnp.cumsum(inc)
-    S = (c - inc) - lead(c - inc)  # same-key hits attempted before j
+    prefix1, totals1 = group_reduce(inc, viable & (h != 0))
+    S = prefix1[:, 0]
+    any_hits = totals1[:, 1] > 0
+
     charged = eligible & ~is_creation_leader & (S + h <= R0)
     charged = charged | (is_creation_leader & charged_ldr)
     rem_b = jnp.maximum(R0 - S, 0)  # budget visible to j
@@ -217,13 +279,19 @@ def decide(
     # Real (charged-only) depletion prefix: refused duplicates inflate S but
     # consume nothing, so persistence decisions must not use S.
     inc_chg = jnp.where(charged & ~is_creation_leader, h, 0)
-    c_chg = jnp.cumsum(inc_chg)
-    S_chg = (c_chg - inc_chg) - lead(c_chg - inc_chg)
-
     # sticky status observed by j: a request that arrives when remaining is
     # actually 0 flips the cached token status to OVER_LIMIT persistently
-    # (algorithms.go:41-44)
+    # (algorithms.go:41-44); leaky expiry refreshes only on a strict-
+    # decrement charge (oracle divergence-1 rule; algorithms.go:157)
+    decr = charged & ~is_creation_leader & (rem_b - h > 0)
+    prefix2, totals2 = group_reduce(inc_chg, decr)
+    S_chg = prefix2[:, 0]
+    total_charged = totals2[:, 0]
+    any_decr = totals2[:, 1] > 0
+
     z = viable & ~eff_leaky & (R0 - S_chg == 0) & ~is_creation_leader
+    _, totals3 = group_reduce(z)
+    any_z = totals3[:, 0] > 0
     sticky_live = sticky0 | (same_prev & _shift1(z, False))
 
     # ---- responses --------------------------------------------------------
@@ -278,16 +346,10 @@ def decide(
     reset = jnp.where(leaky_zero, now + g_durS, reset)
     resp_limit = jnp.where(leaky_zero, lim_q, g_lim_resp)
 
-    # ---- state writeback (one scatter per plane, leaders only) ------------
-    total_charged = seg_sum(jnp.where(charged & ~is_creation_leader, h, 0))
+    # ---- state writeback: one packed scatter (leaders only) ---------------
     rem_final = R0 - total_charged
 
-    any_hits = seg_any(viable & (h != 0))
-    # leaky expiry refresh only on a strict-decrement charge (matches the
-    # oracle's divergence-1 rule; reference algorithms.go:157)
-    any_decr = seg_any(charged & ~is_creation_leader & (rem_b - h > 0))
-
-    sticky_final = sticky0 | seg_any(z)
+    sticky_final = sticky0 | any_z
 
     w_leaky = eff_leaky
     new_expire = jnp.where(
@@ -299,13 +361,13 @@ def decide(
         ),
         g_expire_new,
     )
-    new_ts = jnp.where(
-        existing & w_leaky & ~any_hits, g_ts, now
-    )
+    new_ts = jnp.where(existing & w_leaky & ~any_hits, g_ts, now)
     new_limit = jnp.where(existing, g_limS, g_limQ)
     new_duration = jnp.where(existing, g_durS, g_durQ)
-    new_flags = jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0).astype(jnp.int32) | (
-        jnp.where(~w_leaky & sticky_final, FLAG_STICKY_OVER, 0).astype(jnp.int32)
+    new_flags = jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0).astype(jnp.int64) | (
+        jnp.where(~w_leaky & sticky_final, FLAG_STICKY_OVER, 0).astype(
+            jnp.int64
+        )
     )
 
     # Groups served entirely from a replica write back identical values
@@ -317,34 +379,37 @@ def decide(
     sc_row = jnp.where(w_mask, wrow, 0)
     sc_col = jnp.where(w_mask, wcol, slots)  # out-of-range -> dropped
 
-    def scat(plane, val):
-        return plane.at[sc_row, sc_col].set(val, mode="drop")
+    new_vals = jnp.stack(
+        [
+            fp64,
+            new_expire,
+            rem_final,
+            new_ts,
+            new_limit,
+            new_duration,
+            new_flags,
+            jnp.zeros_like(fp64),
+        ],
+        axis=-1,
+    )  # [B, LANES]
+    new_data = store.data.at[sc_row, sc_col].set(new_vals, mode="drop")
 
-    new_store = Store(
-        tag=scat(store.tag, fp),
-        expire=scat(store.expire, new_expire),
-        remaining=scat(store.remaining, rem_final),
-        ts=scat(store.ts, new_ts),
-        limit=scat(store.limit, new_limit),
-        duration=scat(store.duration, new_duration),
-        flags=scat(store.flags, new_flags),
+    # ---- unsort: one packed scatter ---------------------------------------
+    resp_stack = jnp.stack(
+        [status.astype(jnp.int64), resp_limit, remaining, reset], axis=-1
     )
-
-    # ---- unsort -----------------------------------------------------------
-    def unsort(x):
-        return jnp.zeros_like(x).at[order].set(x)
-
+    unsorted = jnp.zeros_like(resp_stack).at[order].set(resp_stack)
     resp = BatchResponse(
-        status=unsort(status.astype(jnp.int32)),
-        limit=unsort(resp_limit.astype(jnp.int64)),
-        remaining=unsort(remaining.astype(jnp.int64)),
-        reset_time=unsort(reset.astype(jnp.int64)),
+        status=unsorted[:, 0].astype(jnp.int32),
+        limit=unsorted[:, 1],
+        remaining=unsorted[:, 2],
+        reset_time=unsorted[:, 3],
     )
     stats = BatchStats(
         hits=jnp.sum(jnp.where(is_leader & g_live, 1, 0)).astype(jnp.int64),
         misses=jnp.sum(jnp.where(is_leader & ~g_live, 1, 0)).astype(jnp.int64),
     )
-    return new_store, resp, stats
+    return Store(data=new_data), resp, stats
 
 
 def upsert_globals(
@@ -359,18 +424,18 @@ def upsert_globals(
     """Install owner-broadcast GLOBAL statuses as local replica entries —
     the receive side of UpdatePeerGlobals (reference gubernator.go:199-207,
     cache.Add of a token-typed status with expiry = reset_time)."""
-    rows, slots = store.tag.shape
+    rows, slots, _ = store.data.shape
 
     idx = slot_indices(key_hash, rows, slots)
-    fp = fingerprints(key_hash)
+    fp64 = fingerprints(key_hash).astype(jnp.int64)
     rix = jnp.arange(rows)[:, None]
-    tag_rows = store.tag[rix, idx]
-    match = tag_rows == fp[None, :]
+    g = store.data[rix, idx]
+
+    match = g[..., L_TAG] == fp64[None, :]
     found = match.any(axis=0)
     frow = jnp.argmax(match, axis=0)
 
-    exp_rows = store.expire[rix, idx]
-    evict_key = jnp.where(tag_rows == 0, _I64_MIN, exp_rows)
+    evict_key = jnp.where(g[..., L_TAG] == 0, _I64_MIN, g[..., L_EXPIRE])
     erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
 
     wrow = jnp.where(found, frow, erow)
@@ -378,19 +443,14 @@ def upsert_globals(
     sc_row = jnp.where(valid, wrow, 0)
     sc_col = jnp.where(valid, wcol, slots)
 
-    def scat(plane, val):
-        return plane.at[sc_row, sc_col].set(val, mode="drop")
-
     zero = jnp.zeros_like(limit)
-    flags = jnp.where(is_over, FLAG_STICKY_OVER, 0).astype(jnp.int32)
+    flags = jnp.where(is_over, FLAG_STICKY_OVER, 0).astype(jnp.int64)
+    new_vals = jnp.stack(
+        [fp64, reset_time, remaining, zero, limit, zero, flags, zero],
+        axis=-1,
+    )
     return Store(
-        tag=scat(store.tag, fp),
-        expire=scat(store.expire, reset_time),
-        remaining=scat(store.remaining, remaining),
-        ts=scat(store.ts, zero),
-        limit=scat(store.limit, limit),
-        duration=scat(store.duration, zero),
-        flags=scat(store.flags, flags),
+        data=store.data.at[sc_row, sc_col].set(new_vals, mode="drop")
     )
 
 

