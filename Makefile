@@ -1,0 +1,18 @@
+.PHONY: all native proto test bench clean
+
+all: native proto
+
+native:
+	$(MAKE) -C gubernator_tpu/native
+
+proto:
+	./scripts/gen_protos.sh
+
+test:
+	python -m pytest tests/ -x -q
+
+bench:
+	python bench.py
+
+clean:
+	$(MAKE) -C gubernator_tpu/native clean

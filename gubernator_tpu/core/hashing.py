@@ -28,18 +28,21 @@ def ring_hash(key: str) -> int:
     return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
 
 
-def slot_hash(key: str) -> int:
-    """Stable 64-bit hash of a key (Python fallback path)."""
+def _slot_hash_py(key: str) -> int:
+    """Pure-Python fallback 64-bit hash (blake2b-8)."""
     return int.from_bytes(
         hashlib.blake2b(key.encode("utf-8"), digest_size=8).digest(), "little"
     )
 
 
 def _slot_hash_batch_py(keys: Iterable[str]) -> np.ndarray:
-    return np.array([slot_hash(k) for k in keys], dtype=np.uint64)
+    return np.array([_slot_hash_py(k) for k in keys], dtype=np.uint64)
 
 
-# The native batch hasher is loaded lazily; see gubernator_tpu.native.
+# The native batch hasher (XXH64, gubernator_tpu/native) is loaded lazily.
+# Native and fallback produce different hash values; that is fine — slot
+# hashes are local to one process's store — but one process must use ONE
+# implementation consistently, which the lazy singleton guarantees.
 _native_batch = None
 _native_checked = False
 
@@ -52,7 +55,7 @@ def _load_native():
     try:
         from gubernator_tpu.native import hashlib_native
 
-        _native_batch = hashlib_native.blake2b64_batch
+        _native_batch = hashlib_native.hash_batch
     except Exception:
         _native_batch = None
 
@@ -63,6 +66,11 @@ def slot_hash_batch(keys: List[str]) -> np.ndarray:
     if _native_batch is not None:
         return _native_batch(keys)
     return _slot_hash_batch_py(keys)
+
+
+def slot_hash(key: str) -> int:
+    """64-bit slot hash of one key (same implementation as the batch path)."""
+    return int(slot_hash_batch([key])[0])
 
 
 def mix64(x: np.ndarray) -> np.ndarray:

@@ -429,13 +429,14 @@ def upsert_globals(
     idx = slot_indices(key_hash, rows, slots)
     fp64 = fingerprints(key_hash).astype(jnp.int64)
     rix = jnp.arange(rows)[:, None]
-    g = store.data[rix, idx]
+    # slots are fully overwritten, so only tag+expire lanes are read
+    g2 = store.data[..., : L_EXPIRE + 1][rix, idx]
 
-    match = g[..., L_TAG] == fp64[None, :]
+    match = g2[..., L_TAG] == fp64[None, :]
     found = match.any(axis=0)
     frow = jnp.argmax(match, axis=0)
 
-    evict_key = jnp.where(g[..., L_TAG] == 0, _I64_MIN, g[..., L_EXPIRE])
+    evict_key = jnp.where(g2[..., L_TAG] == 0, _I64_MIN, g2[..., L_EXPIRE])
     erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
 
     wrow = jnp.where(found, frow, erow)

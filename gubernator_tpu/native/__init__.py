@@ -1,0 +1,14 @@
+"""Native (C++) components, loaded via ctypes with graceful fallback.
+
+The reference has no native components (pure Go, CGO_ENABLED=0); the ones
+here exist because Python — unlike Go — can't hash millions of keys per
+second per core, and host-side hashing sits on the serving hot path.
+
+`hashlib_native` exposes:
+- hash_batch(keys: list[str]) -> np.ndarray[uint64]   (XXH64)
+- crc32_batch(keys: list[str]) -> np.ndarray[uint32]  (ring points)
+
+Build with `make -C gubernator_tpu/native` (repo Makefile does this).
+Import fails cleanly when the .so is absent; callers
+(core/hashing.slot_hash_batch) fall back to pure Python.
+"""

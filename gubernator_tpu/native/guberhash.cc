@@ -1,0 +1,151 @@
+// Batch 64-bit key hashing for the serving hot path.
+//
+// The serving tier hashes every request key (name + "_" + unique_key) to a
+// 64-bit slot hash before shipping the batch to the device. In Python this
+// costs ~1us/key (hashlib call overhead); at millions of decisions per
+// second host hashing would dominate, so the batch loop lives here. The
+// Python side passes one concatenated byte buffer plus an offsets array and
+// receives a uint64 array — one FFI call per batch, no per-key overhead.
+//
+// Hash: XXH64 (Yann Collet's public-domain algorithm, implemented from the
+// spec). 64-bit avalanche quality is what the slot store needs: row
+// indices and the fingerprint tag are all derived from this one value
+// (gubernator_tpu/core/store.py slot_indices/fingerprints).
+//
+// Build: make -C gubernator_tpu/native   (or scripts in repo Makefile)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint64_t P1 = 0x9E3779B185EBCA87ULL;
+constexpr uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+constexpr uint64_t P3 = 0x165667B19E3779F9ULL;
+constexpr uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+constexpr uint64_t P5 = 0x27D4EB2F165667C5ULL;
+
+inline uint64_t rotl(uint64_t x, int r) {
+  return (x << r) | (x >> (64 - r));
+}
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86-64 / arm64)
+}
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t round1(uint64_t acc, uint64_t lane) {
+  acc += lane * P2;
+  acc = rotl(acc, 31);
+  return acc * P1;
+}
+
+inline uint64_t merge_round(uint64_t acc, uint64_t val) {
+  acc ^= round1(0, val);
+  return acc * P1 + P4;
+}
+
+uint64_t xxh64(const uint8_t* data, size_t len, uint64_t seed) {
+  const uint8_t* p = data;
+  const uint8_t* const end = data + len;
+  uint64_t h;
+
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2;
+    uint64_t v2 = seed + P2;
+    uint64_t v3 = seed;
+    uint64_t v4 = seed - P1;
+    const uint8_t* const limit = end - 32;
+    do {
+      v1 = round1(v1, read64(p));
+      v2 = round1(v2, read64(p + 8));
+      v3 = round1(v3, read64(p + 16));
+      v4 = round1(v4, read64(p + 24));
+      p += 32;
+    } while (p <= limit);
+    h = rotl(v1, 1) + rotl(v2, 7) + rotl(v3, 12) + rotl(v4, 18);
+    h = merge_round(h, v1);
+    h = merge_round(h, v2);
+    h = merge_round(h, v3);
+    h = merge_round(h, v4);
+  } else {
+    h = seed + P5;
+  }
+
+  h += static_cast<uint64_t>(len);
+
+  while (p + 8 <= end) {
+    h ^= round1(0, read64(p));
+    h = rotl(h, 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h ^= static_cast<uint64_t>(read32(p)) * P1;
+    h = rotl(h, 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h ^= static_cast<uint64_t>(*p) * P5;
+    h = rotl(h, 11) * P1;
+    ++p;
+  }
+
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Hash n byte-slices of one concatenated buffer. offsets has n+1 entries;
+// slice i is buf[offsets[i] : offsets[i+1]].
+void guber_hash_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                      uint64_t seed, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] =
+        xxh64(buf + offsets[i],
+              static_cast<size_t>(offsets[i + 1] - offsets[i]), seed);
+  }
+}
+
+// crc32 (IEEE, reflected) batch — ring points for peer ownership, matching
+// the reference picker's hash function (reference hash.go:40-42).
+static uint32_t crc_table[256];
+static bool crc_init_done = false;
+
+static void crc_init() {
+  for (uint32_t i = 0; i < 256; ++i) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; ++k) {
+      c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+    }
+    crc_table[i] = c;
+  }
+  crc_init_done = true;
+}
+
+void guber_crc32_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
+                       uint32_t* out) {
+  if (!crc_init_done) crc_init();
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t c = 0xFFFFFFFFu;
+    for (int64_t j = offsets[i]; j < offsets[i + 1]; ++j) {
+      c = crc_table[(c ^ buf[j]) & 0xFF] ^ (c >> 8);
+    }
+    out[i] = c ^ 0xFFFFFFFFu;
+  }
+}
+
+}  // extern "C"

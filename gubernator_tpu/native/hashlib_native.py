@@ -1,0 +1,66 @@
+"""ctypes bindings for libguberhash.so (see guberhash.cc)."""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+from typing import List
+
+import numpy as np
+
+_SO = pathlib.Path(__file__).resolve().parent / "libguberhash.so"
+if not _SO.exists():
+    raise ImportError(f"native hash library not built: {_SO}")
+
+_lib = ctypes.CDLL(str(_SO))
+_lib.guber_hash_batch.argtypes = [
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int64,
+    ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_uint64),
+]
+_lib.guber_crc32_batch.argtypes = [
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_int64),
+    ctypes.c_int64,
+    ctypes.POINTER(ctypes.c_uint32),
+]
+
+# Fixed seed: slot hashes are instance-local but stable across restarts for
+# debuggability.
+_SEED = 0x67756265726E6174  # "gubernat"
+
+
+def _pack(keys: List[str]):
+    bufs = [k.encode("utf-8") for k in keys]
+    offsets = np.zeros(len(bufs) + 1, np.int64)
+    np.cumsum([len(b) for b in bufs], out=offsets[1:])
+    return b"".join(bufs), offsets
+
+
+def hash_batch(keys: List[str]) -> np.ndarray:
+    """uint64[len(keys)] XXH64 slot hashes."""
+    buf, offsets = _pack(keys)
+    out = np.empty(len(keys), np.uint64)
+    _lib.guber_hash_batch(
+        buf,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(keys),
+        _SEED,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return out
+
+
+def crc32_batch(keys: List[str]) -> np.ndarray:
+    """uint32[len(keys)] IEEE crc32 ring points (matches zlib.crc32)."""
+    buf, offsets = _pack(keys)
+    out = np.empty(len(keys), np.uint32)
+    _lib.guber_crc32_batch(
+        buf,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(keys),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out

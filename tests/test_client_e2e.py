@@ -1,0 +1,72 @@
+"""End-to-end test through a subprocess cluster.
+
+The analogue of the reference's Python client e2e fixture
+(reference python/tests/test_client.py): launch the standalone cluster
+entry point as a subprocess, wait for "Ready" on stdout, then exercise
+health checks and rate limits over real sockets from a different process.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import Algorithm, RateLimitReq, Status, SECOND
+from gubernator_tpu.client import V1Client
+
+
+@pytest.fixture(scope="module")
+def cluster_proc():
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.cluster_main"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+        text=True,
+        env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    deadline = time.monotonic() + 60
+    line = ""
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if "Ready" in line:
+            break
+        if proc.poll() is not None:
+            pytest.fail(f"cluster process died (rc={proc.returncode})")
+    else:
+        proc.kill()
+        pytest.fail("cluster did not print Ready in time")
+    yield proc
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def test_health_check(cluster_proc):
+    with V1Client("127.0.0.1:9090") as client:
+        h = client.health_check(timeout=5)
+    assert h.status == "healthy"
+    assert h.peer_count == 6
+
+
+def test_get_rate_limit(cluster_proc):
+    with V1Client("127.0.0.1:9091") as client:
+        reqs = [
+            RateLimitReq(
+                name="test_e2e",
+                unique_key="account:1234",
+                algorithm=Algorithm.TOKEN_BUCKET,
+                duration=SECOND * 2,
+                limit=10,
+                hits=1,
+            )
+        ]
+        rl = client.get_rate_limits(reqs, timeout=10)[0]
+        assert rl.error == ""
+        assert rl.status == Status.UNDER_LIMIT
+        assert rl.remaining == 9
+        rl = client.get_rate_limits(reqs, timeout=10)[0]
+        assert rl.remaining == 8

@@ -1,0 +1,79 @@
+"""Config layer tests (env parsing, config file injection, validation)."""
+
+import pytest
+
+from gubernator_tpu.serve.config import (
+    BehaviorConfig,
+    ServerConfig,
+    config_from_env,
+    load_config_file,
+)
+
+
+def test_defaults_match_reference():
+    # reference config.go:59-75
+    b = BehaviorConfig()
+    assert b.batch_timeout == 0.5
+    assert b.batch_wait == 0.0005
+    assert b.batch_limit == 1000
+    assert b.global_timeout == 0.5
+    assert b.global_sync_wait == 0.0005
+    assert b.global_batch_limit == 1000
+
+
+def test_env_parsing():
+    env = {
+        "GUBER_GRPC_ADDRESS": "0.0.0.0:1234",
+        "GUBER_HTTP_ADDRESS": "0.0.0.0:1235",
+        "GUBER_BATCH_WAIT_MS": "2",
+        "GUBER_BATCH_LIMIT": "500",
+        "GUBER_PEERS": "a:1, b:2 ,c:3",
+        "GUBER_BACKEND": "exact",
+        "GUBER_CACHE_SIZE": "123",
+        "GUBER_DEBUG": "true",
+    }
+    conf = config_from_env(env)
+    assert conf.grpc_address == "0.0.0.0:1234"
+    assert conf.behaviors.batch_wait == 0.002
+    assert conf.behaviors.batch_limit == 500
+    assert conf.peers == ["a:1", "b:2", "c:3"]
+    assert conf.backend == "exact"
+    assert conf.cache_size == 123
+    assert conf.debug is True
+
+
+def test_batch_limit_cap():
+    with pytest.raises(ValueError):
+        config_from_env({"GUBER_BATCH_LIMIT": "5000"})
+
+
+def test_etcd_k8s_mutual_exclusion():
+    with pytest.raises(ValueError):
+        config_from_env(
+            {
+                "GUBER_ETCD_ENDPOINTS": "localhost:2379",
+                "GUBER_K8S_ENDPOINTS_SELECTOR": "app=x",
+            }
+        )
+
+
+def test_config_file_injection(tmp_path):
+    # reference cmd/gubernator/config.go:239-267
+    f = tmp_path / "test.conf"
+    f.write_text(
+        "# comment\n"
+        "\n"
+        "GUBER_GRPC_ADDRESS=127.0.0.1:7777\n"
+        "GUBER_BACKEND = exact \n"
+    )
+    env = load_config_file(str(f), env={})
+    conf = config_from_env(env)
+    assert conf.grpc_address == "127.0.0.1:7777"
+    assert conf.backend == "exact"
+
+
+def test_config_file_malformed(tmp_path):
+    f = tmp_path / "bad.conf"
+    f.write_text("not a kv line\n")
+    with pytest.raises(ValueError):
+        load_config_file(str(f), env={})

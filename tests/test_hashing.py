@@ -1,0 +1,48 @@
+"""Hashing tests: ring parity with the reference and native/fallback paths."""
+
+import zlib
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.core import hashing
+
+
+def test_ring_hash_is_crc32_ieee():
+    # the reference picker defaults to crc32.ChecksumIEEE (hash.go:40-42);
+    # placement compatibility requires the identical function
+    for s in ["127.0.0.1:81", "test_account:1234", ""]:
+        assert hashing.ring_hash(s) == zlib.crc32(s.encode())
+
+
+def test_slot_hash_batch_consistent_with_single():
+    keys = [f"k:{i}" for i in range(100)]
+    batch = hashing.slot_hash_batch(keys)
+    assert batch.dtype == np.uint64
+    for i in (0, 17, 99):
+        assert hashing.slot_hash(keys[i]) == int(batch[i])
+
+
+def test_slot_hash_no_trivial_collisions():
+    keys = [f"name_{i}_account:{i}" for i in range(50_000)]
+    h = hashing.slot_hash_batch(keys)
+    assert len(set(h.tolist())) == len(keys)
+
+
+def test_native_matches_known_xxh64_vectors():
+    hashlib_native = pytest.importorskip(
+        "gubernator_tpu.native.hashlib_native",
+        reason="native hash library not built (make -C gubernator_tpu/native)",
+    )
+    # crc batch parity with zlib
+    keys = ["a", "abc", "gubernator_tpu", ""]
+    crc = hashlib_native.crc32_batch(keys)
+    for i, k in enumerate(keys):
+        assert int(crc[i]) == zlib.crc32(k.encode())
+
+
+def test_mix64_avalanche():
+    x = np.arange(1, 10_000, dtype=np.uint64)
+    mixed = hashing.mix64(x)
+    # sequential inputs must not produce sequential outputs
+    assert len(set((mixed % np.uint64(1024)).tolist())) > 600
