@@ -58,25 +58,25 @@ def main():
     )
     reqs = BatchRequest(
         key_hash=jnp.asarray(key_hash),
-        hits=jnp.ones((R, B), jnp.int64),
-        limit=jnp.asarray(rng.integers(10, 10_000, (R, B)), jnp.int64),
-        duration=jnp.full((R, B), 60_000, jnp.int64),
+        hits=jnp.ones((R, B), jnp.int32),
+        limit=jnp.asarray(rng.integers(10, 10_000, (R, B)), jnp.int32),
+        duration=jnp.full((R, B), 60_000, jnp.int32),
         algo=jnp.asarray(zipf % 2, jnp.int32),  # per-key stable algorithm
         gnp=jnp.zeros((R, B), bool),
         valid=jnp.ones((R, B), bool),
     )
-    t0 = jnp.int64(1_700_000_000_000)
+    t0 = jnp.int32(1000)  # engine-ms (epoch-relative; see core.store)
 
     def steps(store, reqs):
         def body(i, carry):
             store, acc = carry
             r = jax.tree.map(lambda x: x[i % R], reqs)
-            now = t0 + i.astype(jnp.int64)  # clock advances 1ms per batch
+            now = t0 + i  # clock advances 1ms per batch
             store, resp, _ = decide(store, r, now)
-            return store, acc + jnp.sum(resp.status)
+            return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
 
         return lax.fori_loop(
-            0, S, body, (store, jnp.zeros((), jnp.int64))
+            0, S, body, (store, jnp.zeros((), jnp.int32))
         )
 
     stepped = jax.jit(steps, donate_argnums=(0,))

@@ -6,6 +6,11 @@ fixed bucket sizes so XLA compiles a handful of programs once, runs the
 jitted kernel with the store donated (in-place HBM update, no copies), and
 converts decisions back.
 
+The public API speaks int64 unix-ms and int64 counters (the reference's
+wire types); this layer owns the translation into the device's int32
+envelope — epoch-relative engine-ms via EpochClock, saturating counter
+clamps — documented in core.store.
+
 Thread model: not thread-safe by design; all access is funneled through one
 serving thread/event loop, the same discipline the reference imposes with
 its cache mutex (reference gubernator.go:237-238) but without per-request
@@ -31,11 +36,81 @@ from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.kernels import (
     BatchRequest,
     decide_jit,
+    rebase_jit,
     upsert_globals_jit,
 )
-from gubernator_tpu.core.store import Store, StoreConfig, new_store
+from gubernator_tpu.core.store import (
+    COUNTER_MAX,
+    MAX_DURATION_MS,
+    REBASE_AT,
+    TIME_FLOOR,
+    Store,
+    StoreConfig,
+    new_store,
+)
 
 DEFAULT_BUCKETS = (64, 256, 1024, 4096)
+
+_I32_SAT = COUNTER_MAX
+
+
+def _sat_i32(x: np.ndarray) -> np.ndarray:
+    """Saturate int64 counters into int32 (documented divergence: values
+    beyond ~2.1e9 clamp; see core.store docstring)."""
+    return np.clip(np.asarray(x, np.int64), -_I32_SAT, _I32_SAT).astype(
+        np.int32
+    )
+
+
+def _sat_duration(x: np.ndarray) -> np.ndarray:
+    return np.clip(np.asarray(x, np.int64), TIME_FLOOR, MAX_DURATION_MS).astype(
+        np.int32
+    )
+
+
+class EpochClock:
+    """Maps int64 unix-ms to the store's int32 engine-ms envelope.
+
+    The epoch pins engine-ms 0; `advance` returns now as engine-ms plus a
+    rebase delta once offsets exceed 2^30 (~12.4 days of uptime), which
+    the caller applies to the store with one elementwise pass
+    (store.rebase): stored times shift down by delta and entries already
+    past the new epoch clamp to TIME_FLOOR, i.e. expire naturally. Only
+    jumps rebase cannot represent surface as reset_required — a forward
+    jump past int32 range (> ~24.8 days in one step, no window survives
+    it anyway) or a backward jump past REBASE_AT (shifting up could clamp
+    entries into the far future, making them immortal) — matching the
+    reference's state-loss-on-restart contract."""
+
+    def __init__(self):
+        self.epoch: Optional[int] = None
+
+    def advance(self, now: int) -> Tuple[np.int32, Optional[int], bool]:
+        """Returns (engine_now, rebase_delta, reset_required)."""
+        now = int(now)
+        if self.epoch is None:
+            self.epoch = now
+        e = now - self.epoch
+        if 0 <= e <= REBASE_AT:
+            return np.int32(e), None, False
+        self.epoch = now
+        if -REBASE_AT < e <= _I32_SAT:
+            return np.int32(0), e, False
+        return np.int32(0), None, True
+
+    def to_engine(self, t) -> np.ndarray:
+        """int64 unix-ms (vector) -> int32 engine-ms, clamped."""
+        assert self.epoch is not None
+        return np.clip(
+            np.asarray(t, np.int64) - self.epoch, TIME_FLOOR, _I32_SAT
+        ).astype(np.int32)
+
+    def from_engine(self, t32) -> np.ndarray:
+        """int32 engine-ms -> int64 unix-ms; 0 passes through as the
+        'no reset' sentinel (leaky UNDER_LIMIT, algorithms.go:123-174)."""
+        assert self.epoch is not None
+        t = np.asarray(t32, np.int64)
+        return np.where(t == 0, 0, t + self.epoch)
 
 
 def choose_bucket(buckets: Sequence[int], n: int) -> int:
@@ -70,7 +145,8 @@ def pad_request(
     gnp: np.ndarray,
 ) -> BatchRequest:
     """Pad request arrays to a fixed bucket size with a validity mask, so
-    XLA compiles one program per bucket instead of one per batch size."""
+    XLA compiles one program per bucket instead of one per batch size.
+    Saturates the wire's int64 counters into the device's int32 envelope."""
     n = key_hash.shape[0]
     B = choose_bucket(buckets, n)
 
@@ -83,9 +159,9 @@ def pad_request(
     valid[:n] = True
     return BatchRequest(
         key_hash=pad(key_hash, np.uint64),
-        hits=pad(hits, np.int64),
-        limit=pad(limit, np.int64),
-        duration=pad(duration, np.int64),
+        hits=pad(_sat_i32(hits), np.int32),
+        limit=pad(_sat_i32(limit), np.int32),
+        duration=pad(_sat_duration(duration), np.int32),
         algo=pad(algo, np.int32),
         gnp=pad(gnp, bool),
         valid=valid,
@@ -114,6 +190,7 @@ class TpuEngine:
         self.config = config
         self.buckets = sorted(buckets)
         self.device = device
+        self.clock = EpochClock()
         store = new_store(config)
         if device is not None:
             store = jax.device_put(store, device)
@@ -158,6 +235,14 @@ class TpuEngine:
             for i in range(n)
         ]
 
+    def _engine_now(self, now: int) -> np.int32:
+        e, delta, reset_required = self.clock.advance(now)
+        if reset_required:
+            self.reset()
+        elif delta is not None:
+            self.store = rebase_jit(self.store, np.int32(delta))
+        return e
+
     def decide_arrays(
         self,
         key_hash: np.ndarray,
@@ -168,42 +253,53 @@ class TpuEngine:
         gnp: np.ndarray,
         now: int,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Array-level entry point (also used by the benchmark harness)."""
+        """Array-level entry point (also used by the benchmark harness).
+        Times in/out are int64 unix-ms; conversion happens here."""
         n = key_hash.shape[0]
+        e_now = self._engine_now(now)
         req = pad_request(
             self.buckets, key_hash, hits, limit, duration, algo, gnp
         )
-        self.store, resp, bstats = decide_jit(
-            self.store, req, np.int64(now)
-        )
+        self.store, resp, bstats = decide_jit(self.store, req, e_now)
         self.stats.hits += int(bstats.hits)
         self.stats.misses += int(bstats.misses)
         self.stats.batches += 1
         status, rlimit, remaining, reset = jax.device_get(
             (resp.status, resp.limit, resp.remaining, resp.reset_time)
         )
+        reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
 
     def update_globals(
-        self, updates: Sequence[Tuple[str, RateLimitResp]]
+        self, updates: Sequence[Tuple[str, RateLimitResp]], now: Optional[int] = None
     ) -> None:
         """Install owner-broadcast GLOBAL statuses (UpdatePeerGlobals
         receive path, reference gubernator.go:199-207)."""
         n = len(updates)
         if n == 0:
             return
+        if now is None:
+            now = millisecond_now()
+        self._engine_now(now)  # pin/refresh the epoch
         hashes, limit, remaining, reset, over, valid = pad_to_bucket(
             self.buckets,
             n,
             (slot_hash_batch([k for k, _ in updates]), np.uint64),
-            (np.fromiter((s.limit for _, s in updates), np.int64, n), np.int64),
             (
-                np.fromiter((s.remaining for _, s in updates), np.int64, n),
-                np.int64,
+                _sat_i32(np.fromiter((s.limit for _, s in updates), np.int64, n)),
+                np.int32,
             ),
             (
-                np.fromiter((s.reset_time for _, s in updates), np.int64, n),
-                np.int64,
+                _sat_i32(
+                    np.fromiter((s.remaining for _, s in updates), np.int64, n)
+                ),
+                np.int32,
+            ),
+            (
+                self.clock.to_engine(
+                    np.fromiter((s.reset_time for _, s in updates), np.int64, n)
+                ),
+                np.int32,
             ),
             (
                 np.fromiter(
@@ -232,7 +328,8 @@ class TpuEngine:
             # the GLOBAL replica-install path is a separate XLA program and
             # must not pay jit time inside a broadcast RPC deadline either
             self.update_globals(
-                [(f"warmup:{i}", RateLimitResp(limit=1)) for i in range(b)]
+                [(f"warmup:{i}", RateLimitResp(limit=1)) for i in range(b)],
+                now=now,
             )
         # reset state and counters dirtied by warmup traffic
         self.reset()

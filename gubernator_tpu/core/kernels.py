@@ -10,15 +10,18 @@ cluster-hot-path lock (reference gubernator.go:237) disappears — a batch
 is one XLA program.
 
 Data-movement design (the performance core):
-- Lookup is a two-stage gather on the packed store: tag+expire lanes of
-  all row candidates ([rows, B, 2], for matching and eviction scoring),
-  then full lanes of the one selected slot ([B, LANES]); ONE scatter of
-  [B, LANES] writes back. Measured ~6-9x faster on v5e than per-field
-  planes.
-- All per-group reductions (prefix sums, group totals, any-flags) are
-  cumsum + two small gathers over the sort-contiguous groups — no
-  segment_sum scatters.
-- Leader-broadcast values ride a single stacked [B, K] gather.
+- All state and arithmetic is int32 (native on TPU; int64 is emulated and
+  measured 2-10x slower for these gather/scatter/scan shapes). Time is
+  epoch-relative engine-ms — see core.store docstring for the envelope.
+- Lookup is ONE gather of the full lanes of every row candidate
+  ([rows, B, LANES]); row selection afterwards is pure vector selects.
+  ONE scatter of [B, LANES] writes back.
+- Per-group hit sums use a *segmented saturating* associative scan:
+  segment flags reset at group leaders, and the add saturates at int32
+  max so refused oversized hits can never wrap (saturation only engages
+  when the true sum already exceeds any representable budget, where
+  refusal is the correct answer regardless). Boolean group reductions ride
+  plain int32 cumsums.
 
 Intra-batch duplicate keys
 --------------------------
@@ -39,7 +42,8 @@ ordering is scheduler-dependent, any such consistent order is within its
 observable envelope. Same-batch duplicates with *different* algorithms or
 behaviors resolve with group-leader (first in batch order) semantics.
 
-Time enters as one scalar `now` per batch; all requests in a batch share it.
+Time enters as one int32 engine-ms scalar `now` per batch; all requests in
+a batch share it.
 """
 
 from __future__ import annotations
@@ -64,13 +68,15 @@ from gubernator_tpu.core.store import (
     LANES,
     Store,
     fingerprints,
+    rebase,
     slot_indices,
 )
 
 UNDER = 0
 OVER = 1
 
-_I64_MIN = jnp.iinfo(jnp.int64).min
+_I32_MIN = jnp.iinfo(jnp.int32).min
+_I32_MAX = jnp.iinfo(jnp.int32).max
 _U64_MAX = (1 << 64) - 1
 
 
@@ -78,9 +84,9 @@ class BatchRequest(NamedTuple):
     """Device-side request batch; all arrays are [B]."""
 
     key_hash: jax.Array  # uint64
-    hits: jax.Array  # int64
-    limit: jax.Array  # int64
-    duration: jax.Array  # int64 (ms)
+    hits: jax.Array  # int32 (host-saturated from the wire's int64)
+    limit: jax.Array  # int32
+    duration: jax.Array  # int32 (engine-clamped ms, <= MAX_DURATION_MS)
     algo: jax.Array  # int32: 0 token, 1 leaky
     gnp: jax.Array  # bool: GLOBAL non-owner replica read (gubernator.go:173-195)
     valid: jax.Array  # bool: padding mask
@@ -90,28 +96,56 @@ class BatchResponse(NamedTuple):
     """Device-side response batch; all arrays are [B]."""
 
     status: jax.Array  # int32
-    limit: jax.Array  # int64
-    remaining: jax.Array  # int64
-    reset_time: jax.Array  # int64
+    limit: jax.Array  # int32
+    remaining: jax.Array  # int32
+    reset_time: jax.Array  # int32 engine-ms (0 = no reset, leaky UNDER)
 
 
 class BatchStats(NamedTuple):
-    hits: jax.Array  # int64 scalar: groups answered from live state
-    misses: jax.Array  # int64 scalar: groups created/recreated
+    hits: jax.Array  # int32 scalar: groups answered from live state
+    misses: jax.Array  # int32 scalar: groups created/recreated
 
 
 def _shift1(x: jax.Array, fill) -> jax.Array:
     """x shifted right by one along axis 0, with `fill` at position 0."""
-    return jnp.concatenate([jnp.full((1,), fill, x.dtype), x[:-1]])
+    pad = jnp.full((1,) + x.shape[1:], fill, x.dtype)
+    return jnp.concatenate([pad, x[:-1]])
+
+
+def _sat_add(a: jax.Array, b: jax.Array) -> jax.Array:
+    """a + b saturating at int32 max, overflow-free for a, b >= 0."""
+    return a + jnp.minimum(b, _I32_MAX - a)
+
+
+def _seg_scan(is_leader: jax.Array, values: jax.Array):
+    """Segmented saturating inclusive prefix sums of values [B, K] over
+    contiguous groups whose first element has is_leader set. Returns the
+    inclusive scan [B, K]; callers derive exclusive prefixes by shifting
+    within segments and group totals by gathering at group end positions.
+
+    Saturating add over non-negatives composes associatively
+    (min(a+b, M) for a,b >= 0), and segmentation preserves associativity
+    by the standard (flag, value) construction."""
+    flags = is_leader
+
+    def op(a, b):
+        af, av = a
+        bf, bv = b
+        return af | bf, jnp.where(bf[:, None], bv, _sat_add(av, bv))
+
+    _, incl = lax.associative_scan(op, (flags, values))
+    return incl
 
 
 def decide(
     store: Store, req: BatchRequest, now: jax.Array
 ) -> Tuple[Store, BatchResponse, BatchStats]:
-    """Evaluate one padded batch. Pure; jit with donate_argnums=(0,)."""
+    """Evaluate one padded batch. `now` is int32 engine-ms. Pure; jit with
+    donate_argnums=(0,)."""
     rows, slots, _ = store.data.shape
     B = req.key_hash.shape[0]
-    ar = jnp.arange(B)
+    ar = jnp.arange(B, dtype=jnp.int32)
+    now = now.astype(jnp.int32)
 
     # ---- sort into same-key groups (padding last) -------------------------
     sort_key = jnp.where(req.valid, req.key_hash, jnp.uint64(_U64_MAX))
@@ -123,9 +157,9 @@ def decide(
             req.hits,
             req.limit,
             req.duration,
-            req.algo.astype(jnp.int64),
-            req.gnp.astype(jnp.int64),
-            req.valid.astype(jnp.int64),
+            req.algo,
+            req.gnp.astype(jnp.int32),
+            req.valid.astype(jnp.int32),
         ],
         axis=-1,
     )[order]
@@ -149,10 +183,11 @@ def decide(
         - 1
     )
 
-    def group_reduce(*quantities):
-        """For contiguous sorted groups: per-quantity (prefix_before_j,
-        group_total) via one stacked cumsum + two gathers."""
-        m = jnp.stack([q.astype(jnp.int64) for q in quantities], axis=-1)
+    def bool_group_reduce(*quantities):
+        """For small non-negative int quantities (bools/counters whose batch
+        sum fits int32): per-quantity (prefix_before_j, group_total) via one
+        stacked cumsum + two gathers."""
+        m = jnp.stack([q.astype(jnp.int32) for q in quantities], axis=-1)
         c = jnp.cumsum(m, axis=0)
         before = c - m  # cumsum strictly before j
         start_excl = before[leader_pos]
@@ -160,30 +195,34 @@ def decide(
         totals = c[end_pos] - start_excl
         return prefix, totals
 
-    # ---- slot lookup: two-stage gather ------------------------------------
-    # Stage 1 reads only the tag+expire lanes of all row candidates (match
-    # + eviction scoring); stage 2 reads full lanes for the one selected
-    # slot. Halves gather volume vs a full [rows, B, LANES] read.
+    # ---- slot lookup: one gather of all row candidates --------------------
     idx = slot_indices(kh, rows, slots)  # [rows, B]
-    fp = fingerprints(kh)  # [B] uint32
-    fp64 = fp.astype(jnp.int64)
-    rix = jnp.arange(rows)[:, None]
-    g2 = store.data[..., : L_EXPIRE + 1][rix, idx]  # [rows, B, 2]
+    fp = fingerprints(kh)  # [B] int32, nonzero
+    flat = store.data.reshape(rows * slots, LANES)
+    fidx = idx + (jnp.arange(rows, dtype=jnp.int32) * slots)[:, None]
+    cand = flat[fidx]  # [rows, B, LANES]
 
-    match = g2[..., L_TAG] == fp64[None, :]
+    match = cand[..., L_TAG] == fp[None, :]
     found = match.any(axis=0)
-    frow = jnp.argmax(match, axis=0)  # first matching row
-    fcol = jnp.take_along_axis(idx, frow[None, :], axis=0)[0]
+    frow = jnp.argmax(match, axis=0).astype(jnp.int32)  # first matching row
 
     # eviction candidate among the `rows` choices: empty first, else earliest
     # expiry (the rate-limit analogue of LRU-oldest, see store.py docstring)
     evict_key = jnp.where(
-        g2[..., L_TAG] == 0, _I64_MIN, g2[..., L_EXPIRE]
+        cand[..., L_TAG] == 0, _I32_MIN, cand[..., L_EXPIRE]
     )
-    erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
-    ecol = jnp.take_along_axis(idx, erow[None, :], axis=0)[0]
+    erow = jnp.argmin(evict_key, axis=0).astype(jnp.int32)
 
-    sel = store.data[frow, fcol]  # [B, LANES]
+    # row selection by vector selects (rows is tiny and static)
+    sel = cand[0]
+    fcol = idx[0]
+    ecol = idx[0]
+    for r in range(1, rows):
+        pick = (frow == r)[:, None]
+        sel = jnp.where(pick, cand[r], sel)
+        fcol = jnp.where(frow == r, idx[r], fcol)
+        ecol = jnp.where(erow == r, idx[r], ecol)
+
     exp_f = sel[:, L_EXPIRE]
     rem_f = sel[:, L_REMAINING]
     ts_f = sel[:, L_TS]
@@ -196,14 +235,14 @@ def decide(
     # ---- group-level state resolution: one stacked leader gather ----------
     lead_stack = jnp.stack(
         [
-            live.astype(jnp.int64),
+            live.astype(jnp.int32),
             exp_f,
             rem_f,
             ts_f,
             lim_f,
             dur_f,
             flg_f,
-            algo.astype(jnp.int64),
+            algo,
             h,
             lim_q,
             dur_q,
@@ -244,7 +283,8 @@ def decide(
     g_durE = jnp.where(g_live, g_durS, g_durQ)
     rate = jnp.maximum(g_durE // jnp.maximum(g_limQ, 1), 1)
     leak = jnp.maximum(now - g_ts, 0) // rate
-    leaky_R0 = jnp.minimum(g_rem + leak, g_limS)
+    # overflow-free min(g_rem + leak, g_limS): stored remaining <= limit
+    leaky_R0 = g_rem + jnp.minimum(leak, jnp.maximum(g_limS - g_rem, 0))
 
     # group budget at batch start
     R0_exist = jnp.where(eff_leaky, leaky_R0, g_rem)
@@ -268,11 +308,18 @@ def decide(
     viable = valid & ~gnp_served & ~leaky_zero
     eligible = viable & (h > 0) & (h <= R0)
     inc = jnp.where(eligible & ~is_creation_leader, h, 0)
-    prefix1, totals1 = group_reduce(inc, viable & (h != 0))
+    incl1 = _seg_scan(
+        is_leader,
+        jnp.stack([inc, (viable & (h != 0)).astype(jnp.int32)], axis=-1),
+    )
+    prefix1 = jnp.where(same_prev[:, None], _shift1(incl1, 0), 0)
+    totals1 = incl1[end_pos]
     S = prefix1[:, 0]
     any_hits = totals1[:, 1] > 0
 
-    charged = eligible & ~is_creation_leader & (S + h <= R0)
+    # admission: S + h <= R0, written subtraction-side to stay in int32
+    # (eligible already guarantees h <= R0)
+    charged = eligible & ~is_creation_leader & (S <= R0 - h)
     charged = charged | (is_creation_leader & charged_ldr)
     rem_b = jnp.maximum(R0 - S, 0)  # budget visible to j
 
@@ -284,13 +331,17 @@ def decide(
     # (algorithms.go:41-44); leaky expiry refreshes only on a strict-
     # decrement charge (oracle divergence-1 rule; algorithms.go:157)
     decr = charged & ~is_creation_leader & (rem_b - h > 0)
-    prefix2, totals2 = group_reduce(inc_chg, decr)
+    incl2 = _seg_scan(
+        is_leader, jnp.stack([inc_chg, decr.astype(jnp.int32)], axis=-1)
+    )
+    prefix2 = jnp.where(same_prev[:, None], _shift1(incl2, 0), 0)
+    totals2 = incl2[end_pos]
     S_chg = prefix2[:, 0]
     total_charged = totals2[:, 0]
     any_decr = totals2[:, 1] > 0
 
     z = viable & ~eff_leaky & (R0 - S_chg == 0) & ~is_creation_leader
-    _, totals3 = group_reduce(z)
+    _, totals3 = bool_group_reduce(z)
     any_z = totals3[:, 0] > 0
     sticky_live = sticky0 | (same_prev & _shift1(z, False))
 
@@ -364,9 +415,9 @@ def decide(
     new_ts = jnp.where(existing & w_leaky & ~any_hits, g_ts, now)
     new_limit = jnp.where(existing, g_limS, g_limQ)
     new_duration = jnp.where(existing, g_durS, g_durQ)
-    new_flags = jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0).astype(jnp.int64) | (
+    new_flags = jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0).astype(jnp.int32) | (
         jnp.where(~w_leaky & sticky_final, FLAG_STICKY_OVER, 0).astype(
-            jnp.int64
+            jnp.int32
         )
     )
 
@@ -381,33 +432,33 @@ def decide(
 
     new_vals = jnp.stack(
         [
-            fp64,
+            fp,
             new_expire,
             rem_final,
             new_ts,
             new_limit,
             new_duration,
             new_flags,
-            jnp.zeros_like(fp64),
+            jnp.zeros_like(fp),
         ],
         axis=-1,
     )  # [B, LANES]
     new_data = store.data.at[sc_row, sc_col].set(new_vals, mode="drop")
 
     # ---- unsort: one packed scatter ---------------------------------------
-    resp_stack = jnp.stack(
-        [status.astype(jnp.int64), resp_limit, remaining, reset], axis=-1
-    )
+    resp_stack = jnp.stack([status, resp_limit, remaining, reset], axis=-1)
     unsorted = jnp.zeros_like(resp_stack).at[order].set(resp_stack)
     resp = BatchResponse(
-        status=unsorted[:, 0].astype(jnp.int32),
+        status=unsorted[:, 0],
         limit=unsorted[:, 1],
         remaining=unsorted[:, 2],
         reset_time=unsorted[:, 3],
     )
     stats = BatchStats(
-        hits=jnp.sum(jnp.where(is_leader & g_live, 1, 0)).astype(jnp.int64),
-        misses=jnp.sum(jnp.where(is_leader & ~g_live, 1, 0)).astype(jnp.int64),
+        hits=jnp.sum(jnp.where(is_leader & g_live, 1, 0)).astype(jnp.int32),
+        misses=jnp.sum(jnp.where(is_leader & ~g_live, 1, 0)).astype(
+            jnp.int32
+        ),
     )
     return Store(data=new_data), resp, stats
 
@@ -415,9 +466,9 @@ def decide(
 def upsert_globals(
     store: Store,
     key_hash: jax.Array,  # uint64[B]
-    limit: jax.Array,  # int64[B]
-    remaining: jax.Array,  # int64[B]
-    reset_time: jax.Array,  # int64[B]
+    limit: jax.Array,  # int32[B]
+    remaining: jax.Array,  # int32[B]
+    reset_time: jax.Array,  # int32[B] engine-ms
     is_over: jax.Array,  # bool[B]
     valid: jax.Array,  # bool[B]
 ) -> Store:
@@ -427,27 +478,29 @@ def upsert_globals(
     rows, slots, _ = store.data.shape
 
     idx = slot_indices(key_hash, rows, slots)
-    fp64 = fingerprints(key_hash).astype(jnp.int64)
-    rix = jnp.arange(rows)[:, None]
-    # slots are fully overwritten, so only tag+expire lanes are read
-    g2 = store.data[..., : L_EXPIRE + 1][rix, idx]
+    fp = fingerprints(key_hash)
+    flat = store.data.reshape(rows * slots, LANES)
+    fidx = idx + (jnp.arange(rows, dtype=jnp.int32) * slots)[:, None]
+    cand = flat[fidx]  # slots are fully overwritten; only tag+expire used
 
-    match = g2[..., L_TAG] == fp64[None, :]
+    match = cand[..., L_TAG] == fp[None, :]
     found = match.any(axis=0)
-    frow = jnp.argmax(match, axis=0)
+    frow = jnp.argmax(match, axis=0).astype(jnp.int32)
 
-    evict_key = jnp.where(g2[..., L_TAG] == 0, _I64_MIN, g2[..., L_EXPIRE])
-    erow = jnp.argmin(evict_key, axis=0).astype(frow.dtype)
+    evict_key = jnp.where(cand[..., L_TAG] == 0, _I32_MIN, cand[..., L_EXPIRE])
+    erow = jnp.argmin(evict_key, axis=0).astype(jnp.int32)
 
     wrow = jnp.where(found, frow, erow)
-    wcol = jnp.take_along_axis(idx, wrow[None, :], axis=0)[0]
+    wcol = idx[0]
+    for r in range(1, rows):
+        wcol = jnp.where(wrow == r, idx[r], wcol)
     sc_row = jnp.where(valid, wrow, 0)
     sc_col = jnp.where(valid, wcol, slots)
 
     zero = jnp.zeros_like(limit)
-    flags = jnp.where(is_over, FLAG_STICKY_OVER, 0).astype(jnp.int64)
+    flags = jnp.where(is_over, FLAG_STICKY_OVER, 0).astype(jnp.int32)
     new_vals = jnp.stack(
-        [fp64, reset_time, remaining, zero, limit, zero, flags, zero],
+        [fp, reset_time, remaining, zero, limit, zero, flags, zero],
         axis=-1,
     )
     return Store(
@@ -463,3 +516,8 @@ def decide_jit(store, req, now):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def upsert_globals_jit(store, key_hash, limit, remaining, reset_time, is_over, valid):
     return upsert_globals(store, key_hash, limit, remaining, reset_time, is_over, valid)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def rebase_jit(store, delta):
+    return rebase(store, delta)

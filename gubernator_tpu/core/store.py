@@ -1,15 +1,14 @@
 """Device-resident rate-limit state: the slot store.
 
 The TPU-native replacement for the reference's per-key LRU hash map
-(reference cache/lru.go). State is ONE dense int64 array of shape
+(reference cache/lru.go). State is ONE dense int32 array of shape
 [rows, slots, LANES] living in HBM:
 
 - Each key hashes to one candidate slot per row (`rows` independent
   choices) plus a 32-bit fingerprint tag.
 - A key occupies exactly one of its candidate slots; lookup compares the
-  tag lane across the `rows` candidates with a vectorized two-stage gather
-  (tag+expire lanes of every candidate, then full lanes of the selected
-  slot) — no probing loops, fixed shapes for XLA.
+  tag lane across the `rows` candidates with one vectorized gather — no
+  probing loops, fixed shapes for XLA.
 - On insert, an empty candidate is preferred, otherwise the candidate with
   the earliest expiry is evicted. For rate-limit state, expiry time is the
   natural recency metric (an entry past its reset is worthless), so
@@ -17,14 +16,31 @@ The TPU-native replacement for the reference's per-key LRU hash map
   (cache/lru.go:92-94) with the same "state loss => brief over-admission"
   contract (reference architecture.md:5-11).
 
-The packed lane layout exists for TPU performance: one wide gather and one
-wide scatter per batch instead of one per field — measured ~6-9x faster
-than per-field planes on v5e. Lane meanings:
+int32 everywhere (the TPU-first choice)
+---------------------------------------
+TPU v5e has no native int64 ALU path — XLA emulates 64-bit integer math as
+pairs of 32-bit ops, which measured 2-10x slower for the gathers, scatters
+and prefix scans this kernel is made of. All device state and arithmetic is
+therefore int32:
 
-  L_TAG       fingerprint (low 32 bits; 0 = empty slot)
-  L_EXPIRE    entry expiry, unix ms; miss if < now
+- **Time** is milliseconds relative to a host-managed *epoch* (see
+  core.engine.EpochClock). Wall clock enters each batch as one int32
+  "engine-ms" scalar in [0, 2^30]; the host rebases the epoch (one cheap
+  elementwise pass, `rebase`) every ~12 days of uptime so offsets never
+  overflow. External APIs remain int64 unix-ms end to end.
+- **Counters** (hits/limit/remaining) saturate at 2^31-1 at the host
+  boundary. Documented divergence from the reference's int64 fields:
+  limits above ~2.1 billion per window and durations above ~12.4 days
+  (MAX_DURATION_MS) are clamped. Both are far outside the reference's own
+  tested envelope and production use.
+
+The packed lane layout exists for TPU performance: one wide gather and one
+wide scatter per batch instead of one per field. Lane meanings:
+
+  L_TAG       fingerprint (bitcast of key-hash high 32 bits; 0 = empty)
+  L_EXPIRE    entry expiry, engine-ms; miss if < now
   L_REMAINING tokens remaining in window / bucket
-  L_TS        leaky last-leak timestamp (token: creation time)
+  L_TS        leaky last-leak timestamp (token: creation time), engine-ms
   L_LIMIT     stored limit
   L_DURATION  stored duration ms
   L_FLAGS     FLAG_* bits
@@ -59,6 +75,14 @@ LANES = 8
 FLAG_STICKY_OVER = 1  # token window created over-limit: status persists OVER
 FLAG_ALGO_LEAKY = 2  # slot holds leaky-bucket state (else token bucket)
 
+# Engine-time envelope. `now` stays in [0, REBASE_AT]; stored times stay in
+# [TIME_FLOOR, INT32_MAX]; durations are clamped to MAX_DURATION_MS so
+# now + duration never exceeds int32 range (2^30 + 2^30 - 1 = INT32_MAX).
+MAX_DURATION_MS = (1 << 30) - 1  # ~12.4 days
+TIME_FLOOR = -(1 << 29)
+REBASE_AT = 1 << 30
+COUNTER_MAX = (1 << 31) - 1
+
 # Per-row salts for deriving independent slot indices from one 64-bit hash.
 _ROW_SALTS = np.array(
     [
@@ -83,7 +107,7 @@ class StoreConfig:
     factor under ~50% of that for negligible eviction of live entries."""
 
     rows: int = 4
-    slots: int = 1 << 17  # 524,288 entries at rows=4 (~32 MiB packed)
+    slots: int = 1 << 17  # 524,288 entries at rows=4 (~16 MiB packed)
 
     def __post_init__(self):
         assert 1 <= self.rows <= MAX_ROWS, f"rows must be in [1,{MAX_ROWS}]"
@@ -99,11 +123,11 @@ class Store(NamedTuple):
     kernels index lanes directly.
     """
 
-    data: jax.Array  # int64[rows, slots, LANES]
+    data: jax.Array  # int32[rows, slots, LANES]
 
     @property
     def tag(self) -> jax.Array:
-        return self.data[..., L_TAG].astype(jnp.uint32)
+        return self.data[..., L_TAG]
 
     @property
     def expire(self) -> jax.Array:
@@ -132,8 +156,23 @@ class Store(NamedTuple):
 
 def new_store(config: StoreConfig = StoreConfig()) -> Store:
     return Store(
-        data=jnp.zeros((config.rows, config.slots, LANES), jnp.int64)
+        data=jnp.zeros((config.rows, config.slots, LANES), jnp.int32)
     )
+
+
+def rebase(store: Store, delta: jax.Array) -> Store:
+    """Shift all stored times by -delta (the host moved the epoch forward
+    by `delta` ms). One elementwise pass over the store; runs every ~12
+    days of engine uptime (see EpochClock), so the int64 widening here is
+    free in practice."""
+    lane = jnp.arange(LANES)
+    is_time = (lane == L_EXPIRE) | (lane == L_TS)
+    shifted = jnp.clip(
+        store.data.astype(jnp.int64) - jnp.where(is_time, delta, 0),
+        TIME_FLOOR,
+        COUNTER_MAX,
+    ).astype(jnp.int32)
+    return Store(data=jnp.where(is_time, shifted, store.data))
 
 
 def mix64(x: jax.Array) -> jax.Array:
@@ -152,6 +191,8 @@ def slot_indices(key_hash: jax.Array, rows: int, slots: int) -> jax.Array:
 
 
 def fingerprints(key_hash: jax.Array) -> jax.Array:
-    """Nonzero 32-bit tags [B] from key hashes [B]."""
+    """Nonzero int32 tags [B] from key hashes [B] (bitcast of the high 32
+    bits, so the full hash entropy is split between slot index and tag)."""
     fp = (key_hash >> jnp.uint64(32)).astype(jnp.uint32)
-    return jnp.where(fp == 0, jnp.uint32(1), fp)
+    fp = jnp.where(fp == 0, jnp.uint32(1), fp)
+    return jax.lax.bitcast_convert_type(fp, jnp.int32)

@@ -39,6 +39,20 @@ def _pack(keys: List[str]):
     return b"".join(bufs), offsets
 
 
+def hash_batch_seed(keys: List[str], seed: int) -> np.ndarray:
+    """uint64[len(keys)] XXH64 hashes with an explicit seed (test hook)."""
+    buf, offsets = _pack(keys)
+    out = np.empty(len(keys), np.uint64)
+    _lib.guber_hash_batch(
+        buf,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(keys),
+        ctypes.c_uint64(seed),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+    )
+    return out
+
+
 def hash_batch(keys: List[str]) -> np.ndarray:
     """uint64[len(keys)] XXH64 slot hashes."""
     buf, offsets = _pack(keys)

@@ -37,12 +37,18 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from gubernator_tpu.core.engine import pad_request, pad_to_bucket
+from gubernator_tpu.core.engine import (
+    EpochClock,
+    _sat_i32,
+    pad_request,
+    pad_to_bucket,
+)
 from gubernator_tpu.core.kernels import (
     BatchRequest,
     BatchResponse,
     BatchStats,
     decide,
+    rebase_jit,
     upsert_globals,
 )
 from gubernator_tpu.core.store import Store, StoreConfig, mix64, new_store
@@ -96,7 +102,7 @@ def _shard_decide(store: Store, req: BatchRequest, now, n_shards: int):
 def _shard_sync_globals(
     store: Store,
     key_hash: jax.Array,  # uint64[B] global keys to broadcast
-    limit: jax.Array,  # int64[B] request limit (for owner-side peek of misses)
+    limit: jax.Array,  # int32[B] request limit (for owner-side peek of misses)
     duration: jax.Array,
     algo: jax.Array,  # int32[B]: must match the stored algorithm, or the
     # peek would take the mismatch-recreate path and wipe owner state
@@ -112,7 +118,7 @@ def _shard_sync_globals(
     B = key_hash.shape[0]
     peek = BatchRequest(
         key_hash=key_hash,
-        hits=jnp.zeros(B, jnp.int64),
+        hits=jnp.zeros(B, jnp.int32),
         limit=limit,
         duration=duration,
         algo=algo,
@@ -186,6 +192,7 @@ class MeshEngine:
         self.n = len(devices)
         self.config = config
         self.buckets = sorted(buckets)
+        self.clock = EpochClock()
 
         sharding = NamedSharding(self.mesh, P("shard"))
         self.store_sharding = sharding
@@ -234,6 +241,16 @@ class MeshEngine:
     def reset(self) -> None:
         self.store = self._fresh_store()
 
+    def _engine_now(self, now: int) -> np.int32:
+        e, delta, reset_required = self.clock.advance(now)
+        if reset_required:
+            self.reset()
+        elif delta is not None:
+            # rebase is elementwise, so it runs shard-local with the
+            # store's sharding preserved — no collective needed
+            self.store = rebase_jit(self.store, np.int32(delta))
+        return e
+
     def decide_arrays(
         self,
         key_hash: np.ndarray,
@@ -245,13 +262,15 @@ class MeshEngine:
         now: int,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         n = key_hash.shape[0]
+        e_now = self._engine_now(now)
         req = pad_request(
             self.buckets, key_hash, hits, limit, duration, algo, gnp
         )
-        self.store, resp, _stats = self._step(self.store, req, np.int64(now))
+        self.store, resp, _stats = self._step(self.store, req, e_now)
         status, rlimit, remaining, reset = jax.device_get(
             (resp.status, resp.limit, resp.remaining, resp.reset_time)
         )
+        reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
 
     def update_globals(
@@ -261,20 +280,24 @@ class MeshEngine:
         remaining: np.ndarray,
         reset_time: np.ndarray,
         is_over: np.ndarray,
+        now: Optional[int] = None,
     ) -> None:
         """Install broadcast GLOBAL statuses on their owning shards — the
         receive side of UpdatePeerGlobals (reference gubernator.go:199-207)
-        for a mesh-backed host."""
+        for a mesh-backed host. reset_time is int64 unix-ms."""
         n = key_hash.shape[0]
         if n == 0:
             return
+        from gubernator_tpu.api.types import millisecond_now
+
+        self._engine_now(millisecond_now() if now is None else now)
         kh, lim, rem, rst, over, valid = pad_to_bucket(
             self.buckets,
             n,
             (key_hash, np.uint64),
-            (limit, np.int64),
-            (remaining, np.int64),
-            (reset_time, np.int64),
+            (_sat_i32(limit), np.int32),
+            (_sat_i32(remaining), np.int32),
+            (self.clock.to_engine(reset_time), np.int32),
             (is_over, bool),
         )
         self.store = self._upsert(
@@ -296,6 +319,7 @@ class MeshEngine:
             return
         if algo is None:
             algo = np.zeros(n, np.int32)
+        e_now = self._engine_now(now)
         req = pad_request(
             self.buckets,
             key_hash,
@@ -312,5 +336,5 @@ class MeshEngine:
             req.duration,
             req.algo,
             req.valid,
-            np.int64(now),
+            e_now,
         )

@@ -157,22 +157,29 @@ class MeshBackend:
 
     def warmup(self) -> None:
         np = self._np
+        from gubernator_tpu.api.types import millisecond_now
+
+        # One real wall-clock now threads through every warmup call: mixing
+        # clock domains would trip the EpochClock's large-jump reset path
+        # and leave the epoch pinned at a synthetic time.
+        now = millisecond_now()
         for b in self.engine.buckets:
             k = np.arange(1, b + 1, dtype=np.uint64)
             ones = np.ones(b, np.int64)
             self.engine.decide_arrays(
                 key_hash=k, hits=ones, limit=ones * 10, duration=ones * 1000,
                 algo=np.zeros(b, np.int32), gnp=np.zeros(b, bool),
-                now=1,
+                now=now,
             )
             self.engine.update_globals(
                 key_hash=k,
                 limit=ones,
                 remaining=ones,
-                reset_time=ones,
+                reset_time=ones * now,
                 is_over=np.zeros(b, bool),
+                now=now,
             )
-            self.engine.sync_globals(k, ones, ones * 1000, now=1)
+            self.engine.sync_globals(k, ones, ones * 1000, now=now)
         self.engine.reset()
 
     def stats(self) -> dict:

@@ -1,0 +1,70 @@
+"""Separate fixed dispatch overhead from real per-step cost (dev tool)."""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import gubernator_tpu  # noqa: F401
+    from gubernator_tpu.core.kernels import BatchRequest, decide
+    from gubernator_tpu.core.store import StoreConfig, new_store
+
+    ROWS, SLOTS = 2, 1 << 19
+    rng = np.random.default_rng(42)
+
+    for B in (4096, 16384, 65536):
+        zipf = rng.zipf(1.2, size=B) % 100_000
+        key_hash = jnp.asarray(
+            (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+            ^ np.uint64(0xDEADBEEFCAFEF00D)
+        )
+        req = BatchRequest(
+            key_hash=key_hash,
+            hits=jnp.ones(B, jnp.int64),
+            limit=jnp.full(B, 1000, jnp.int64),
+            duration=jnp.full(B, 60_000, jnp.int64),
+            algo=jnp.asarray(zipf % 2, jnp.int32),
+            gnp=jnp.zeros(B, bool),
+            valid=jnp.ones(B, bool),
+        )
+        t0 = jnp.int64(1_700_000_000_000)
+
+        for S in (8, 64, 256):
+            @jax.jit
+            def stepped(store, req, S=S):
+                def body(i, carry):
+                    store, acc = carry
+                    s, r, _ = decide(store, req, t0 + i)
+                    return s, acc + r.status.sum().astype(jnp.int32)
+
+                return lax.fori_loop(
+                    0, S, body, (store, jnp.zeros((), jnp.int32))
+                )
+
+            store = new_store(StoreConfig(rows=ROWS, slots=SLOTS))
+            out = stepped(store, req)
+            jax.block_until_ready(out)
+            best = 1e9
+            for _ in range(3):
+                t = time.monotonic()
+                out = stepped(store, req)
+                jax.block_until_ready(out)
+                best = min(best, time.monotonic() - t)
+            print(
+                f"B={B:6d} S={S:4d}: total {best*1000:8.1f} ms  "
+                f"{best/S*1e6:8.1f} us/step  "
+                f"{S*B/best/1e6:7.2f} M dec/s",
+                file=sys.stderr,
+            )
+
+
+if __name__ == "__main__":
+    main()

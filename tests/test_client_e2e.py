@@ -29,17 +29,31 @@ def cluster_proc():
         env=env,
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     )
+    # Read "Ready" under a hard deadline: readline() itself can block
+    # forever if the process wedges without output, so it runs on a
+    # daemon thread and the main thread enforces the timeout.
+    import queue
+    import threading
+
+    lines: "queue.Queue[str]" = queue.Queue()
+
+    def _pump():
+        for ln in proc.stdout:
+            lines.put(ln)
+
+    threading.Thread(target=_pump, daemon=True).start()
     deadline = time.monotonic() + 60
-    line = ""
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if "Ready" in line:
-            break
+    while True:
         if proc.poll() is not None:
             pytest.fail(f"cluster process died (rc={proc.returncode})")
-    else:
-        proc.kill()
-        pytest.fail("cluster did not print Ready in time")
+        try:
+            if "Ready" in lines.get(timeout=1):
+                break
+        except queue.Empty:
+            pass
+        if time.monotonic() > deadline:
+            proc.kill()
+            pytest.fail("cluster did not print Ready in time")
     yield proc
     proc.terminate()
     proc.wait(timeout=10)

@@ -146,7 +146,8 @@ def test_global_replica_read(engine):
                     reset_time=T0 + 3000,
                 ),
             )
-        ]
+        ],
+        now=T0,
     )
     r = RateLimitReq(
         name="test", unique_key="account:g1", hits=1, limit=5, duration=3000
