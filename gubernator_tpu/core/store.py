@@ -2,15 +2,18 @@
 
 The TPU-native replacement for the reference's per-key LRU hash map
 (reference cache/lru.go). State is ONE dense int32 array of shape
-[rows, slots, LANES] living in HBM:
+[buckets, ways, LANES] living in HBM:
 
-- Each key hashes to one candidate slot per row (`rows` independent
-  choices) plus a 32-bit fingerprint tag.
-- A key occupies exactly one of its candidate slots; lookup compares the
-  tag lane across the `rows` candidates with one vectorized gather — no
-  probing loops, fixed shapes for XLA.
-- On insert, an empty candidate is preferred, otherwise the candidate with
-  the earliest expiry is evicted. For rate-limit state, expiry time is the
+- Each key hashes to ONE bucket of `ways` set-associative entry slots,
+  plus a 32-bit fingerprint tag. The bucket's ways are contiguous in
+  memory (ways*LANES lanes), so lookup is a single vectorized gather of
+  whole buckets — no probing loops, fixed shapes for XLA, and (because
+  batches are sorted by bucket) the gather and writeback indices are
+  monotonically sorted, which XLA/Mosaic turn into fast paths.
+- A key occupies exactly one way of its bucket; lookup compares the tag
+  lane across the ways with vector selects.
+- On insert, an empty way is preferred, otherwise the way with the
+  earliest expiry is evicted. For rate-limit state, expiry time is the
   natural recency metric (an entry past its reset is worthless), so
   evict-earliest-expiry plays the role of the reference's LRU eviction
   (cache/lru.go:92-94) with the same "state loss => brief over-admission"
@@ -83,36 +86,33 @@ TIME_FLOOR = -(1 << 29)
 REBASE_AT = 1 << 30
 COUNTER_MAX = (1 << 31) - 1
 
-# Per-row salts for deriving independent slot indices from one 64-bit hash.
-_ROW_SALTS = np.array(
-    [
-        0x9E3779B97F4A7C15,
-        0xC2B2AE3D27D4EB4F,
-        0x165667B19E3779F9,
-        0x27D4EB2F165667C5,
-        0x85EBCA77C2B2AE63,
-        0xFF51AFD7ED558CCD,
-        0xC4CEB9FE1A85EC53,
-        0x2545F4914F6CDD1D,
-    ],
-    dtype=np.uint64,
-)
+# 128-lane rows of the dense device view (see dense_view): how many entry
+# slots pack into one native (sublane, 128-lane) vector row.
+DENSE_LANES = 128
+SLOTS_PER_DENSE_ROW = DENSE_LANES // LANES  # 16
 
-MAX_ROWS = len(_ROW_SALTS)
+MAX_ROWS = 8  # max ways per bucket
 
 
 @dataclass(frozen=True)
 class StoreConfig:
-    """Capacity knobs. Total capacity ~= rows * slots entries; keep load
-    factor under ~50% of that for negligible eviction of live entries."""
+    """Capacity knobs. Total capacity = rows * slots entries (`slots`
+    buckets of `rows` set-associative ways each); keep load factor under
+    ~50% of that for negligible eviction of live entries."""
 
-    rows: int = 4
-    slots: int = 1 << 17  # 524,288 entries at rows=4 (~16 MiB packed)
+    rows: int = 4  # ways per bucket (set associativity)
+    slots: int = 1 << 17  # buckets (524,288 entries at rows=4, ~16 MiB)
 
     def __post_init__(self):
-        assert 1 <= self.rows <= MAX_ROWS, f"rows must be in [1,{MAX_ROWS}]"
+        # rows must divide SLOTS_PER_DENSE_ROW so a bucket never straddles
+        # a dense 128-lane row (the pallas writeback's sorted-row contract
+        # and the sorted-scatter monotonicity both depend on it)
+        assert self.rows in (1, 2, 4, 8), "rows (ways) must be 1, 2, 4 or 8"
         assert self.slots > 0 and (self.slots & (self.slots - 1)) == 0, (
             "slots must be a power of two"
+        )
+        assert (self.rows * self.slots) % SLOTS_PER_DENSE_ROW == 0, (
+            "total capacity must be a multiple of 16 for the dense view"
         )
 
 
@@ -123,7 +123,7 @@ class Store(NamedTuple):
     kernels index lanes directly.
     """
 
-    data: jax.Array  # int32[rows, slots, LANES]
+    data: jax.Array  # int32[buckets, ways, LANES]
 
     @property
     def tag(self) -> jax.Array:
@@ -156,7 +156,7 @@ class Store(NamedTuple):
 
 def new_store(config: StoreConfig = StoreConfig()) -> Store:
     return Store(
-        data=jnp.zeros((config.rows, config.slots, LANES), jnp.int32)
+        data=jnp.zeros((config.slots, config.rows, LANES), jnp.int32)
     )
 
 
@@ -183,11 +183,13 @@ def mix64(x: jax.Array) -> jax.Array:
     return x ^ (x >> jnp.uint64(31))
 
 
-def slot_indices(key_hash: jax.Array, rows: int, slots: int) -> jax.Array:
-    """[rows, B] candidate slot index per row for each key hash [B]."""
-    salts = jnp.asarray(_ROW_SALTS[:rows])  # [rows]
-    mixed = mix64(key_hash[None, :] ^ salts[:, None])  # [rows, B]
-    return (mixed & jnp.uint64(slots - 1)).astype(jnp.int32)
+_BUCKET_SALT = np.uint64(0x9E3779B97F4A7C15)
+
+
+def bucket_index(key_hash: jax.Array, buckets: int) -> jax.Array:
+    """[B] owning bucket index for each key hash [B]."""
+    mixed = mix64(key_hash ^ _BUCKET_SALT)
+    return (mixed & jnp.uint64(buckets - 1)).astype(jnp.int32)
 
 
 def fingerprints(key_hash: jax.Array) -> jax.Array:

@@ -55,16 +55,7 @@ def hash_batch_seed(keys: List[str], seed: int) -> np.ndarray:
 
 def hash_batch(keys: List[str]) -> np.ndarray:
     """uint64[len(keys)] XXH64 slot hashes."""
-    buf, offsets = _pack(keys)
-    out = np.empty(len(keys), np.uint64)
-    _lib.guber_hash_batch(
-        buf,
-        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
-        len(keys),
-        _SEED,
-        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-    )
-    return out
+    return hash_batch_seed(keys, _SEED)
 
 
 def crc32_batch(keys: List[str]) -> np.ndarray:

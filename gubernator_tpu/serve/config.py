@@ -48,6 +48,10 @@ class ServerConfig:
     cache_size: int = 50_000  # exact backend capacity
     store_rows: int = 4  # slot-store geometry (tpu/mesh backends)
     store_slots: int = 1 << 17
+    # force a jax platform ("cpu", "tpu"); "" = jax default. Lets the
+    # daemon run CPU-only on dev boxes where a TPU runtime is registered
+    # but unavailable.
+    jax_platform: str = ""
 
     # device micro-batcher (host-side window before a device batch launches)
     device_batch_wait: float = 0.0005
@@ -143,6 +147,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         store_rows=_get_int(env, "GUBER_STORE_ROWS", 4),
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 17),
+        jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
         device_batch_wait=_get_float_ms(
             env, "GUBER_DEVICE_BATCH_WAIT_MS", 0.0005
         ),

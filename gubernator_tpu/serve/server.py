@@ -35,6 +35,11 @@ log = logging.getLogger("gubernator_tpu.server")
 
 
 def make_backend(conf: ServerConfig):
+    if conf.jax_platform:
+        import jax
+
+        jax.config.update("jax_platforms", conf.jax_platform)
+
     from gubernator_tpu.core.store import StoreConfig
 
     store = StoreConfig(rows=conf.store_rows, slots=conf.store_slots)

@@ -1,0 +1,92 @@
+"""TPU-only check: pallas writeback == XLA scatter writeback, bit-exact,
+over many randomized decide batches threading one store. Run on a real
+chip (dev tool; the CI-equivalent lives in tests/test_kernels.py which
+exercises the XLA path against the oracle on CPU)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import gubernator_tpu  # noqa: F401
+    from gubernator_tpu.core import kernels as K
+    from gubernator_tpu.core.kernels import BatchRequest
+    from gubernator_tpu.core.store import StoreConfig, new_store
+
+    assert jax.default_backend() == "tpu", "run on TPU"
+
+    B = 512
+    rng = np.random.default_rng(7)
+
+    def mk_req(step):
+        # small key space to force heavy duplicate groups + evictions
+        keys = rng.integers(1, 400, B).astype(np.uint64)
+        kh = (keys * np.uint64(0x9E3779B97F4A7C15)) ^ np.uint64(
+            0xABCDEF0123456789
+        )
+        return BatchRequest(
+            key_hash=jnp.asarray(kh),
+            hits=jnp.asarray(rng.integers(0, 5, B), jnp.int32),
+            limit=jnp.asarray(rng.integers(1, 50, B), jnp.int32),
+            duration=jnp.asarray(rng.integers(10, 5000, B), jnp.int32),
+            algo=jnp.asarray((keys % 2).astype(np.int32)),
+            gnp=jnp.asarray(rng.random(B) < 0.1),
+            valid=jnp.asarray(rng.random(B) < 0.95),
+        )
+
+    results = {}
+    for mode in ("xla", "pallas"):
+        os.environ["GUBER_WRITEBACK"] = mode
+
+        @jax.jit
+        def step(store, req, now):
+            return K.decide(store, req, now)
+
+        # tiny store (rows=2 x slots=256 = 512 entries) -> eviction churn
+        store = new_store(StoreConfig(rows=2, slots=256))
+        rng_state = np.random.default_rng(7)
+        globals()["rng"] = rng_state  # reset stream per mode
+        outs = []
+        r = np.random.default_rng(7)
+
+        def mk(step_i):
+            keys = r.integers(1, 400, B).astype(np.uint64)
+            kh = (keys * np.uint64(0x9E3779B97F4A7C15)) ^ np.uint64(
+                0xABCDEF0123456789
+            )
+            return BatchRequest(
+                key_hash=jnp.asarray(kh),
+                hits=jnp.asarray(r.integers(0, 5, B), jnp.int32),
+                limit=jnp.asarray(r.integers(1, 50, B), jnp.int32),
+                duration=jnp.asarray(r.integers(10, 5000, B), jnp.int32),
+                algo=jnp.asarray((keys % 2).astype(np.int32)),
+                gnp=jnp.asarray(r.random(B) < 0.1),
+                valid=jnp.asarray(r.random(B) < 0.95),
+            )
+
+        for i in range(50):
+            req = mk(i)
+            store, resp, stats = step(store, req, jnp.int32(1000 + 7 * i))
+            outs.append(jax.device_get(resp))
+        results[mode] = (jax.device_get(store.data), outs)
+        del os.environ["GUBER_WRITEBACK"]
+
+    sx, ox = results["xla"]
+    sp, op = results["pallas"]
+    np.testing.assert_array_equal(sx, sp)
+    for i, (a, b) in enumerate(zip(ox, op)):
+        for f in a._fields:
+            np.testing.assert_array_equal(
+                getattr(a, f), getattr(b, f), err_msg=f"batch {i} field {f}"
+            )
+    print("pallas == xla over 50 batches: OK", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,7 +1,8 @@
 """Microprofile of decide-kernel stages on the real chip (dev tool).
 
 Times each stage via marginal cost between two loop lengths, cancelling the
-~70ms fixed dispatch overhead of the tunnel.
+~70ms fixed dispatch overhead of the tunnel. Updated for the v2 bucketed
+layout (sorted gathers + writeback variants).
 """
 import os
 import sys
@@ -11,28 +12,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-S1, S2 = 64, 256
+S1, S2 = 32, 128
 
 
 def bench(name, make_loop, *args):
     import jax
 
-    f1, f2 = make_loop(S1), make_loop(S2)
+    try:
+        f1, f2 = make_loop(S1), make_loop(S2)
 
-    def run(f):
-        out = f(*args)
-        jax.block_until_ready(out)
-        best = 1e9
-        for _ in range(3):
-            t = time.monotonic()
+        def run(f):
             out = f(*args)
             jax.block_until_ready(out)
-            best = min(best, time.monotonic() - t)
-        return best
+            best = 1e9
+            for _ in range(3):
+                t = time.monotonic()
+                out = f(*args)
+                jax.block_until_ready(out)
+                best = min(best, time.monotonic() - t)
+            return best
 
-    t1, t2 = run(f1), run(f2)
-    us = (t2 - t1) / (S2 - S1) * 1e6
-    print(f"{name:40s} {us:8.1f} us/step", file=sys.stderr)
+        t1, t2 = run(f1), run(f2)
+        us = (t2 - t1) / (S2 - S1) * 1e6
+        print(f"{name:44s} {us:8.1f} us/step", file=sys.stderr)
+    except Exception as e:  # keep profiling the rest
+        print(f"{name:44s} FAILED {type(e).__name__}: {str(e)[:90]}",
+              file=sys.stderr)
 
 
 def main():
@@ -41,18 +46,18 @@ def main():
     from jax import lax
 
     import gubernator_tpu  # noqa: F401
+    from gubernator_tpu.core import kernels as K
     from gubernator_tpu.core.kernels import BatchRequest, decide
     from gubernator_tpu.core.store import (
+        LANES,
         StoreConfig,
-        fingerprints,
         new_store,
-        slot_indices,
     )
 
     B = 4096
-    ROWS, SLOTS = 2, 1 << 19
+    WAYS, BUCKETS = 2, 1 << 19
     rng = np.random.default_rng(42)
-    store = new_store(StoreConfig(rows=ROWS, slots=SLOTS))
+    store = new_store(StoreConfig(rows=WAYS, slots=BUCKETS))
     zipf = rng.zipf(1.2, size=B) % 100_000
     key_hash = jnp.asarray(
         (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
@@ -60,130 +65,97 @@ def main():
     )
     req = BatchRequest(
         key_hash=key_hash,
-        hits=jnp.ones(B, jnp.int64),
-        limit=jnp.full(B, 1000, jnp.int64),
-        duration=jnp.full(B, 60_000, jnp.int64),
+        hits=jnp.ones(B, jnp.int32),
+        limit=jnp.full(B, 1000, jnp.int32),
+        duration=jnp.full(B, 60_000, jnp.int32),
         algo=jnp.asarray(zipf % 2, jnp.int32),
         gnp=jnp.zeros(B, bool),
         valid=jnp.ones(B, bool),
     )
-    now = jnp.int64(1_700_000_000_000)
-    idx = slot_indices(key_hash, ROWS, SLOTS)
-    rix = jnp.arange(ROWS)[:, None]
-    fp64 = fingerprints(key_hash).astype(jnp.int64)
-    vals = jnp.stack([fp64] * 8, axis=-1)
+    now0 = jnp.int32(1000)
 
-    def mk(body, carry_init):
+    def mk_loop(body):
         def make_loop(S):
             @jax.jit
-            def f(*args):
+            def f(store, req):
                 def b(i, c):
-                    return body(i, c, *args)
+                    s, acc = c
+                    s2, acc2 = body(i, s, acc, req)
+                    return s2, acc2
 
-                return lax.fori_loop(0, S, b, carry_init)
+                return lax.fori_loop(
+                    0, S, b, (store, jnp.zeros((), jnp.int32))
+                )
 
             return f
 
         return make_loop
 
-    # full decide
-    def full_body(i, store, req):
-        s, r, _ = decide(store, req, now + i)
-        return s
+    def full_body(i, s, acc, req):
+        s2, r, _ = decide(s, req, now0 + i)
+        return s2, acc + r.status.sum().astype(jnp.int32)
 
-    def mk_full(S):
-        @jax.jit
-        def f(store, req):
-            def b(i, s):
-                s2, r, _ = decide(s, req, now + i)
-                return s2
+    def dce_body(i, s, acc, req):
+        s2, r, _ = decide(s, req, now0 + i)
+        return s, acc + r.status.sum().astype(jnp.int32)
 
-            return lax.fori_loop(0, S, b, store)
+    for mode in ("xla", "pallas"):
+        os.environ["GUBER_WRITEBACK"] = mode
+        bench(f"decide [{mode} writeback]", mk_loop(full_body), store, req)
+    os.environ["GUBER_WRITEBACK"] = "xla"
+    bench("decide [writeback DCE'd]", mk_loop(dce_body), store, req)
 
-        return f
+    # isolated writeback costs on this layout
+    n_slots = BUCKETS * WAYS
+    n_rows = n_slots * LANES // 128
+    slot_np = np.sort(rng.integers(0, n_slots, B)).astype(np.int32)
+    slot = jnp.asarray(slot_np)
+    row16 = jnp.asarray(slot_np // 16)
+    vals8 = jnp.ones((B, LANES), jnp.int32)
+    vals128 = jnp.ones((B, 128), jnp.int32)
+    flat8 = jnp.zeros((n_slots, LANES), jnp.int32)
+    dense = jnp.zeros((n_rows, 128), jnp.int32)
 
-    bench("full decide", mk_full, store, req)
+    def mk_state_loop(body, init):
+        def make_loop(S):
+            @jax.jit
+            def f(st):
+                return lax.fori_loop(0, S, body, st)
 
-    z32 = jnp.zeros((), jnp.int32)
-    z64 = jnp.zeros((), jnp.int64)
+            return f
 
-    def sort_body(i, acc, req):
-        order = jnp.argsort(req.key_hash ^ i.astype(jnp.uint64))
-        return acc + order.sum().astype(jnp.int32)
+        return make_loop
 
-    bench("argsort u64", mk(sort_body, z32), req)
+    def sc8(i, d):
+        return d.at[slot].set(vals8 + i)
 
-    def sort32_body(i, acc, req):
-        kh32 = (req.key_hash >> jnp.uint64(32)).astype(jnp.uint32)
-        order = jnp.argsort(kh32 ^ i.astype(jnp.uint32))
-        return acc + order.sum().astype(jnp.int32)
+    def sc8h(i, d):
+        return d.at[slot].set(
+            vals8 + i, indices_are_sorted=True, unique_indices=True
+        )
 
-    bench("argsort u32", mk(sort32_body, z32), req)
+    def sc128(i, d):
+        return d.at[row16].set(vals128 + i)
 
-    def g1_body(i, acc, store, req):
-        g2 = store.data[..., :2][rix, (idx + i) & (SLOTS - 1)]
-        return acc + g2.sum().astype(jnp.int64)
+    def sc128h(i, d):
+        return d.at[row16].set(
+            vals128 + i, indices_are_sorted=True, unique_indices=True
+        )
 
-    bench("gather stage1 [rows,B,2] i64", mk(g1_body, z64), store, req)
+    def run_state(name, body, init):
+        def make_loop(S):
+            @jax.jit
+            def f(st):
+                return lax.fori_loop(0, S, body, st)
 
-    def g2_body(i, acc, store, req):
-        sel = store.data[0, (idx[0] + i) & (SLOTS - 1)]
-        return acc + sel.sum().astype(jnp.int64)
+            return f
 
-    bench("gather stage2 [B,8] i64", mk(g2_body, z64), store, req)
+        bench(name, make_loop, init)
 
-    def sc_body(i, store, req):
-        d = store.data.at[0, (idx[0] + i) & (SLOTS - 1)].set(vals)
-        return store._replace(data=d)
-
-    def mk_sc(S):
-        @jax.jit
-        def f(store, req):
-            def b(i, s):
-                return sc_body(i, s, req)
-
-            return lax.fori_loop(0, S, b, store)
-
-        return f
-
-    bench("scatter [B,8] i64", mk_sc, store, req)
-
-    m = jnp.ones((B, 3), jnp.int64)
-
-    def cs_body(i, acc):
-        c = jnp.cumsum(m + i, axis=0)
-        return acc + c[-1].sum().astype(jnp.int64)
-
-    bench("cumsum [B,3] i64", mk(cs_body, z64))
-
-    m32 = jnp.ones((B, 3), jnp.int32)
-
-    def cs32_body(i, acc):
-        c = jnp.cumsum(m32 + i, axis=0)
-        return acc + c[-1].sum().astype(jnp.int32)
-
-    bench("cumsum [B,3] i32", mk(cs32_body, z32))
-
-    store32 = jnp.zeros((ROWS, SLOTS, 8), jnp.int32)
-
-    def g32_body(i, acc, s32):
-        sel = s32[0, (idx[0] + i) & (SLOTS - 1)]
-        return acc + sel.sum().astype(jnp.int32)
-
-    bench("gather [B,8] i32", mk(g32_body, z32), store32)
-
-    # cummax/associative_scan pair (leader_pos / next_leader machinery)
-    def scan_body(i, acc, req):
-        ar = jnp.arange(B)
-        kh = req.key_hash
-        same_prev = jnp.concatenate([jnp.array([False]), kh[1:] == kh[:-1]])
-        is_leader = ~same_prev
-        leader_pos = lax.cummax(jnp.where(is_leader, ar, 0))
-        lead_idx = jnp.where(is_leader, ar, B)
-        nli = lax.associative_scan(jnp.minimum, lead_idx, reverse=True)
-        return acc + (leader_pos.sum() + nli.sum()).astype(jnp.int32) + i * 0
-
-    bench("cummax+rev assoc_scan i32", mk(scan_body, z32), req)
+    run_state("scatter [B,8] sorted (no hints)", sc8, flat8)
+    run_state("scatter [B,8] sorted+unique hints", sc8h, flat8)
+    run_state("scatter [B,128] rows (no hints)", sc128, dense)
+    run_state("scatter [B,128] rows sorted+unique", sc128h, dense)
 
 
 if __name__ == "__main__":

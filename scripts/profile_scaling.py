@@ -28,14 +28,14 @@ def main():
         )
         req = BatchRequest(
             key_hash=key_hash,
-            hits=jnp.ones(B, jnp.int64),
-            limit=jnp.full(B, 1000, jnp.int64),
-            duration=jnp.full(B, 60_000, jnp.int64),
+            hits=jnp.ones(B, jnp.int32),
+            limit=jnp.full(B, 1000, jnp.int32),
+            duration=jnp.full(B, 60_000, jnp.int32),
             algo=jnp.asarray(zipf % 2, jnp.int32),
             gnp=jnp.zeros(B, bool),
             valid=jnp.ones(B, bool),
         )
-        t0 = jnp.int64(1_700_000_000_000)
+        t0 = jnp.int32(1000)  # engine-ms (epoch-relative; see core.store)
 
         for S in (8, 64, 256):
             @jax.jit

@@ -1,0 +1,135 @@
+"""Micro-bench scatter/gather variants on the real chip (dev tool).
+
+Explores whether unique_indices / indices_are_sorted hints and sorted
+batch ordering make the store gather/scatter cheap enough to hit the
+10M decisions/s north star without a pallas kernel.
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+S1, S2 = 64, 256
+
+
+def bench(name, make_loop, *args):
+    import jax
+
+    f1, f2 = make_loop(S1), make_loop(S2)
+
+    def run(f):
+        out = f(*args)
+        jax.block_until_ready(out)
+        best = 1e9
+        for _ in range(3):
+            t = time.monotonic()
+            out = f(*args)
+            jax.block_until_ready(out)
+            best = min(best, time.monotonic() - t)
+        return best
+
+    t1, t2 = run(f1), run(f2)
+    us = (t2 - t1) / (S2 - S1) * 1e6
+    print(f"{name:48s} {us:8.1f} us/step", file=sys.stderr)
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    import gubernator_tpu  # noqa: F401
+
+    B = 4096
+    SLOTS = 1 << 19
+    LANES = 8
+    rng = np.random.default_rng(42)
+    data = jnp.zeros((SLOTS, LANES), jnp.int32)
+    cols_np = rng.integers(0, SLOTS, B).astype(np.int32)
+    cols = jnp.asarray(cols_np)
+    cols_sorted = jnp.asarray(np.sort(cols_np))
+    vals = jnp.ones((B, LANES), jnp.int32)
+
+    def mk(body, carry):
+        def make_loop(S):
+            @jax.jit
+            def f(*args):
+                return lax.fori_loop(0, S, lambda i, c: body(i, c, *args), carry)
+
+            return f
+
+        return make_loop
+
+    z32 = jnp.zeros((), jnp.int32)
+
+    # --- scatters into [SLOTS, LANES] ---
+    def sc_base(i, d, c, v):
+        return d.at[(c + i) & (SLOTS - 1)].set(v)
+
+    bench("scatter set (baseline)", mk(sc_base, data), cols, vals)
+
+    def sc_uniq(i, d, c, v):
+        return d.at[(c + i) & (SLOTS - 1)].set(v, unique_indices=True)
+
+    bench("scatter set unique", mk(sc_uniq, data), cols, vals)
+
+    def sc_sorted(i, d, c, v):
+        # sorted + unique: col array pre-sorted; +i then & keeps near-sorted
+        # (not exactly, but XLA only sees the hint on a traced value)
+        return d.at[c].set(v + i, indices_are_sorted=True, unique_indices=True)
+
+    bench("scatter set sorted+unique", mk(sc_sorted, data), cols_sorted, vals)
+
+    def sc_uniq_only_sortedidx(i, d, c, v):
+        return d.at[c].set(v + i, unique_indices=True)
+
+    bench("scatter set unique (sorted data)", mk(sc_uniq_only_sortedidx, data), cols_sorted, vals)
+
+    # --- gathers of [B, LANES] from [SLOTS, LANES] ---
+    def g_base(i, acc, d, c):
+        return acc + d[(c + i) & (SLOTS - 1)].sum().astype(jnp.int32)
+
+    bench("gather (baseline)", mk(g_base, z32), data, cols)
+
+    def g_sorted(i, acc, d, c):
+        g = jnp.take(d, c, axis=0, indices_are_sorted=True)
+        return acc + (g + i).sum().astype(jnp.int32)
+
+    bench("gather sorted hint (sorted data)", mk(g_sorted, z32), data, cols_sorted)
+
+    def g_u32_base(i, acc, d, c):
+        return acc + d[(c + i) & (SLOTS - 1)].sum().astype(jnp.int32)
+
+    # gather with unique hint
+    def g_uniqhint(i, acc, d, c):
+        g = jnp.take(d, c, axis=0, unique_indices=True, indices_are_sorted=True)
+        return acc + (g + i).sum().astype(jnp.int32)
+
+    bench("gather sorted+unique hint", mk(g_uniqhint, z32), data, cols_sorted)
+
+    # one-lane scatter (is cost per-lane or per-row?)
+    vals1 = jnp.ones((B,), jnp.int32)
+    data1 = jnp.zeros((SLOTS,), jnp.int32)
+
+    def sc_1lane(i, d, c, v):
+        return d.at[(c + i) & (SLOTS - 1)].set(v)
+
+    bench("scatter 1-lane set", mk(sc_1lane, data1), cols, vals1)
+
+    def sc_1lane_u(i, d, c, v):
+        return d.at[(c + i) & (SLOTS - 1)].set(v, unique_indices=True)
+
+    bench("scatter 1-lane set unique", mk(sc_1lane_u, data1), cols, vals1)
+
+    # scatter-add (used by sketch-style designs)
+    def sc_add(i, d, c, v):
+        return d.at[(c + i) & (SLOTS - 1)].add(v)
+
+    bench("scatter 1-lane add", mk(sc_add, data1), cols, vals1)
+
+
+if __name__ == "__main__":
+    main()
