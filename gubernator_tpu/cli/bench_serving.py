@@ -1,0 +1,188 @@
+"""Serving-path benchmark suite: the reference's benchmark configs over a
+real in-process cluster.
+
+Reproduces the four benchmarks of reference benchmark_test.go against
+localhost gRPC — the apples-to-apples serving numbers (the device-kernel
+throughput number lives in bench.py):
+
+  no_batching      BenchmarkServer_GetPeerRateLimitNoBatching (:27-53) —
+                   direct PeersV1/GetPeerRateLimits unary calls
+  get_rate_limit   BenchmarkServer_GetRateLimit (:55-79) — single-item
+                   V1/GetRateLimits
+  ping             BenchmarkServer_Ping (:81-98) — V1/HealthCheck
+  thundering_herd  BenchmarkServer_ThunderingHeard [sic] (:109-137) —
+                   100 concurrent workers issuing GetRateLimits
+  batched          no reference analogue: one 1000-item GetRateLimits per
+                   call, the shape production batching actually sends
+                   (reference README.md:111-117 observes ~1000-item peaks)
+
+Usage: python -m gubernator_tpu.cli.bench_serving [--backend tpu|exact]
+       [--seconds N] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+from typing import Callable, List
+
+import grpc
+
+from gubernator_tpu.api.grpc_glue import PeersV1Stub, V1Stub
+from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2
+from gubernator_tpu.cluster import LocalCluster
+
+ADDRESSES = [f"127.0.0.1:{p}" for p in range(9980, 9986)]
+
+
+def _req(key: str) -> gubernator_pb2.RateLimitReq:
+    return gubernator_pb2.RateLimitReq(
+        name="get_rate_limit_benchmark",
+        unique_key=key,
+        hits=1,
+        limit=1_000_000,
+        duration=10_000,
+        algorithm=gubernator_pb2.TOKEN_BUCKET,
+    )
+
+
+def _measure(
+    name: str,
+    call: Callable[[int], None],
+    seconds: float,
+    workers: int = 1,
+) -> dict:
+    """Run `call(i)` as fast as possible for `seconds` on N workers."""
+    stop = time.monotonic() + seconds
+    counts = [0] * workers
+    errors = [0] * workers
+
+    def run(w: int):
+        i = 0
+        while time.monotonic() < stop:
+            try:
+                call(w * 1_000_000 + i)
+                counts[w] += 1
+            except grpc.RpcError:
+                errors[w] += 1
+            i += 1
+
+    threads = [
+        threading.Thread(target=run, args=(w,), daemon=True)
+        for w in range(workers)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    n = sum(counts)
+    res = {
+        "name": name,
+        "ops": n,
+        "errors": sum(errors),
+        "seconds": round(elapsed, 3),
+        "ops_per_sec": round(n / elapsed, 1),
+        "workers": workers,
+    }
+    print(
+        f"{name:18s} {res['ops_per_sec']:12,.0f} ops/s   "
+        f"({n} ops, {workers} workers, {elapsed:.1f}s)",
+        file=sys.stderr,
+    )
+    return res
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description="serving benchmarks")
+    parser.add_argument("--backend", default="exact")
+    parser.add_argument("--seconds", type=float, default=3.0)
+    parser.add_argument("--nodes", type=int, default=6)
+    parser.add_argument("--json", action="store_true")
+    args = parser.parse_args(argv)
+
+    backend_factory = None
+    if args.backend != "tpu":
+        from gubernator_tpu.serve.backends import ExactBackend
+
+        backend_factory = lambda: ExactBackend(100_000)  # noqa: E731
+
+    cluster = LocalCluster(
+        ADDRESSES[: args.nodes], backend_factory=backend_factory
+    )
+    print("starting cluster...", file=sys.stderr)
+    cluster.start()
+    try:
+        target = cluster.peer_at(0)
+        chan = grpc.insecure_channel(target)
+        v1 = V1Stub(chan)
+        peers = PeersV1Stub(chan)
+
+        results = []
+
+        def no_batching(i: int):
+            peers.GetPeerRateLimits(
+                peers_pb2.GetPeerRateLimitsReq(requests=[_req(f"k{i % 1000}")])
+            )
+
+        def get_rate_limit(i: int):
+            v1.GetRateLimits(
+                gubernator_pb2.GetRateLimitsReq(
+                    requests=[_req(f"k{i % 1000}")]
+                )
+            )
+
+        def ping(i: int):
+            v1.HealthCheck(gubernator_pb2.HealthCheckReq())
+
+        # per-worker channels for the herd so one channel isn't the choke
+        herd_stubs: List[V1Stub] = [
+            V1Stub(grpc.insecure_channel(cluster.get_peer()))
+            for _ in range(100)
+        ]
+
+        def herd(i: int):
+            herd_stubs[i % 100].GetRateLimits(
+                gubernator_pb2.GetRateLimitsReq(
+                    requests=[_req(f"k{i % 1000}")]
+                )
+            )
+
+        batch = gubernator_pb2.GetRateLimitsReq(
+            requests=[_req(f"k{i}") for i in range(1000)]
+        )
+
+        def batched(i: int):
+            v1.GetRateLimits(batch)
+
+        results.append(
+            _measure("no_batching", no_batching, args.seconds)
+        )
+        results.append(
+            _measure("get_rate_limit", get_rate_limit, args.seconds)
+        )
+        results.append(_measure("ping", ping, args.seconds))
+        results.append(
+            _measure("thundering_herd", herd, args.seconds, workers=100)
+        )
+        b = _measure("batched", batched, args.seconds)
+        b["decisions_per_sec"] = round(b["ops_per_sec"] * 1000, 1)
+        print(
+            f"{'':18s} -> {b['decisions_per_sec']:12,.0f} decisions/s",
+            file=sys.stderr,
+        )
+        results.append(b)
+
+        if args.json:
+            print(json.dumps(results))
+        return 0
+    finally:
+        cluster.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

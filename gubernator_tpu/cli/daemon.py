@@ -7,10 +7,10 @@ GUBER_* env vars with an optional KEY=value config file injected first
 
 import argparse
 import asyncio
-import logging
 import sys
 
 from gubernator_tpu.serve.config import config_from_env, load_config_file
+from gubernator_tpu.serve.logging_setup import setup_logging
 from gubernator_tpu.serve.server import run_daemon
 
 
@@ -28,9 +28,9 @@ def main(argv=None) -> int:
         env = load_config_file(args.config)
     conf = config_from_env(env)
 
-    logging.basicConfig(
-        level=logging.DEBUG if conf.debug else logging.INFO,
-        format="%(asctime)s %(name)s %(levelname)s %(message)s",
+    setup_logging(
+        level="debug" if conf.debug else conf.log_level,
+        json_format=conf.log_json,
     )
     asyncio.run(run_daemon(conf))
     return 0

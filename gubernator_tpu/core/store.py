@@ -91,8 +91,6 @@ COUNTER_MAX = (1 << 31) - 1
 DENSE_LANES = 128
 SLOTS_PER_DENSE_ROW = DENSE_LANES // LANES  # 16
 
-MAX_ROWS = 8  # max ways per bucket
-
 
 @dataclass(frozen=True)
 class StoreConfig:

@@ -69,6 +69,8 @@ class ServerConfig:
     k8s_endpoints_selector: str = ""
 
     debug: bool = False
+    log_level: str = "info"  # panic|fatal|error|warn|info|debug|trace
+    log_json: bool = False
 
     def resolved_advertise(self) -> str:
         return self.advertise_address or self.grpc_address
@@ -79,6 +81,9 @@ class ServerConfig:
             raise ValueError(
                 "choose either etcd or kubernetes discovery, not both"
             )
+        from gubernator_tpu.serve.logging_setup import parse_level
+
+        parse_level(self.log_level)  # raises ValueError with a clean message
 
 
 def _get(env, key: str, default: str = "") -> str:
@@ -162,6 +167,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         k8s_pod_port=_get(env, "GUBER_K8S_POD_PORT"),
         k8s_endpoints_selector=_get(env, "GUBER_K8S_ENDPOINTS_SELECTOR"),
         debug=_get(env, "GUBER_DEBUG") in ("1", "true", "yes"),
+        log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
+        log_json=_get(env, "GUBER_LOG_JSON") in ("1", "true", "yes"),
     )
     conf.validate()
     return conf

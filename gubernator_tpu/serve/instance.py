@@ -31,6 +31,8 @@ from gubernator_tpu.api.types import (
     RateLimitReq,
     RateLimitResp,
 )
+from gubernator_tpu.core.hashing import slot_hash_batch
+from gubernator_tpu.core.sketches import TrafficStats
 from gubernator_tpu.serve.batcher import DeviceBatcher
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE, ServerConfig
 from gubernator_tpu.serve.global_mgr import GlobalManager
@@ -58,6 +60,7 @@ class Instance:
         self.global_mgr = GlobalManager(conf.behaviors, self)
         self.picker = ConsistentHashPicker()
         self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
+        self.traffic = TrafficStats()
 
     def start(self) -> None:
         self.batcher.start()
@@ -83,6 +86,7 @@ class Instance:
         out: List[Optional[RateLimitResp]] = [None] * len(reqs)
         local: List[Tuple[int, RateLimitReq, bool]] = []  # idx, req, gnp
         forwards: List[Tuple[int, RateLimitReq, PeerClient]] = []
+        observed: List[str] = []
 
         for i, r in enumerate(reqs):
             if not r.unique_key:
@@ -96,6 +100,7 @@ class Instance:
                 )
                 continue
             key = r.hash_key()
+            observed.append(key)
             try:
                 peer = self.get_peer(key)
             except Exception as e:
@@ -114,6 +119,9 @@ class Instance:
                 local.append((i, r, True))
             else:
                 forwards.append((i, r, peer))
+
+        if observed:
+            self.traffic.observe(observed, slot_hash_batch(observed))
 
         async def forward(i, r, peer):
             key = r.hash_key()

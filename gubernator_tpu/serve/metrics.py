@@ -66,6 +66,11 @@ DEVICE_LAUNCH_MS = Histogram(
     buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 25, 100),
     registry=REGISTRY,
 )
+DISTINCT_KEYS = Gauge(
+    "distinct_keys_estimate",
+    "HyperLogLog estimate of distinct rate-limit keys seen",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:

@@ -179,6 +179,7 @@ class Server:
         app.router.add_post("/v1/GetRateLimits", self._http_get_rate_limits)
         app.router.add_get("/v1/HealthCheck", self._http_health)
         app.router.add_get("/metrics", self._http_metrics)
+        app.router.add_get("/v1/debug/stats", self._http_debug_stats)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
         host, _, port = self.conf.http_address.rpartition(":")
@@ -247,6 +248,20 @@ class Server:
         stats = self.backend.stats()
         if "size" in stats:
             metrics.CACHE_SIZE.set(stats["size"])
+        metrics.DISTINCT_KEYS.set(self.instance.traffic.hll.estimate())
+
+    async def _http_debug_stats(self, request: web.Request):
+        """Traffic observability: HLL cardinality + top hot keys + backend
+        counters (no reference analogue; see core/sketches.py)."""
+        try:
+            top_n = int(request.query.get("top", "20"))
+        except ValueError:
+            return web.json_response(
+                {"error": "'top' must be an integer"}, status=400
+            )
+        body = self.instance.traffic.snapshot(max(top_n, 0))
+        body["backend"] = self.backend.stats()
+        return web.json_response(body)
 
     # -- discovery ----------------------------------------------------------
 

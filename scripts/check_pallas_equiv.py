@@ -22,23 +22,6 @@ def main():
     assert jax.default_backend() == "tpu", "run on TPU"
 
     B = 512
-    rng = np.random.default_rng(7)
-
-    def mk_req(step):
-        # small key space to force heavy duplicate groups + evictions
-        keys = rng.integers(1, 400, B).astype(np.uint64)
-        kh = (keys * np.uint64(0x9E3779B97F4A7C15)) ^ np.uint64(
-            0xABCDEF0123456789
-        )
-        return BatchRequest(
-            key_hash=jnp.asarray(kh),
-            hits=jnp.asarray(rng.integers(0, 5, B), jnp.int32),
-            limit=jnp.asarray(rng.integers(1, 50, B), jnp.int32),
-            duration=jnp.asarray(rng.integers(10, 5000, B), jnp.int32),
-            algo=jnp.asarray((keys % 2).astype(np.int32)),
-            gnp=jnp.asarray(rng.random(B) < 0.1),
-            valid=jnp.asarray(rng.random(B) < 0.95),
-        )
 
     results = {}
     for mode in ("xla", "pallas"):
@@ -50,10 +33,8 @@ def main():
 
         # tiny store (rows=2 x slots=256 = 512 entries) -> eviction churn
         store = new_store(StoreConfig(rows=2, slots=256))
-        rng_state = np.random.default_rng(7)
-        globals()["rng"] = rng_state  # reset stream per mode
         outs = []
-        r = np.random.default_rng(7)
+        r = np.random.default_rng(7)  # identical stream for both modes
 
         def mk(step_i):
             keys = r.integers(1, 400, B).astype(np.uint64)

@@ -116,16 +116,6 @@ def main():
     flat8 = jnp.zeros((n_slots, LANES), jnp.int32)
     dense = jnp.zeros((n_rows, 128), jnp.int32)
 
-    def mk_state_loop(body, init):
-        def make_loop(S):
-            @jax.jit
-            def f(st):
-                return lax.fori_loop(0, S, body, st)
-
-            return f
-
-        return make_loop
-
     def sc8(i, d):
         return d.at[slot].set(vals8 + i)
 

@@ -332,3 +332,26 @@ def test_global_rate_limits(cluster):
         time.sleep(0.05)
     assert _hist_count(metrics.GLOBAL_ASYNC_DURATIONS) > async_before
     assert _hist_count(metrics.GLOBAL_BROADCAST_DURATIONS) > bcast_before
+
+
+def test_traffic_stats_observability(cluster):
+    """Every served request feeds the HLL + heavy-hitter sketches
+    (core/sketches.py; surfaced at /v1/debug/stats)."""
+    client = V1Client(cluster.peer_at(0))
+    for i in range(5):
+        client.get_rate_limits(
+            [
+                RateLimitReq(
+                    name="test_traffic",
+                    unique_key="hot" if i % 2 == 0 else f"cold{i}",
+                    hits=1,
+                    limit=100,
+                    duration=10 * SECOND,
+                )
+            ]
+        )
+    snap = cluster.instance_at(0).traffic.snapshot()
+    assert snap["observed_total"] >= 5
+    keys = {h["key"] for h in snap["hot_keys"]}
+    assert "test_traffic_hot" in keys
+    assert snap["distinct_keys_estimate"] >= 2
