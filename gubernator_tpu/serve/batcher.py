@@ -73,17 +73,31 @@ class DeviceBatcher:
         while True:
             item = await self._queue.get()
             batch: List[Tuple] = [item]
-            deadline = loop.time() + self.batch_wait
+            # Opportunistic drain: everything already enqueued rides this
+            # launch. While the backend is busy in _flush, new arrivals
+            # accumulate in the queue, so batches grow with load on their
+            # own ("batch while busy") and a solo request never waits.
             while len(batch) < self.batch_limit:
-                timeout = deadline - loop.time()
-                if timeout <= 0:
-                    break
                 try:
-                    batch.append(
-                        await asyncio.wait_for(self._queue.get(), timeout)
-                    )
-                except asyncio.TimeoutError:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
                     break
+            # Optional fixed window (reference BatchWait semantics,
+            # peers.go:143-172) for staggered arrivals while idle.
+            if self.batch_wait > 0:
+                deadline = loop.time() + self.batch_wait
+                while len(batch) < self.batch_limit:
+                    timeout = deadline - loop.time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        batch.append(
+                            await asyncio.wait_for(
+                                self._queue.get(), timeout
+                            )
+                        )
+                    except asyncio.TimeoutError:
+                        break
             await self._flush(batch)
 
     async def _flush(self, batch) -> None:

@@ -18,10 +18,17 @@ MAX_BATCH_SIZE = 1000  # hard request-list cap (reference gubernator.go:34)
 
 @dataclass
 class BehaviorConfig:
-    """Batching/gossip knobs; times in seconds (float)."""
+    """Batching/gossip knobs; times in seconds (float).
+
+    batch_wait divergence from the reference's 500us default
+    (config.go:62): peer batches here drain everything already enqueued
+    before sending ("batch while busy"), so coalescing scales with load
+    without holding solo requests hostage to a window. Set
+    GUBER_BATCH_WAIT_MS=0.5 to restore the reference's fixed window on
+    top of the drain."""
 
     batch_timeout: float = 0.5  # peer batch RPC deadline
-    batch_wait: float = 0.0005  # micro-batch window (500us)
+    batch_wait: float = 0.0  # extra micro-batch window (0 = drain only)
     batch_limit: int = MAX_BATCH_SIZE
 
     global_timeout: float = 0.5  # GLOBAL gossip RPC deadline
@@ -53,8 +60,12 @@ class ServerConfig:
     # but unavailable.
     jax_platform: str = ""
 
-    # device micro-batcher (host-side window before a device batch launches)
-    device_batch_wait: float = 0.0005
+    # Device micro-batcher. 0 = flush immediately with whatever has
+    # accumulated ("batch while busy": arrivals during a device launch
+    # coalesce into the next batch, so batching scales with load and a
+    # solo request pays no window). >0 = also hold the batch open that
+    # many seconds after the first arrival (reference BatchWait).
+    device_batch_wait: float = 0.0
     device_batch_limit: int = MAX_BATCH_SIZE
 
     # static peers: list of gRPC addresses; advertise address must appear
@@ -123,7 +134,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
     env = os.environ if env is None else env
     b = BehaviorConfig(
         batch_timeout=_get_float_ms(env, "GUBER_BATCH_TIMEOUT_MS", 0.5),
-        batch_wait=_get_float_ms(env, "GUBER_BATCH_WAIT_MS", 0.0005),
+        batch_wait=_get_float_ms(env, "GUBER_BATCH_WAIT_MS", 0.0),
         batch_limit=_get_int(env, "GUBER_BATCH_LIMIT", MAX_BATCH_SIZE),
         global_timeout=_get_float_ms(env, "GUBER_GLOBAL_TIMEOUT_MS", 0.5),
         global_sync_wait=_get_float_ms(
@@ -154,7 +165,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 17),
         jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
         device_batch_wait=_get_float_ms(
-            env, "GUBER_DEVICE_BATCH_WAIT_MS", 0.0005
+            env, "GUBER_DEVICE_BATCH_WAIT_MS", 0.0
         ),
         device_batch_limit=_get_int(
             env, "GUBER_DEVICE_BATCH_LIMIT", MAX_BATCH_SIZE

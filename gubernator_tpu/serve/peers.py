@@ -113,23 +113,34 @@ class PeerClient:
 
     async def _run(self) -> None:
         """Coalesce queued requests; flush at batch_limit or after
-        batch_wait from the first enqueue (reference peers.go:143-172)."""
+        batch_wait from the first enqueue (reference peers.go:143-172).
+        Everything already enqueued is drained without waiting, so batches
+        grow with in-flight RPC load while a lone request only waits the
+        configured window (batch_wait=0 disables even that)."""
         while True:
             batch: List[Tuple[RateLimitReq, asyncio.Future]] = []
             item = await self._queue.get()
             batch.append(item)
-            deadline = asyncio.get_running_loop().time() + self.conf.batch_wait
             while len(batch) < self.conf.batch_limit:
-                timeout = deadline - asyncio.get_running_loop().time()
-                if timeout <= 0:
-                    break
                 try:
-                    item = await asyncio.wait_for(
-                        self._queue.get(), timeout=timeout
-                    )
-                except asyncio.TimeoutError:
+                    batch.append(self._queue.get_nowait())
+                except asyncio.QueueEmpty:
                     break
-                batch.append(item)
+            if self.conf.batch_wait > 0:
+                deadline = (
+                    asyncio.get_running_loop().time() + self.conf.batch_wait
+                )
+                while len(batch) < self.conf.batch_limit:
+                    timeout = deadline - asyncio.get_running_loop().time()
+                    if timeout <= 0:
+                        break
+                    try:
+                        item = await asyncio.wait_for(
+                            self._queue.get(), timeout=timeout
+                        )
+                    except asyncio.TimeoutError:
+                        break
+                    batch.append(item)
             await self._send_batch(batch)
 
     async def _send_batch(self, batch) -> None:
