@@ -1,38 +1,39 @@
-"""Pallas TPU kernel: apply sorted per-entry updates to the slot store.
+"""Pallas TPU kernel: apply sorted bucket-row updates to the slot store.
 
-XLA's general scatter on TPU serializes row-at-a-time through a slow
-generic path — measured ~340us for 4096 8-lane rows into a 16 MiB table,
-~70% of the whole decide kernel (see scripts/profile_scatter_variants.py).
-This kernel replaces it with a tiled sweep that rides the hardware
+XLA's general scatter on TPU serializes row-at-a-time through a generic
+path. This kernel replaces it with a tiled sweep that rides the hardware
 properly:
 
-- The store is viewed DENSE as int32[n_rows, 128]: 16 packed entry slots
-  (8 lanes each) per native 128-lane vector row, so HBM<->VMEM DMA runs at
-  line rate with zero layout padding.
-- The update stream arrives sorted by destination row (the decide kernel
-  already sorts its batch by bucket), so each grid tile owns one
+- The store (canonical shape int32[buckets, W] with W = ways*LANES) is
+  viewed DENSE as int32[n_rows, 128]: 128/W bucket rows per native
+  128-lane vector row, so HBM<->VMEM DMA runs at line rate with zero
+  layout padding (the reshape is contiguous).
+- The update stream arrives sorted by destination bucket (the decide
+  kernel sorts its batch bucket-major), so each grid tile owns one
   contiguous range [tile_start[t], tile_start[t+1]) of updates — computed
   with one tiny searchsorted on the XLA side and handed to the kernel as
   scalar-prefetch arguments.
 - Per tile: the block pipeline DMAs the [TILE, 128] store tile in, the
   kernel copies it through and merges its updates with dynamic-sublane
-  read-modify-writes (a lane mask built from the slot's position selects
-  the 8 target lanes), and the pipeline DMAs the tile back out. Skipped
-  entries (duplicate-key followers, padding) cost one predicated scalar
-  check.
+  read-modify-writes (a lane mask built from the bucket's position in the
+  dense row selects the W target lanes), and the pipeline DMAs the tile
+  back out. Skipped items (non-leaders, padding) cost one predicated
+  scalar check.
 
 The whole sweep moves 2x the store size over HBM regardless of update
 count; at 16 MiB that is ~40us of DMA plus ~10-20 cycles per applied
-update — an order of magnitude under the XLA scatter.
+update.
 
-`apply_updates` is the TPU path; `apply_updates_xla` is the portable
-fallback (CPU test meshes, interpret-free debugging) with identical
-semantics: for each u with col[u] >= 0, entry slot (row16[u], col[u]) is
-overwritten by vals128[u]'s 8 lanes at that slot position; updates apply
-in index order (later wins on collision).
+Gated behind GUBER_WRITEBACK=pallas (see kernels._use_pallas_writeback):
+semantics are verified bit-exact against the XLA scatter path
+(scripts/check_pallas_equiv.py), but Mosaic's scalar-loop overhead makes
+it slower than the XLA row scatter at production batch sizes until the
+update application is vectorized.
 """
 
 from __future__ import annotations
+
+import functools
 
 import jax
 import jax.numpy as jnp
@@ -40,7 +41,7 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from gubernator_tpu.core.store import DENSE_LANES, LANES, SLOTS_PER_DENSE_ROW
+from gubernator_tpu.core.store import DENSE_LANES
 
 # Update-loop tile: rows of the dense view swept per grid step. 2 MiB per
 # buffer; with in+out double buffering plus the positioned-values array the
@@ -50,11 +51,13 @@ _TILE = 4096
 
 def _merge_kernel(
     tile_start_ref,  # SMEM int32[NT+1]: update range per tile
-    row16_ref,  # SMEM int32[B]: dense-view row of each update (sorted)
-    col_ref,  # SMEM int32[B]: slot-in-row 0..15, or -1 = skip
+    row_ref,  # SMEM int32[B]: dense-view row of each update (sorted)
+    col_ref,  # SMEM int32[B]: bucket-in-row 0..(128/W - 1), or -1 = skip
     tile_ref,  # VMEM int32[TILE, 128]: store tile (in)
     vals_ref,  # VMEM int32[B, 128]: update lanes, pre-positioned
     out_ref,  # VMEM int32[TILE, 128]: store tile (out)
+    *,
+    unit: int,  # lanes per bucket row (W)
 ):
     t = pl.program_id(0)
     out_ref[:] = tile_ref[:]
@@ -69,9 +72,9 @@ def _merge_kernel(
 
         @pl.when(c >= 0)
         def _():
-            r = row16_ref[u] - base
-            lo = c * LANES
-            mask = (lane >= lo) & (lane < lo + LANES)
+            r = row_ref[u] - base
+            lo = c * unit
+            mask = (lane >= lo) & (lane < lo + unit)
             old = out_ref[pl.ds(r, 1), :]
             new = vals_ref[pl.ds(u, 1), :]
             out_ref[pl.ds(r, 1), :] = jnp.where(mask, new, old)
@@ -82,19 +85,20 @@ def _merge_kernel(
 
 
 def apply_updates(
-    data: jax.Array,  # int32[buckets, ways, LANES]
-    row16: jax.Array,  # int32[B] sorted dense-view rows (sentinel n_rows)
-    col: jax.Array,  # int32[B] slot position in row, or -1 = skip
+    data: jax.Array,  # int32[buckets, W]
+    row: jax.Array,  # int32[B] sorted dense-view rows (sentinel n_rows)
+    col: jax.Array,  # int32[B] bucket position in dense row, or -1 = skip
     vals128: jax.Array,  # int32[B, 128] pre-positioned update lanes
 ) -> jax.Array:
-    """Merge the sorted update stream into the store (TPU pallas path)."""
-    buckets, ways, lanes = data.shape
-    n_rows = (buckets * ways * lanes) // DENSE_LANES
+    """Merge the sorted bucket-row update stream into the store."""
+    buckets, W = data.shape
+    assert DENSE_LANES % W == 0, "ways*LANES must divide 128"
+    n_rows = (buckets * W) // DENSE_LANES
     tile = min(_TILE, n_rows)
     nt = pl.cdiv(n_rows, tile)
 
     boundaries = jnp.arange(nt + 1, dtype=jnp.int32) * tile
-    tile_start = jnp.searchsorted(row16, boundaries, side="left").astype(
+    tile_start = jnp.searchsorted(row, boundaries, side="left").astype(
         jnp.int32
     )
 
@@ -121,39 +125,26 @@ def apply_updates(
         ),
     )
     out = pl.pallas_call(
-        _merge_kernel,
+        functools.partial(_merge_kernel, unit=W),
         out_shape=jax.ShapeDtypeStruct((n_rows, DENSE_LANES), jnp.int32),
         grid_spec=grid_spec,
         input_output_aliases={3: 0},
-    )(tile_start, row16, col, dense, vals128)
-    return out.reshape(buckets, ways, lanes)
-
-
-def apply_updates_xla(
-    data: jax.Array,
-    slot: jax.Array,  # int32[B] flat entry index (bucket*ways + way)
-    apply: jax.Array,  # bool[B]
-    vals8: jax.Array,  # int32[B, LANES]
-) -> jax.Array:
-    """Portable fallback: plain XLA scatter with drop semantics."""
-    buckets, ways, lanes = data.shape
-    total = buckets * ways
-    flat = data.reshape(total, lanes)
-    sc = jnp.where(apply, slot, total)  # out-of-range -> dropped
-    return flat.at[sc].set(vals8, mode="drop").reshape(buckets, ways, lanes)
+    )(tile_start, row, col, dense, vals128)
+    return out.reshape(buckets, W)
 
 
 def position_vals(
-    vals8: jax.Array, col: jax.Array  # int32[B, LANES], int32[B]
+    rows_w: jax.Array, col: jax.Array  # int32[B, W], int32[B]
 ) -> jax.Array:
-    """Spread each 8-lane entry to its slot position in a 128-lane row:
-    out[b, col[b]*8 : col[b]*8+8] = vals8[b] (other lanes zero). Pure
-    vector select — no gather/scatter."""
-    B = vals8.shape[0]
-    pos = jnp.arange(SLOTS_PER_DENSE_ROW, dtype=jnp.int32)
+    """Spread each W-lane bucket row to its position in a 128-lane dense
+    row: out[b, col[b]*W : (col[b]+1)*W] = rows_w[b] (other lanes zero).
+    Pure vector select — no gather/scatter."""
+    B, W = rows_w.shape
+    upr = DENSE_LANES // W
+    pos = jnp.arange(upr, dtype=jnp.int32)
     placed = jnp.where(
         (col[:, None, None] == pos[None, :, None]),
-        vals8[:, None, :],
+        rows_w[:, None, :],
         0,
     )
     return placed.reshape(B, DENSE_LANES)

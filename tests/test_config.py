@@ -11,10 +11,11 @@ from gubernator_tpu.serve.config import (
 
 
 def test_defaults_match_reference():
-    # reference config.go:59-75
+    # reference config.go:59-75; batch_wait=0 is a documented divergence
+    # (drain-while-busy batching, see serve/config.py BehaviorConfig)
     b = BehaviorConfig()
     assert b.batch_timeout == 0.5
-    assert b.batch_wait == 0.0005
+    assert b.batch_wait == 0.0
     assert b.batch_limit == 1000
     assert b.global_timeout == 0.5
     assert b.global_sync_wait == 0.0005
