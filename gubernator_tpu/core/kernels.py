@@ -15,17 +15,18 @@ Data-movement design (the performance core):
   epoch-relative engine-ms — see core.store docstring for the envelope.
 - The store keeps ONE canonical shape [buckets, ways*LANES] through the
   whole program: the lookup gathers whole bucket rows from it and the
-  writeback scatters whole (merged) bucket rows back into it. No reshape
-  of the store ever happens inside jit — reshapes force XLA to insert
-  layout-conversion copies of the entire array per step, which measured
-  3x the cost of all actual compute (profiler: 3 x ~0.8 ms copies per
-  step for a 32 MiB store on v5e).
+  writeback scatter-ADDS whole delta rows back into it
+  (_writeback_delta_add). No reshape of the store ever happens inside
+  jit — reshapes force XLA to insert layout-conversion copies of the
+  entire array per step, which measured 3x the cost of all actual
+  compute (profiler: 3 x ~0.8 ms copies per step for a 32 MiB store on
+  v5e). With the default ways=16, a bucket row is exactly 128 lanes —
+  the native TPU vector width, the fast path for both transfers.
 - The batch is sorted BUCKET-major, so every index stream downstream of
   the sort (bucket gather, group-leader gathers, writeback destinations)
   is monotonically non-decreasing, and all requests touching one bucket
-  are contiguous — which is what lets the writeback merge per-entry
-  updates into whole bucket rows (a second tiny segmented scan) and
-  write each bucket exactly once.
+  are contiguous — which gives the writeback its per-bucket conflict
+  accounting and XLA its sorted gather/scatter fast path.
 - Per-group hit sums use a *segmented saturating* associative scan:
   segment flags reset at group leaders, and the add saturates at int32
   max so refused oversized hits can never wrap (saturation only engages
@@ -65,12 +66,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from gubernator_tpu.core.pallas_store import (
-    apply_updates,
-    position_vals,
-)
 from gubernator_tpu.core.store import (
-    DENSE_LANES,
     FLAG_ALGO_LEAKY,
     FLAG_STICKY_OVER,
     L_DURATION,
@@ -83,24 +79,14 @@ from gubernator_tpu.core.store import (
     LANES,
     Store,
     bucket_index,
-    fingerprints,
+    decode_sort_key,
+    group_sort_key,
     rebase,
 )
 
 UNDER = 0
 OVER = 1
 
-
-def _use_pallas_writeback() -> bool:
-    """Writeback path selection at trace time. The pallas tile-sweep merge
-    (core/pallas_store.py) is currently gated behind GUBER_WRITEBACK=pallas:
-    its semantics are verified bit-exact against the XLA path on TPU
-    (scripts/check_pallas_equiv.py) but Mosaic's ~800ns/iteration scalar
-    loop overhead makes it slower than the XLA scatter at production batch
-    sizes until the update application is vectorized."""
-    import os
-
-    return os.environ.get("GUBER_WRITEBACK", "xla") == "pallas"
 
 _I32_MIN = jnp.iinfo(jnp.int32).min
 _I32_MAX = jnp.iinfo(jnp.int32).max
@@ -164,19 +150,6 @@ def _seg_scan(is_leader: jax.Array, values: jax.Array):
     return incl
 
 
-def _seg_max(is_leader: jax.Array, values: jax.Array) -> jax.Array:
-    """Segmented inclusive running max of values [B, K] over contiguous
-    segments whose first element has is_leader set."""
-
-    def op(a, b):
-        af, av = a
-        bf, bv = b
-        return af | bf, jnp.where(bf[:, None], bv, jnp.maximum(av, bv))
-
-    _, incl = lax.associative_scan(op, (is_leader, values))
-    return incl
-
-
 def _segment_ends(is_leader: jax.Array, ar: jax.Array) -> jax.Array:
     """[B] inclusive end position of each element's segment: predecessor
     of the next leader (B-1 for the final segment)."""
@@ -188,64 +161,96 @@ def _segment_ends(is_leader: jax.Array, ar: jax.Array) -> jax.Array:
     )
 
 
-def _write_bucket_rows(
+def _writeback_delta_add(
     data: jax.Array,  # int32[buckets, ways*LANES]
     bkt: jax.Array,  # int32[B] bucket per item, sorted non-decreasing
     valid: jax.Array,  # bool[B]
-    write_item: jax.Array,  # bool[B] item has an entry update to apply
-    wway: jax.Array,  # int32[B] destination way within the bucket
+    write_item: jax.Array,  # bool[B] the group member designated to write
+    # (decide: the group leader; upsert_globals: the LAST duplicate, for
+    # last-wins install semantics) — exactly one per writing group
+    found: jax.Array,  # bool[B] tag matched in the bucket
+    fway: jax.Array,  # int32[B] matching way (valid where found)
+    eway: jax.Array,  # int32[B] eviction-candidate way (for misses)
     new_vals: jax.Array,  # int32[B, LANES] the update for write_item rows
     cand: jax.Array,  # int32[B, ways, LANES] pre-write bucket contents
     is_b_leader: jax.Array,  # bool[B] first item of its bucket segment
     b_end: jax.Array,  # int32[B] inclusive end of the bucket segment
-    use_pallas: bool,
 ) -> jax.Array:
-    """Merge per-entry updates into whole bucket rows and write each
-    touched bucket exactly once, preserving the store's canonical shape.
+    """Apply per-entry updates as ONE scatter-ADD of delta rows — no
+    cross-group merge pass at all.
 
-    Because the batch is bucket-sorted, all updates to one bucket are one
-    contiguous segment: a segmented running-max finds, per way, the LAST
-    item writing that way (later-in-batch wins, matching the reference's
-    sequential cache.Add ordering); its lanes patch the gathered bucket
-    row. Every position in a bucket segment computes the IDENTICAL merged
-    row (lastw is gathered at the shared segment end), so the scatter can
-    legally write from ALL valid positions: duplicates store the same
-    value, and the index stream becomes monotonically non-decreasing
-    (sentinels last), which lets XLA take its sorted-scatter path —
-    measured ~25% faster end-to-end than a leaders-only unsorted scatter
-    on v5e."""
+    Each designated writer adds (new_vals - old_entry_lanes) into its
+    way's lanes of its bucket row; all other positions add zero rows at
+    their own (sorted) bucket index, so the scatter's index stream is the
+    already-sorted bucket stream and duplicate indices are legal by the
+    arithmetic: updates to one bucket touch DISJOINT ways, so the adds
+    compose exactly (old + (new - old) = new; int32 wrap-around in the
+    subtraction self-corrects on the add). Measured on v5e this replaces
+    ~500us of [B,128] segmented select-scans with ~30us of [B,16]
+    cumsums + one add-scatter at B=16384.
+
+    Way-disjointness is guaranteed, not assumed:
+    - two found-groups can never share a way (one tag per way);
+    - a miss-group's eviction way is DROPPED (entry simply not persisted
+      this batch) if any found-group in the same bucket matches it, or an
+      earlier miss-group already claimed it. A dropped create costs brief
+      over-admission for that key — the same contract as reference LRU
+      eviction / restart state loss (architecture.md:5-11) — and is
+      vanishingly rare at sane load factors (needs >=2 fresh keys
+      colliding in one bucket in one batch).
+    """
     B = bkt.shape[0]
     buckets, W = data.shape
     ways = W // LANES
     ar = jnp.arange(B, dtype=jnp.int32)
 
     way_ids = jnp.arange(ways, dtype=jnp.int32)[None, :]
-    poscand = jnp.where(
-        write_item[:, None] & (wway[:, None] == way_ids), ar[:, None], -1
-    )  # [B, ways]
-    lastw = jnp.take(
-        _seg_max(is_b_leader, poscand),
-        b_end,
-        axis=0,
-        indices_are_sorted=True,
-    )  # [B, ways] last writer position per way, or -1
-
-    patched = jnp.take(
-        new_vals, jnp.maximum(lastw, 0).reshape(-1), axis=0
-    ).reshape(B, ways, LANES)
-    newrow = jnp.where((lastw >= 0)[:, :, None], patched, cand).reshape(
-        B, W
+    miss_w = write_item & ~found
+    found_w = write_item & found
+    onehotM = (miss_w[:, None] & (eway[:, None] == way_ids)).astype(jnp.int32)
+    onehotF = (found_w[:, None] & (fway[:, None] == way_ids)).astype(
+        jnp.int32
     )
 
-    if use_pallas:
-        write_row = is_b_leader & jnp.any(lastw >= 0, axis=1)
-        upr = DENSE_LANES // W  # bucket rows per 128-lane dense row
-        n_rows = (buckets * W) // DENSE_LANES
-        row = jnp.where(valid, bkt // upr, n_rows)  # sorted, sentinel last
-        col = jnp.where(write_row, bkt % upr, -1)  # -1 = skip
-        return apply_updates(data, row, col, position_vals(newrow, col))
+    # bucket-segment prefix/total machinery over [B, 2*ways] in ONE cumsum
+    stacked = jnp.concatenate([onehotM, onehotF], axis=1)
+    c = jnp.cumsum(stacked, axis=0)
+    before = c - stacked
+    b_leader_pos = lax.cummax(jnp.where(is_b_leader, ar, 0))
+    start_excl = jnp.take(
+        before, b_leader_pos, axis=0, indices_are_sorted=True
+    )
+    prefix = before - start_excl  # strictly-before-j within my bucket
+    totals = (
+        jnp.take(c, b_end, axis=0, indices_are_sorted=True) - start_excl
+    )
+
+    # conflict tests for miss-writers, selected at eway via one-hot dot
+    ohM_b = onehotM != 0
+    earlier_miss = jnp.sum(
+        jnp.where(ohM_b, prefix[:, :ways], 0), axis=1
+    )
+    found_any = jnp.sum(
+        jnp.where(ohM_b, totals[:, ways:], 0), axis=1
+    )
+    dropped = miss_w & ((earlier_miss > 0) | (found_any > 0))
+
+    writer = found_w | (miss_w & ~dropped)
+    way = jnp.where(found, fway, eway)
+
+    # old entry lanes at the destination way (vector selects; ways static)
+    old8 = cand[:, 0]
+    for w in range(1, ways):
+        old8 = jnp.where((way == w)[:, None], cand[:, w], old8)
+
+    delta8 = jnp.where(writer[:, None], new_vals - old8, 0)
+    dmask = (way[:, None] == way_ids) & writer[:, None]  # [B, ways]
+    drow = jnp.where(
+        dmask[:, :, None], delta8[:, None, :], 0
+    ).reshape(B, W)
+
     dst = jnp.where(valid, bkt, buckets)  # out of range -> dropped
-    return data.at[dst].set(newrow, mode="drop", indices_are_sorted=True)
+    return data.at[dst].add(drow, mode="drop", indices_are_sorted=True)
 
 
 def decide(
@@ -264,15 +269,9 @@ def decide(
     # grouping by full key hash up to fingerprint collisions (two keys with
     # equal bucket AND tag are indistinguishable in the store regardless),
     # and bucket-major order makes every downstream gather/scatter index
-    # monotonic — the XLA fast path — and gives the pallas writeback its
-    # contiguous per-tile update ranges.
-    bkt_u = bucket_index(req.key_hash, buckets)
-    fp_raw = (req.key_hash >> jnp.uint64(32)).astype(jnp.uint32)
-    fp_raw = jnp.where(fp_raw == 0, jnp.uint32(1), fp_raw)
-    sort_key = (bkt_u.astype(jnp.uint64) << jnp.uint64(32)) | fp_raw.astype(
-        jnp.uint64
-    )
-    sort_key = jnp.where(req.valid, sort_key, jnp.uint64(_U64_MAX))
+    # monotonic — the XLA fast path for both the bucket-row gather and the
+    # delta-add writeback scatter.
+    sort_key = group_sort_key(req.key_hash, req.valid, buckets)
     order = jnp.argsort(sort_key, stable=True)
     skey = sort_key[order]
     # one packed gather reorders all non-key request fields
@@ -317,16 +316,7 @@ def decide(
         return prefix, totals
 
     # ---- bucket lookup: ONE sorted gather of whole bucket rows ------------
-    # bkt decoded from the sorted key; the invalid tail decodes to 2^32-1
-    # and is clamped IN THE UNSIGNED DOMAIN to buckets-1 so the index
-    # stream stays non-decreasing (the indices_are_sorted promise below);
-    # those rows read junk that `valid` masks out downstream.
-    bkt = jnp.minimum(
-        skey >> jnp.uint64(32), jnp.uint64(buckets - 1)
-    ).astype(jnp.int32)
-    fp = jax.lax.bitcast_convert_type(
-        skey.astype(jnp.uint32), jnp.int32
-    )  # low 32 bits = fingerprint, nonzero for valid rows
+    bkt, fp = decode_sort_key(skey, buckets)
     cand = jnp.take(
         store.data, bkt, axis=0, indices_are_sorted=True
     ).reshape(B, ways, LANES)
@@ -350,8 +340,7 @@ def decide(
     )
     eway = jnp.argmin(evict_key, axis=1).astype(jnp.int32)
 
-    # way selection by vector selects (ways is tiny and static)
-    wway = jnp.where(found, fway, eway)
+    # found-way state selection by vector selects (ways is tiny and static)
     sel = cand[:, 0]
     for w in range(1, ways):
         sel = jnp.where((fway == w)[:, None], cand[:, w], sel)
@@ -577,21 +566,21 @@ def decide(
         axis=-1,
     )  # [B, LANES]
 
-    # Whole-bucket-row writeback: merge this batch's entry updates into
-    # bucket rows (later-in-batch wins per way) and write each touched
-    # bucket once. Keeps the store in its canonical shape — see the
-    # module docstring for why that is the load-bearing property.
-    new_data = _write_bucket_rows(
+    # Delta-add writeback: each writing group leader adds
+    # (new - old) into its way's lanes; disjoint ways compose exactly and
+    # the store keeps its canonical shape (see _writeback_delta_add).
+    new_data = _writeback_delta_add(
         store.data,
         bkt,
         valid,
         w_mask,
-        wway,
+        found,
+        fway,
+        eway,
         new_vals,
         cand,
         is_b_leader,
         b_end,
-        _use_pallas_writeback(),
     )
 
     # ---- unsort: one packed scatter ---------------------------------------
@@ -634,11 +623,10 @@ def upsert_globals(
     B = key_hash.shape[0]
     ar = jnp.arange(B, dtype=jnp.int32)
 
-    bkt_u = bucket_index(key_hash, buckets)
-    sort_key = jnp.where(valid, bkt_u, jnp.int32(buckets))
+    sort_key = group_sort_key(key_hash, valid, buckets)
     order = jnp.argsort(sort_key, stable=True)
-    bkt = jnp.minimum(sort_key[order], buckets - 1)
-    fp = fingerprints(key_hash)[order]
+    skey = sort_key[order]
+    bkt, fp = decode_sort_key(skey, buckets)
     valid_s = valid[order]
     stack = jnp.stack(
         [
@@ -662,7 +650,6 @@ def upsert_globals(
         cand[:, :, L_TAG] == 0, _I32_MIN, cand[:, :, L_EXPIRE]
     )
     eway = jnp.argmin(evict_key, axis=1).astype(jnp.int32)
-    wway = jnp.where(found, fway, eway)
 
     zero = jnp.zeros_like(bkt)
     flags = jnp.where(stack[:, 3] != 0, FLAG_STICKY_OVER, 0).astype(
@@ -673,23 +660,30 @@ def upsert_globals(
         axis=-1,
     )
 
+    # duplicate keys in one broadcast batch: LAST in batch order wins,
+    # matching the reference's sequential cache.Add (gubernator.go:199-207)
+    # — the writer for each (bucket,fp) group is its final member.
+    is_last = jnp.concatenate([skey[:-1] != skey[1:], jnp.array([True])])
+    writer = valid_s & is_last
+
     b_same_prev = jnp.concatenate(
         [jnp.array([False]), bkt[1:] == bkt[:-1]]
     )
     is_b_leader = valid_s & ~b_same_prev
     b_end = _segment_ends(is_b_leader, ar)
     return Store(
-        data=_write_bucket_rows(
+        data=_writeback_delta_add(
             store.data,
             bkt,
             valid_s,
-            valid_s,
-            wway,
+            writer,
+            found,
+            fway,
+            eway,
             new_vals,
             cand,
             is_b_leader,
             b_end,
-            use_pallas=False,
         )
     )
 

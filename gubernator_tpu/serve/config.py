@@ -53,8 +53,9 @@ class ServerConfig:
 
     backend: str = "tpu"  # tpu | exact | mesh
     cache_size: int = 50_000  # exact backend capacity
-    store_rows: int = 4  # slot-store geometry (tpu/mesh backends)
-    store_slots: int = 1 << 17
+    store_rows: int = 16  # slot-store geometry (tpu/mesh backends);
+    # 16 ways = 128-lane bucket rows, the fast TPU layout (core.store)
+    store_slots: int = 1 << 15
     # force a jax platform ("cpu", "tpu"); "" = jax default. Lets the
     # daemon run CPU-only on dev boxes where a TPU runtime is registered
     # but unavailable.
@@ -161,8 +162,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         behaviors=b,
         backend=_get(env, "GUBER_BACKEND", "tpu"),
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
-        store_rows=_get_int(env, "GUBER_STORE_ROWS", 4),
-        store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 17),
+        store_rows=_get_int(env, "GUBER_STORE_ROWS", 16),
+        store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 15),
         jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
         device_batch_wait=_get_float_ms(
             env, "GUBER_DEVICE_BATCH_WAIT_MS", 0.0

@@ -1,8 +1,9 @@
-"""Microprofile of decide-kernel stages on the real chip (dev tool).
+"""Microprofile of decide-kernel cost structure on the real chip (dev tool).
 
-Times each stage via marginal cost between two loop lengths, cancelling the
-~70ms fixed dispatch overhead of the tunnel. Updated for the v2 bucketed
-layout (sorted gathers + writeback variants).
+Median-of-reps with S fused steps per dispatch (tunnel overhead <2% of the
+measurement). Reports the full kernel, the kernel with the writeback
+scatter DCE'd (store not threaded), and isolated gather/scatter shapes for
+the current [buckets, 16 ways * 8 lanes] layout.
 """
 import os
 import sys
@@ -12,29 +13,24 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
-S1, S2 = 32, 128
+S, REPS = 512, 5
 
 
-def bench(name, make_loop, *args):
+def bench(name, make_f, *args):
     import jax
 
     try:
-        f1, f2 = make_loop(S1), make_loop(S2)
-
-        def run(f):
+        f = make_f(S)
+        out = f(*args)
+        jax.block_until_ready(out)
+        times = []
+        for _ in range(REPS):
+            t = time.monotonic()
             out = f(*args)
             jax.block_until_ready(out)
-            best = 1e9
-            for _ in range(3):
-                t = time.monotonic()
-                out = f(*args)
-                jax.block_until_ready(out)
-                best = min(best, time.monotonic() - t)
-            return best
-
-        t1, t2 = run(f1), run(f2)
-        us = (t2 - t1) / (S2 - S1) * 1e6
-        print(f"{name:44s} {us:8.1f} us/step", file=sys.stderr)
+            times.append(time.monotonic() - t)
+        med = sorted(times)[len(times) // 2]
+        print(f"{name:44s} {med/S*1e6:8.1f} us/step", file=sys.stderr)
     except Exception as e:  # keep profiling the rest
         print(f"{name:44s} FAILED {type(e).__name__}: {str(e)[:90]}",
               file=sys.stderr)
@@ -46,16 +42,11 @@ def main():
     from jax import lax
 
     import gubernator_tpu  # noqa: F401
-    from gubernator_tpu.core import kernels as K
     from gubernator_tpu.core.kernels import BatchRequest, decide
-    from gubernator_tpu.core.store import (
-        LANES,
-        StoreConfig,
-        new_store,
-    )
+    from gubernator_tpu.core.store import LANES, StoreConfig, new_store
 
-    B = 4096
-    WAYS, BUCKETS = 2, 1 << 19
+    B = 16384
+    WAYS, BUCKETS = 16, 1 << 16
     rng = np.random.default_rng(42)
     store = new_store(StoreConfig(rows=WAYS, slots=BUCKETS))
     zipf = rng.zipf(1.2, size=B) % 100_000
@@ -75,13 +66,12 @@ def main():
     now0 = jnp.int32(1000)
 
     def mk_loop(body):
-        def make_loop(S):
+        def make_f(S):
             @jax.jit
             def f(store, req):
                 def b(i, c):
                     s, acc = c
-                    s2, acc2 = body(i, s, acc, req)
-                    return s2, acc2
+                    return body(i, s, acc, req)
 
                 return lax.fori_loop(
                     0, S, b, (store, jnp.zeros((), jnp.int32))
@@ -89,7 +79,7 @@ def main():
 
             return f
 
-        return make_loop
+        return make_f
 
     def full_body(i, s, acc, req):
         s2, r, _ = decide(s, req, now0 + i)
@@ -99,53 +89,38 @@ def main():
         s2, r, _ = decide(s, req, now0 + i)
         return s, acc + r.status.sum().astype(jnp.int32)
 
-    for mode in ("xla", "pallas"):
-        os.environ["GUBER_WRITEBACK"] = mode
-        bench(f"decide [{mode} writeback]", mk_loop(full_body), store, req)
-    os.environ["GUBER_WRITEBACK"] = "xla"
+    bench("decide full (delta-add writeback)", mk_loop(full_body), store, req)
     bench("decide [writeback DCE'd]", mk_loop(dce_body), store, req)
 
-    # isolated writeback costs on this layout
-    n_slots = BUCKETS * WAYS
-    n_rows = n_slots * LANES // 128
-    slot_np = np.sort(rng.integers(0, n_slots, B)).astype(np.int32)
-    slot = jnp.asarray(slot_np)
-    row16 = jnp.asarray(slot_np // 16)
-    vals8 = jnp.ones((B, LANES), jnp.int32)
-    vals128 = jnp.ones((B, 128), jnp.int32)
-    flat8 = jnp.zeros((n_slots, LANES), jnp.int32)
-    dense = jnp.zeros((n_rows, 128), jnp.int32)
+    # isolated transfer shapes on this layout
+    rows_np = np.sort(
+        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15)) % BUCKETS
+    ).astype(np.int32)
+    row_dup = jnp.asarray(rows_np)
+    vals = jnp.ones((B, WAYS * LANES), jnp.int32)
+    dense = jnp.zeros((BUCKETS, WAYS * LANES), jnp.int32)
 
-    def sc8(i, d):
-        return d.at[slot].set(vals8 + i)
-
-    def sc8h(i, d):
-        return d.at[slot].set(
-            vals8 + i, indices_are_sorted=True, unique_indices=True
-        )
-
-    def sc128(i, d):
-        return d.at[row16].set(vals128 + i)
-
-    def sc128h(i, d):
-        return d.at[row16].set(
-            vals128 + i, indices_are_sorted=True, unique_indices=True
-        )
-
-    def run_state(name, body, init):
-        def make_loop(S):
+    def mk2(body):
+        def make_f(S):
             @jax.jit
-            def f(st):
-                return lax.fori_loop(0, S, body, st)
+            def f(d):
+                return lax.fori_loop(0, S, body, d)
 
             return f
 
-        bench(name, make_loop, init)
+        return make_f
 
-    run_state("scatter [B,8] sorted (no hints)", sc8, flat8)
-    run_state("scatter [B,8] sorted+unique hints", sc8h, flat8)
-    run_state("scatter [B,128] rows (no hints)", sc128, dense)
-    run_state("scatter [B,128] rows sorted+unique", sc128h, dense)
+    def sc_add(i, d):
+        return d.at[row_dup].add(
+            vals + d[0, 0], mode="drop", indices_are_sorted=True
+        )
+
+    def g128(i, d):
+        g = jnp.take(d, row_dup, axis=0, indices_are_sorted=True)
+        return d.at[row_dup].add(g, mode="drop", indices_are_sorted=True)
+
+    bench("[B,128] scatter-add dup sorted", mk2(sc_add), dense)
+    bench("[B,128] gather + scatter-add", mk2(g128), dense)
 
 
 if __name__ == "__main__":
