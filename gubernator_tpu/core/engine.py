@@ -35,7 +35,7 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.kernels import (
     BatchRequest,
-    decide_jit,
+    decide_presorted_jit,
     rebase_jit,
     upsert_globals_jit,
 )
@@ -46,6 +46,7 @@ from gubernator_tpu.core.store import (
     TIME_FLOOR,
     Store,
     StoreConfig,
+    group_sort_key_np,
     new_store,
 )
 
@@ -135,37 +136,56 @@ def pad_to_bucket(buckets: Sequence[int], n: int, *arrs):
     return (*out, valid)
 
 
-def pad_request(
+def pad_request_sorted(
     buckets: Sequence[int],
+    store_buckets: int,
     key_hash: np.ndarray,
     hits: np.ndarray,
     limit: np.ndarray,
     duration: np.ndarray,
     algo: np.ndarray,
     gnp: np.ndarray,
-) -> BatchRequest:
-    """Pad request arrays to a fixed bucket size with a validity mask, so
-    XLA compiles one program per bucket instead of one per batch size.
-    Saturates the wire's int64 counters into the device's int32 envelope."""
+) -> Tuple[BatchRequest, np.ndarray]:
+    """Pad request arrays to a fixed bucket size (one compiled program
+    per bucket, not per batch size) plus the host-side presort that
+    decide_presorted requires: rows ordered by (bucket, fingerprint) of the key hash, with
+    the padding tail repeating the LAST sorted row's key (valid=False) so
+    the device's bucket stream stays monotonic.
+
+    Returns (sorted_request, order) where order[i] is the caller's index
+    of sorted row i (order is a permutation of the padded size B; padding
+    rows map to themselves). Unpermute device responses with
+    `resp_orig[order] = resp_sorted`. Sorting host-side removes the two
+    largest fixed costs (key sort + response unsort) from the device
+    program; it is one numpy argsort pipelined with device compute."""
     n = key_hash.shape[0]
     B = choose_bucket(buckets, n)
 
-    def pad(x, dtype):
-        out = np.zeros(B, dtype)
-        out[:n] = x
+    skey = group_sort_key_np(key_hash, store_buckets)
+    order_n = np.argsort(skey, kind="stable").astype(np.int32)
+
+    def pad_sorted(x, dtype, sat=None):
+        x = sat(x) if sat is not None else np.asarray(x, dtype)
+        out = np.empty(B, dtype)
+        out[:n] = x[order_n]
+        out[n:] = out[n - 1] if n else 0
         return out
 
     valid = np.zeros(B, bool)
     valid[:n] = True
-    return BatchRequest(
-        key_hash=pad(key_hash, np.uint64),
-        hits=pad(_sat_i32(hits), np.int32),
-        limit=pad(_sat_i32(limit), np.int32),
-        duration=pad(_sat_duration(duration), np.int32),
-        algo=pad(algo, np.int32),
-        gnp=pad(gnp, bool),
+    req = BatchRequest(
+        key_hash=pad_sorted(key_hash, np.uint64),
+        hits=pad_sorted(hits, np.int32, _sat_i32),
+        limit=pad_sorted(limit, np.int32, _sat_i32),
+        duration=pad_sorted(duration, np.int32, _sat_duration),
+        algo=pad_sorted(algo, np.int32),
+        gnp=pad_sorted(gnp, bool),
         valid=valid,
     )
+    order = np.empty(B, np.int32)
+    order[:n] = order_n
+    order[n:] = np.arange(n, B, dtype=np.int32)
+    return req, order
 
 
 class EngineStats:
@@ -257,16 +277,32 @@ class TpuEngine:
         Times in/out are int64 unix-ms; conversion happens here."""
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
-        req = pad_request(
-            self.buckets, key_hash, hits, limit, duration, algo, gnp
+        req, order = pad_request_sorted(
+            self.buckets,
+            self.config.slots,
+            key_hash,
+            hits,
+            limit,
+            duration,
+            algo,
+            gnp,
         )
-        self.store, resp, bstats = decide_jit(self.store, req, e_now)
+        self.store, resp, bstats = decide_presorted_jit(
+            self.store, req, e_now
+        )
         self.stats.hits += int(bstats.hits)
         self.stats.misses += int(bstats.misses)
         self.stats.batches += 1
-        status, rlimit, remaining, reset = jax.device_get(
+        sorted_out = jax.device_get(
             (resp.status, resp.limit, resp.remaining, resp.reset_time)
         )
+        # responses come back in sorted order; one numpy pass unpermutes
+        out = []
+        for a in sorted_out:
+            u = np.empty_like(a)
+            u[order] = a
+            out.append(u)
+        status, rlimit, remaining, reset = out
         reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
 

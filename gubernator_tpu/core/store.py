@@ -232,6 +232,21 @@ def group_sort_key(
     return jnp.where(valid, key, jnp.uint64(0xFFFFFFFFFFFFFFFF))
 
 
+def group_sort_key_np(key_hash: np.ndarray, buckets: int) -> np.ndarray:
+    """Host/numpy twin of group_sort_key (without the valid handling):
+    uint64 (bucket << 32 | fingerprint) for presorting batches before
+    dispatch (engine.pad_request_sorted). Must stay bit-identical to the
+    device pair (bucket_index, fingerprints)."""
+    from gubernator_tpu.core import hashing
+
+    kh = np.asarray(key_hash, np.uint64)
+    mixed = hashing.mix64(kh ^ _BUCKET_SALT)
+    bkt = mixed & np.uint64(buckets - 1)
+    fp = (kh >> np.uint64(32)).astype(np.uint64)
+    fp = np.where(fp == 0, np.uint64(1), fp)
+    return (bkt << np.uint64(32)) | fp
+
+
 def decode_sort_key(skey: jax.Array, buckets: int):
     """(bkt, fp) decoded from sorted group_sort_key values. The invalid
     tail decodes to 2^32-1 and is clamped IN THE UNSIGNED DOMAIN to

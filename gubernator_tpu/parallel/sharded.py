@@ -40,14 +40,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from gubernator_tpu.core.engine import (
     EpochClock,
     _sat_i32,
-    pad_request,
+    pad_request_sorted,
     pad_to_bucket,
 )
 from gubernator_tpu.core.kernels import (
     BatchRequest,
     BatchResponse,
     BatchStats,
-    decide,
+    decide_presorted,
     rebase_jit,
     upsert_globals,
 )
@@ -77,8 +77,11 @@ def _shard_decide(store: Store, req: BatchRequest, now, n_shards: int):
     me = jax.lax.axis_index("shard")
     store = jax.tree.map(lambda x: x[0], store)  # [1, r, s] -> [r, s]
     mine = owner_of(req.key_hash, n_shards) == me
+    # masking non-owned rows leaves them interspersed; decide_presorted's
+    # key-based grouping handles that (ownership is per-key, so groups
+    # stay uniformly valid or invalid)
     local_req = req._replace(valid=req.valid & mine)
-    new_store_shard, resp, stats = decide(store, local_req, now)
+    new_store_shard, resp, stats = decide_presorted(store, local_req, now)
 
     # Non-owners contribute zeros; one psum combines the mesh's answers.
     mask = mine & req.valid
@@ -125,7 +128,7 @@ def _shard_sync_globals(
         gnp=jnp.zeros(B, bool),
         valid=valid & mine,
     )
-    store2, resp, _ = decide(store, peek, now)
+    store2, resp, _ = decide_presorted(store, peek, now)
 
     mask = mine & valid
 
@@ -263,13 +266,26 @@ class MeshEngine:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
-        req = pad_request(
-            self.buckets, key_hash, hits, limit, duration, algo, gnp
+        req, order = pad_request_sorted(
+            self.buckets,
+            self.config.slots,
+            key_hash,
+            hits,
+            limit,
+            duration,
+            algo,
+            gnp,
         )
         self.store, resp, _stats = self._step(self.store, req, e_now)
-        status, rlimit, remaining, reset = jax.device_get(
+        sorted_out = jax.device_get(
             (resp.status, resp.limit, resp.remaining, resp.reset_time)
         )
+        out = []
+        for a in sorted_out:
+            u = np.empty_like(a)
+            u[order] = a
+            out.append(u)
+        status, rlimit, remaining, reset = out
         reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
 
@@ -320,8 +336,9 @@ class MeshEngine:
         if algo is None:
             algo = np.zeros(n, np.int32)
         e_now = self._engine_now(now)
-        req = pad_request(
+        req, _order = pad_request_sorted(
             self.buckets,
+            self.config.slots,
             key_hash,
             np.zeros(n, np.int64),
             limit,

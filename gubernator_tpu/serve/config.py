@@ -54,7 +54,11 @@ class ServerConfig:
     backend: str = "tpu"  # tpu | exact | mesh
     cache_size: int = 50_000  # exact backend capacity
     store_rows: int = 16  # slot-store geometry (tpu/mesh backends);
-    # 16 ways = 128-lane bucket rows, the fast TPU layout (core.store)
+    # 16 ways = 128-lane bucket rows, the fast TPU layout (core.store).
+    # NOTE: capacity = rows * slots. The defaults changed together
+    # (4 x 2^17 -> 16 x 2^15, same 524,288 entries); deployments pinning
+    # only one of GUBER_STORE_ROWS / GUBER_STORE_SLOTS should re-check
+    # the product, not just one knob.
     store_slots: int = 1 << 15
     # force a jax platform ("cpu", "tpu"); "" = jax default. Lets the
     # daemon run CPU-only on dev boxes where a TPU runtime is registered

@@ -313,3 +313,103 @@ def test_eviction_recreates_window(engine):
     resp = small.get_rate_limits(rs, now=T0 + 1)
     assert all(r.remaining in (3, 4) for r in resp)
     assert any(r.remaining == 4 for r in resp)
+
+
+# ------------------------------------------------------- presorted kernel
+
+
+def test_presorted_equals_wrapper_with_interspersed_invalids():
+    """decide_presorted under the caller contract (host-sorted rows,
+    padding repeats the last key, invalid rows possibly interspersed as
+    the mesh's ownership masking produces) matches the self-sorting
+    decide() wrapper row for row, and writes the same store."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from gubernator_tpu.core.kernels import (
+        BatchRequest,
+        decide,
+        decide_presorted,
+    )
+    from gubernator_tpu.core.store import (
+        StoreConfig,
+        group_sort_key_np,
+        new_store,
+    )
+
+    rng = np.random.default_rng(7)
+    cfg = StoreConfig(rows=16, slots=1 << 10)
+    B, n = 64, 50
+
+    for trial in range(4):
+        keys = rng.integers(1, 2**63, n, dtype=np.int64).astype(np.uint64)
+        keys = keys[rng.integers(0, n, n)]  # force duplicate keys
+        hits = rng.integers(0, 4, n).astype(np.int64)
+        limit = rng.integers(1, 6, n).astype(np.int64)
+        duration = np.full(n, 60_000, np.int64)
+        algo = rng.integers(0, 2, n).astype(np.int32)
+        # per-key validity mask (the mesh masks whole key groups)
+        key_valid = {k: bool(rng.random() < 0.7) for k in set(keys.tolist())}
+        valid_n = np.asarray([key_valid[k] for k in keys.tolist()])
+
+        # --- host-sorted presorted request, padding repeats last row ----
+        skey = group_sort_key_np(keys, cfg.slots)
+        order = np.argsort(skey, kind="stable")
+
+        def pad(x, fill_from_last=True):
+            out = np.empty(B, x.dtype)
+            out[:n] = x[order]
+            out[n:] = out[n - 1]
+            return out
+
+        valid = np.zeros(B, bool)
+        valid[:n] = valid_n[order]
+        req_sorted = BatchRequest(
+            key_hash=jnp.asarray(pad(keys)),
+            hits=jnp.asarray(pad(hits.astype(np.int32))),
+            limit=jnp.asarray(pad(limit.astype(np.int32))),
+            duration=jnp.asarray(pad(duration.astype(np.int32))),
+            algo=jnp.asarray(pad(algo)),
+            gnp=jnp.zeros(B, bool),
+            valid=jnp.asarray(valid),
+        )
+
+        # --- same batch, original order, through the wrapper ------------
+        def pad0(x, dtype):
+            out = np.zeros(B, dtype)
+            out[:n] = x
+            return out
+
+        valid0 = np.zeros(B, bool)
+        valid0[:n] = valid_n
+        req_orig = BatchRequest(
+            key_hash=jnp.asarray(pad0(keys, np.uint64)),
+            hits=jnp.asarray(pad0(hits, np.int32)),
+            limit=jnp.asarray(pad0(limit, np.int32)),
+            duration=jnp.asarray(pad0(duration, np.int32)),
+            algo=jnp.asarray(pad0(algo, np.int32)),
+            gnp=jnp.zeros(B, bool),
+            valid=jnp.asarray(valid0),
+        )
+
+        now = jnp.int32(1000 + trial)
+        s1, r1, st1 = jax.jit(decide_presorted)(
+            new_store(cfg), req_sorted, now
+        )
+        s2, r2, st2 = jax.jit(decide)(new_store(cfg), req_orig, now)
+
+        # unpermute the presorted responses host-side
+        for f in ("status", "limit", "remaining", "reset_time"):
+            a = np.asarray(getattr(r1, f))[:n]
+            u = np.empty_like(a)
+            u[order] = a
+            b = np.asarray(getattr(r2, f))[:n]
+            np.testing.assert_array_equal(
+                u[valid_n], b[valid_n], err_msg=f"{f} trial={trial}"
+            )
+        np.testing.assert_array_equal(
+            np.asarray(s1.data), np.asarray(s2.data), err_msg="store"
+        )
+        assert int(st1.hits) == int(st2.hits)
+        assert int(st1.misses) == int(st2.misses)
