@@ -188,6 +188,17 @@ def pad_request_sorted(
     return req, order
 
 
+def unpermute_responses(order: np.ndarray, sorted_arrays):
+    """Inverse of pad_request_sorted's row order: one O(B) numpy store
+    per response array (`out[order] = sorted`)."""
+    out = []
+    for a in sorted_arrays:
+        u = np.empty_like(a)
+        u[order] = a
+        out.append(u)
+    return out
+
+
 class EngineStats:
     def __init__(self):
         self.hits = 0
@@ -293,16 +304,13 @@ class TpuEngine:
         self.stats.hits += int(bstats.hits)
         self.stats.misses += int(bstats.misses)
         self.stats.batches += 1
-        sorted_out = jax.device_get(
-            (resp.status, resp.limit, resp.remaining, resp.reset_time)
-        )
         # responses come back in sorted order; one numpy pass unpermutes
-        out = []
-        for a in sorted_out:
-            u = np.empty_like(a)
-            u[order] = a
-            out.append(u)
-        status, rlimit, remaining, reset = out
+        status, rlimit, remaining, reset = unpermute_responses(
+            order,
+            jax.device_get(
+                (resp.status, resp.limit, resp.remaining, resp.reset_time)
+            ),
+        )
         reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
 

@@ -268,9 +268,13 @@ def decide_presorted(
       indices_are_sorted=True. Hosts pad by repeating the last real
       row's key with valid=False, which preserves monotonicity.
     - invalid rows may appear anywhere (the mesh path masks non-owned
-      rows in place, serve/parallel sharding), but all rows of one
-      same-key group share one validity (ownership and padding are
-      per-key properties).
+      rows in place, serve/parallel sharding), with one constraint: a
+      group containing any valid row must have a VALID leader (first
+      row). Ownership masking keeps whole groups uniform, and padding
+      appends invalid followers after the last valid row, so both
+      callers satisfy it; a hypothetical invalid-leader/valid-follower
+      group would silently skip its state write (w_mask gates on the
+      leader's validity).
 
     Moving the sort (and the response unsort) to the host removes the
     two largest fixed costs from the device program (~30% at B=16k on

@@ -42,6 +42,7 @@ from gubernator_tpu.core.engine import (
     _sat_i32,
     pad_request_sorted,
     pad_to_bucket,
+    unpermute_responses,
 )
 from gubernator_tpu.core.kernels import (
     BatchRequest,
@@ -277,15 +278,12 @@ class MeshEngine:
             gnp,
         )
         self.store, resp, _stats = self._step(self.store, req, e_now)
-        sorted_out = jax.device_get(
-            (resp.status, resp.limit, resp.remaining, resp.reset_time)
+        status, rlimit, remaining, reset = unpermute_responses(
+            order,
+            jax.device_get(
+                (resp.status, resp.limit, resp.remaining, resp.reset_time)
+            ),
         )
-        out = []
-        for a in sorted_out:
-            u = np.empty_like(a)
-            u[order] = a
-            out.append(u)
-        status, rlimit, remaining, reset = out
         reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
 

@@ -1,0 +1,292 @@
+"""BASELINE.json benchmark scenarios (configs 1-5), one JSON line each.
+
+The flagship single-chip number lives in bench.py (the driver runs it);
+this suite reproduces the full baseline matrix on whatever devices are
+visible:
+
+  1 token_1k    Token-bucket, 1k unique keys, single chip
+                (reference benchmark_test.go's CPU-baseline shape)
+  2 leaky_100k  Leaky-bucket, 100k keys, single chip
+  3 global_mesh GLOBAL behavior on a key-sharded device mesh: replica
+                reads + one psum gossip step per interval
+                (reference global.go's gossip -> collective)
+  4 zipf_10m    Zipfian 10M-key heavy-hitter workload, 1 GiB store,
+                single chip (HLL/topk observability runs host-side in
+                serving and is benched in its own tests)
+  5 mixed_shard Mixed token+leaky at v5e-32 scale: each chip owns
+                100M/32 ~= 3.1M keys of a mesh-sharded store; this runs
+                the per-chip slice, which is the number that multiplies
+                by the mesh size (decisions combine with one psum,
+                measured in scenario 3)
+
+Run: python scripts/bench_scenarios.py [--scenario N] [--cpu-mesh M]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
+    """Decisions/s for the presorted kernel over `key_space` keys."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gubernator_tpu.core.kernels import BatchRequest, decide_presorted
+    from gubernator_tpu.core.store import group_sort_key_np, new_store
+
+    R = 8
+    rng = np.random.default_rng(42)
+    store = new_store(store_cfg)
+    zipf = rng.zipf(1.2, size=(R, B)) % key_space
+    key_hash = (
+        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+        ^ np.uint64(0xDEADBEEFCAFEF00D)
+    )
+    limit = rng.integers(10, 10_000, (R, B))
+    order = np.argsort(
+        group_sort_key_np(key_hash, store_cfg.slots), axis=1, kind="stable"
+    )
+    key_hash = np.take_along_axis(key_hash, order, axis=1)
+    zipf_s = np.take_along_axis(zipf, order, axis=1)
+    limit = np.take_along_axis(limit, order, axis=1)
+    if algo_mode == "token":
+        algo = np.zeros((R, B), np.int32)
+    elif algo_mode == "leaky":
+        algo = np.ones((R, B), np.int32)
+    else:
+        algo = (zipf_s % 2).astype(np.int32)
+    reqs = BatchRequest(
+        key_hash=jnp.asarray(key_hash),
+        hits=jnp.ones((R, B), jnp.int32),
+        limit=jnp.asarray(limit, jnp.int32),
+        duration=jnp.full((R, B), 60_000, jnp.int32),
+        algo=jnp.asarray(algo),
+        gnp=jnp.zeros((R, B), bool),
+        valid=jnp.ones((R, B), bool),
+    )
+    t0 = jnp.int32(1000)
+
+    def steps(store, reqs):
+        def body(i, carry):
+            store, acc = carry
+            r = jax.tree.map(lambda x: x[i % R], reqs)
+            store, resp, _ = decide_presorted(store, r, t0 + i)
+            return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
+
+        return lax.fori_loop(0, S, body, (store, jnp.zeros((), jnp.int32)))
+
+    stepped = jax.jit(steps, donate_argnums=(0,))
+    store, acc = stepped(store, reqs)
+    jax.block_until_ready(acc)
+    best = float("inf")
+    for _ in range(reps):
+        t = time.monotonic()
+        store, acc = stepped(store, reqs)
+        jax.block_until_ready(acc)
+        best = min(best, time.monotonic() - t)
+    return S * B / best
+
+
+def scenario_token_1k():
+    from gubernator_tpu.core.store import StoreConfig
+
+    v = _measure_kernel(StoreConfig(rows=16, slots=1 << 12), 1_000, "token")
+    return "token_bucket_1k_keys_single_chip", v
+
+
+def scenario_leaky_100k():
+    from gubernator_tpu.core.store import StoreConfig
+
+    v = _measure_kernel(
+        StoreConfig(rows=16, slots=1 << 15), 100_000, "leaky"
+    )
+    return "leaky_bucket_100k_keys_single_chip", v
+
+
+def scenario_global_mesh():
+    """GLOBAL over a key-sharded mesh, fused on-device: every step
+    answers a batch of replica/owner reads against each chip's store
+    shard and combines with one psum; every 8th step runs the gossip
+    collective (owner peek + psum broadcast + replica upsert), i.e. a
+    sync interval of 8 batch windows (reference global.go's async
+    aggregate -> owner -> broadcast loop as collectives)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from gubernator_tpu.core.kernels import BatchRequest, decide_presorted
+    from gubernator_tpu.core.store import (
+        StoreConfig,
+        group_sort_key_np,
+        new_store,
+    )
+    from gubernator_tpu.parallel.sharded import (
+        _shard_decide,
+        _shard_sync_globals,
+    )
+
+    devs = jax.devices()
+    n = len(devs)
+    mesh = Mesh(np.asarray(devs), ("shard",))
+    cfg = StoreConfig(rows=16, slots=1 << 13)
+
+    B, KEYS, R, S = 16384, 100_000, 8, 256
+    rng = np.random.default_rng(42)
+    zipf = rng.zipf(1.2, size=(R, B)) % KEYS
+    kh = (
+        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+        ^ np.uint64(0xDEADBEEFCAFEF00D)
+    )
+    order = np.argsort(
+        group_sort_key_np(kh, cfg.slots), axis=1, kind="stable"
+    )
+    kh = np.take_along_axis(kh, order, axis=1)
+    reqs = BatchRequest(
+        key_hash=jnp.asarray(kh),
+        hits=jnp.ones((R, B), jnp.int32),
+        limit=jnp.full((R, B), 1000, jnp.int32),
+        duration=jnp.full((R, B), 60_000, jnp.int32),
+        algo=jnp.zeros((R, B), jnp.int32),
+        gnp=jnp.ones((R, B), bool),  # GLOBAL replica-read traffic
+        valid=jnp.ones((R, B), bool),
+    )
+    g_kh = jnp.asarray(kh[0, :1024])
+    t0 = jnp.int32(1000)
+
+    def body_all(store, reqs):
+        def body(i, carry):
+            store, acc = carry
+            r = jax.tree.map(lambda x: x[i % R], reqs)
+            store, resp, _ = _shard_decide(store, r, t0 + i, n_shards=n)
+
+            def do_sync(store):
+                store2, _resp = _shard_sync_globals(
+                    store,
+                    g_kh,
+                    jnp.full(1024, 1000, jnp.int32),
+                    jnp.full(1024, 60_000, jnp.int32),
+                    jnp.zeros(1024, jnp.int32),
+                    jnp.ones(1024, bool),
+                    t0 + i,
+                    n_shards=n,
+                )
+                return store2
+
+            store = lax.cond(i % 8 == 7, do_sync, lambda s: s, store)
+            return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
+
+        return lax.fori_loop(0, S, body, (store, jnp.zeros((), jnp.int32)))
+
+    stepped = jax.jit(
+        jax.shard_map(
+            body_all,
+            mesh=mesh,
+            in_specs=(P("shard"), P()),
+            out_specs=(P("shard"), P()),
+        ),
+        donate_argnums=(0,),
+    )
+
+    base = new_store(cfg)
+    sharding = NamedSharding(mesh, P("shard"))
+    store = jax.tree.map(
+        lambda x: jax.device_put(
+            jnp.broadcast_to(x[None], (n,) + x.shape), sharding
+        ),
+        base,
+    )
+    store, acc = stepped(store, reqs)
+    jax.block_until_ready(acc)
+    best = float("inf")
+    for _ in range(3):
+        t = time.monotonic()
+        store, acc = stepped(store, reqs)
+        jax.block_until_ready(acc)
+        best = min(best, time.monotonic() - t)
+    return f"global_mesh_{n}dev_psum_gossip", S * B / best
+
+
+def scenario_zipf_10m():
+    from gubernator_tpu.core.store import StoreConfig
+
+    # 2^21 buckets x 16 ways = 33.5M entries (1 GiB), ~30% load at 10M keys
+    v = _measure_kernel(
+        StoreConfig(rows=16, slots=1 << 21), 10_000_000, "mixed"
+    )
+    return "zipf_10m_keys_single_chip_1gib_store", v
+
+
+def scenario_mixed_shard():
+    from gubernator_tpu.core.store import StoreConfig
+
+    # per-chip slice of the v5e-32 config: 100M/32 keys against a
+    # 2^19-bucket shard (8.4M entries, 256 MiB per chip)
+    v = _measure_kernel(
+        StoreConfig(rows=16, slots=1 << 19), 3_125_000, "mixed"
+    )
+    return "mixed_100m_keys_v5e32_per_chip_slice", v
+
+
+SCENARIOS = {
+    1: scenario_token_1k,
+    2: scenario_leaky_100k,
+    3: scenario_global_mesh,
+    4: scenario_zipf_10m,
+    5: scenario_mixed_shard,
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--scenario", type=int, default=0, help="0 = all")
+    ap.add_argument(
+        "--cpu-mesh",
+        type=int,
+        default=0,
+        help="force an N-virtual-device CPU mesh (functional check of the "
+        "multi-chip path; perf numbers only mean anything on real chips)",
+    )
+    args = ap.parse_args()
+
+    if args.cpu_mesh:
+        # sitecustomize pre-imports jax against the TPU tunnel; env vars
+        # are too late — force through jax.config before first device use
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
+
+    import gubernator_tpu  # noqa: F401
+
+    todo = [args.scenario] if args.scenario else sorted(SCENARIOS)
+    for n in todo:
+        name, value = SCENARIOS[n]()
+        print(
+            json.dumps(
+                {
+                    "metric": name,
+                    "value": round(value, 1),
+                    "unit": "decisions/s",
+                    "vs_baseline": round(value / 2000.0, 1),
+                }
+            ),
+            flush=True,
+        )
+
+
+if __name__ == "__main__":
+    main()
