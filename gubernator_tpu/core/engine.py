@@ -52,6 +52,20 @@ from gubernator_tpu.core.store import (
 
 DEFAULT_BUCKETS = (64, 256, 1024, 4096)
 
+
+def _np_presort(key_hash: np.ndarray, store_buckets: int) -> np.ndarray:
+    return np.argsort(
+        group_sort_key_np(key_hash, store_buckets), kind="stable"
+    ).astype(np.int32)
+
+
+try:  # native LSD radix presort (~3.6x numpy at 16k keys); same order
+    from gubernator_tpu.native.hashlib_native import presort as _presort
+except (ImportError, AttributeError, OSError):  # pragma: no cover
+    # not built, or a stale .so predating guber_presort (AttributeError
+    # surfaces at binding time), or a load failure — numpy path works
+    _presort = _np_presort
+
 _I32_SAT = COUNTER_MAX
 
 
@@ -161,8 +175,7 @@ def pad_request_sorted(
     n = key_hash.shape[0]
     B = choose_bucket(buckets, n)
 
-    skey = group_sort_key_np(key_hash, store_buckets)
-    order_n = np.argsort(skey, kind="stable").astype(np.int32)
+    order_n = _presort(key_hash, store_buckets)
 
     def pad_sorted(x, dtype, sat=None):
         x = sat(x) if sat is not None else np.asarray(x, dtype)

@@ -26,6 +26,12 @@ _lib.guber_crc32_batch.argtypes = [
     ctypes.c_int64,
     ctypes.POINTER(ctypes.c_uint32),
 ]
+_lib.guber_presort.argtypes = [
+    ctypes.POINTER(ctypes.c_uint64),
+    ctypes.c_int64,
+    ctypes.c_uint64,
+    ctypes.POINTER(ctypes.c_int32),
+]
 
 # Fixed seed: slot hashes are instance-local but stable across restarts for
 # debuggability.
@@ -67,5 +73,21 @@ def crc32_batch(keys: List[str]) -> np.ndarray:
         offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
         len(keys),
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+    )
+    return out
+
+
+def presort(key_hash: np.ndarray, buckets: int) -> np.ndarray:
+    """int32[n] stable argsort of key hashes by (bucket, fingerprint) —
+    the order decide_presorted requires. Bit-identical to
+    np.argsort(store.group_sort_key_np(kh, buckets), kind="stable") and
+    ~15x faster (LSD radix in C)."""
+    kh = np.ascontiguousarray(key_hash, np.uint64)
+    out = np.empty(kh.shape[0], np.int32)
+    _lib.guber_presort(
+        kh.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        kh.shape[0],
+        ctypes.c_uint64(buckets),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return out

@@ -123,6 +123,8 @@ class PeersV1Servicer:
 class Server:
     """One daemon: gRPC + HTTP, an Instance, and discovery."""
 
+    _profiling = False
+
     def __init__(self, conf: ServerConfig, backend=None):
         self.conf = conf
         self.backend = backend if backend is not None else make_backend(conf)
@@ -180,6 +182,7 @@ class Server:
         app.router.add_get("/v1/HealthCheck", self._http_health)
         app.router.add_get("/metrics", self._http_metrics)
         app.router.add_get("/v1/debug/stats", self._http_debug_stats)
+        app.router.add_get("/v1/debug/profile", self._http_debug_profile)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
         host, _, port = self.conf.http_address.rpartition(":")
@@ -262,6 +265,63 @@ class Server:
         body = self.instance.traffic.snapshot(max(top_n, 0))
         body["backend"] = self.backend.stats()
         return web.json_response(body)
+
+    async def _http_debug_profile(self, request: web.Request):
+        """Capture a JAX/XLA device profile for ?ms= milliseconds (default
+        1000) and write it under ?dir= (default /tmp/guber-profile). View
+        with TensorBoard or Perfetto. The reference has no tracing at all
+        (SURVEY.md section 5); this is the TPU-native replacement for its
+        per-RPC Prometheus histograms when you need to see *inside* a
+        batch."""
+        import asyncio
+
+        import os.path
+
+        try:
+            ms = int(request.query.get("ms", "1000"))
+        except ValueError:
+            return web.json_response(
+                {"error": "'ms' must be an integer"}, status=400
+            )
+        ms = max(0, min(ms, 60_000))  # reported below as actually captured
+        # `name` is a single path component under a fixed base — this is
+        # the only write-capable endpoint on the HTTP surface, so clients
+        # must not be able to aim it at arbitrary paths
+        name = request.query.get("name", "trace")
+        if os.path.basename(name) != name or name in ("", ".", ".."):
+            return web.json_response(
+                {"error": "'name' must be a bare directory name"},
+                status=400,
+            )
+        out_dir = os.path.join("/tmp/guber-profile", name)
+        if self._profiling:
+            return web.json_response(
+                {"error": "profile already in progress"}, status=409
+            )
+        self._profiling = True
+        started = False
+        try:
+            import jax
+
+            jax.profiler.start_trace(out_dir)
+            started = True
+            await asyncio.sleep(ms / 1000.0)
+        except Exception as e:  # tunnel backends may not support tracing
+            return web.json_response(
+                {"error": f"profiler unavailable: {e}"}, status=501
+            )
+        finally:
+            # stop even on client disconnect (CancelledError) so the
+            # endpoint is usable again without a restart
+            if started:
+                try:
+                    import jax
+
+                    jax.profiler.stop_trace()
+                except Exception:
+                    log.exception("stop_trace failed")
+            self._profiling = False
+        return web.json_response({"trace_dir": out_dir, "captured_ms": ms})
 
     # -- discovery ----------------------------------------------------------
 

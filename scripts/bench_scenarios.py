@@ -36,18 +36,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
-    """Decisions/s for the presorted kernel over `key_space` keys."""
-    import jax
+R = 8  # distinct pre-staged batches cycled through every scenario
+
+
+def _zipf_batches(key_space, buckets, B, rng=None, gnp=False, algo_mode="mixed"):
+    """(BatchRequest [R,B], sorted zipf ids): presorted zipf traffic —
+    the one key/limit/sort recipe every scenario shares."""
     import jax.numpy as jnp
-    from jax import lax
 
-    from gubernator_tpu.core.kernels import BatchRequest, decide_presorted
-    from gubernator_tpu.core.store import group_sort_key_np, new_store
+    from gubernator_tpu.core.kernels import BatchRequest
+    from gubernator_tpu.core.store import group_sort_key_np
 
-    R = 8
-    rng = np.random.default_rng(42)
-    store = new_store(store_cfg)
+    rng = rng or np.random.default_rng(42)
     zipf = rng.zipf(1.2, size=(R, B)) % key_space
     key_hash = (
         (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
@@ -55,7 +55,7 @@ def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
     )
     limit = rng.integers(10, 10_000, (R, B))
     order = np.argsort(
-        group_sort_key_np(key_hash, store_cfg.slots), axis=1, kind="stable"
+        group_sort_key_np(key_hash, buckets), axis=1, kind="stable"
     )
     key_hash = np.take_along_axis(key_hash, order, axis=1)
     zipf_s = np.take_along_axis(zipf, order, axis=1)
@@ -66,15 +66,44 @@ def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
         algo = np.ones((R, B), np.int32)
     else:
         algo = (zipf_s % 2).astype(np.int32)
-    reqs = BatchRequest(
+    return BatchRequest(
         key_hash=jnp.asarray(key_hash),
         hits=jnp.ones((R, B), jnp.int32),
         limit=jnp.asarray(limit, jnp.int32),
         duration=jnp.full((R, B), 60_000, jnp.int32),
         algo=jnp.asarray(algo),
-        gnp=jnp.zeros((R, B), bool),
+        gnp=jnp.full((R, B), gnp, bool),
         valid=jnp.ones((R, B), bool),
-    )
+    ), zipf_s
+
+
+def _time_steps(stepped, store, reqs, B, S, reps=3):
+    """Best-of-reps decisions/s for a jitted S-step loop (warm-up run
+    first; store threads through via donation)."""
+    import jax
+
+    store, acc = stepped(store, reqs)
+    jax.block_until_ready(acc)
+    best = float("inf")
+    for _ in range(reps):
+        t = time.monotonic()
+        store, acc = stepped(store, reqs)
+        jax.block_until_ready(acc)
+        best = min(best, time.monotonic() - t)
+    return S * B / best
+
+
+def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
+    """Decisions/s for the presorted kernel over `key_space` keys."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gubernator_tpu.core.kernels import decide_presorted
+    from gubernator_tpu.core.store import new_store
+
+    store = new_store(store_cfg)
+    reqs, _ = _zipf_batches(key_space, store_cfg.slots, B, algo_mode=algo_mode)
     t0 = jnp.int32(1000)
 
     def steps(store, reqs):
@@ -87,15 +116,7 @@ def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
         return lax.fori_loop(0, S, body, (store, jnp.zeros((), jnp.int32)))
 
     stepped = jax.jit(steps, donate_argnums=(0,))
-    store, acc = stepped(store, reqs)
-    jax.block_until_ready(acc)
-    best = float("inf")
-    for _ in range(reps):
-        t = time.monotonic()
-        store, acc = stepped(store, reqs)
-        jax.block_until_ready(acc)
-        best = min(best, time.monotonic() - t)
-    return S * B / best
+    return _time_steps(stepped, store, reqs, B, S, reps)
 
 
 def scenario_token_1k():
@@ -128,12 +149,7 @@ def scenario_global_mesh():
     from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-    from gubernator_tpu.core.kernels import BatchRequest, decide_presorted
-    from gubernator_tpu.core.store import (
-        StoreConfig,
-        group_sort_key_np,
-        new_store,
-    )
+    from gubernator_tpu.core.store import StoreConfig, new_store
     from gubernator_tpu.parallel.sharded import (
         _shard_decide,
         _shard_sync_globals,
@@ -144,27 +160,10 @@ def scenario_global_mesh():
     mesh = Mesh(np.asarray(devs), ("shard",))
     cfg = StoreConfig(rows=16, slots=1 << 13)
 
-    B, KEYS, R, S = 16384, 100_000, 8, 256
-    rng = np.random.default_rng(42)
-    zipf = rng.zipf(1.2, size=(R, B)) % KEYS
-    kh = (
-        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
-        ^ np.uint64(0xDEADBEEFCAFEF00D)
-    )
-    order = np.argsort(
-        group_sort_key_np(kh, cfg.slots), axis=1, kind="stable"
-    )
-    kh = np.take_along_axis(kh, order, axis=1)
-    reqs = BatchRequest(
-        key_hash=jnp.asarray(kh),
-        hits=jnp.ones((R, B), jnp.int32),
-        limit=jnp.full((R, B), 1000, jnp.int32),
-        duration=jnp.full((R, B), 60_000, jnp.int32),
-        algo=jnp.zeros((R, B), jnp.int32),
-        gnp=jnp.ones((R, B), bool),  # GLOBAL replica-read traffic
-        valid=jnp.ones((R, B), bool),
-    )
-    g_kh = jnp.asarray(kh[0, :1024])
+    B, KEYS, S = 16384, 100_000, 256
+    # token-only GLOBAL replica-read traffic over the shared zipf recipe
+    reqs, _ = _zipf_batches(KEYS, cfg.slots, B, gnp=True, algo_mode="token")
+    g_kh = reqs.key_hash[0, :1024]
     t0 = jnp.int32(1000)
 
     def body_all(store, reqs):
@@ -209,15 +208,10 @@ def scenario_global_mesh():
         ),
         base,
     )
-    store, acc = stepped(store, reqs)
-    jax.block_until_ready(acc)
-    best = float("inf")
-    for _ in range(3):
-        t = time.monotonic()
-        store, acc = stepped(store, reqs)
-        jax.block_until_ready(acc)
-        best = min(best, time.monotonic() - t)
-    return f"global_mesh_{n}dev_psum_gossip", S * B / best
+    return (
+        f"global_mesh_{n}dev_psum_gossip",
+        _time_steps(stepped, store, reqs, B, S),
+    )
 
 
 def scenario_zipf_10m():

@@ -59,3 +59,26 @@ def test_mix64_avalanche():
     mixed = hashing.mix64(x)
     # sequential inputs must not produce sequential outputs
     assert len(set((mixed % np.uint64(1024)).tolist())) > 600
+
+
+def test_native_presort_matches_numpy():
+    """The C radix presort must order exactly like the numpy reference
+    (stable argsort of group_sort_key_np) — decide_presorted's caller
+    contract depends on it."""
+    hashlib_native = pytest.importorskip(
+        "gubernator_tpu.native.hashlib_native"
+    )
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 7, 1000, 16384):
+        for buckets in (1 << 10, 1 << 15, 1 << 21):
+            kh = rng.integers(0, 2**63, n, dtype=np.int64).astype(np.uint64)
+            # force duplicates (stability matters)
+            if n > 10:
+                kh[n // 2 :] = kh[: n - n // 2]
+            want = np.argsort(
+                group_sort_key_np(kh, buckets), kind="stable"
+            )
+            got = hashlib_native.presort(kh, buckets)
+            assert (want == got).all(), (n, buckets)
