@@ -20,9 +20,11 @@ lock traffic.
 from __future__ import annotations
 
 import bisect
+import functools
 from typing import List, Optional, Sequence, Tuple
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from gubernator_tpu.api.types import (
@@ -35,8 +37,10 @@ from gubernator_tpu.api.types import (
 from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.kernels import (
     BatchRequest,
-    decide_presorted_jit,
+    decide_presorted,
+    pack_outputs,
     rebase_jit,
+    unpack_outputs,
     upsert_globals_jit,
 )
 from gubernator_tpu.core.store import (
@@ -53,6 +57,13 @@ from gubernator_tpu.core.store import (
 DEFAULT_BUCKETS = (64, 256, 1024, 4096)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _decide_packed_jit(store, req, now):
+    """decide_presorted + pack_outputs: one host transfer per batch."""
+    store, resp, stats = decide_presorted(store, req, now)
+    return store, pack_outputs(resp, stats)
+
+
 def _np_presort(key_hash: np.ndarray, store_buckets: int) -> np.ndarray:
     return np.argsort(
         group_sort_key_np(key_hash, store_buckets), kind="stable"
@@ -60,10 +71,13 @@ def _np_presort(key_hash: np.ndarray, store_buckets: int) -> np.ndarray:
 
 
 try:  # native LSD radix presort (~3.6x numpy at 16k keys); same order
-    from gubernator_tpu.native.hashlib_native import presort as _presort
+    from gubernator_tpu.native import hashlib_native as _hn
+
+    if not _hn._HAS_PRESORT:  # stale prebuilt .so without the symbol
+        raise AttributeError("guber_presort missing")
+    _presort = _hn.presort
 except (ImportError, AttributeError, OSError):  # pragma: no cover
-    # not built, or a stale .so predating guber_presort (AttributeError
-    # surfaces at binding time), or a load failure — numpy path works
+    # not built / stale / load failure — the numpy path works
     _presort = _np_presort
 
 _I32_SAT = COUNTER_MAX
@@ -311,18 +325,17 @@ class TpuEngine:
             algo,
             gnp,
         )
-        self.store, resp, bstats = decide_presorted_jit(
-            self.store, req, e_now
+        self.store, packed = _decide_packed_jit(self.store, req, e_now)
+        packed = np.asarray(jax.device_get(packed))
+        s_status, s_lim, s_rem, s_reset, b_hits, b_misses = unpack_outputs(
+            packed, req.key_hash.shape[0]
         )
-        self.stats.hits += int(bstats.hits)
-        self.stats.misses += int(bstats.misses)
+        self.stats.hits += int(b_hits)
+        self.stats.misses += int(b_misses)
         self.stats.batches += 1
         # responses come back in sorted order; one numpy pass unpermutes
         status, rlimit, remaining, reset = unpermute_responses(
-            order,
-            jax.device_get(
-                (resp.status, resp.limit, resp.remaining, resp.reset_time)
-            ),
+            order, (s_status, s_lim, s_rem, s_reset)
         )
         reset = self.clock.from_engine(reset)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]

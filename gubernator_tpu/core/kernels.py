@@ -755,9 +755,33 @@ def upsert_globals(
     )
 
 
-@functools.partial(jax.jit, donate_argnums=(0,))
-def decide_presorted_jit(store, req, now):
-    return decide_presorted(store, req, now)
+def pack_outputs(resp: BatchResponse, stats: BatchStats) -> jax.Array:
+    """Responses + stats as ONE int32[4*B+2] array: remote/tunneled
+    devices charge per transfer, so hosts fetch a single array and split
+    with unpack_outputs (measured 320ms -> 114ms per 1k batch through
+    the axon tunnel; locally it removes five dispatch round-trips)."""
+    return jnp.concatenate(
+        [
+            resp.status,
+            resp.limit,
+            resp.remaining,
+            resp.reset_time,
+            jnp.stack([stats.hits, stats.misses]),
+        ]
+    )
+
+
+def unpack_outputs(packed, B: int):
+    """(status, limit, remaining, reset_time, hits, misses) from a
+    pack_outputs array (host-side numpy or device array)."""
+    return (
+        packed[0:B],
+        packed[B : 2 * B],
+        packed[2 * B : 3 * B],
+        packed[3 * B : 4 * B],
+        packed[4 * B],
+        packed[4 * B + 1],
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

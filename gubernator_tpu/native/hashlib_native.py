@@ -26,12 +26,17 @@ _lib.guber_crc32_batch.argtypes = [
     ctypes.c_int64,
     ctypes.POINTER(ctypes.c_uint32),
 ]
-_lib.guber_presort.argtypes = [
-    ctypes.POINTER(ctypes.c_uint64),
-    ctypes.c_int64,
-    ctypes.c_uint64,
-    ctypes.POINTER(ctypes.c_int32),
-]
+try:  # symbol absent in a stale prebuilt .so — the hash/crc fast paths
+    # above must keep working regardless; presort() raises if missing
+    _lib.guber_presort.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+    _HAS_PRESORT = True
+except AttributeError:
+    _HAS_PRESORT = False
 
 # Fixed seed: slot hashes are instance-local but stable across restarts for
 # debuggability.
@@ -82,6 +87,11 @@ def presort(key_hash: np.ndarray, buckets: int) -> np.ndarray:
     the order decide_presorted requires. Bit-identical to
     np.argsort(store.group_sort_key_np(kh, buckets), kind="stable") and
     ~15x faster (LSD radix in C)."""
+    if not _HAS_PRESORT:
+        raise AttributeError(
+            "libguberhash.so predates guber_presort; rebuild with "
+            "make -C gubernator_tpu/native"
+        )
     kh = np.ascontiguousarray(key_hash, np.uint64)
     out = np.empty(kh.shape[0], np.int32)
     _lib.guber_presort(

@@ -39,7 +39,9 @@ def log(*a):
 R = 8  # distinct pre-staged batches cycled through every scenario
 
 
-def _zipf_batches(key_space, buckets, B, rng=None, gnp=False, algo_mode="mixed"):
+def _zipf_batches(
+    key_space, buckets, B, rng=None, gnp=False, algo_mode="mixed", limit=None
+):
     """(BatchRequest [R,B], sorted zipf ids): presorted zipf traffic —
     the one key/limit/sort recipe every scenario shares."""
     import jax.numpy as jnp
@@ -53,7 +55,9 @@ def _zipf_batches(key_space, buckets, B, rng=None, gnp=False, algo_mode="mixed")
         (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
         ^ np.uint64(0xDEADBEEFCAFEF00D)
     )
-    limit = rng.integers(10, 10_000, (R, B))
+    limit = np.full((R, B), limit) if limit else rng.integers(
+        10, 10_000, (R, B)
+    )
     order = np.argsort(
         group_sort_key_np(key_hash, buckets), axis=1, kind="stable"
     )
@@ -162,7 +166,10 @@ def scenario_global_mesh():
 
     B, KEYS, S = 16384, 100_000, 256
     # token-only GLOBAL replica-read traffic over the shared zipf recipe
-    reqs, _ = _zipf_batches(KEYS, cfg.slots, B, gnp=True, algo_mode="token")
+    # fixed limit=1000 keeps this metric comparable across runs
+    reqs, _ = _zipf_batches(
+        KEYS, cfg.slots, B, gnp=True, algo_mode="token", limit=1000
+    )
     g_kh = reqs.key_hash[0, :1024]
     t0 = jnp.int32(1000)
 
