@@ -161,6 +161,25 @@ def _segment_ends(is_leader: jax.Array, ar: jax.Array) -> jax.Array:
     )
 
 
+def _use_sweep_writeback(buckets: int, W: int, B: int) -> bool:
+    """Trace-time opt-in for the pallas store-sweep writeback
+    (core/pallas_sweep.py) via GUBER_WRITEBACK=sweep. The XLA scatter
+    remains the default — it currently measures faster (see the sweep
+    module's STATUS note)."""
+    import os
+
+    if os.environ.get("GUBER_WRITEBACK", "scatter") != "sweep":
+        return False
+    from gubernator_tpu.core.pallas_sweep import CHUNK, TILE_ROWS
+
+    return (
+        W == 128
+        and buckets % TILE_ROWS == 0
+        and B >= CHUNK
+        and B % 8 == 0
+    )
+
+
 def _writeback_delta_add(
     data: jax.Array,  # int32[buckets, ways*LANES]
     bkt: jax.Array,  # int32[B] bucket per item, sorted non-decreasing,
@@ -251,6 +270,10 @@ def _writeback_delta_add(
         dmask[:, :, None], delta8[:, None, :], 0
     ).reshape(B, W)
 
+    if _use_sweep_writeback(buckets, W, B):
+        from gubernator_tpu.core.pallas_sweep import _apply_inline
+
+        return _apply_inline(data, bkt, drow)
     return data.at[bkt].add(drow, indices_are_sorted=True)
 
 

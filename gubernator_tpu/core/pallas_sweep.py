@@ -1,0 +1,198 @@
+"""Pallas store-sweep writeback: apply delta rows with DMA + MXU matmuls.
+
+The XLA scatter that applies the decide kernel's delta rows costs ~300us
+at B=16k on v5e — ~15x off the HBM bandwidth bound for the 16 MiB it
+actually moves — because XLA lowers scatter as serialized row updates.
+This kernel instead SWEEPS the whole store once per batch:
+
+  for each tile of TILE_ROWS bucket rows (grid):  [Mosaic pipelines tiles]
+    for each chunk of up to CHUNK update rows whose (sorted) bucket falls
+    in the tile (dynamic range via scalar-prefetched searchsorted bounds):
+      DMA the chunk's combined rows HBM -> VMEM
+      M[c, r] = 1.0 where chunk row c targets tile row r   (one-hot)
+      tile += M^T @ chunk_deltas                            (MXU)
+
+Update rows arrive as ONE combined int32[B, 256] array: lanes 0-127 are
+the delta row, lanes 128-255 replicate the row's bucket id — Mosaic DMA
+slices must be whole 128-lane groups, so shipping the bucket inside the
+row sidesteps unaligned narrow copies and needs just one DMA per chunk.
+TILE_ROWS == 128 keeps the one-hot comparison a pure [CHUNK, 128]
+vector op against a lane iota (no sub-lane slicing anywhere).
+
+Exactness of the matmuls: the writeback contract guarantees at most
+ONE update row touches any (bucket, lane) cell (way-disjointness,
+kernels._writeback_delta_add), so every output cell is a sum of one
+value and zeros — no accumulation rounding. Values themselves exceed
+the MXU's bf16 pass precision, so each int32 delta is split into four
+8-bit halves (each exact in bf16), matmul'd separately, and recombined
+in int32 (wrap-safe: the shifted sums reassemble delta mod 2^32).
+
+STATUS: bit-exact on v5e but currently ~30% SLOWER than the XLA
+scatter-add it would replace (~330us vs ~250us at B=16k; per-tile DMA
+waits don't pipeline and the one-hot matmuls pad ~64 real updates per
+tile to CHUNK rows). Kept as the opt-in GUBER_WRITEBACK=sweep path: it
+documents the pallas approach, and workloads with much larger batches
+(more updates per tile) shift the balance toward the sweep.
+
+Because the update stream is bucket-sorted, rows DMA'd beyond the tile's
+[lo, hi) range map outside [0, TILE_ROWS) and one-hot to zero — the
+sort does the range masking for free; only re-reads caused by clamping
+a chunk's start against the end of the array need an explicit index
+mask.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE_ROWS = 128  # bucket rows per grid step (== lane width: see docstring)
+CHUNK = 128  # update rows per DMA/matmul chunk
+
+
+def _kernel(
+    bounds_ref,  # SMEM int32[ntiles+1]: searchsorted tile ranges
+    data_ref,  # VMEM int32[TILE_ROWS, 128] current tile (aliased out)
+    comb_ref,  # ANY int32[B, 256]: delta lanes 0-127, bucket id 128-255
+    out_ref,  # VMEM int32[TILE_ROWS, 128]
+    comb_s,  # VMEM scratch int32[CHUNK, 256]
+    sem,  # DMA semaphore
+):
+    t = pl.program_id(0)
+    B = comb_ref.shape[0]
+    lo = bounds_ref[t]
+    hi = bounds_ref[t + 1]
+    tile_base = t * TILE_ROWS
+
+    acc0 = data_ref[:]
+
+    # chunk windows advance from an 8-aligned base so every dynamic DMA
+    # start is provably sublane-aligned AND windows tile [lo_al, hi) with
+    # no gaps. The re-read prefix [lo_al, lo) belongs to the previous
+    # tile, whose buckets one-hot to zero here — the sort masks it free.
+    lo_al8 = lo // 8
+
+    def chunk_body(c, acc):
+        want8 = lo_al8 + c * (CHUNK // 8)
+        start8 = jnp.minimum(want8, (B - CHUNK) // 8)  # end clamp
+        start = start8 * 8
+        cp = pltpu.make_async_copy(
+            comb_ref.at[pl.ds(start, CHUNK), :], comb_s, sem
+        )
+        cp.start()
+        cp.wait()
+
+        d = comb_s[:, :128]
+        rel = comb_s[:, 128:] - tile_base  # [CHUNK, 128], lanes identical
+        gidx = start + lax.broadcasted_iota(jnp.int32, (CHUNK, 128), 0)
+        # rows before this chunk's intended window were handled by the
+        # previous chunk (re-read only happens under the end clamp)
+        fresh = gidx >= want8 * 8
+        row_ids = lax.broadcasted_iota(jnp.int32, (CHUNK, 128), 1)
+        onehot = ((rel == row_ids) & fresh).astype(jnp.float32)
+
+        contract = (((0,), (0,)), ((), ()))  # sum over the CHUNK dim
+        # int32 deltas split into four 8-bit halves: each is exactly
+        # representable in bf16 (8 mantissa bits), so the MXU's default
+        # single-pass bf16 matmul is exact — measured faster than two
+        # 16-bit halves at 3-pass HIGHEST precision
+        parts = (
+            (d & 0xFF, 0),
+            ((d >> 8) & 0xFF, 8),
+            ((d >> 16) & 0xFF, 16),
+            (d >> 24, 24),
+        )
+        for p, shift in parts:
+            r = lax.dot_general(
+                onehot,
+                p.astype(jnp.float32),
+                contract,
+                preferred_element_type=jnp.float32,
+            ).astype(jnp.int32)
+            acc = acc + (r << shift)
+        return acc
+
+    nchunks = (hi - lo_al8 * 8 + CHUNK - 1) // CHUNK
+    out_ref[:] = lax.fori_loop(0, nchunks, chunk_body, acc0)
+
+
+def _apply_inline(
+    data: jax.Array,  # int32[buckets, 128]
+    bkt: jax.Array,  # int32[B] sorted non-decreasing, in range
+    drow: jax.Array,  # int32[B, 128] zero rows for non-writers
+    interpret: bool = False,
+) -> jax.Array:
+    """data with every delta row added at its bucket; traceable inside a
+    larger jit (kernels._writeback_delta_add's opt-in path). Requires
+    buckets % TILE_ROWS == 0, 128 lanes, B >= CHUNK, and B % 8 == 0
+    (the chunk windows advance in 8-row sublane steps; a ragged tail
+    would fall outside every window and its updates would be lost)."""
+    buckets, W = data.shape
+    assert W == 128 and buckets % TILE_ROWS == 0
+    B = bkt.shape[0]
+    assert B >= CHUNK, "use the XLA scatter for small batches"
+    assert B % 8 == 0, "B must be a multiple of the sublane tiling (8)"
+    ntiles = buckets // TILE_ROWS
+
+    bounds = jnp.searchsorted(
+        bkt, jnp.arange(ntiles + 1, dtype=jnp.int32) * TILE_ROWS, side="left"
+    ).astype(jnp.int32)
+    comb = jnp.concatenate(
+        [drow, jnp.broadcast_to(bkt[:, None], (B, 128))], axis=1
+    )
+
+    # The session runs with x64 enabled (uint64 key hashes); tracing this
+    # kernel under x64 trips an astype recursion inside pallas (jax
+    # v0.9.x). Every input here is int32, so trace the pallas_call with
+    # x64 locally disabled — numerics are identical.
+    with jax.enable_x64(False):
+        return _call(data, bounds, comb, ntiles, buckets, interpret)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def sweep_apply(data: jax.Array, bkt: jax.Array, drow: jax.Array):
+    """Standalone jitted _apply_inline (tests, benchmarks)."""
+    return _apply_inline(data, bkt, drow)
+
+
+def _call(data, bounds, comb, ntiles, buckets, interpret=False):
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(ntiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (TILE_ROWS, 128), lambda t, bounds: (t, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec(
+            (TILE_ROWS, 128), lambda t, bounds: (t, 0),
+            memory_space=pltpu.VMEM,
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((CHUNK, 256), jnp.int32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    kwargs = (
+        dict(interpret=True)
+        if interpret
+        else dict(
+            compiler_params=pltpu.CompilerParams(
+                dimension_semantics=("arbitrary",)
+            )
+        )
+    )
+    return pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((buckets, 128), jnp.int32),
+        grid_spec=grid_spec,
+        input_output_aliases={1: 0},
+        **kwargs,
+    )(bounds, data, comb)
