@@ -52,6 +52,17 @@ hot-key case) and is conservative otherwise; since the reference's own
 ordering is scheduler-dependent, any such consistent order is within its
 observable envelope. Same-batch duplicates with *different* algorithms or
 behaviors resolve with group-leader (first in batch order) semantics.
+One observable consequence: when every duplicate mismatches the STORED
+entry's algorithm, the reference recreates the window once per request
+(each call wipes and recreates, algorithms.go:33-38,100-105) while this
+kernel recreates once per batch and charges the remaining duplicates
+against the new window — a strictly more useful behavior for what is a
+pathological, scheduler-dependent case in the reference. Similarly,
+same-batch duplicate leaky PEEKS (hits=0) all read one state snapshot,
+whereas the reference's sequential peeks each re-apply the sub-tick
+leak (a peek persists the replenished remaining without advancing the
+timestamp, algorithms.go:118-138) and so can ratchet remaining upward
+call by call within one tick.
 
 Time enters as one int32 engine-ms scalar `now` per batch; all requests in
 a batch share it.
@@ -484,7 +495,14 @@ def decide_presorted(
     # (eligible already guarantees h <= R0)
     charged = eligible & ~is_creation_leader & (S <= R0 - h)
     charged = charged | (is_creation_leader & charged_ldr)
-    rem_b = jnp.maximum(R0 - S, 0)  # budget visible to j
+    # Attempt-inflated budget: used ONLY for the decr predicate below.
+    # For CHARGED positions S == the charged-only prefix (once an
+    # equal-or-smaller attempt is refused every later one is too), so
+    # decr is unaffected by the inflation; REPORTED remaining must use
+    # the charged-only prefix instead (rem_vis) or refused duplicates
+    # would see phantom consumption (sequential-greedy reports the true
+    # leftover to refused requests).
+    rem_b = jnp.maximum(R0 - S, 0)
 
     # Real (charged-only) depletion prefix: refused duplicates inflate S but
     # consume nothing, so persistence decisions must not use S.
@@ -502,6 +520,7 @@ def decide_presorted(
     S_chg = prefix2[:, 0]
     total_charged = totals2[:, 0]
     any_decr = totals2[:, 1] > 0
+    rem_vis = jnp.maximum(R0 - S_chg, 0)  # true budget visible to j
 
     z = viable & ~eff_leaky & (R0 - S_chg == 0) & ~is_creation_leader
     _, totals3 = bool_group_reduce(z)
@@ -513,22 +532,22 @@ def decide_presorted(
 
     # token, existing-style position (incl. followers of a creation)
     tok_status = jnp.where(
-        rem_b == 0,
+        rem_vis == 0,
         OVER,
         jnp.where(charged | (h == 0), st_cached, OVER),
     )
     tok_remaining = jnp.where(
-        rem_b == 0, 0, jnp.where(charged, rem_b - h, rem_b)
+        rem_vis == 0, 0, jnp.where(charged, rem_vis - h, rem_vis)
     )
     g_expire_new = jnp.where(existing, g_exp, now + g_durQ)
     tok_reset = g_expire_new
 
     # leaky, existing-style position: status is computed fresh each call and
     # reset_time only appears on OVER paths (algorithms.go:123-160)
-    lk_over = (rem_b == 0) | (~charged & (h != 0))
+    lk_over = (rem_vis == 0) | (~charged & (h != 0))
     lk_status = jnp.where(lk_over, OVER, UNDER)
     lk_remaining = jnp.where(
-        rem_b == 0, 0, jnp.where(charged, rem_b - h, rem_b)
+        rem_vis == 0, 0, jnp.where(charged, rem_vis - h, rem_vis)
     )
     lk_reset = jnp.where(lk_over, now + rate, 0)
 
