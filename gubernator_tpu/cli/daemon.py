@@ -32,6 +32,57 @@ def main(argv=None) -> int:
         level="debug" if conf.debug else conf.log_level,
         json_format=conf.log_json,
     )
+
+    if conf.dist_coordinator:
+        # multi-host mesh: join the jax.distributed program first; then
+        # process 0 serves while every other process runs the lockstep
+        # follower loop until the leader closes the step pipe
+        from gubernator_tpu.parallel.multihost import (
+            MultiHostMeshEngine,
+            initialize_distributed,
+        )
+
+        # fail fast on the misconfigurations that otherwise deadlock the
+        # whole mesh inside a collective or an accept() loop
+        if conf.dist_process_id == 0:
+            if conf.backend != "multihost":
+                raise SystemExit(
+                    "GUBER_DIST_COORDINATOR is set but GUBER_BACKEND="
+                    f"{conf.backend!r}; the leader must use "
+                    "GUBER_BACKEND=multihost"
+                )
+            if len(conf.dist_followers) != conf.dist_num_processes - 1:
+                raise SystemExit(
+                    f"GUBER_DIST_FOLLOWERS lists "
+                    f"{len(conf.dist_followers)} addresses but "
+                    f"GUBER_DIST_NUM_PROCESSES={conf.dist_num_processes} "
+                    "implies "
+                    f"{conf.dist_num_processes - 1} followers"
+                )
+        elif not conf.dist_step_listen:
+            raise SystemExit(
+                "follower processes (GUBER_DIST_PROCESS_ID > 0) require "
+                "GUBER_DIST_STEP_LISTEN"
+            )
+
+        if conf.jax_platform:
+            import jax
+
+            jax.config.update("jax_platforms", conf.jax_platform)
+        initialize_distributed(
+            conf.dist_coordinator,
+            conf.dist_num_processes,
+            conf.dist_process_id,
+        )
+        if conf.dist_process_id != 0:
+            from gubernator_tpu.core.store import StoreConfig
+
+            eng = MultiHostMeshEngine(
+                StoreConfig(rows=conf.store_rows, slots=conf.store_slots)
+            )
+            eng.follower_loop(conf.dist_step_listen)
+            return 0
+
     asyncio.run(run_daemon(conf))
     return 0
 

@@ -1,0 +1,291 @@
+"""Multi-host mesh: one logical device mesh spanning several processes.
+
+`parallel/sharded.py` shards the key space over the chips of ONE host
+(single-controller). This module extends the same engine across hosts the
+way JAX scales: `jax.distributed` turns N processes into one SPMD program
+over a global `Mesh`, and the psum that combines per-shard decisions rides
+ICI within a host and DCN (gloo/TCP on CPU, ICI/DCN collectives on TPU
+pods) between hosts — the moral equivalent of the reference wiring more
+peers into its gossip mesh (reference peers.go/global.go), except the
+"gossip" is a compiler-scheduled collective.
+
+Multi-controller SPMD requires every process to issue the SAME jitted
+calls in the same order. Serving is request-driven on the leader
+(process 0), so followers run a lockstep loop fed by a step pipe: before
+each device call the leader broadcasts (kind, now, arrays) over plain
+length-prefixed TCP; every process then issues the identical call. The
+pipe is a trusted-cluster side channel exactly like the reference's
+insecure peer gRPC (reference peers.go:130-139); a follower failure
+surfaces as a broken pipe and the cluster restarts fresh — the documented
+state-loss contract (reference architecture.md:5-11).
+
+Scaling model (BASELINE config 5, v5e-32 = 4 hosts x 8 chips): each chip
+owns 1/32 of the key space; decisions need one all-reduce over the 32
+shards. On real pods the mesh axis should be ordered so that the
+reduction's intra-host hops ride ICI and only the host-level combine
+crosses DCN — jax device order (process-major) does this by default.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import pickle
+import socket
+import struct
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("gubernator_tpu.multihost")
+
+_MAGIC = b"GMH1"
+
+
+def _encode_msg(obj: dict) -> bytes:
+    buf = io.BytesIO()
+    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = buf.getvalue()
+    return _MAGIC + struct.pack("<Q", len(payload)) + payload
+
+
+def _send_msg(sock: socket.socket, obj: dict) -> None:
+    sock.sendall(_encode_msg(obj))
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("step pipe closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> dict:
+    if _recv_exact(sock, 4) != _MAGIC:
+        raise ConnectionError("step pipe desync")
+    (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class StepPipe:
+    """Leader side: broadcast each device step to every follower and wait
+    for acks (the ack keeps processes in lockstep so no follower falls
+    more than one collective behind)."""
+
+    def __init__(self, follower_addrs: Sequence[str], timeout_s: float = 30.0):
+        import time
+
+        self.socks: List[socket.socket] = []
+        for addr in follower_addrs:
+            host, _, port = addr.rpartition(":")
+            deadline = time.monotonic() + timeout_s
+            while True:  # follower binds its listener after the jax
+                # rendezvous; retry until it is up
+                try:
+                    s = socket.create_connection((host, int(port)), timeout=5)
+                    break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise
+                    time.sleep(0.1)
+            s.settimeout(None)  # connect timeout must not cap step acks
+            s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self.socks.append(s)
+
+    def broadcast(self, msg: dict) -> None:
+        wire = _encode_msg(msg)  # serialize once for every follower
+        for s in self.socks:
+            s.sendall(wire)
+
+    def await_acks(self) -> None:
+        for s in self.socks:
+            m = _recv_msg(s)
+            if m.get("kind") != "ack":
+                raise RuntimeError(f"unexpected follower reply: {m}")
+
+    def close(self) -> None:
+        for s in self.socks:
+            try:
+                _send_msg(s, {"kind": "shutdown"})
+                s.close()
+            except OSError:
+                pass
+
+
+def initialize_distributed(
+    coordinator: str, num_processes: int, process_id: int
+) -> None:
+    """jax.distributed.initialize with the platform this image needs
+    forced first (the TPU tunnel pre-registers itself)."""
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+class MultiHostMeshEngine:
+    """MeshEngine over the GLOBAL device mesh plus the leader-side step
+    pipe. Construct identically in every process; only the leader calls
+    the public decide/update/sync methods (followers run follower_loop).
+    """
+
+    def __init__(
+        self,
+        store_config,
+        followers: Optional[Sequence[str]] = None,
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+    ):
+        import jax
+
+        from gubernator_tpu.parallel.sharded import MeshEngine
+
+        self.is_leader = jax.process_index() == 0
+        self.inner = MeshEngine(
+            store_config, devices=jax.devices(), buckets=buckets
+        )
+        self.pipe = (
+            StepPipe(followers) if (self.is_leader and followers) else None
+        )
+
+    @property
+    def buckets(self):
+        return self.inner.buckets
+
+    # -- leader API ---------------------------------------------------------
+
+    def _lockstep(self, msg: dict) -> None:
+        if self.pipe:
+            self.pipe.broadcast(msg)
+
+    def _done(self) -> None:
+        if self.pipe:
+            self.pipe.await_acks()
+
+    def decide_arrays(self, key_hash, hits, limit, duration, algo, gnp, now):
+        assert self.is_leader
+        self._lockstep(
+            {
+                "kind": "decide",
+                "key_hash": key_hash,
+                "hits": hits,
+                "limit": limit,
+                "duration": duration,
+                "algo": algo,
+                "gnp": gnp,
+                "now": now,
+            }
+        )
+        try:
+            return self.inner.decide_arrays(
+                key_hash, hits, limit, duration, algo, gnp, now
+            )
+        finally:
+            self._done()
+
+    def update_globals(self, key_hash, limit, remaining, reset_time, is_over,
+                       now=None):
+        assert self.is_leader
+        from gubernator_tpu.api.types import millisecond_now
+
+        now = millisecond_now() if now is None else now
+        self._lockstep(
+            {
+                "kind": "upsert",
+                "key_hash": key_hash,
+                "limit": limit,
+                "remaining": remaining,
+                "reset_time": reset_time,
+                "is_over": is_over,
+                "now": now,
+            }
+        )
+        try:
+            return self.inner.update_globals(
+                key_hash, limit, remaining, reset_time, is_over, now=now
+            )
+        finally:
+            self._done()
+
+    def reset(self) -> None:
+        assert self.is_leader
+        self._lockstep({"kind": "reset"})
+        try:
+            self.inner.reset()
+        finally:
+            self._done()
+
+    def sync_globals(self, key_hash, limit, duration, now, algo=None):
+        assert self.is_leader
+        self._lockstep(
+            {
+                "kind": "sync",
+                "key_hash": key_hash,
+                "limit": limit,
+                "duration": duration,
+                "algo": algo,
+                "now": now,
+            }
+        )
+        try:
+            return self.inner.sync_globals(
+                key_hash, limit, duration, now, algo=algo
+            )
+        finally:
+            self._done()
+
+    def close(self) -> None:
+        if self.pipe:
+            self.pipe.close()
+
+    # -- follower API -------------------------------------------------------
+
+    def follower_loop(self, listen_addr: str, ready_cb=None) -> None:
+        """Serve lockstep steps until the leader shuts the pipe. Each
+        message triggers the identical jitted call the leader makes, so
+        the global-mesh collectives line up."""
+        assert not self.is_leader
+        host, _, port = listen_addr.rpartition(":")
+        srv = socket.create_server((host, int(port)))
+        if ready_cb:
+            ready_cb()
+        conn, peer = srv.accept()
+        conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        log.info("step pipe connected from %s", peer)
+        while True:
+            msg = _recv_msg(conn)
+            kind = msg.pop("kind")
+            if kind == "shutdown":
+                break
+            if kind == "decide":
+                self.inner.decide_arrays(**msg)
+            elif kind == "reset":
+                self.inner.reset()
+            elif kind == "upsert":
+                self.inner.update_globals(
+                    msg["key_hash"],
+                    msg["limit"],
+                    msg["remaining"],
+                    msg["reset_time"],
+                    msg["is_over"],
+                    now=msg["now"],
+                )
+            elif kind == "sync":
+                self.inner.sync_globals(
+                    msg["key_hash"],
+                    msg["limit"],
+                    msg["duration"],
+                    msg["now"],
+                    algo=msg["algo"],
+                )
+            else:
+                raise RuntimeError(f"unknown step kind {kind!r}")
+            _send_msg(conn, {"kind": "ack"})
+        conn.close()
+        srv.close()

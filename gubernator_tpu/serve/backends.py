@@ -8,6 +8,9 @@ implement it:
 - TpuBackend — single-device slot store + jitted decide kernel.
 - MeshBackend — multi-device mesh-sharded store (key-space sharding with
   psum combine); the scale-up backend for one host with a TPU slice.
+- MultiHostBackend — the same sharded store over a GLOBAL mesh spanning
+  jax.distributed processes (parallel/multihost.py); only the leader
+  process serves, followers run the lockstep step loop.
 
 All three are driven from the single serving event loop / batcher task, so
 none of them need internal locking (the reference instead serializes on a
@@ -97,15 +100,19 @@ class MeshBackend:
         store: StoreConfig = StoreConfig(),
         devices=None,
         buckets: Sequence[int] = (64, 256, 1024, 4096),
+        engine=None,
     ):
         import numpy as np
 
         from gubernator_tpu.core.hashing import slot_hash_batch
-        from gubernator_tpu.parallel.sharded import MeshEngine
 
         self._np = np
         self._hash = slot_hash_batch
-        self.engine = MeshEngine(store, devices=devices, buckets=buckets)
+        if engine is None:
+            from gubernator_tpu.parallel.sharded import MeshEngine
+
+            engine = MeshEngine(store, devices=devices, buckets=buckets)
+        self.engine = engine
 
     def decide(self, reqs, gnp, now=None):
         import numpy as np
@@ -184,3 +191,31 @@ class MeshBackend:
 
     def stats(self) -> dict:
         return {}
+
+
+class MultiHostBackend(MeshBackend):
+    """Leader-side backend over a multi-process global mesh. Construct
+    only on process 0; follower processes run
+    MultiHostMeshEngine.follower_loop instead of serving (cli/daemon.py
+    wires both roles from GUBER_DIST_* env)."""
+
+    def __init__(
+        self,
+        store: StoreConfig = StoreConfig(),
+        followers: Sequence[str] = (),
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+    ):
+        from gubernator_tpu.parallel.multihost import MultiHostMeshEngine
+
+        # the lockstep wrapper exposes the same decide/update/sync/reset
+        # surface MeshBackend drives
+        super().__init__(
+            store,
+            buckets=buckets,
+            engine=MultiHostMeshEngine(
+                store, followers=list(followers), buckets=buckets
+            ),
+        )
+
+    def close(self) -> None:
+        self.engine.close()

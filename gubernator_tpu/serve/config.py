@@ -51,7 +51,7 @@ class ServerConfig:
     advertise_address: str = ""  # address peers should dial; default grpc
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
 
-    backend: str = "tpu"  # tpu | exact | mesh
+    backend: str = "tpu"  # tpu | exact | mesh | multihost
     cache_size: int = 50_000  # exact backend capacity
     store_rows: int = 16  # slot-store geometry (tpu/mesh backends);
     # 16 ways = 128-lane bucket rows, the fast TPU layout (core.store).
@@ -64,6 +64,15 @@ class ServerConfig:
     # daemon run CPU-only on dev boxes where a TPU runtime is registered
     # but unavailable.
     jax_platform: str = ""
+
+    # multi-host mesh (GUBER_DIST_*): one jax.distributed program over
+    # several hosts; process 0 serves (backend=multihost), others run the
+    # lockstep follower loop (parallel/multihost.py)
+    dist_coordinator: str = ""
+    dist_num_processes: int = 1
+    dist_process_id: int = 0
+    dist_followers: tuple = ()
+    dist_step_listen: str = ""
 
     # Device micro-batcher. 0 = flush immediately with whatever has
     # accumulated ("batch while busy": arrivals during a device launch
@@ -169,6 +178,15 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         store_rows=_get_int(env, "GUBER_STORE_ROWS", 16),
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 15),
         jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
+        dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),
+        dist_num_processes=_get_int(env, "GUBER_DIST_NUM_PROCESSES", 1),
+        dist_process_id=_get_int(env, "GUBER_DIST_PROCESS_ID", 0),
+        dist_followers=tuple(
+            p.strip()
+            for p in _get(env, "GUBER_DIST_FOLLOWERS").split(",")
+            if p.strip()
+        ),
+        dist_step_listen=_get(env, "GUBER_DIST_STEP_LISTEN"),
         device_batch_wait=_get_float_ms(
             env, "GUBER_DEVICE_BATCH_WAIT_MS", 0.0
         ),

@@ -49,6 +49,10 @@ def make_backend(conf: ServerConfig):
         return TpuBackend(store)
     if conf.backend == "mesh":
         return MeshBackend(store)
+    if conf.backend == "multihost":
+        from gubernator_tpu.serve.backends import MultiHostBackend
+
+        return MultiHostBackend(store, followers=conf.dist_followers)
     raise ValueError(f"unknown backend '{conf.backend}'")
 
 
@@ -173,6 +177,9 @@ class Server:
             await self.grpc_server.stop(grace=1.0)
             self.grpc_server = None
         await self.instance.stop()
+        close = getattr(self.backend, "close", None)
+        if close is not None:
+            close()  # e.g. MultiHostBackend: clean step-pipe shutdown
 
     # -- HTTP gateway -------------------------------------------------------
 

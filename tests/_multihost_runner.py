@@ -1,0 +1,91 @@
+"""Two-process multi-host mesh exercise (spawned by test_multihost.py).
+
+Run as: python tests/_multihost_runner.py <role> <coordinator> <step_port>
+Role "leader" drives rate-limit traffic over a 2-process global mesh and
+asserts the decisions; role "follower" runs the lockstep loop. Leader
+prints LEADER-OK on success.
+"""
+
+import sys
+
+
+def main():
+    role, coordinator, step_port = sys.argv[1], sys.argv[2], sys.argv[3]
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from gubernator_tpu.parallel.multihost import (
+        MultiHostMeshEngine,
+        initialize_distributed,
+    )
+    from gubernator_tpu.core.store import StoreConfig
+    import numpy as np
+
+    pid = 0 if role == "leader" else 1
+    initialize_distributed(coordinator, num_processes=2, process_id=pid)
+    assert len(jax.devices()) == 2, jax.devices()
+
+    cfg = StoreConfig(rows=16, slots=1 << 8)
+    T0 = 1_700_000_000_000
+
+    if role == "follower":
+        eng = MultiHostMeshEngine(cfg, buckets=(16,))
+        eng.follower_loop(f"127.0.0.1:{step_port}")
+        print("FOLLOWER-OK", flush=True)
+        return
+
+    eng = MultiHostMeshEngine(
+        cfg, followers=[f"127.0.0.1:{step_port}"], buckets=(16,)
+    )
+
+    from gubernator_tpu.core.hashing import slot_hash_batch
+    from gubernator_tpu.parallel.sharded import owner_of_np
+
+    # enough keys that both shards (one device per process) own some
+    keys = [f"mh:{i}" for i in range(12)]
+    kh = slot_hash_batch(keys)
+    owners = owner_of_np(kh, 2)
+    assert set(owners.tolist()) == {0, 1}, "keys must span both hosts"
+
+    ones = np.ones(len(keys), np.int64)
+    limit = ones * 2
+    dur = ones * 60_000
+    algo = np.zeros(len(keys), np.int32)
+    gnp = np.zeros(len(keys), bool)
+
+    # two charges then OVER, across both shards, via the global-mesh psum
+    s1, _, r1, _ = eng.decide_arrays(kh, ones, limit, dur, algo, gnp, T0)
+    assert (s1 == 0).all() and (r1 == 1).all(), (s1, r1)
+    s2, _, r2, _ = eng.decide_arrays(kh, ones, limit, dur, algo, gnp, T0 + 1)
+    assert (s2 == 0).all() and (r2 == 0).all(), (s2, r2)
+    s3, _, r3, _ = eng.decide_arrays(kh, ones, limit, dur, algo, gnp, T0 + 2)
+    assert (s3 == 1).all() and (r3 == 0).all(), (s3, r3)
+
+    # GLOBAL gossip collective: owner peek + broadcast + replica install
+    eng.sync_globals(kh, limit, dur, T0 + 3)
+    # replica reads answer from installed state everywhere
+    s4, _, r4, _ = eng.decide_arrays(
+        kh, np.zeros(len(keys), np.int64), limit, dur, algo,
+        np.ones(len(keys), bool), T0 + 4,
+    )
+    assert (s4 == 1).all(), s4  # all shards report the OVER status
+
+    # broadcast-install path (UpdatePeerGlobals receive side)
+    eng.update_globals(
+        kh, ones * 9, ones * 7, ones * (T0 + 60_000),
+        np.zeros(len(keys), bool), now=T0 + 5,
+    )
+    s5, l5, r5, _ = eng.decide_arrays(
+        kh, np.zeros(len(keys), np.int64), ones * 9, dur, algo,
+        np.ones(len(keys), bool), T0 + 6,
+    )
+    assert (r5 == 7).all() and (l5 == 9).all(), (l5, r5)
+
+    eng.close()
+    print("LEADER-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

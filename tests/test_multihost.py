@@ -1,0 +1,62 @@
+"""Multi-host mesh e2e: two real OS processes, one global jax mesh.
+
+Spawns a leader and a follower (tests/_multihost_runner.py), each with
+one CPU device, joined via jax.distributed; the leader drives decide /
+sync_globals / update_globals batches whose psum collectives cross the
+process boundary (gloo over TCP — the CPU stand-in for DCN), with the
+lockstep step pipe keeping both controllers issuing identical programs.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import pathlib
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.timeout(180)
+def test_two_process_mesh():
+    coord = f"127.0.0.1:{_free_port()}"
+    step_port = str(_free_port())
+    runner = str(ROOT / "tests" / "_multihost_runner.py")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # one device per process, no forced count
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
+
+    follower = subprocess.Popen(
+        [sys.executable, runner, "follower", coord, step_port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=ROOT, env=env,
+    )
+    leader = subprocess.Popen(
+        [sys.executable, runner, "leader", coord, step_port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=ROOT, env=env,
+    )
+    try:
+        l_out, _ = leader.communicate(timeout=150)
+        f_out, _ = follower.communicate(timeout=30)
+    except subprocess.TimeoutExpired:
+        leader.kill()
+        follower.kill()
+        l_out = leader.communicate()[0]
+        f_out = follower.communicate()[0]
+        pytest.fail(f"timeout\nleader:\n{l_out}\nfollower:\n{f_out}")
+
+    assert leader.returncode == 0 and "LEADER-OK" in l_out, (
+        f"leader failed:\n{l_out}\nfollower:\n{f_out}"
+    )
+    assert follower.returncode == 0 and "FOLLOWER-OK" in f_out, (
+        f"follower failed:\n{f_out}"
+    )
