@@ -57,9 +57,12 @@ class ExactBackend:
         return out
 
     def update_globals(
-        self, updates: Sequence[Tuple[str, RateLimitResp]]
+        self, updates: Sequence[Tuple[str, RateLimitResp]], now=None
     ) -> None:
         # cache.Add(key, status, status.reset_time) — gubernator.go:199-207
+        # (`now` is unused here — expiry comes from the status — but kept
+        # for interface parity with the device backends, whose epoch
+        # clocks must see the caller's clock domain in tests)
         for key, status in updates:
             self.cache.add(key, status, status.reset_time)
 
@@ -81,8 +84,8 @@ class TpuBackend:
     def decide(self, reqs, gnp, now=None):
         return self.engine.get_rate_limits(reqs, now=now, gnp=list(gnp))
 
-    def update_globals(self, updates):
-        self.engine.update_globals(list(updates))
+    def update_globals(self, updates, now=None):
+        self.engine.update_globals(list(updates), now=now)
 
     def warmup(self) -> None:
         """Compile all batch buckets at boot so no request pays jit time."""
@@ -143,7 +146,7 @@ class MeshBackend:
             for i in range(n)
         ]
 
-    def update_globals(self, updates):
+    def update_globals(self, updates, now=None):
         np = self._np
         n = len(updates)
         if n == 0:
@@ -160,6 +163,7 @@ class MeshBackend:
             is_over=np.fromiter(
                 (s.status == Status.OVER_LIMIT for _, s in updates), bool, n
             ),
+            now=now,
         )
 
     def warmup(self) -> None:

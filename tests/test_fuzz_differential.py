@@ -25,6 +25,7 @@ import pytest
 from gubernator_tpu.api.types import (
     Algorithm,
     RateLimitReq,
+    RateLimitResp,
     Status,
 )
 from gubernator_tpu.core.cache import LRUCache
@@ -139,3 +140,70 @@ def test_epoch_far_future_jump_resets():
     resp = engine.get_rate_limits([r], now=far)[0]
     assert resp.remaining == 4
     assert resp.reset_time == far + 1000
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_fuzz_global_paths_vs_exact_backend(seed):
+    """GLOBAL-path fuzz: interleave owned decides, non-owner replica reads
+    (gnp), and owner-broadcast installs (update_globals), comparing the
+    TPU backend against the exact host backend, which implements the
+    reference's replica semantics directly (serve/backends.py)."""
+    from gubernator_tpu.serve.backends import ExactBackend, TpuBackend
+
+    rng = np.random.default_rng(seed)
+    tpu = TpuBackend(StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64))
+    exact = ExactBackend()
+    keys = [f"g:{i}" for i in range(16)]
+    now = T0
+
+    for step in range(150):
+        now += int(rng.choice([1, 5, 60, 700]))
+        roll = rng.random()
+        if roll < 0.25:
+            # owner broadcast: install replica statuses for some keys
+            picked = rng.choice(len(keys), size=3, replace=False)
+            updates = []
+            for k in picked:
+                updates.append(
+                    (
+                        f"fuzzg_{keys[k]}",
+                        RateLimitResp(
+                            status=Status(int(rng.integers(0, 2))),
+                            limit=int(rng.choice([5, 9])),
+                            remaining=int(rng.integers(0, 5)),
+                            reset_time=now + int(rng.choice([500, 5000])),
+                        ),
+                    )
+                )
+            tpu.update_globals(updates, now=now)
+            exact.update_globals(updates, now=now)
+            continue
+        # mixed owned + replica-read traffic (unique keys per batch: the
+        # exact backend serves replicas per-request while the kernel
+        # shares one group snapshot)
+        picked = rng.choice(len(keys), size=4, replace=False)
+        batch = []
+        gnp = []
+        for k in picked:
+            batch.append(
+                RateLimitReq(
+                    name="fuzzg",
+                    unique_key=keys[k],
+                    hits=int(rng.choice([0, 1, 2])),
+                    limit=int(rng.choice([5, 9])),
+                    duration=int(rng.choice([1000, 60_000])),
+                    algorithm=Algorithm.TOKEN_BUCKET,
+                )
+            )
+            gnp.append(bool(rng.random() < 0.5))
+        got = tpu.decide(batch, gnp, now=now)
+        want = exact.decide(batch, gnp, now=now)
+        for i, (g, w) in enumerate(zip(got, want)):
+            ctx = (
+                f"seed={seed} step={step} i={i} gnp={gnp[i]} "
+                f"req={batch[i]}"
+            )
+            assert g.status == w.status, ctx
+            assert g.limit == w.limit, ctx
+            assert g.remaining == w.remaining, ctx
+            assert g.reset_time == w.reset_time, ctx
