@@ -4,6 +4,7 @@ all: native proto
 
 native:
 	$(MAKE) -C gubernator_tpu/native
+	$(MAKE) -C gubernator_tpu/native/edge
 
 proto:
 	./scripts/gen_protos.sh
@@ -16,3 +17,4 @@ bench:
 
 clean:
 	$(MAKE) -C gubernator_tpu/native clean
+	$(MAKE) -C gubernator_tpu/native/edge clean

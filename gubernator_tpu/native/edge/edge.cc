@@ -1,0 +1,633 @@
+// gubernator-tpu native serving edge.
+//
+// The latency-critical front door the reference implements in compiled Go
+// (its gRPC/JSON gateway): this C++ process terminates client HTTP/1.1
+// JSON connections, validates + parses requests, coalesces them across
+// ALL connections into micro-batches (the reference's BatchWait /
+// BatchLimit semantics, config.go:59-62), and forwards each batch to the
+// Python serving daemon as ONE binary frame over a unix-domain socket
+// (serve/edge_bridge.py). The daemon pays one read + one decode per
+// batch; all per-request parse/serialize cost stays here, outside the
+// Python process. Responses fan back to the originating connections.
+//
+// Scope: POST /v1/GetRateLimits (the hot path). Everything else
+// (HealthCheck, metrics, debug) is served by the daemon's own HTTP
+// listener; GET /v1/HealthCheck here reports edge liveness only.
+//
+// Build: make -C gubernator_tpu/native/edge
+// Run:   guber-edge --listen 8080 --backend /tmp/guber-edge.sock
+//                   [--batch-wait-us 500] [--batch-limit 1000]
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- protocol
+
+constexpr uint32_t kMagicReq = 0x31424547;   // 'GEB1'
+constexpr uint32_t kMagicResp = 0x32424547;  // 'GEB2'
+
+struct Item {
+  std::string name;
+  std::string key;
+  int64_t hits = 0;
+  int64_t limit = 0;
+  int64_t duration = 0;
+  uint8_t algorithm = 0;
+  uint8_t behavior = 0;
+};
+
+struct Decision {
+  uint8_t status = 0;
+  int64_t limit = 0;
+  int64_t remaining = 0;
+  int64_t reset_time = 0;
+  std::string error;
+};
+
+void put_u16(std::string& b, uint16_t v) { b.append((char*)&v, 2); }
+void put_u32(std::string& b, uint32_t v) { b.append((char*)&v, 4); }
+void put_i64(std::string& b, int64_t v) { b.append((char*)&v, 8); }
+
+// ------------------------------------------------------------- minimal JSON
+// Parser for the fixed GetRateLimitsReq schema; tolerant of whitespace,
+// field order, string/number duality for int64 fields (the JSON gateway
+// emits int64 as strings), and unknown fields (skipped).
+
+struct JsonCursor {
+  const char* p;
+  const char* end;
+  bool fail = false;
+
+  void ws() {
+    while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+      ++p;
+  }
+  bool eat(char c) {
+    ws();
+    if (p < end && *p == c) {
+      ++p;
+      return true;
+    }
+    return false;
+  }
+  bool parse_string(std::string& out) {
+    ws();
+    if (p >= end || *p != '"') return false;
+    ++p;
+    out.clear();
+    while (p < end && *p != '"') {
+      char c = *p++;
+      if (c == '\\' && p < end) {
+        char e = *p++;
+        switch (e) {
+          case 'n': out.push_back('\n'); break;
+          case 't': out.push_back('\t'); break;
+          case 'r': out.push_back('\r'); break;
+          case 'b': out.push_back('\b'); break;
+          case 'f': out.push_back('\f'); break;
+          case 'u': {
+            if (end - p < 4) return false;
+            unsigned cp = 0;
+            for (int i = 0; i < 4; ++i) {
+              char h = *p++;
+              cp <<= 4;
+              if (h >= '0' && h <= '9') cp |= h - '0';
+              else if (h >= 'a' && h <= 'f') cp |= h - 'a' + 10;
+              else if (h >= 'A' && h <= 'F') cp |= h - 'A' + 10;
+              else return false;
+            }
+            // UTF-8 encode (BMP only; surrogate pairs unsupported — the
+            // rate-limit key space in practice is ASCII)
+            if (cp < 0x80) out.push_back((char)cp);
+            else if (cp < 0x800) {
+              out.push_back((char)(0xC0 | (cp >> 6)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            } else {
+              out.push_back((char)(0xE0 | (cp >> 12)));
+              out.push_back((char)(0x80 | ((cp >> 6) & 0x3F)));
+              out.push_back((char)(0x80 | (cp & 0x3F)));
+            }
+            break;
+          }
+          default: out.push_back(e);
+        }
+      } else {
+        out.push_back(c);
+      }
+    }
+    if (p >= end) return false;
+    ++p;  // closing quote
+    return true;
+  }
+  bool parse_i64(int64_t& out) {
+    ws();
+    if (p < end && *p == '"') {  // gateway-style string int64
+      std::string s;
+      if (!parse_string(s)) return false;
+      out = strtoll(s.c_str(), nullptr, 10);
+      return true;
+    }
+    char* q = nullptr;
+    out = strtoll(p, &q, 10);
+    if (q == p) return false;
+    p = q;
+    return true;
+  }
+  // skip any value (for unknown fields)
+  bool skip_value() {
+    ws();
+    if (p >= end) return false;
+    if (*p == '"') {
+      std::string s;
+      return parse_string(s);
+    }
+    if (*p == '{' || *p == '[') {
+      char open = *p, close = (open == '{') ? '}' : ']';
+      int depth = 0;
+      bool in_str = false;
+      while (p < end) {
+        char c = *p++;
+        if (in_str) {
+          if (c == '\\') { if (p < end) ++p; }
+          else if (c == '"') in_str = false;
+        } else if (c == '"') in_str = true;
+        else if (c == open) ++depth;
+        else if (c == close && --depth == 0) return true;
+      }
+      return false;
+    }
+    while (p < end && *p != ',' && *p != '}' && *p != ']') ++p;
+    return true;
+  }
+};
+
+bool field_is(const std::string& f, const char* snake, const char* camel) {
+  return f == snake || f == camel;
+}
+
+// algorithm / behavior accept both enum names and numbers
+uint8_t parse_algorithm(JsonCursor& c, bool& ok) {
+  c.ws();
+  if (c.p < c.end && *c.p == '"') {
+    std::string s;
+    ok = c.parse_string(s);
+    return s == "LEAKY_BUCKET" ? 1 : 0;
+  }
+  int64_t v = 0;
+  ok = c.parse_i64(v);
+  return (uint8_t)v;
+}
+
+uint8_t parse_behavior(JsonCursor& c, bool& ok) {
+  c.ws();
+  if (c.p < c.end && *c.p == '"') {
+    std::string s;
+    ok = c.parse_string(s);
+    if (s == "NO_BATCHING") return 1;
+    if (s == "GLOBAL") return 2;
+    return 0;
+  }
+  int64_t v = 0;
+  ok = c.parse_i64(v);
+  return (uint8_t)v;
+}
+
+// returns false on malformed JSON
+bool parse_get_rate_limits(const char* body, size_t len,
+                           std::vector<Item>& out) {
+  JsonCursor c{body, body + len};
+  if (!c.eat('{')) return false;
+  std::string field;
+  while (true) {
+    if (c.eat('}')) return true;
+    if (!c.parse_string(field) || !c.eat(':')) return false;
+    if (field_is(field, "requests", "requests")) {
+      if (!c.eat('[')) return false;
+      if (c.eat(']')) { /* empty */ }
+      else {
+        do {
+          if (!c.eat('{')) return false;
+          Item it;
+          std::string f;
+          while (true) {
+            if (c.eat('}')) break;
+            if (!c.parse_string(f) || !c.eat(':')) return false;
+            bool ok = true;
+            if (field_is(f, "name", "name")) ok = c.parse_string(it.name);
+            else if (field_is(f, "unique_key", "uniqueKey"))
+              ok = c.parse_string(it.key);
+            else if (field_is(f, "hits", "hits")) ok = c.parse_i64(it.hits);
+            else if (field_is(f, "limit", "limit")) ok = c.parse_i64(it.limit);
+            else if (field_is(f, "duration", "duration"))
+              ok = c.parse_i64(it.duration);
+            else if (field_is(f, "algorithm", "algorithm"))
+              it.algorithm = parse_algorithm(c, ok);
+            else if (field_is(f, "behavior", "behavior"))
+              it.behavior = parse_behavior(c, ok);
+            else ok = c.skip_value();
+            if (!ok) return false;
+            c.eat(',');
+          }
+          out.push_back(std::move(it));
+        } while (c.eat(','));
+        if (!c.eat(']')) return false;
+      }
+    } else {
+      if (!c.skip_value()) return false;
+    }
+    c.eat(',');
+  }
+}
+
+const char* kStatusName[2] = {"UNDER_LIMIT", "OVER_LIMIT"};
+
+void json_escape(std::string& out, const std::string& s) {
+  for (char ch : s) {
+    switch (ch) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if ((unsigned char)ch < 0x20) {
+          char buf[8];
+          snprintf(buf, sizeof buf, "\\u%04x", ch);
+          out += buf;
+        } else out.push_back(ch);
+    }
+  }
+}
+
+std::string render_responses(const Decision* d, size_t n) {
+  std::string out = "{\"responses\": [";
+  char num[32];
+  for (size_t i = 0; i < n; ++i) {
+    if (i) out += ", ";
+    out += "{\"status\": \"";
+    out += kStatusName[d[i].status & 1];
+    out += "\", \"limit\": \"";
+    snprintf(num, sizeof num, "%lld", (long long)d[i].limit);
+    out += num;
+    out += "\", \"remaining\": \"";
+    snprintf(num, sizeof num, "%lld", (long long)d[i].remaining);
+    out += num;
+    out += "\", \"resetTime\": \"";
+    snprintf(num, sizeof num, "%lld", (long long)d[i].reset_time);
+    out += num;
+    out += "\", \"error\": \"";
+    json_escape(out, d[i].error);
+    out += "\", \"metadata\": {}}";
+  }
+  out += "]}";
+  return out;
+}
+
+// ---------------------------------------------------------------- batcher
+
+struct Pending {
+  std::vector<Item> items;
+  std::vector<Decision> decisions;
+  bool done = false;
+  bool failed = false;
+  std::mutex m;
+  std::condition_variable cv;
+};
+
+class Batcher {
+ public:
+  Batcher(std::string backend_path, int batch_wait_us, int batch_limit)
+      : path_(std::move(backend_path)),
+        wait_us_(batch_wait_us),
+        limit_(batch_limit),
+        thread_([this] { run(); }) {
+    // eager connect so HealthCheck reflects the backend before traffic
+    backend_ok_ = connect_backend();
+  }
+
+  // enqueue and block until the batch round-trips
+  bool submit(Pending* p) {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      queue_.push_back(p);
+      queued_items_ += p->items.size();
+    }
+    cv_.notify_one();
+    std::unique_lock<std::mutex> lk(p->m);
+    p->cv.wait(lk, [p] { return p->done; });
+    return !p->failed;
+  }
+
+  bool backend_ok() const { return backend_ok_; }
+
+ private:
+  bool connect_backend() {
+    fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd_ < 0) return false;
+    sockaddr_un addr{};
+    addr.sun_family = AF_UNIX;
+    snprintf(addr.sun_path, sizeof addr.sun_path, "%s", path_.c_str());
+    if (connect(fd_, (sockaddr*)&addr, sizeof addr) != 0) {
+      close(fd_);
+      fd_ = -1;
+      return false;
+    }
+    return true;
+  }
+
+  bool send_all(const char* p, size_t n) {
+    while (n) {
+      ssize_t w = write(fd_, p, n);
+      if (w <= 0) return false;
+      p += w;
+      n -= (size_t)w;
+    }
+    return true;
+  }
+  bool recv_all(char* p, size_t n) {
+    while (n) {
+      ssize_t r = read(fd_, p, n);
+      if (r <= 0) return false;
+      p += r;
+      n -= (size_t)r;
+    }
+    return true;
+  }
+
+  bool roundtrip(std::vector<Pending*>& batch) {
+    std::string frame;
+    uint32_t n = 0;
+    std::string payload;
+    for (Pending* p : batch) {
+      for (const Item& it : p->items) {
+        put_u16(payload, (uint16_t)it.name.size());
+        payload += it.name;
+        put_u16(payload, (uint16_t)it.key.size());
+        payload += it.key;
+        put_i64(payload, it.hits);
+        put_i64(payload, it.limit);
+        put_i64(payload, it.duration);
+        payload.push_back((char)it.algorithm);
+        payload.push_back((char)it.behavior);
+        ++n;
+      }
+    }
+    put_u32(frame, kMagicReq);
+    put_u32(frame, n);
+    put_u32(frame, (uint32_t)payload.size());
+    frame += payload;
+    if (!send_all(frame.data(), frame.size())) return false;
+
+    char hdr[8];
+    if (!recv_all(hdr, 8)) return false;
+    uint32_t magic, rn;
+    memcpy(&magic, hdr, 4);
+    memcpy(&rn, hdr + 4, 4);
+    if (magic != kMagicResp || rn != n) return false;
+    std::vector<Decision> all(rn);
+    for (uint32_t i = 0; i < rn; ++i) {
+      char fix[25];
+      if (!recv_all(fix, 25)) return false;
+      all[i].status = (uint8_t)fix[0];
+      memcpy(&all[i].limit, fix + 1, 8);
+      memcpy(&all[i].remaining, fix + 9, 8);
+      memcpy(&all[i].reset_time, fix + 17, 8);
+      uint16_t elen;
+      if (!recv_all((char*)&elen, 2)) return false;
+      all[i].error.resize(elen);
+      if (elen && !recv_all(all[i].error.data(), elen)) return false;
+    }
+    size_t off = 0;
+    for (Pending* p : batch) {
+      p->decisions.assign(all.begin() + off,
+                          all.begin() + off + p->items.size());
+      off += p->items.size();
+    }
+    return true;
+  }
+
+  void run() {
+    while (true) {
+      std::vector<Pending*> batch;
+      {
+        std::unique_lock<std::mutex> lk(m_);
+        cv_.wait(lk, [this] { return !queue_.empty(); });
+        // batch window: flush at limit_ items or after wait_us_
+        if ((int)queued_items_ < limit_ && wait_us_ > 0) {
+          cv_.wait_for(lk, std::chrono::microseconds(wait_us_), [this] {
+            return (int)queued_items_ >= limit_;
+          });
+        }
+        size_t take_items = 0;
+        while (!queue_.empty()) {
+          size_t next = queue_.front()->items.size();
+          if (!batch.empty() && (int)(take_items + next) > limit_) break;
+          batch.push_back(queue_.front());
+          take_items += next;
+          queue_.pop_front();
+          if ((int)take_items >= limit_) break;
+        }
+        queued_items_ -= take_items;
+      }
+      bool ok = backend_ok_ && fd_ >= 0;
+      if (!ok) {
+        ok = connect_backend();
+        backend_ok_ = ok;
+      }
+      if (ok) {
+        ok = roundtrip(batch);
+        if (!ok) {
+          close(fd_);
+          fd_ = -1;
+          backend_ok_ = false;
+        }
+      }
+      for (Pending* p : batch) {
+        {
+          std::lock_guard<std::mutex> lk(p->m);
+          p->failed = !ok;
+          p->done = true;
+        }
+        p->cv.notify_one();
+      }
+    }
+  }
+
+  std::string path_;
+  int wait_us_;
+  int limit_;
+  int fd_ = -1;
+  std::atomic<bool> backend_ok_{false};
+  std::mutex m_;
+  std::condition_variable cv_;
+  std::deque<Pending*> queue_;
+  size_t queued_items_ = 0;
+  std::thread thread_;
+};
+
+// -------------------------------------------------------------- HTTP layer
+
+void http_reply(int fd, int code, const char* reason,
+                const std::string& body) {
+  char hdr[256];
+  int n = snprintf(hdr, sizeof hdr,
+                   "HTTP/1.1 %d %s\r\n"
+                   "Content-Type: application/json\r\n"
+                   "Content-Length: %zu\r\n\r\n",
+                   code, reason, body.size());
+  (void)!write(fd, hdr, (size_t)n);
+  (void)!write(fd, body.data(), body.size());
+}
+
+void serve_connection(int fd, Batcher* batcher) {
+  std::string buf;
+  char tmp[16384];
+  while (true) {
+    // read until end of headers
+    size_t hdr_end;
+    while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
+      ssize_t r = read(fd, tmp, sizeof tmp);
+      if (r <= 0) {
+        close(fd);
+        return;
+      }
+      buf.append(tmp, (size_t)r);
+      if (buf.size() > (16u << 20)) { close(fd); return; }
+    }
+    std::string head = buf.substr(0, hdr_end);
+    bool has_clen = false;
+    size_t content_len = 0;
+    {
+      // case-insensitive content-length scan
+      std::string lower = head;
+      for (char& c : lower) c = (char)tolower(c);
+      size_t pos = lower.find("content-length:");
+      if (pos != std::string::npos) {
+        has_clen = true;
+        content_len = strtoull(lower.c_str() + pos + 15, nullptr, 10);
+      }
+    }
+    bool is_post = head.rfind("POST", 0) == 0;
+    if (is_post && !has_clen) {
+      // no chunked support: fail clean and close (a desynced keep-alive
+      // stream would mis-parse the chunk body as the next request)
+      http_reply(fd, 411, "Length Required",
+                 "{\"error\": \"Content-Length required\"}");
+      close(fd);
+      return;
+    }
+    if (content_len > (16u << 20)) {
+      http_reply(fd, 413, "Payload Too Large",
+                 "{\"error\": \"body exceeds 16 MiB\"}");
+      close(fd);
+      return;
+    }
+    size_t body_start = hdr_end + 4;
+    while (buf.size() < body_start + content_len) {
+      ssize_t r = read(fd, tmp, sizeof tmp);
+      if (r <= 0) { close(fd); return; }
+      buf.append(tmp, (size_t)r);
+    }
+
+    bool is_post_grl = head.rfind("POST /v1/GetRateLimits", 0) == 0;
+    bool is_health = head.rfind("GET /v1/HealthCheck", 0) == 0;
+    if (is_health) {
+      http_reply(fd, 200, "OK",
+                 batcher->backend_ok()
+                     ? "{\"status\": \"healthy\", \"message\": "
+                       "\"edge\", \"peerCount\": 0}"
+                     : "{\"status\": \"unhealthy\", \"message\": "
+                       "\"backend unreachable\", \"peerCount\": 0}");
+    } else if (!is_post_grl) {
+      http_reply(fd, 404, "Not Found", "{\"error\": \"not found\"}");
+    } else {
+      Pending p;
+      bool too_long = false;
+      if (!parse_get_rate_limits(buf.data() + body_start, content_len,
+                                 p.items)) {
+        http_reply(fd, 400, "Bad Request",
+                   "{\"error\": \"malformed JSON\"}");
+      } else if ([&] {
+                   for (const Item& it : p.items)
+                     if (it.name.size() > 65535 || it.key.size() > 65535)
+                       return true;
+                   return false;
+                 }()) {
+        too_long = true;
+        http_reply(fd, 400, "Bad Request",
+                   "{\"error\": \"name/unique_key exceeds 65535 "
+                   "bytes\"}");
+      } else if (p.items.empty()) {
+        http_reply(fd, 200, "OK", "{\"responses\": []}");
+      } else if (too_long) {
+        // already replied
+      } else if (!batcher->submit(&p)) {
+        http_reply(fd, 503, "Service Unavailable",
+                   "{\"error\": \"backend unavailable\"}");
+      } else {
+        http_reply(fd, 200, "OK",
+                   render_responses(p.decisions.data(),
+                                    p.decisions.size()));
+      }
+    }
+    buf.erase(0, body_start + content_len);
+  }
+}
+
+}  // namespace
+
+#include <chrono>
+
+int main(int argc, char** argv) {
+  int port = 8080;
+  std::string backend = "/tmp/guber-edge.sock";
+  int batch_wait_us = 500;
+  int batch_limit = 1000;
+  for (int i = 1; i + 1 < argc; i += 2) {
+    std::string a = argv[i];
+    if (a == "--listen") port = atoi(argv[i + 1]);
+    else if (a == "--backend") backend = argv[i + 1];
+    else if (a == "--batch-wait-us") batch_wait_us = atoi(argv[i + 1]);
+    else if (a == "--batch-limit") batch_limit = atoi(argv[i + 1]);
+  }
+
+  Batcher batcher(backend, batch_wait_us, batch_limit);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(srv, (sockaddr*)&addr, sizeof addr) != 0 || listen(srv, 512) != 0) {
+    perror("bind/listen");
+    return 1;
+  }
+  fprintf(stderr, "guber-edge listening on :%d backend=%s\n", port,
+          backend.c_str());
+  fflush(stderr);
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    std::thread(serve_connection, fd, &batcher).detach();
+  }
+}

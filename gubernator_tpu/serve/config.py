@@ -64,6 +64,7 @@ class ServerConfig:
     # daemon run CPU-only on dev boxes where a TPU runtime is registered
     # but unavailable.
     jax_platform: str = ""
+    edge_socket: str = ""  # unix socket for the native edge bridge
 
     # multi-host mesh (GUBER_DIST_*): one jax.distributed program over
     # several hosts; process 0 serves (backend=multihost), others run the
@@ -178,6 +179,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         store_rows=_get_int(env, "GUBER_STORE_ROWS", 16),
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 15),
         jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
+        edge_socket=_get(env, "GUBER_EDGE_SOCKET"),
         dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),
         dist_num_processes=_get_int(env, "GUBER_DIST_NUM_PROCESSES", 1),
         dist_process_id=_get_int(env, "GUBER_DIST_PROCESS_ID", 0),

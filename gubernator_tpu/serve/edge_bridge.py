@@ -1,0 +1,153 @@
+"""Backend bridge for the native serving edge (native/edge/edge.cc).
+
+The C++ edge terminates client HTTP/JSON connections and coalesces
+requests into batches; each batch crosses into Python as ONE compact
+binary frame over a unix-domain socket, so the Python process pays one
+read + one decode per BATCH instead of per request — the per-request
+Python HTTP/JSON overhead (the serving tier's real bottleneck) stays in
+C++. The decisions still flow through the full serving Instance
+(validation, ring ownership, forwarding, GLOBAL replica handling), so
+edge-fronted and directly-connected clients see identical semantics.
+
+Frame protocol (little-endian, lengths in bytes):
+
+  request frame:   u32 magic 'GEB1' | u32 n | u32 payload_len |
+                   payload = n x item
+      item: u16 name_len | name | u16 key_len | key |
+            i64 hits | i64 limit | i64 duration | u8 algorithm |
+            u8 behavior
+  response frame:  u32 magic 'GEB2' | u32 n | n x item
+      item: u8 status | i64 limit | i64 remaining | i64 reset_time |
+            u16 error_len | error
+
+One frame in flight per connection; the current edge uses a single
+backend connection with serial round-trips (one batch in flight), so
+throughput scales with batch size rather than connection count.
+Malformed input closes the connection.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+from typing import List, Optional
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+)
+from gubernator_tpu.serve.config import MAX_BATCH_SIZE
+
+log = logging.getLogger("gubernator_tpu.edge")
+
+MAGIC_REQ = 0x31424547  # 'GEB1' little-endian
+MAGIC_RESP = 0x32424547  # 'GEB2'
+
+_HDR = struct.Struct("<II")
+_ITEM_FIX = struct.Struct("<qqqBB")
+_RESP_FIX = struct.Struct("<Bqqq")
+
+
+def decode_request_frame(payload: bytes, n: int) -> List[RateLimitReq]:
+    reqs: List[RateLimitReq] = []
+    off = 0
+    for _ in range(n):
+        (name_len,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        name = payload[off : off + name_len].decode()
+        off += name_len
+        (key_len,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        key = payload[off : off + key_len].decode()
+        off += key_len
+        hits, limit, duration, algo, behavior = _ITEM_FIX.unpack_from(
+            payload, off
+        )
+        off += _ITEM_FIX.size
+        # clamp unknown enum bytes to the default, matching the daemon's
+        # JSON gateway (server._enum_val) — one bad client item must not
+        # poison the co-batched requests of other connections
+        reqs.append(
+            RateLimitReq(
+                name=name,
+                unique_key=key,
+                hits=hits,
+                limit=limit,
+                duration=duration,
+                algorithm=Algorithm(algo) if algo in (0, 1)
+                else Algorithm.TOKEN_BUCKET,
+                behavior=Behavior(behavior) if behavior in (0, 1, 2)
+                else Behavior.BATCHING,
+            )
+        )
+    if off != len(payload):
+        raise ValueError("trailing bytes in request frame")
+    return reqs
+
+
+def encode_response_frame(resps) -> bytes:
+    parts = [_HDR.pack(MAGIC_RESP, len(resps))]
+    for r in resps:
+        err = r.error.encode()
+        parts.append(
+            _RESP_FIX.pack(
+                int(r.status), r.limit, r.remaining, r.reset_time
+            )
+        )
+        parts.append(struct.pack("<H", len(err)))
+        parts.append(err)
+    return b"".join(parts)
+
+
+class EdgeBridge:
+    """Unix-socket server feeding edge batches into the serving instance."""
+
+    def __init__(self, instance, path: str):
+        self.instance = instance
+        self.path = path
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> None:
+        self._server = await asyncio.start_unix_server(
+            self._serve_conn, path=self.path
+        )
+        log.info("edge bridge listening on %s", self.path)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+            self._server = None
+
+    async def _serve_conn(self, reader, writer):
+        try:
+            while True:
+                hdr = await reader.readexactly(_HDR.size)
+                magic, n = _HDR.unpack(hdr)
+                if magic != MAGIC_REQ:
+                    raise ValueError(f"bad magic {magic:#x}")
+                (plen,) = struct.unpack(
+                    "<I", await reader.readexactly(4)
+                )
+                payload = await reader.readexactly(plen)
+                reqs = decode_request_frame(payload, n)
+                # the edge caps frames at its batch limit, but two large
+                # co-batched requests can still exceed the instance's
+                # MAX_BATCH_SIZE — split instead of erroring the frame
+                resps = []
+                for i in range(0, len(reqs), MAX_BATCH_SIZE):
+                    resps.extend(
+                        await self.instance.get_rate_limits(
+                            reqs[i : i + MAX_BATCH_SIZE]
+                        )
+                    )
+                writer.write(encode_response_frame(resps))
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError):
+            pass
+        except Exception:
+            log.exception("edge bridge connection error")
+        finally:
+            writer.close()

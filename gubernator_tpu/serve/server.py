@@ -128,6 +128,7 @@ class Server:
     """One daemon: gRPC + HTTP, an Instance, and discovery."""
 
     _profiling = False
+    _edge = None
 
     def __init__(self, conf: ServerConfig, backend=None):
         self.conf = conf
@@ -163,10 +164,18 @@ class Server:
 
         if self.conf.http_address:
             await self._start_http()
+        if self.conf.edge_socket:
+            from gubernator_tpu.serve.edge_bridge import EdgeBridge
+
+            self._edge = EdgeBridge(self.instance, self.conf.edge_socket)
+            await self._edge.start()
 
         await self._start_discovery()
 
     async def stop(self) -> None:
+        if self._edge is not None:
+            await self._edge.stop()
+            self._edge = None
         if self._pool is not None:
             await self._pool.close()
             self._pool = None

@@ -1,0 +1,135 @@
+"""Native serving edge e2e: C++ front-end -> unix-socket bridge -> daemon.
+
+Skipped when the edge binary is not built (make -C
+gubernator_tpu/native/edge). Asserts the edge parses gateway-style JSON
+(string int64s, enum names), shares rate-limit state with the daemon's
+own HTTP listener, and reports backend health.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+
+pytestmark = pytest.mark.skipif(
+    not EDGE_BIN.exists(),
+    reason="edge binary not built (make -C gubernator_tpu/native/edge)",
+)
+
+DAEMON_HTTP = 19184
+EDGE_HTTP = 19185
+GRPC = 19194
+SOCK = "/tmp/guber-edge-pytest.sock"
+
+
+def _post(port, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/GetRateLimits",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def edge_stack():
+    import os
+
+    try:
+        os.unlink(SOCK)
+    except FileNotFoundError:
+        pass
+    env = dict(
+        os.environ,
+        GUBER_BACKEND="exact",
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{GRPC}",
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
+        GUBER_EDGE_SOCKET=SOCK,
+        PYTHONPATH=str(ROOT),
+    )
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=ROOT, env=env,
+    )
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline and not pathlib.Path(SOCK).exists():
+        time.sleep(0.2)
+        if daemon.poll() is not None:
+            pytest.fail(f"daemon died:\n{daemon.stdout.read()}")
+    edge = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(EDGE_HTTP), "--backend", SOCK],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    time.sleep(0.3)
+    yield
+    edge.kill()
+    daemon.terminate()
+    daemon.wait(timeout=10)
+
+
+def test_edge_serves_and_shares_state(edge_stack):
+    out = _post(
+        EDGE_HTTP,
+        {
+            "requests": [
+                {"name": "e", "uniqueKey": "k1", "hits": 1, "limit": 3,
+                 "duration": 60000},
+                # gateway-style string int64s + enum name
+                {"name": "e", "uniqueKey": "k2", "hits": "2", "limit": "5",
+                 "duration": "60000", "algorithm": "LEAKY_BUCKET"},
+            ]
+        },
+    )
+    r = out["responses"]
+    assert r[0]["status"] == "UNDER_LIMIT" and r[0]["remaining"] == "2"
+    assert r[1]["status"] == "UNDER_LIMIT" and r[1]["remaining"] == "3"
+
+    # state is shared with the daemon's own HTTP listener
+    out2 = _post(
+        DAEMON_HTTP,
+        {"requests": [{"name": "e", "uniqueKey": "k1", "hits": 1,
+                       "limit": 3, "duration": 60000}]},
+    )
+    assert out2["responses"][0]["remaining"] == "1"
+
+    # and back through the edge again
+    out3 = _post(
+        EDGE_HTTP,
+        {"requests": [{"name": "e", "uniqueKey": "k1", "hits": 1,
+                       "limit": 3, "duration": 60000}]},
+    )
+    assert out3["responses"][0]["remaining"] == "0"
+
+
+def test_edge_health_and_errors(edge_stack):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{EDGE_HTTP}/v1/HealthCheck", timeout=10
+    ) as r:
+        assert json.loads(r.read())["status"] == "healthy"
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{EDGE_HTTP}/v1/GetRateLimits",
+        data=b"{not json",
+        headers={"Content-Type": "application/json"},
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+    # validation errors surface per-item through the frame protocol
+    out = _post(
+        EDGE_HTTP,
+        {"requests": [{"name": "", "uniqueKey": "x", "hits": 1,
+                       "limit": 1, "duration": 1000}]},
+    )
+    assert out["responses"][0]["error"] != ""
