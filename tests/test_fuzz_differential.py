@@ -36,7 +36,7 @@ from gubernator_tpu.core.store import StoreConfig
 T0 = 1_700_000_000_000
 
 
-@pytest.mark.parametrize("seed", [1, 2, 3])
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 7])
 def test_fuzz_vs_oracle(seed):
     rng = np.random.default_rng(seed)
     # store big enough that eviction never fires (eviction is covered by
@@ -142,7 +142,7 @@ def test_epoch_far_future_jump_resets():
     assert resp.reset_time == far + 1000
 
 
-@pytest.mark.parametrize("seed", [5, 6])
+@pytest.mark.parametrize("seed", [5, 6, 8])
 def test_fuzz_global_paths_vs_exact_backend(seed):
     """GLOBAL-path fuzz: interleave owned decides, non-owner replica reads
     (gnp), and owner-broadcast installs (update_globals), comparing the
