@@ -25,6 +25,7 @@
 #include <sys/un.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -313,13 +314,23 @@ struct Pending {
 
 class Batcher {
  public:
-  Batcher(std::string backend_path, int batch_wait_us, int batch_limit)
+  // `workers` backend connections pull batches from one shared queue, so
+  // batch N+1 is in flight while N awaits its response (the daemon's
+  // asyncio loop serves each unix connection independently). Ordering
+  // across concurrent batches is no more defined than the reference's
+  // concurrent goroutines — per-connection HTTP pipelining stays FIFO.
+  Batcher(std::string backend_path, int batch_wait_us, int batch_limit,
+          int workers)
       : path_(std::move(backend_path)),
         wait_us_(batch_wait_us),
-        limit_(batch_limit),
-        thread_([this] { run(); }) {
-    // eager connect so HealthCheck reflects the backend before traffic
-    backend_ok_ = connect_backend();
+        limit_(batch_limit) {
+    for (int i = 0; i < workers; ++i)
+      threads_.emplace_back([this] { run(); });
+    // block until every worker attempted its eager connect, so a
+    // readiness probe hitting HealthCheck right after the listen port
+    // opens sees the true backend state
+    while (started_.load() < workers)
+      std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
 
   // enqueue and block until the batch round-trips
@@ -335,35 +346,34 @@ class Batcher {
     return !p->failed;
   }
 
-  bool backend_ok() const { return backend_ok_; }
+  bool backend_ok() const { return connected_.load() > 0; }
 
  private:
-  bool connect_backend() {
-    fd_ = socket(AF_UNIX, SOCK_STREAM, 0);
-    if (fd_ < 0) return false;
+  int connect_backend() {
+    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+    if (fd < 0) return -1;
     sockaddr_un addr{};
     addr.sun_family = AF_UNIX;
     snprintf(addr.sun_path, sizeof addr.sun_path, "%s", path_.c_str());
-    if (connect(fd_, (sockaddr*)&addr, sizeof addr) != 0) {
-      close(fd_);
-      fd_ = -1;
-      return false;
+    if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
+      close(fd);
+      return -1;
     }
-    return true;
+    return fd;
   }
 
-  bool send_all(const char* p, size_t n) {
+  static bool send_all(int fd, const char* p, size_t n) {
     while (n) {
-      ssize_t w = write(fd_, p, n);
+      ssize_t w = write(fd, p, n);
       if (w <= 0) return false;
       p += w;
       n -= (size_t)w;
     }
     return true;
   }
-  bool recv_all(char* p, size_t n) {
+  static bool recv_all(int fd, char* p, size_t n) {
     while (n) {
-      ssize_t r = read(fd_, p, n);
+      ssize_t r = read(fd, p, n);
       if (r <= 0) return false;
       p += r;
       n -= (size_t)r;
@@ -371,7 +381,7 @@ class Batcher {
     return true;
   }
 
-  bool roundtrip(std::vector<Pending*>& batch) {
+  bool roundtrip(int fd, std::vector<Pending*>& batch) {
     std::string frame;
     uint32_t n = 0;
     std::string payload;
@@ -393,10 +403,10 @@ class Batcher {
     put_u32(frame, n);
     put_u32(frame, (uint32_t)payload.size());
     frame += payload;
-    if (!send_all(frame.data(), frame.size())) return false;
+    if (!send_all(fd, frame.data(), frame.size())) return false;
 
     char hdr[8];
-    if (!recv_all(hdr, 8)) return false;
+    if (!recv_all(fd, hdr, 8)) return false;
     uint32_t magic, rn;
     memcpy(&magic, hdr, 4);
     memcpy(&rn, hdr + 4, 4);
@@ -404,15 +414,15 @@ class Batcher {
     std::vector<Decision> all(rn);
     for (uint32_t i = 0; i < rn; ++i) {
       char fix[25];
-      if (!recv_all(fix, 25)) return false;
+      if (!recv_all(fd, fix, 25)) return false;
       all[i].status = (uint8_t)fix[0];
       memcpy(&all[i].limit, fix + 1, 8);
       memcpy(&all[i].remaining, fix + 9, 8);
       memcpy(&all[i].reset_time, fix + 17, 8);
       uint16_t elen;
-      if (!recv_all((char*)&elen, 2)) return false;
+      if (!recv_all(fd, (char*)&elen, 2)) return false;
       all[i].error.resize(elen);
-      if (elen && !recv_all(all[i].error.data(), elen)) return false;
+      if (elen && !recv_all(fd, all[i].error.data(), elen)) return false;
     }
     size_t off = 0;
     for (Pending* p : batch) {
@@ -424,6 +434,9 @@ class Batcher {
   }
 
   void run() {
+    int fd = connect_backend();
+    if (fd >= 0) connected_.fetch_add(1);
+    started_.fetch_add(1);
     while (true) {
       std::vector<Pending*> batch;
       {
@@ -446,25 +459,27 @@ class Batcher {
         }
         queued_items_ -= take_items;
       }
-      bool ok = backend_ok_ && fd_ >= 0;
-      if (!ok) {
-        ok = connect_backend();
-        backend_ok_ = ok;
+      if (batch.empty()) continue;
+      if (fd < 0) {
+        fd = connect_backend();
+        if (fd >= 0) connected_.fetch_add(1);
       }
+      bool ok = fd >= 0;
       if (ok) {
-        ok = roundtrip(batch);
+        ok = roundtrip(fd, batch);
         if (!ok) {
-          close(fd_);
-          fd_ = -1;
-          backend_ok_ = false;
+          close(fd);
+          fd = -1;
+          connected_.fetch_sub(1);
         }
       }
       for (Pending* p : batch) {
-        {
-          std::lock_guard<std::mutex> lk(p->m);
-          p->failed = !ok;
-          p->done = true;
-        }
+        // notify while holding p->m: submit() may destroy the stack
+        // Pending the instant it observes done, so notifying after
+        // unlock races with the cv's destruction
+        std::lock_guard<std::mutex> lk(p->m);
+        p->failed = !ok;
+        p->done = true;
         p->cv.notify_one();
       }
     }
@@ -473,13 +488,13 @@ class Batcher {
   std::string path_;
   int wait_us_;
   int limit_;
-  int fd_ = -1;
-  std::atomic<bool> backend_ok_{false};
+  std::atomic<int> connected_{0};
+  std::atomic<int> started_{0};
   std::mutex m_;
   std::condition_variable cv_;
   std::deque<Pending*> queue_;
   size_t queued_items_ = 0;
-  std::thread thread_;
+  std::vector<std::thread> threads_;
 };
 
 // -------------------------------------------------------------- HTTP layer
@@ -600,15 +615,18 @@ int main(int argc, char** argv) {
   std::string backend = "/tmp/guber-edge.sock";
   int batch_wait_us = 500;
   int batch_limit = 1000;
+  int workers = 2;
   for (int i = 1; i + 1 < argc; i += 2) {
     std::string a = argv[i];
     if (a == "--listen") port = atoi(argv[i + 1]);
     else if (a == "--backend") backend = argv[i + 1];
     else if (a == "--batch-wait-us") batch_wait_us = atoi(argv[i + 1]);
     else if (a == "--batch-limit") batch_limit = atoi(argv[i + 1]);
+    else if (a == "--workers")
+      workers = std::max(1, atoi(argv[i + 1]));
   }
 
-  Batcher batcher(backend, batch_wait_us, batch_limit);
+  Batcher batcher(backend, batch_wait_us, batch_limit, workers);
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -20,10 +20,11 @@ Frame protocol (little-endian, lengths in bytes):
       item: u8 status | i64 limit | i64 remaining | i64 reset_time |
             u16 error_len | error
 
-One frame in flight per connection; the current edge uses a single
-backend connection with serial round-trips (one batch in flight), so
-throughput scales with batch size rather than connection count.
-Malformed input closes the connection.
+One frame in flight per connection; the edge opens `--workers`
+backend connections (default 2) whose batches round-trip concurrently,
+so this handler runs concurrently with itself — safe because the
+serving instance already serves concurrent gRPC/HTTP callers from one
+event loop. Malformed input closes the connection.
 """
 
 from __future__ import annotations
