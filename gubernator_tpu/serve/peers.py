@@ -51,6 +51,17 @@ class PeerClient:
 
     def connect(self) -> None:
         if self.channel is None:
+            # grpc.aio dials lazily and accepts any string, so validate
+            # the target's SYNTAX eagerly. This mirrors the reference,
+            # whose non-blocking grpc.Dial also only fails fast on
+            # unparsable targets (gubernator.go:260-291): health reports
+            # unhealthy for malformed peers, while well-formed but
+            # unreachable ones surface at request time, as there.
+            host, _, port = self.host.rpartition(":")
+            if not host or not port.isdigit() or not (
+                0 < int(port) < 65536
+            ):
+                raise ValueError(f"invalid peer address {self.host!r}")
             self.channel = grpc.aio.insecure_channel(self.host)
             self.stub = PeersV1Stub(self.channel)
         if self._flusher is None:

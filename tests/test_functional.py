@@ -355,3 +355,31 @@ def test_traffic_stats_observability(cluster):
     keys = {h["key"] for h in snap["hot_keys"]}
     assert "test_traffic_hot" in keys
     assert snap["distinct_keys_estimate"] >= 2
+
+
+def test_health_unhealthy_on_bad_peer(cluster):
+    """A peer that cannot even be dialed (malformed address) makes the
+    node report unhealthy with the failed peer named, and recovers once
+    the peer list is fixed (reference gubernator.go:260-291)."""
+    from gubernator_tpu.api.types import PeerInfo
+
+    server = cluster.servers[0]
+    inst = server.instance
+    good = [
+        PeerInfo(address=a, is_owner=(a == ADDRESSES[0]))
+        for a in ADDRESSES
+    ]
+    bad = good + [PeerInfo(address="not-an-address:-1")]
+
+    try:
+        cluster.run(inst.set_peers(bad))
+        h = inst.health_check()
+        assert h.status == "unhealthy"
+        assert "not-an-address:-1" in h.message
+        # healthy peers still serve
+        assert h.peer_count == len(ADDRESSES)
+    finally:
+        # restore for any test running after this one (module-scoped
+        # cluster fixture)
+        cluster.run(inst.set_peers(good))
+    assert inst.health_check().status == "healthy"
