@@ -76,9 +76,14 @@ def main(argv=None) -> int:
         )
         if conf.dist_process_id != 0:
             from gubernator_tpu.core.store import StoreConfig
+            from gubernator_tpu.serve.backends import buckets_for_limit
 
+            # the bucket ladder must match the leader's exactly: warmup
+            # replays every bucket through the step pipe and a follower
+            # missing one would die in choose_bucket mid-lockstep
             eng = MultiHostMeshEngine(
-                StoreConfig(rows=conf.store_rows, slots=conf.store_slots)
+                StoreConfig(rows=conf.store_rows, slots=conf.store_slots),
+                buckets=buckets_for_limit(conf.device_batch_limit),
             )
             eng.follower_loop(conf.dist_step_listen)
             return 0

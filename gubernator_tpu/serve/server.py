@@ -45,14 +45,19 @@ def make_backend(conf: ServerConfig):
     store = StoreConfig(rows=conf.store_rows, slots=conf.store_slots)
     if conf.backend == "exact":
         return ExactBackend(conf.cache_size)
+    from gubernator_tpu.serve.backends import buckets_for_limit
+
+    buckets = buckets_for_limit(conf.device_batch_limit)
     if conf.backend == "tpu":
-        return TpuBackend(store)
+        return TpuBackend(store, buckets=buckets)
     if conf.backend == "mesh":
-        return MeshBackend(store)
+        return MeshBackend(store, buckets=buckets)
     if conf.backend == "multihost":
         from gubernator_tpu.serve.backends import MultiHostBackend
 
-        return MultiHostBackend(store, followers=conf.dist_followers)
+        return MultiHostBackend(
+            store, followers=conf.dist_followers, buckets=buckets
+        )
     raise ValueError(f"unknown backend '{conf.backend}'")
 
 

@@ -78,3 +78,17 @@ def test_config_file_malformed(tmp_path):
     f.write_text("not a kv line\n")
     with pytest.raises(ValueError):
         load_config_file(str(f), env={})
+
+
+def test_buckets_follow_device_batch_limit():
+    """A GUBER_DEVICE_BATCH_LIMIT above the default 4096 bucket ladder
+    must extend the engine's padding buckets, or the first coalesced
+    batch above 4096 would crash choose_bucket at runtime."""
+    from gubernator_tpu.serve.backends import buckets_for_limit
+    from gubernator_tpu.core.engine import choose_bucket
+
+    assert buckets_for_limit(1000) == (64, 256, 1024, 4096)
+    b = buckets_for_limit(10_000)
+    assert choose_bucket(sorted(b), 10_000) == 16384
+    b = buckets_for_limit(16_384)
+    assert choose_bucket(sorted(b), 16_384) == 16384
