@@ -66,7 +66,8 @@ def _measure(
             try:
                 call(w * 1_000_000 + i)
                 counts[w] += 1
-            except grpc.RpcError:
+            except (grpc.RpcError, OSError):
+                # OSError covers urllib/socket failures on the edge path
                 errors[w] += 1
             i += 1
 
@@ -97,12 +98,26 @@ def _measure(
     return res
 
 
+async def _attach_edge_bridge(server, sock_path):
+    from gubernator_tpu.serve.edge_bridge import EdgeBridge
+
+    bridge = EdgeBridge(server.instance, sock_path)
+    await bridge.start()
+    return bridge
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="serving benchmarks")
     parser.add_argument("--backend", default="exact")
     parser.add_argument("--seconds", type=float, default=3.0)
     parser.add_argument("--nodes", type=int, default=6)
     parser.add_argument("--json", action="store_true")
+    parser.add_argument(
+        "--edge",
+        action="store_true",
+        help="also bench through the native C++ edge (requires "
+        "make -C gubernator_tpu/native/edge)",
+    )
     args = parser.parse_args(argv)
 
     backend_factory = None
@@ -159,6 +174,76 @@ def main(argv=None) -> int:
         def batched(i: int):
             v1.GetRateLimits(batch)
 
+        # optional: front node 0 with the native edge (HTTP/JSON in C++,
+        # batched frames into the same instance) and measure through it
+        edge_proc = None
+        if args.edge:
+            import json as _json
+            import pathlib
+            import subprocess
+            import urllib.request
+
+            edge_bin = (
+                pathlib.Path(__file__).resolve().parents[1]
+                / "native" / "edge" / "guber-edge"
+            )
+            if not edge_bin.exists():
+                print(
+                    "edge binary missing; build it with "
+                    "make -C gubernator_tpu/native/edge",
+                    file=sys.stderr,
+                )
+                return 1
+            sock = "/tmp/guber-bench-edge.sock"
+            try:
+                import os
+
+                os.unlink(sock)
+            except FileNotFoundError:
+                pass
+            edge_bridge = cluster.run(
+                _attach_edge_bridge(cluster.servers[0], sock)
+            )
+            edge_port = 19979
+            edge_proc = subprocess.Popen(
+                [str(edge_bin), "--listen", str(edge_port),
+                 "--backend", sock, "--workers", "4"],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            )
+            # poll for readiness instead of hoping a fixed sleep suffices
+            import socket as _socket
+
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                try:
+                    _socket.create_connection(
+                        ("127.0.0.1", edge_port), timeout=1
+                    ).close()
+                    break
+                except OSError:
+                    time.sleep(0.05)
+            edge_body = _json.dumps(
+                {
+                    "requests": [
+                        {"name": "edge", "uniqueKey": "K", "hits": 1,
+                         "limit": 1000000, "duration": 10000}
+                    ]
+                }
+            ).encode()
+
+            def through_edge(i: int):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{edge_port}/v1/GetRateLimits",
+                    data=edge_body,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+
+            results.append(
+                _measure("edge_front_door", through_edge, args.seconds,
+                         workers=16)
+            )
+
         results.append(
             _measure("no_batching", no_batching, args.seconds)
         )
@@ -181,6 +266,17 @@ def main(argv=None) -> int:
             print(json.dumps(results))
         return 0
     finally:
+        try:
+            if "edge_proc" in locals() and edge_proc is not None:
+                edge_proc.kill()
+                edge_proc.wait(timeout=5)
+            if "edge_bridge" in locals() and edge_bridge is not None:
+                cluster.run(edge_bridge.stop())
+            import os as _os
+
+            _os.unlink("/tmp/guber-bench-edge.sock")
+        except Exception:
+            pass
         cluster.stop()
 
 

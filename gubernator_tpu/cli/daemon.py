@@ -75,8 +75,8 @@ def main(argv=None) -> int:
             conf.dist_process_id,
         )
         if conf.dist_process_id != 0:
+            from gubernator_tpu.core.engine import buckets_for_limit
             from gubernator_tpu.core.store import StoreConfig
-            from gubernator_tpu.serve.backends import buckets_for_limit
 
             # the bucket ladder must match the leader's exactly: warmup
             # replays every bucket through the step pipe and a follower

@@ -64,6 +64,18 @@ def _decide_packed_jit(store, req, now):
     return store, pack_outputs(resp, stats)
 
 
+def buckets_for_limit(limit: int) -> tuple:
+    """Padding buckets covering batches up to `limit` (the daemon's
+    GUBER_DEVICE_BATCH_LIMIT). DEFAULT_BUCKETS tops out at 4096; a
+    larger device batch limit must extend the ladder or choose_bucket
+    raises at runtime on the first big batch — each extra bucket costs
+    one XLA compile at warmup."""
+    base = list(DEFAULT_BUCKETS)
+    while base[-1] < limit:
+        base.append(base[-1] * 4)
+    return tuple(base)
+
+
 def _np_presort(key_hash: np.ndarray, store_buckets: int) -> np.ndarray:
     return np.argsort(
         group_sort_key_np(key_hash, store_buckets), kind="stable"

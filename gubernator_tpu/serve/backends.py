@@ -223,16 +223,3 @@ class MultiHostBackend(MeshBackend):
 
     def close(self) -> None:
         self.engine.close()
-
-def buckets_for_limit(limit: int) -> tuple:
-    """Padding buckets covering batches up to `limit` (the daemon's
-    GUBER_DEVICE_BATCH_LIMIT). The default ladder tops out at 4096; a
-    larger device batch limit must extend it or choose_bucket raises at
-    runtime on the first big batch — each extra bucket costs one XLA
-    compile at warmup."""
-    from gubernator_tpu.core.engine import DEFAULT_BUCKETS
-
-    base = list(DEFAULT_BUCKETS)
-    while base[-1] < limit:
-        base.append(base[-1] * 4)
-    return tuple(base)

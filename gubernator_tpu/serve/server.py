@@ -45,7 +45,7 @@ def make_backend(conf: ServerConfig):
     store = StoreConfig(rows=conf.store_rows, slots=conf.store_slots)
     if conf.backend == "exact":
         return ExactBackend(conf.cache_size)
-    from gubernator_tpu.serve.backends import buckets_for_limit
+    from gubernator_tpu.core.engine import buckets_for_limit
 
     buckets = buckets_for_limit(conf.device_batch_limit)
     if conf.backend == "tpu":

@@ -84,7 +84,7 @@ def test_buckets_follow_device_batch_limit():
     """A GUBER_DEVICE_BATCH_LIMIT above the default 4096 bucket ladder
     must extend the engine's padding buckets, or the first coalesced
     batch above 4096 would crash choose_bucket at runtime."""
-    from gubernator_tpu.serve.backends import buckets_for_limit
+    from gubernator_tpu.core.engine import buckets_for_limit
     from gubernator_tpu.core.engine import choose_bucket
 
     assert buckets_for_limit(1000) == (64, 256, 1024, 4096)
