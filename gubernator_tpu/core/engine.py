@@ -66,13 +66,14 @@ def _decide_packed_jit(store, req, now):
 
 def buckets_for_limit(limit: int) -> tuple:
     """Padding buckets covering batches up to `limit` (the daemon's
-    GUBER_DEVICE_BATCH_LIMIT). DEFAULT_BUCKETS tops out at 4096; a
-    larger device batch limit must extend the ladder or choose_bucket
-    raises at runtime on the first big batch — each extra bucket costs
-    one XLA compile at warmup."""
-    base = list(DEFAULT_BUCKETS)
-    while base[-1] < limit:
-        base.append(base[-1] * 4)
+    GUBER_DEVICE_BATCH_LIMIT) — each rung costs one XLA compile at
+    warmup. Rungs above the limit are useless, so the ladder is trimmed
+    to the rungs below it plus one final rung at the limit itself
+    (rounded up to a 128-lane multiple): a limit between rungs (e.g.
+    5000) caps padding waste at the rounding instead of jumping to the
+    next power-of-four (which would pad 4097-5000-row batches 3.3x)."""
+    base = [b for b in DEFAULT_BUCKETS if b < limit]
+    base.append(-(-limit // 128) * 128)
     return tuple(base)
 
 

@@ -162,11 +162,46 @@ void guber_crc32_batch(const uint8_t* buf, const int64_t* offsets, int64_t n,
 namespace {
 
 constexpr uint64_t BUCKET_SALT = 0x9E3779B97F4A7C15ULL;
+// must match gubernator_tpu/parallel/sharded.py _SHARD_SALT
+constexpr uint64_t SHARD_SALT = 0xA24BAED4963EE407ULL;
 
 inline uint64_t splitmix64(uint64_t x) {
   x = (x ^ (x >> 30)) * 0xBF58476D1CE4E5B9ULL;
   x = (x ^ (x >> 27)) * 0x94D049BB133111EBULL;
   return x ^ (x >> 31);
+}
+
+// Stable LSD radix argsort of `keys` (low `total_bits` bits meaningful);
+// writes the permutation into order_out.
+void radix_argsort(std::vector<uint64_t>& keys, int64_t n, int total_bits,
+                   int32_t* order_out) {
+  std::vector<int32_t> idx(n), idx2(n);
+  for (int64_t i = 0; i < n; ++i) idx[i] = static_cast<int32_t>(i);
+  std::vector<uint64_t> keys2(n);
+
+  const int passes = (total_bits + 15) / 16;
+  static thread_local std::vector<uint32_t> count(1 << 16);
+  for (int pass = 0; pass < passes; ++pass) {
+    const int shift = pass * 16;
+    std::memset(count.data(), 0, count.size() * sizeof(uint32_t));
+    for (int64_t i = 0; i < n; ++i) {
+      ++count[(keys[i] >> shift) & 0xFFFF];
+    }
+    uint32_t sum = 0;
+    for (uint32_t d = 0; d < (1u << 16); ++d) {
+      uint32_t c = count[d];
+      count[d] = sum;
+      sum += c;
+    }
+    for (int64_t i = 0; i < n; ++i) {
+      uint32_t pos = count[(keys[i] >> shift) & 0xFFFF]++;
+      keys2[pos] = keys[i];
+      idx2[pos] = idx[i];
+    }
+    keys.swap(keys2);
+    idx.swap(idx2);
+  }
+  std::memcpy(order_out, idx.data(), n * sizeof(int32_t));
 }
 
 }  // namespace
@@ -190,35 +225,38 @@ void guber_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
     if (fp == 0) fp = 1;
     keys[i] = (bkt << 32) | fp;
   }
+  radix_argsort(keys, n, 32 + bucket_bits, order_out);
+}
 
-  std::vector<int32_t> idx(n), idx2(n);
-  for (int64_t i = 0; i < n; ++i) idx[i] = static_cast<int32_t>(i);
-  std::vector<uint64_t> keys2(n);
+// Mesh-sharded presort: argsort by (owner_shard, bucket, fingerprint) and
+// per-shard row counts. owner = splitmix64(kh ^ SHARD_SALT) % n_shards —
+// must stay bit-identical to parallel/sharded.py owner_of / owner_of_np.
+// Rows of one shard come out contiguous, internally in the (bucket, fp)
+// order decide_presorted requires, so the host can slice per-shard
+// sub-batches straight out of the permutation (batch-axis sharding over
+// the mesh: each chip gets only the rows it owns).
+void guber_presort_sharded(const uint64_t* key_hash, int64_t n,
+                           uint64_t buckets, uint64_t n_shards,
+                           int32_t* order_out, int64_t* counts_out) {
+  const uint64_t bmask = buckets - 1;
+  int bucket_bits = 0;
+  while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
+  int shard_bits = 1;
+  while ((1ULL << shard_bits) < n_shards) ++shard_bits;
 
-  const int total_bits = 32 + bucket_bits;
-  const int passes = (total_bits + 15) / 16;
-  uint32_t count[1 << 16];
-  for (int pass = 0; pass < passes; ++pass) {
-    const int shift = pass * 16;
-    std::memset(count, 0, sizeof(count));
-    for (int64_t i = 0; i < n; ++i) {
-      ++count[(keys[i] >> shift) & 0xFFFF];
-    }
-    uint32_t sum = 0;
-    for (uint32_t d = 0; d < (1u << 16); ++d) {
-      uint32_t c = count[d];
-      count[d] = sum;
-      sum += c;
-    }
-    for (int64_t i = 0; i < n; ++i) {
-      uint32_t pos = count[(keys[i] >> shift) & 0xFFFF]++;
-      keys2[pos] = keys[i];
-      idx2[pos] = idx[i];
-    }
-    keys.swap(keys2);
-    idx.swap(idx2);
+  for (uint64_t s = 0; s < n_shards; ++s) counts_out[s] = 0;
+
+  std::vector<uint64_t> keys(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t kh = key_hash[i];
+    uint64_t owner = splitmix64(kh ^ SHARD_SALT) % n_shards;
+    ++counts_out[owner];
+    uint64_t bkt = splitmix64(kh ^ BUCKET_SALT) & bmask;
+    uint64_t fp = kh >> 32;
+    if (fp == 0) fp = 1;
+    keys[i] = (owner << (32 + bucket_bits)) | (bkt << 32) | fp;
   }
-  std::memcpy(order_out, idx.data(), n * sizeof(int32_t));
+  radix_argsort(keys, n, 32 + bucket_bits + shard_bits, order_out);
 }
 
 }  // extern "C"

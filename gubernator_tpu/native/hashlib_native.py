@@ -38,6 +38,19 @@ try:  # symbol absent in a stale prebuilt .so — the hash/crc fast paths
 except AttributeError:
     _HAS_PRESORT = False
 
+try:
+    _lib.guber_presort_sharded.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _HAS_PRESORT_SHARDED = True
+except AttributeError:
+    _HAS_PRESORT_SHARDED = False
+
 # Fixed seed: slot hashes are instance-local but stable across restarts for
 # debuggability.
 _SEED = 0x67756265726E6174  # "gubernat"
@@ -101,3 +114,27 @@ def presort(key_hash: np.ndarray, buckets: int) -> np.ndarray:
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
     )
     return out
+
+
+def presort_sharded(key_hash: np.ndarray, buckets: int, n_shards: int):
+    """(order int32[n], counts int64[n_shards]) — stable argsort by
+    (owner_shard, bucket, fingerprint) plus per-shard row counts. The
+    contiguous per-shard runs of the permutation are the mesh engine's
+    per-chip sub-batches (parallel/sharded.py pad_request_sharded)."""
+    if not _HAS_PRESORT_SHARDED:
+        raise AttributeError(
+            "libguberhash.so predates guber_presort_sharded; rebuild with "
+            "make -C gubernator_tpu/native"
+        )
+    kh = np.ascontiguousarray(key_hash, np.uint64)
+    order = np.empty(kh.shape[0], np.int32)
+    counts = np.empty(n_shards, np.int64)
+    _lib.guber_presort_sharded(
+        kh.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        kh.shape[0],
+        ctypes.c_uint64(buckets),
+        ctypes.c_uint64(n_shards),
+        order.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+    )
+    return order, counts

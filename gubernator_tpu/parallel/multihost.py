@@ -158,6 +158,18 @@ class MultiHostMeshEngine:
     def buckets(self):
         return self.inner.buckets
 
+    @property
+    def sub_buckets(self):
+        return self.inner.sub_buckets
+
+    @property
+    def n(self):
+        return self.inner.n
+
+    @property
+    def stats(self):
+        return self.inner.stats
+
     # -- leader API ---------------------------------------------------------
 
     def _lockstep(self, msg: dict) -> None:

@@ -11,11 +11,16 @@ Inside one host, this framework distributes keys across TPU chips instead:
   hash (`owner = mix64(key_hash) mod n`) instead of a sorted ring search:
   with homogeneous chips there is no reason to pay the ring's lookup cost
   or its imbalance (the reference places one point per peer, hash.go:62-67).
-- A request batch is replicated to all chips (`shard_map`); each chip
-  evaluates the full batch against its own store shard with non-owned rows
-  masked invalid, and the per-chip decisions are combined with one
-  `jax.lax.psum` over ICI — the collective plays the role of the
-  peer-to-peer forwarding RPCs (reference peers.go) with zero host hops.
+- The request BATCH is sharded too: the host presorts each batch by
+  (owner_shard, bucket, fingerprint) — one native radix pass — slices the
+  contiguous per-shard runs into per-chip sub-batches, and lays the
+  [n_shards, B_sub] request arrays out over the mesh's batch axis. Each
+  chip evaluates ONLY the ~B/n rows it owns, so aggregate decisions/s
+  scales with chip count — the same economy as the reference forwarding
+  each key only to its owner peer (reference peers.go:111-207). The decide
+  path needs NO collective at all: responses come back per-shard and the
+  host unpermutes them into request order (it already owns the
+  permutation).
 - GLOBAL mode's owner->replica broadcast (reference global.go:158-232)
   becomes `sync_globals`: owners peek authoritative status, one psum
   replicates it mesh-wide, and every non-owner installs replica entries —
@@ -38,20 +43,18 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from gubernator_tpu.core.engine import (
+    EngineStats,
     EpochClock,
     _sat_i32,
     pad_request_sorted,
     pad_to_bucket,
-    unpermute_responses,
 )
 from gubernator_tpu.core.kernels import (
     BatchRequest,
     BatchResponse,
-    BatchStats,
     decide_presorted,
     pack_outputs,
     rebase_jit,
-    unpack_outputs,
     upsert_globals,
 )
 from gubernator_tpu.core.store import Store, StoreConfig, mix64, new_store
@@ -75,41 +78,155 @@ def owner_of_np(key_hash: np.ndarray, n_shards: int) -> np.ndarray:
     )
 
 
-def _shard_decide(store: Store, req: BatchRequest, now, n_shards: int):
-    """Per-device body under shard_map: store is this device's shard."""
-    me = jax.lax.axis_index("shard")
+def _local_decide(store: Store, req: BatchRequest, now):
+    """Per-device body under shard_map: store AND batch are this device's
+    shards. The host routed every request row to its owner chip
+    (pad_request_sharded), so each chip runs the plain single-device
+    kernel on its own sub-batch — no collective on the decide path, the
+    mesh analogue of the reference forwarding only owned keys to a peer
+    (reference peers.go:111-207). Responses + stats pack into one int32
+    row per shard (one host transfer total)."""
     store = jax.tree.map(lambda x: x[0], store)  # [1, r, s] -> [r, s]
-    mine = owner_of(req.key_hash, n_shards) == me
-    # masking non-owned rows leaves them interspersed; decide_presorted's
-    # key-based grouping handles that (ownership is per-key, so groups
-    # stay uniformly valid or invalid)
-    local_req = req._replace(valid=req.valid & mine)
-    new_store_shard, resp, stats = decide_presorted(store, local_req, now)
+    req = jax.tree.map(lambda x: x[0], req)  # [1, B_sub] -> [B_sub]
+    new_store_shard, resp, stats = decide_presorted(store, req, now)
+    packed = pack_outputs(resp, stats)
+    return jax.tree.map(lambda x: x[None], new_store_shard), packed[None]
 
-    # Non-owners contribute zeros; one psum combines the mesh's answers.
-    mask = mine & req.valid
 
-    def combine(x):
-        return jax.lax.psum(jnp.where(mask, x, 0), "shard")
+def _local_decide_gathered(store: Store, req: BatchRequest, now):
+    """_local_decide + one all_gather of the packed response rows: when
+    the mesh spans processes the serving host cannot fetch follower
+    shards directly, so the responses ride the compiled collective path
+    (ICI within a host, DCN between hosts) and come out replicated."""
+    store, packed = _local_decide(store, req, now)
+    return store, jax.lax.all_gather(packed[0], "shard")
 
-    resp = BatchResponse(
-        status=combine(resp.status),
-        limit=combine(resp.limit),
-        remaining=combine(resp.remaining),
-        reset_time=combine(resp.reset_time),
+
+def _np_presort_sharded(
+    key_hash: np.ndarray, store_buckets: int, n_shards: int
+):
+    """Numpy fallback for the native sharded presort: stable argsort by
+    (owner_shard, bucket, fingerprint) + per-shard counts."""
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    owner = owner_of_np(key_hash, n_shards)
+    # owner bits sit just above the (bucket << 32 | fp) group key, like
+    # the native sort key (guberhash.cc guber_presort_sharded)
+    bucket_bits = max(int(store_buckets).bit_length() - 1, 1)
+    comp = (
+        owner.astype(np.uint64) << np.uint64(32 + bucket_bits)
+    ) | group_sort_key_np(key_hash, store_buckets)
+    order = np.argsort(comp, kind="stable").astype(np.int32)
+    counts = np.bincount(owner, minlength=n_shards).astype(np.int64)
+    return order, counts
+
+
+try:  # native radix presort with shard partitioning (guberhash.cc)
+    from gubernator_tpu.native import hashlib_native as _hn
+
+    if not _hn._HAS_PRESORT_SHARDED:
+        raise AttributeError("guber_presort_sharded missing")
+    _presort_sharded = _hn.presort_sharded
+except (ImportError, AttributeError, OSError):  # pragma: no cover
+    _presort_sharded = _np_presort_sharded
+
+
+def sub_batch_ladder(buckets: Sequence[int]) -> tuple:
+    """Padding rungs for per-shard sub-batches: the host ladder densified
+    with 1.5x midpoints (64, 96, 128, 192, ... between min and max rung).
+    Shard counts concentrate at ~B/n_shards + multinomial jitter, so the
+    coarse 4x host ladder would pad a shard's rows up to 4x (measured:
+    total mesh work grew instead of staying flat); midpoints cap padding
+    waste at 1.5x for one extra compile per octave at warmup."""
+    lo, hi = min(buckets), max(buckets)
+    rungs = set(buckets)
+    p = lo
+    while p < hi:
+        rungs.add(p)
+        rungs.add(min(p * 3 // 2, hi))
+        p *= 2
+    rungs.add(hi)
+    return tuple(sorted(rungs))
+
+
+def pad_request_sharded(
+    buckets: Sequence[int],
+    store_buckets: int,
+    n_shards: int,
+    key_hash: np.ndarray,
+    hits: np.ndarray,
+    limit: np.ndarray,
+    duration: np.ndarray,
+    algo: np.ndarray,
+    gnp: np.ndarray,
+):
+    """Partition a batch into per-shard sub-batches: the mesh sibling of
+    engine.pad_request_sorted. One (owner, bucket, fp) radix sort makes
+    each shard's rows a contiguous presorted run; every field becomes a
+    [n_shards, B_sub] array (B_sub = bucket fitting the LARGEST shard's
+    count) whose row s is shard s's sub-batch padded by repeating its
+    last row with valid=False (preserving the monotonic bucket stream).
+
+    Returns (req, order, take_idx):
+    - req: BatchRequest of [n_shards, B_sub] arrays, batch-axis shardable
+      P("shard") — row s belongs on chip s.
+    - order[k]: caller index of the k-th row in global sorted order.
+    - take_idx[k]: flattened [n_shards*B_sub] device position of that row.
+    Unpermute responses with `out[order] = resp_flat[take_idx]`.
+    """
+    from gubernator_tpu.core.engine import (
+        _sat_duration as sat_dur,
+        _sat_i32 as sat_i32,
+        choose_bucket,
     )
-    stats = BatchStats(
-        hits=jax.lax.psum(stats.hits, "shard"),
-        misses=jax.lax.psum(stats.misses, "shard"),
+
+    n = key_hash.shape[0]
+    if n == 0:
+        # empty batch: one all-invalid row per shard (smallest rung)
+        B0 = buckets[0] if hasattr(buckets, "__getitem__") else min(buckets)
+        req = BatchRequest(
+            key_hash=np.zeros((n_shards, B0), np.uint64),
+            hits=np.zeros((n_shards, B0), np.int32),
+            limit=np.zeros((n_shards, B0), np.int32),
+            duration=np.zeros((n_shards, B0), np.int32),
+            algo=np.zeros((n_shards, B0), np.int32),
+            gnp=np.zeros((n_shards, B0), bool),
+            valid=np.zeros((n_shards, B0), bool),
+        )
+        return req, np.empty(0, np.int32), np.empty(0, np.int64)
+    order, counts = _presort_sharded(key_hash, store_buckets, n_shards)
+    counts32 = counts.astype(np.int64)
+    starts = np.zeros(n_shards + 1, np.int64)
+    np.cumsum(counts32, out=starts[1:])
+    B_sub = choose_bucket(buckets, max(int(counts32.max()), 1))
+
+    # src[s, j]: index into the sorted arrays for padded cell (s, j) —
+    # clamped to the shard's last real row (repeat-pad); empty shards
+    # clamp to a neighbouring row, masked invalid below.
+    j = np.arange(B_sub, dtype=np.int64)[None, :]
+    src = starts[:-1, None] + np.minimum(
+        j, np.maximum(counts32[:, None] - 1, 0)
     )
-    return jax.tree.map(lambda x: x[None], new_store_shard), resp, stats
+    np.clip(src, 0, max(n - 1, 0), out=src)
+    valid = j < counts32[:, None]
 
+    def shard_field(x, dtype, sat=None):
+        x = sat(x) if sat is not None else np.asarray(x, dtype)
+        return x[order][src]  # [n_shards, B_sub]
 
-def _packed_shard_decide(store, req, now, n_shards: int):
-    """_shard_decide with responses + stats packed into one int32 array —
-    one host transfer instead of six (see engine._decide_packed_jit)."""
-    store, resp, stats = _shard_decide(store, req, now, n_shards)
-    return store, pack_outputs(resp, stats)
+    req = BatchRequest(
+        key_hash=shard_field(key_hash, np.uint64),
+        hits=shard_field(hits, np.int32, sat_i32),
+        limit=shard_field(limit, np.int32, sat_i32),
+        duration=shard_field(duration, np.int32, sat_dur),
+        algo=shard_field(algo, np.int32),
+        gnp=shard_field(gnp, bool),
+        valid=valid,
+    )
+    # global sorted position k lives at device cell (shard_of_k, k-start)
+    shard_of_k = np.repeat(np.arange(n_shards, dtype=np.int64), counts32)
+    take_idx = shard_of_k * B_sub + (np.arange(n, dtype=np.int64) - starts[shard_of_k])
+    return req, order, take_idx
 
 
 def _shard_sync_globals(
@@ -205,19 +322,27 @@ class MeshEngine:
         self.n = len(devices)
         self.config = config
         self.buckets = sorted(buckets)
+        self.sub_buckets = sub_batch_ladder(self.buckets)
         self.clock = EpochClock()
+        self.stats = EngineStats()
 
         sharding = NamedSharding(self.mesh, P("shard"))
         self.store_sharding = sharding
         self.store = self._fresh_store()
 
-        decide_fn = functools.partial(_packed_shard_decide, n_shards=self.n)
+        # a single-process mesh host can fetch every response shard
+        # directly; a multi-process mesh must all_gather them (the serving
+        # leader cannot address follower-process shards)
+        span = len({d.process_index for d in devices}) > 1
         self._step = jax.jit(
             jax.shard_map(
-                decide_fn,
+                _local_decide_gathered if span else _local_decide,
                 mesh=self.mesh,
-                in_specs=(P("shard"), P(), P()),
-                out_specs=(P("shard"), P()),
+                in_specs=(P("shard"), P("shard"), P()),
+                out_specs=(P("shard"), P() if span else P("shard")),
+                # the all_gather output IS replicated, but the static
+                # varying-axis check can't prove it — disable just there
+                check_vma=not span,
             ),
             donate_argnums=(0,),
         )
@@ -276,9 +401,10 @@ class MeshEngine:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
-        req, order = pad_request_sorted(
-            self.buckets,
+        req, order, take_idx = pad_request_sharded(
+            self.sub_buckets,
             self.config.slots,
+            self.n,
             key_hash,
             hits,
             limit,
@@ -286,16 +412,22 @@ class MeshEngine:
             algo,
             gnp,
         )
+        B_sub = req.key_hash.shape[1]
         self.store, packed = self._step(self.store, req, e_now)
-        packed = np.asarray(jax.device_get(packed))
-        s_status, s_lim, s_rem, s_reset, _h, _m = unpack_outputs(
-            packed, req.key_hash.shape[0]
-        )
-        status, rlimit, remaining, reset = unpermute_responses(
-            order, (s_status, s_lim, s_rem, s_reset)
-        )
+        packed = np.asarray(jax.device_get(packed))  # [n_shards, 4*B_sub+2]
+        self.stats.hits += int(packed[:, 4 * B_sub].sum())
+        self.stats.misses += int(packed[:, 4 * B_sub + 1].sum())
+        self.stats.batches += 1
+
+        def unflatten(col0):
+            flat = packed[:, col0 * B_sub : (col0 + 1) * B_sub].reshape(-1)
+            out = np.empty(n, flat.dtype)
+            out[order] = flat[take_idx]
+            return out
+
+        status, rlimit, remaining, reset = (unflatten(c) for c in range(4))
         reset = self.clock.from_engine(reset)
-        return status[:n], rlimit[:n], remaining[:n], reset[:n]
+        return status, rlimit, remaining, reset
 
     def update_globals(
         self,

@@ -169,19 +169,38 @@ class MeshBackend:
     def warmup(self) -> None:
         np = self._np
         from gubernator_tpu.api.types import millisecond_now
+        from gubernator_tpu.parallel.sharded import owner_of_np
 
         # One real wall-clock now threads through every warmup call: mixing
         # clock domains would trip the EpochClock's large-jump reset path
         # and leave the epoch pinned at a synthetic time.
         now = millisecond_now()
+        # The decide path pads PER-SHARD sub-batches to the dense sub-rung
+        # ladder (sharded.sub_batch_ladder); compile each rung by crafting
+        # a batch with exactly `r` keys owned by every shard. Driving the
+        # public decide_arrays keeps this lockstep-safe for the multi-host
+        # engine (followers replay the same call).
+        n = self.engine.n
+        rungs = self.engine.sub_buckets
+        rng = np.random.default_rng(0xB007)
+        pool = rng.integers(1, 2**63, 4 * n * max(rungs), np.int64).astype(
+            np.uint64
+        )
+        owners = owner_of_np(pool, n)
+        per_shard = [pool[owners == s] for s in range(n)]
+        for r in rungs:
+            k = np.concatenate([p[:r] for p in per_shard])
+            ones = np.ones(k.shape[0], np.int64)
+            self.engine.decide_arrays(
+                key_hash=k, hits=ones, limit=ones * 10, duration=ones * 1000,
+                algo=np.zeros(k.shape[0], np.int32),
+                gnp=np.zeros(k.shape[0], bool),
+                now=now,
+            )
+        # broadcast-receive + gossip collective programs per host rung
         for b in self.engine.buckets:
             k = np.arange(1, b + 1, dtype=np.uint64)
             ones = np.ones(b, np.int64)
-            self.engine.decide_arrays(
-                key_hash=k, hits=ones, limit=ones * 10, duration=ones * 1000,
-                algo=np.zeros(b, np.int32), gnp=np.zeros(b, bool),
-                now=now,
-            )
             self.engine.update_globals(
                 key_hash=k,
                 limit=ones,
@@ -191,10 +210,14 @@ class MeshBackend:
                 now=now,
             )
             self.engine.sync_globals(k, ones, ones * 1000, now=now)
+        # clear state and counters dirtied by warmup traffic (the stats
+        # object is shared through the multihost wrapper's property, so
+        # mutate in place rather than rebinding)
         self.engine.reset()
+        self.engine.stats.__init__()
 
     def stats(self) -> dict:
-        return {}
+        return self.engine.stats.snapshot()
 
 
 class MultiHostBackend(MeshBackend):

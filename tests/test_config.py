@@ -87,8 +87,13 @@ def test_buckets_follow_device_batch_limit():
     from gubernator_tpu.core.engine import buckets_for_limit
     from gubernator_tpu.core.engine import choose_bucket
 
-    assert buckets_for_limit(1000) == (64, 256, 1024, 4096)
+    assert buckets_for_limit(1000) == (64, 256, 1024)
     b = buckets_for_limit(10_000)
-    assert choose_bucket(sorted(b), 10_000) == 16384
+    assert choose_bucket(sorted(b), 10_000) == 10_112  # 10_000 up to x128
     b = buckets_for_limit(16_384)
-    assert choose_bucket(sorted(b), 16_384) == 16384
+    assert choose_bucket(sorted(b), 16_384) == 16_384
+    # a limit between rungs becomes its own final rung instead of padding
+    # to the next power-of-four (ADVICE r1: 5000 used to pad 3.3x to 16384)
+    b = buckets_for_limit(5000)
+    assert b == (64, 256, 1024, 4096, 5120)
+    assert choose_bucket(sorted(b), 4500) == 5120

@@ -91,6 +91,43 @@ def test_keys_spread_across_shards(mesh_engine):
     assert (counts > 20).all(), counts  # roughly uniform ownership
 
 
+def test_batch_is_sharded_not_replicated(mesh_engine):
+    """The scaling property: each chip's sub-batch holds only the rows it
+    owns (~B/n), not the full batch — n chips do ~B work total, so
+    aggregate decisions/s grows with the mesh instead of replicating the
+    full batch on every chip."""
+    from gubernator_tpu.parallel.sharded import pad_request_sharded
+
+    B = 256
+    hashes = slot_hash_batch([f"scale:{i}" for i in range(B)])
+    req, order, take_idx = pad_request_sharded(
+        mesh_engine.buckets,
+        mesh_engine.config.slots,
+        mesh_engine.n,
+        hashes,
+        np.ones(B, np.int64),
+        np.full(B, 10, np.int64),
+        np.full(B, 1000, np.int64),
+        np.zeros(B, np.int32),
+        np.zeros(B, bool),
+    )
+    n_shards, B_sub = req.key_hash.shape
+    assert n_shards == mesh_engine.n
+    # per-chip batch is ~B/n padded to a bucket, far below the full B
+    counts = np.bincount(owner_of_np(hashes, mesh_engine.n), minlength=8)
+    assert B_sub == 64 and B_sub >= counts.max(), (B_sub, counts)
+    # every valid row sits on the shard that owns its key
+    for s in range(n_shards):
+        v = req.valid[s]
+        assert v.sum() == counts[s]
+        assert (owner_of_np(req.key_hash[s][v], mesh_engine.n) == s).all()
+    # round-trip: order/take_idx reassemble the original key order
+    flat = req.key_hash.reshape(-1)
+    back = np.empty(B, np.uint64)
+    back[order] = flat[take_idx]
+    assert (back == hashes).all()
+
+
 def test_sync_globals_installs_replicas_on_all_shards(mesh_engine):
     reqs = [
         RateLimitReq(
