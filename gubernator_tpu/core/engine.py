@@ -92,6 +92,11 @@ try:  # native LSD radix presort (~3.6x numpy at 16k keys); same order
 except (ImportError, AttributeError, OSError):  # pragma: no cover
     # not built / stale / load failure — the numpy path works
     _presort = _np_presort
+    _hn = None
+
+# native one-pass gather+clip+pad marshalling (guberhash.cc); the numpy
+# fallback below costs ~40ns/element across the six request fields
+_marshal = _hn if (_hn is not None and _hn._HAS_MARSHAL) else None
 
 _I32_SAT = COUNTER_MAX
 
@@ -204,24 +209,43 @@ def pad_request_sorted(
 
     order_n = _presort(key_hash, store_buckets)
 
-    def pad_sorted(x, dtype, sat=None):
-        x = sat(x) if sat is not None else np.asarray(x, dtype)
-        out = np.empty(B, dtype)
-        out[:n] = x[order_n]
-        out[n:] = out[n - 1] if n else 0
-        return out
-
     valid = np.zeros(B, bool)
     valid[:n] = True
-    req = BatchRequest(
-        key_hash=pad_sorted(key_hash, np.uint64),
-        hits=pad_sorted(hits, np.int32, _sat_i32),
-        limit=pad_sorted(limit, np.int32, _sat_i32),
-        duration=pad_sorted(duration, np.int32, _sat_duration),
-        algo=pad_sorted(algo, np.int32),
-        gnp=pad_sorted(gnp, bool),
-        valid=valid,
-    )
+    if _marshal is not None and n:
+        req = BatchRequest(
+            key_hash=_marshal.gather_pad_u64(key_hash, order_n, B),
+            hits=_marshal.gather_pad_i64_clip(
+                hits, order_n, B, -_I32_SAT, _I32_SAT
+            ),
+            limit=_marshal.gather_pad_i64_clip(
+                limit, order_n, B, -_I32_SAT, _I32_SAT
+            ),
+            duration=_marshal.gather_pad_i64_clip(
+                duration, order_n, B, TIME_FLOOR, MAX_DURATION_MS
+            ),
+            algo=_marshal.gather_pad_i32(algo, order_n, B),
+            gnp=_marshal.gather_pad_u8(
+                np.asarray(gnp, bool).view(np.uint8), order_n, B
+            ).view(bool),
+            valid=valid,
+        )
+    else:
+        def pad_sorted(x, dtype, sat=None):
+            x = sat(x) if sat is not None else np.asarray(x, dtype)
+            out = np.empty(B, dtype)
+            out[:n] = x[order_n]
+            out[n:] = out[n - 1] if n else 0
+            return out
+
+        req = BatchRequest(
+            key_hash=pad_sorted(key_hash, np.uint64),
+            hits=pad_sorted(hits, np.int32, _sat_i32),
+            limit=pad_sorted(limit, np.int32, _sat_i32),
+            duration=pad_sorted(duration, np.int32, _sat_duration),
+            algo=pad_sorted(algo, np.int32),
+            gnp=pad_sorted(gnp, bool),
+            valid=valid,
+        )
     order = np.empty(B, np.int32)
     order[:n] = order_n
     order[n:] = np.arange(n, B, dtype=np.int32)
@@ -314,7 +338,7 @@ class TpuEngine:
             self.store = rebase_jit(self.store, np.int32(delta))
         return e
 
-    def decide_arrays(
+    def decide_submit(
         self,
         key_hash: np.ndarray,
         hits: np.ndarray,
@@ -323,9 +347,15 @@ class TpuEngine:
         algo: np.ndarray,
         gnp: np.ndarray,
         now: int,
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Array-level entry point (also used by the benchmark harness).
-        Times in/out are int64 unix-ms; conversion happens here."""
+    ):
+        """Presort + dispatch one batch WITHOUT waiting for the result.
+
+        The store update is effective immediately (the jitted call threads
+        the donated store), so the next submit may follow at once; jax
+        dispatch is async, which lets the caller presort batch i+1 while
+        the device still computes batch i — the pipelining the serving
+        batcher and the e2e bench rely on. Returns an opaque handle for
+        decide_wait."""
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
         req, order = pad_request_sorted(
@@ -339,19 +369,57 @@ class TpuEngine:
             gnp,
         )
         self.store, packed = _decide_packed_jit(self.store, req, e_now)
+        # capture the epoch the batch was computed under: a later submit
+        # may rebase/reset the clock before this batch's wait, and the
+        # in-flight engine-ms outputs must convert against THEIR epoch
+        return (packed, order, n, req.key_hash.shape[0], self.clock.epoch)
+
+    def decide_wait(
+        self, handle
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Fetch + unpermute the responses for a decide_submit handle."""
+        packed, order, n, B, epoch = handle
         packed = np.asarray(jax.device_get(packed))
-        s_status, s_lim, s_rem, s_reset, b_hits, b_misses = unpack_outputs(
-            packed, req.key_hash.shape[0]
-        )
-        self.stats.hits += int(b_hits)
-        self.stats.misses += int(b_misses)
+        self.stats.hits += int(packed[4 * B])
+        self.stats.misses += int(packed[4 * B + 1])
         self.stats.batches += 1
-        # responses come back in sorted order; one numpy pass unpermutes
-        status, rlimit, remaining, reset = unpermute_responses(
-            order, (s_status, s_lim, s_rem, s_reset)
-        )
-        reset = self.clock.from_engine(reset)
+        # responses come back in sorted order; one pass unpermutes (the
+        # [4, B] view of the packed transfer is zero-copy)
+        if _marshal is not None:
+            u = _marshal.unpermute_i32(
+                packed[: 4 * B].reshape(4, B), order, n
+            )
+            status, rlimit, remaining, reset = u[0], u[1], u[2], u[3]
+        else:
+            s_status, s_lim, s_rem, s_reset, _h, _m = unpack_outputs(
+                packed, B
+            )
+            status, rlimit, remaining, reset = unpermute_responses(
+                order, (s_status, s_lim, s_rem, s_reset)
+            )
+        # convert with the submit-time epoch (see decide_submit); 0 stays
+        # the 'no reset' sentinel
+        r = np.asarray(reset, np.int64)
+        reset = np.where(r == 0, 0, r + epoch)
         return status[:n], rlimit[:n], remaining[:n], reset[:n]
+
+    def decide_arrays(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        algo: np.ndarray,
+        gnp: np.ndarray,
+        now: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Array-level entry point (also used by the benchmark harness).
+        Times in/out are int64 unix-ms; conversion happens here."""
+        return self.decide_wait(
+            self.decide_submit(
+                key_hash, hits, limit, duration, algo, gnp, now
+            )
+        )
 
     def update_globals(
         self, updates: Sequence[Tuple[str, RateLimitResp]], now: Optional[int] = None

@@ -27,12 +27,20 @@ the MXU's bf16 pass precision, so each int32 delta is split into four
 8-bit halves (each exact in bf16), matmul'd separately, and recombined
 in int32 (wrap-safe: the shifted sums reassemble delta mod 2^32).
 
-STATUS: bit-exact on v5e but currently ~30% SLOWER than the XLA
-scatter-add it would replace (~330us vs ~250us at B=16k; per-tile DMA
-waits don't pipeline and the one-hot matmuls pad ~64 real updates per
-tile to CHUNK rows). Kept as the opt-in GUBER_WRITEBACK=sweep path: it
-documents the pallas approach, and workloads with much larger batches
-(more updates per tile) shift the balance toward the sweep.
+STATUS (r2, measured with a hard scalar-fetch barrier — see
+scripts/bench_hbm.py): bit-exact on v5e, cross-tile DMA prefetch added
+(tile t-1 prefetches tile t's first update chunk, so per-tile DMA issue
+latency is hidden), TILE_ROWS/CHUNK parameterized. Still ~15% slower
+than the XLA scatter at B=16k — and the measurements show WHY, which is
+the durable lesson: on this chip XLA's own elementwise pass over the
+16 MiB store runs at only ~180 GB/s effective, a bare pallas identity
+sweep costs ~260us, and the scatter's 351us is therefore ~2.6x off the
+*achievable* floor, not the ~15x the HBM spec sheet suggested. Any
+full-store sweep pays >=260us of streaming before doing work, so at
+production load factors (touched rows ~ half the store) the scatter's
+touched-rows-only traffic wins structurally. The sweep only pays off
+when updates are dense relative to the store (B approaching the bucket
+count); it stays the opt-in GUBER_WRITEBACK=sweep path.
 
 Because the update stream is bucket-sorted, rows DMA'd beyond the tile's
 [lo, hi) range map outside [0, TILE_ROWS) and one-hot to zero — the
@@ -44,6 +52,7 @@ mask.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -51,8 +60,27 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-TILE_ROWS = 128  # bucket rows per grid step (== lane width: see docstring)
-CHUNK = 128  # update rows per DMA/matmul chunk
+# Tile/chunk geometry (trace-time constants; env knobs for benchmarking).
+# TILE_ROWS: bucket rows per grid step, a multiple of 128 — each 128-row
+# block gets its own one-hot matmul (the lane-width trick, see docstring).
+# CHUNK: update rows per DMA window.
+TILE_ROWS = int(os.environ.get("GUBER_SWEEP_TILE", "128"))
+CHUNK = int(os.environ.get("GUBER_SWEEP_CHUNK", "128"))
+
+
+def _first_chunk_dma(bounds_ref, comb_ref, comb_s, sem, t, slot):
+    """Async copy of tile t's FIRST update chunk into ping-pong slot
+    `slot`. Issued one grid step EARLY (tile t-1 prefetches for tile t)
+    so the wait at tile t is satisfied long before it's reached — per-tile
+    DMA issue latency was the dominant cost of the serialized version."""
+    B = comb_ref.shape[0]
+    lo_al8 = bounds_ref[t] // 8
+    start8 = jnp.minimum(lo_al8, (B - CHUNK) // 8)
+    return pltpu.make_async_copy(
+        comb_ref.at[pl.ds(start8 * 8, CHUNK), :],
+        comb_s.at[slot],
+        sem.at[slot],
+    )
 
 
 def _kernel(
@@ -60,14 +88,33 @@ def _kernel(
     data_ref,  # VMEM int32[TILE_ROWS, 128] current tile (aliased out)
     comb_ref,  # ANY int32[B, 256]: delta lanes 0-127, bucket id 128-255
     out_ref,  # VMEM int32[TILE_ROWS, 128]
-    comb_s,  # VMEM scratch int32[CHUNK, 256]
-    sem,  # DMA semaphore
+    comb_s,  # VMEM scratch int32[3, CHUNK, 256]: slots 0/1 ping-pong
+    # prefetched first chunks across tiles; slot 2 serves the rare
+    # second-and-later chunks of a dense tile
+    sem,  # DMA semaphores (3,)
 ):
     t = pl.program_id(0)
+    nt = pl.num_programs(0)
     B = comb_ref.shape[0]
     lo = bounds_ref[t]
     hi = bounds_ref[t + 1]
     tile_base = t * TILE_ROWS
+    slot = lax.rem(t, 2)
+    nonempty = hi > lo
+
+    # t=0 has no predecessor to prefetch for it; issue inline
+    @pl.when((t == 0) & nonempty)
+    def _():
+        _first_chunk_dma(bounds_ref, comb_ref, comb_s, sem, t, slot).start()
+
+    # prefetch the NEXT tile's first chunk into the other slot while this
+    # tile computes (skip empty tiles — both sites test the same bounds,
+    # so every started DMA is waited exactly once)
+    @pl.when((t + 1 < nt) & (bounds_ref[t + 1] < bounds_ref[t + 2]))
+    def _():
+        _first_chunk_dma(
+            bounds_ref, comb_ref, comb_s, sem, t + 1, 1 - slot
+        ).start()
 
     acc0 = data_ref[:]
 
@@ -77,24 +124,14 @@ def _kernel(
     # tile, whose buckets one-hot to zero here — the sort masks it free.
     lo_al8 = lo // 8
 
-    def chunk_body(c, acc):
-        want8 = lo_al8 + c * (CHUNK // 8)
-        start8 = jnp.minimum(want8, (B - CHUNK) // 8)  # end clamp
-        start = start8 * 8
-        cp = pltpu.make_async_copy(
-            comb_ref.at[pl.ds(start, CHUNK), :], comb_s, sem
-        )
-        cp.start()
-        cp.wait()
-
-        d = comb_s[:, :128]
-        rel = comb_s[:, 128:] - tile_base  # [CHUNK, 128], lanes identical
+    def process(chunk, want8, start, acc):
+        d = chunk[:, :128]
+        buck = chunk[:, 128:]  # [CHUNK, 128], lanes identical (bucket id)
         gidx = start + lax.broadcasted_iota(jnp.int32, (CHUNK, 128), 0)
         # rows before this chunk's intended window were handled by the
         # previous chunk (re-read only happens under the end clamp)
         fresh = gidx >= want8 * 8
         row_ids = lax.broadcasted_iota(jnp.int32, (CHUNK, 128), 1)
-        onehot = ((rel == row_ids) & fresh).astype(jnp.float32)
 
         contract = (((0,), (0,)), ((), ()))  # sum over the CHUNK dim
         # int32 deltas split into four 8-bit halves: each is exactly
@@ -102,23 +139,55 @@ def _kernel(
         # single-pass bf16 matmul is exact — measured faster than two
         # 16-bit halves at 3-pass HIGHEST precision
         parts = (
-            (d & 0xFF, 0),
-            ((d >> 8) & 0xFF, 8),
-            ((d >> 16) & 0xFF, 16),
-            (d >> 24, 24),
+            (d & 0xFF).astype(jnp.float32),
+            ((d >> 8) & 0xFF).astype(jnp.float32),
+            ((d >> 16) & 0xFF).astype(jnp.float32),
+            (d >> 24).astype(jnp.float32),
         )
-        for p, shift in parts:
-            r = lax.dot_general(
-                onehot,
-                p.astype(jnp.float32),
-                contract,
-                preferred_element_type=jnp.float32,
-            ).astype(jnp.int32)
-            acc = acc + (r << shift)
-        return acc
+        # one [CHUNK, 128] one-hot + 4 matmuls per 128-row block of the
+        # tile; blocks assemble with a concat (a .at[].add would lower to
+        # an unsupported in-kernel scatter)
+        adds = []
+        for blk in range(TILE_ROWS // 128):
+            rel = buck - (tile_base + blk * 128)
+            onehot = ((rel == row_ids) & fresh).astype(jnp.float32)
+            add = None
+            for shift, p in enumerate(parts):
+                r = lax.dot_general(
+                    onehot,
+                    p,
+                    contract,
+                    preferred_element_type=jnp.float32,
+                ).astype(jnp.int32)
+                r = r << (8 * shift)
+                add = r if add is None else add + r
+            adds.append(add)
+        total = adds[0] if len(adds) == 1 else jnp.concatenate(adds, axis=0)
+        return acc + total
 
-    nchunks = (hi - lo_al8 * 8 + CHUNK - 1) // CHUNK
-    out_ref[:] = lax.fori_loop(0, nchunks, chunk_body, acc0)
+    def chunk_body(c, acc):
+        # rare path (a tile holding >CHUNK update rows): blocking DMA
+        # through the dedicated slot 2 so the cross-tile ping-pong slots
+        # stay untouched
+        want8 = lo_al8 + c * (CHUNK // 8)
+        start8 = jnp.minimum(want8, (B - CHUNK) // 8)  # end clamp
+        cp = pltpu.make_async_copy(
+            comb_ref.at[pl.ds(start8 * 8, CHUNK), :],
+            comb_s.at[2],
+            sem.at[2],
+        )
+        cp.start()
+        cp.wait()
+        return process(comb_s[2], want8, start8 * 8, acc)
+
+    def with_updates():
+        _first_chunk_dma(bounds_ref, comb_ref, comb_s, sem, t, slot).wait()
+        start8_0 = jnp.minimum(lo_al8, (B - CHUNK) // 8)
+        acc = process(comb_s[slot], lo_al8, start8_0 * 8, acc0)
+        nchunks = (hi - lo_al8 * 8 + CHUNK - 1) // CHUNK
+        return lax.fori_loop(1, nchunks, chunk_body, acc)
+
+    out_ref[:] = lax.cond(nonempty, with_updates, lambda: acc0)
 
 
 def _apply_inline(
@@ -176,8 +245,8 @@ def _call(data, bounds, comb, ntiles, buckets, interpret=False):
             memory_space=pltpu.VMEM,
         ),
         scratch_shapes=[
-            pltpu.VMEM((CHUNK, 256), jnp.int32),
-            pltpu.SemaphoreType.DMA(()),
+            pltpu.VMEM((3, CHUNK, 256), jnp.int32),
+            pltpu.SemaphoreType.DMA((3,)),
         ],
     )
     kwargs = (

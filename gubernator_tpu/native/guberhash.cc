@@ -228,6 +228,58 @@ void guber_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
   radix_argsort(keys, n, 32 + bucket_bits, order_out);
 }
 
+// Batch marshalling: gather-with-permutation + pad in one C pass. The
+// serving hot path must build the device request arrays (sorted by the
+// presort permutation, clipped to the int32 envelope, padded by
+// repeating the last sorted row) and unpermute the responses for every
+// batch; the numpy version costs ~40ns/element across six fields
+// (~630us/16k batch), this runs in one cache-friendly pass.
+
+void guber_gather_pad_i64_clip(const int64_t* src, const int32_t* order,
+                               int64_t n, int64_t b, int64_t lo, int64_t hi,
+                               int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t v = src[order[i]];
+    v = v < lo ? lo : (v > hi ? hi : v);
+    out[i] = static_cast<int32_t>(v);
+  }
+  const int32_t fill = n ? out[n - 1] : 0;
+  for (int64_t i = n; i < b; ++i) out[i] = fill;
+}
+
+void guber_gather_pad_i32(const int32_t* src, const int32_t* order,
+                          int64_t n, int64_t b, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[order[i]];
+  const int32_t fill = n ? out[n - 1] : 0;
+  for (int64_t i = n; i < b; ++i) out[i] = fill;
+}
+
+void guber_gather_pad_u64(const uint64_t* src, const int32_t* order,
+                          int64_t n, int64_t b, uint64_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[order[i]];
+  const uint64_t fill = n ? out[n - 1] : 0;
+  for (int64_t i = n; i < b; ++i) out[i] = fill;
+}
+
+void guber_gather_pad_u8(const uint8_t* src, const int32_t* order,
+                         int64_t n, int64_t b, uint8_t* out) {
+  for (int64_t i = 0; i < n; ++i) out[i] = src[order[i]];
+  const uint8_t fill = n ? out[n - 1] : 0;
+  for (int64_t i = n; i < b; ++i) out[i] = fill;
+}
+
+// out[order[i]] = sorted[i] for the first n positions of each of `k`
+// response arrays laid out back to back ([k, b] row-major), writing into
+// k output arrays of length b back to back.
+void guber_unpermute_i32(const int32_t* sorted, const int32_t* order,
+                         int64_t n, int64_t b, int64_t k, int32_t* out) {
+  for (int64_t a = 0; a < k; ++a) {
+    const int32_t* s = sorted + a * b;
+    int32_t* o = out + a * b;
+    for (int64_t i = 0; i < n; ++i) o[order[i]] = s[i];
+  }
+}
+
 // Mesh-sharded presort: argsort by (owner_shard, bucket, fingerprint) and
 // per-shard row counts. owner = splitmix64(kh ^ SHARD_SALT) % n_shards —
 // must stay bit-identical to parallel/sharded.py owner_of / owner_of_np.

@@ -39,6 +39,91 @@ except AttributeError:
     _HAS_PRESORT = False
 
 try:
+    _i32p = ctypes.POINTER(ctypes.c_int32)
+    _lib.guber_gather_pad_i64_clip.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), _i32p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, _i32p,
+    ]
+    _lib.guber_gather_pad_i32.argtypes = [
+        _i32p, _i32p, ctypes.c_int64, ctypes.c_int64, _i32p,
+    ]
+    _lib.guber_gather_pad_u64.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), _i32p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint64),
+    ]
+    _lib.guber_gather_pad_u8.argtypes = [
+        ctypes.POINTER(ctypes.c_uint8), _i32p, ctypes.c_int64,
+        ctypes.c_int64, ctypes.POINTER(ctypes.c_uint8),
+    ]
+    _lib.guber_unpermute_i32.argtypes = [
+        _i32p, _i32p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        _i32p,
+    ]
+    _HAS_MARSHAL = True
+except AttributeError:
+    _HAS_MARSHAL = False
+
+
+def _ptr(a, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def gather_pad_i64_clip(src, order, b: int, lo: int, hi: int) -> np.ndarray:
+    """int32[b] = clip(src[order], lo, hi) padded with its last value."""
+    src = np.ascontiguousarray(src, np.int64)
+    out = np.empty(b, np.int32)
+    _lib.guber_gather_pad_i64_clip(
+        _ptr(src, ctypes.c_int64), _ptr(order, ctypes.c_int32),
+        src.shape[0], b, lo, hi, _ptr(out, ctypes.c_int32),
+    )
+    return out
+
+
+def gather_pad_i32(src, order, b: int) -> np.ndarray:
+    src = np.ascontiguousarray(src, np.int32)
+    out = np.empty(b, np.int32)
+    _lib.guber_gather_pad_i32(
+        _ptr(src, ctypes.c_int32), _ptr(order, ctypes.c_int32),
+        src.shape[0], b, _ptr(out, ctypes.c_int32),
+    )
+    return out
+
+
+def gather_pad_u64(src, order, b: int) -> np.ndarray:
+    src = np.ascontiguousarray(src, np.uint64)
+    out = np.empty(b, np.uint64)
+    _lib.guber_gather_pad_u64(
+        _ptr(src, ctypes.c_uint64), _ptr(order, ctypes.c_int32),
+        src.shape[0], b, _ptr(out, ctypes.c_uint64),
+    )
+    return out
+
+
+def gather_pad_u8(src, order, b: int) -> np.ndarray:
+    src = np.ascontiguousarray(src, np.uint8)
+    out = np.empty(b, np.uint8)
+    _lib.guber_gather_pad_u8(
+        _ptr(src, ctypes.c_uint8), _ptr(order, ctypes.c_int32),
+        src.shape[0], b, _ptr(out, ctypes.c_uint8),
+    )
+    return out
+
+
+def unpermute_i32(sorted_stack: np.ndarray, order: np.ndarray,
+                  n: int) -> np.ndarray:
+    """[k, b] row-major response stack -> out[:, order[:n]] scatter:
+    out[a, order[i]] = sorted[a, i] for i < n (padding rows untouched)."""
+    sorted_stack = np.ascontiguousarray(sorted_stack, np.int32)
+    k, b = sorted_stack.shape
+    out = np.empty((k, b), np.int32)
+    _lib.guber_unpermute_i32(
+        _ptr(sorted_stack, ctypes.c_int32), _ptr(order, ctypes.c_int32),
+        n, b, k, _ptr(out, ctypes.c_int32),
+    )
+    return out
+
+
+try:
     _lib.guber_presort_sharded.argtypes = [
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int64,

@@ -209,10 +209,11 @@ def pad_request_sharded(
     )
     np.clip(src, 0, max(n - 1, 0), out=src)
     valid = j < counts32[:, None]
+    idx = order[src]  # compose once: caller index per padded cell
 
     def shard_field(x, dtype, sat=None):
         x = sat(x) if sat is not None else np.asarray(x, dtype)
-        return x[order][src]  # [n_shards, B_sub]
+        return x[idx]  # [n_shards, B_sub]
 
     req = BatchRequest(
         key_hash=shard_field(key_hash, np.uint64),

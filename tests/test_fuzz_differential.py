@@ -126,6 +126,32 @@ def test_epoch_rebase_preserves_state():
     assert third.remaining == 9
 
 
+def test_pipelined_submit_across_rebase_keeps_epoch():
+    """A decide_submit in flight while a LATER submit rebases the clock
+    must still convert its reset times against the epoch it was computed
+    under (regression: decide_wait used the live epoch, shifting an
+    in-flight batch's reset_time by the rebase delta of up to ~12 days)."""
+    import numpy as np
+
+    engine = TpuEngine(StoreConfig(rows=16, slots=1 << 8), buckets=(16,))
+    day = 86_400_000
+
+    def arrays(key, now):
+        kh = np.asarray([hash(key) % (2**63) + 1], np.uint64)
+        one = np.ones(1, np.int64)
+        return engine.decide_submit(
+            kh, one, one * 10, one * 10 * day, np.zeros(1, np.int32),
+            np.zeros(1, bool), now,
+        )
+
+    h1 = arrays("a", T0)  # epoch pinned at T0; window resets T0+10d
+    h2 = arrays("b", T0 + 13 * day)  # forces a rebase before h1's wait
+    _, _, _, reset1 = engine.decide_wait(h1)
+    assert int(reset1[0]) == T0 + 10 * day, reset1
+    _, _, _, reset2 = engine.decide_wait(h2)
+    assert int(reset2[0]) == T0 + 23 * day, reset2
+
+
 def test_epoch_far_future_jump_resets():
     """A forward jump no rebase can represent (> int32 range in one step)
     resets the store — the documented state-loss contract — instead of
