@@ -39,7 +39,7 @@ class LocalCluster:
 
     # -- lifecycle ----------------------------------------------------------
 
-    def start(self, timeout: float = 30.0) -> None:
+    def start(self, timeout: float = 90.0) -> None:
         started = threading.Event()
         failure: list = []
 

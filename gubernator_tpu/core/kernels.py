@@ -116,6 +116,42 @@ class BatchRequest(NamedTuple):
     valid: jax.Array  # bool: padding mask
 
 
+class BatchGroups(NamedTuple):
+    """Group (unique-key) structure of a presorted batch.
+
+    Store I/O scales with the number of GROUPS, not requests: duplicate
+    keys in a batch share one state read and one state write, so the
+    bucket-row gather, the eviction-conflict accounting and the
+    writeback scatter all run at [G] instead of [B]. Real traffic is
+    duplicate-heavy (the bench's zipf batch has G/B ~ 0.26), which makes
+    this the single largest device-time lever after the branch-free
+    rewrite itself.
+
+    The host computes groups for free during the radix presort
+    (guberhash.cc emits them from the sorted key stream); callers
+    without host groups get an on-device derivation at G == B
+    (decide_presorted(groups=None)) with the exact historical cost.
+
+    - key_hash uint64[G]: the group leader's key hash (host-gathered:
+      a device-side 64-bit 1-column gather measured ~87us at B=16k,
+      the single most expensive narrow op in the kernel).
+    - leader_pos int32[G]: index in [B] of the group's first row; padded
+      groups carry B (clipped gathers repeat the last real row, keeping
+      every derived stream monotone).
+    - end_pos int32[G]: inclusive index of the group's last row (padding
+      request rows belong to the last real group), non-decreasing.
+    - valid bool[G]: real group (false for padding slots).
+    - group_id int32[B]: each request row's group slot, non-decreasing;
+      padding request rows point at the last real group.
+    """
+
+    key_hash: jax.Array
+    leader_pos: jax.Array
+    end_pos: jax.Array
+    valid: jax.Array
+    group_id: jax.Array
+
+
 class BatchResponse(NamedTuple):
     """Device-side response batch; all arrays are [B]."""
 
@@ -128,6 +164,8 @@ class BatchResponse(NamedTuple):
 class BatchStats(NamedTuple):
     hits: jax.Array  # int32 scalar: groups answered from live state
     misses: jax.Array  # int32 scalar: groups created/recreated
+
+
 
 
 def _shift1(x: jax.Array, fill) -> jax.Array:
@@ -289,7 +327,10 @@ def _writeback_delta_add(
 
 
 def decide_presorted(
-    store: Store, req: BatchRequest, now: jax.Array
+    store: Store,
+    req: BatchRequest,
+    now: jax.Array,
+    groups: BatchGroups | None = None,
 ) -> Tuple[Store, BatchResponse, BatchStats]:
     """Evaluate one PRESORTED padded batch; responses come back in the
     same (sorted) order. `now` is int32 engine-ms. Pure; jit with
@@ -309,6 +350,11 @@ def decide_presorted(
       callers satisfy it; a hypothetical invalid-leader/valid-follower
       group would silently skip its state write (w_mask gates on the
       leader's validity).
+    - `groups` (optional) carries the host-computed group structure
+      padded to a [G] rung; all store I/O (bucket gather, conflict
+      accounting, writeback scatter) then runs at [G] instead of [B] —
+      the duplicate-compaction fast path (see BatchGroups). Without it,
+      an equivalent structure is derived on device at G == B.
 
     Moving the sort (and the response unsort) to the host removes the
     two largest fixed costs from the device program (~30% at B=16k on
@@ -318,65 +364,65 @@ def decide_presorted(
     buckets, _W = store.data.shape
     ways = _W // LANES
     B = req.key_hash.shape[0]
-    ar = jnp.arange(B, dtype=jnp.int32)
     now = now.astype(jnp.int32)
 
     h = req.hits
     lim_q = req.limit
-    dur_q = req.duration
     algo = req.algo
     gnp = req.gnp
     valid = req.valid
+    ar = jnp.arange(B, dtype=jnp.int32)
 
-    # grouping key, computed elementwise from the (already sorted) hashes
-    bkt = bucket_index(req.key_hash, buckets)
-    fp = fingerprints(req.key_hash)
-
-    same_prev = jnp.concatenate(
-        [
-            jnp.array([False]),
-            (bkt[1:] == bkt[:-1]) & (fp[1:] == fp[:-1]),
-        ]
-    )
-    # leaders are KEY-based (first row of each same-key run), regardless
-    # of validity: with interspersed invalid rows (mesh masking) a group's
-    # leader must still exist so group state resolves; invalid groups are
-    # excluded from charging and writes by `valid` downstream.
-    is_leader = ~same_prev
-    leader_pos = lax.cummax(jnp.where(is_leader, ar, 0))
-    end_pos = _segment_ends(is_leader, ar)
-
-    def bool_group_reduce(*quantities):
-        """For small non-negative int quantities (bools/counters whose batch
-        sum fits int32): per-quantity (prefix_before_j, group_total) via one
-        stacked cumsum + two gathers."""
-        m = jnp.stack([q.astype(jnp.int32) for q in quantities], axis=-1)
-        c = jnp.cumsum(m, axis=0)
-        before = c - m  # cumsum strictly before j
-        start_excl = jnp.take(
-            before, leader_pos, axis=0, indices_are_sorted=True
+    if groups is None:
+        # On-device grouping at G == B (compat path): group slot g sits
+        # at the group's leader position; follower positions become
+        # padding slots. Identical cost/semantics to the pre-compaction
+        # kernel.
+        bkt_r = bucket_index(req.key_hash, buckets)
+        fp_r = fingerprints(req.key_hash)
+        same_prev = jnp.concatenate(
+            [
+                jnp.array([False]),
+                (bkt_r[1:] == bkt_r[:-1]) & (fp_r[1:] == fp_r[:-1]),
+            ]
         )
-        prefix = before - start_excl
-        totals = (
-            jnp.take(c, end_pos, axis=0, indices_are_sorted=True)
-            - start_excl
+        # leaders are KEY-based (first row of each same-key run),
+        # regardless of validity: with interspersed invalid rows (mesh
+        # masking) a group's leader must still exist so group state
+        # resolves; invalid groups are excluded from charging and writes
+        # by `valid` downstream.
+        is_leader = ~same_prev
+        group_id = lax.cummax(jnp.where(is_leader, ar, 0))
+        groups = BatchGroups(
+            key_hash=req.key_hash,  # slot g == request g
+            leader_pos=ar,
+            end_pos=_segment_ends(is_leader, ar),
+            valid=is_leader & valid,
+            group_id=group_id,
         )
-        return prefix, totals
+    else:
+        gi = groups.group_id
+        same_prev = jnp.concatenate(
+            [jnp.array([False]), gi[1:] == gi[:-1]]
+        )
+        is_leader = ~same_prev
 
-    # ---- bucket lookup: ONE sorted gather of whole bucket rows ------------
+    G = groups.leader_pos.shape[0]
+    lead_clip = jnp.minimum(groups.leader_pos, B - 1)
+    end_pos_G = groups.end_pos
+
+    # ---- group-level state: gathers and lookup at [G] ---------------------
+    kh_G = groups.key_hash
+    bkt = bucket_index(kh_G, buckets)  # [G] non-decreasing
+    fp = fingerprints(kh_G)
+
+    # bucket lookup: ONE sorted gather of whole bucket rows, one row per
+    # GROUP (duplicate keys share the read)
     cand = jnp.take(
         store.data, bkt, axis=0, indices_are_sorted=True
-    ).reshape(B, ways, LANES)
+    ).reshape(G, ways, LANES)
 
-    # bucket segments (>= 1 key group each; groups sharing a bucket are
-    # adjacent because the order is bucket-major)
-    b_same_prev = jnp.concatenate(
-        [jnp.array([False]), bkt[1:] == bkt[:-1]]
-    )
-    is_b_leader = ~b_same_prev
-    b_end = _segment_ends(is_b_leader, ar)
-
-    match = cand[:, :, L_TAG] == fp[:, None]  # [B, ways]
+    match = cand[:, :, L_TAG] == fp[:, None]  # [G, ways]
     found = match.any(axis=1)
     fway = jnp.argmax(match, axis=1).astype(jnp.int32)  # first matching way
 
@@ -392,48 +438,27 @@ def decide_presorted(
     for w in range(1, ways):
         sel = jnp.where((fway == w)[:, None], cand[:, w], sel)
 
-    exp_f = sel[:, L_EXPIRE]
-    rem_f = sel[:, L_REMAINING]
-    ts_f = sel[:, L_TS]
-    lim_f = sel[:, L_LIMIT]
-    dur_f = sel[:, L_DURATION]
-    flg_f = sel[:, L_FLAGS]
+    g_exp = sel[:, L_EXPIRE]
+    g_rem = sel[:, L_REMAINING]
+    g_ts = sel[:, L_TS]
+    g_limS = sel[:, L_LIMIT]
+    g_durS = sel[:, L_DURATION]
+    g_flg = sel[:, L_FLAGS]
 
-    live = found & (exp_f >= now)  # lazy expiry (reference cache/lru.go:109)
+    g_live = found & (g_exp >= now)  # lazy expiry (reference cache/lru.go:109)
 
-    # ---- group-level state resolution: one stacked leader gather ----------
-    lead_stack = jnp.take(
-        jnp.stack(
-            [
-                live.astype(jnp.int32),
-                exp_f,
-                rem_f,
-                ts_f,
-                lim_f,
-                dur_f,
-                flg_f,
-                algo,
-                h,
-                lim_q,
-                dur_q,
-            ],
-            axis=-1,
-        ),
-        leader_pos,
+    # leader's request fields define the group's semantics (group-leader
+    # rule for mixed duplicates, see module docstring)
+    lead_req = jnp.take(
+        jnp.stack([algo, h, lim_q, req.duration], axis=-1),
+        lead_clip,
         axis=0,
         indices_are_sorted=True,
     )
-    g_live = lead_stack[:, 0] != 0
-    g_exp = lead_stack[:, 1]
-    g_rem = lead_stack[:, 2]
-    g_ts = lead_stack[:, 3]
-    g_limS = lead_stack[:, 4]
-    g_durS = lead_stack[:, 5]
-    g_flg = lead_stack[:, 6]
-    g_algo = lead_stack[:, 7]
-    g_hits = lead_stack[:, 8]
-    g_limQ = lead_stack[:, 9]
-    g_durQ = lead_stack[:, 10]
+    g_algo = lead_req[:, 0]
+    g_hits = lead_req[:, 1]
+    g_limQ = lead_req[:, 2]
+    g_durQ = lead_req[:, 3]
 
     stored_leaky = (g_flg & FLAG_ALGO_LEAKY) != 0
     req_leaky = g_algo == 1
@@ -442,11 +467,6 @@ def decide_presorted(
     mismatch = g_live & (stored_leaky != req_leaky)
     existing = g_live & ~mismatch
     eff_leaky = jnp.where(existing, stored_leaky, ~mismatch & req_leaky)
-
-    # GLOBAL non-owner replica read: answer straight from the live entry,
-    # no mutation (reference gubernator.go:178-187). On a miss the request
-    # is processed as if owned (gubernator.go:189-194).
-    gnp_served = gnp & existing & ~stored_leaky
 
     # leaky guard (documented divergence: reference div-by-zero,
     # algorithms.go:107): existing leaky group with request limit <= 0
@@ -476,25 +496,72 @@ def decide_presorted(
         existing, (g_flg & FLAG_STICKY_OVER) != 0, ~eff_leaky & over_c
     )
 
-    is_creation_leader = is_leader & ~existing
+    # ---- bridge: group values needed per request, one stacked gather ------
+    bridge = jnp.take(
+        jnp.stack(
+            [
+                existing.astype(jnp.int32),
+                eff_leaky.astype(jnp.int32),
+                R0,
+                sticky0.astype(jnp.int32),
+                rate,
+                g_exp,
+                g_rem,
+                g_limS,
+                g_durS,
+                g_limQ,
+                g_durQ,
+                over_c.astype(jnp.int32),
+                leaky_zero.astype(jnp.int32),
+                (existing & ~stored_leaky).astype(jnp.int32),
+                charged_ldr.astype(jnp.int32),
+                g_hits,
+            ],
+            axis=-1,
+        ),
+        groups.group_id,
+        axis=0,
+        indices_are_sorted=True,
+    )
+    existing_r = bridge[:, 0] != 0
+    eff_leaky_r = bridge[:, 1] != 0
+    R0_r = bridge[:, 2]
+    sticky0_r = bridge[:, 3] != 0
+    rate_r = bridge[:, 4]
+    g_exp_r = bridge[:, 5]
+    g_rem_r = bridge[:, 6]
+    g_limS_r = bridge[:, 7]
+    g_durS_r = bridge[:, 8]
+    g_limQ_r = bridge[:, 9]
+    g_durQ_r = bridge[:, 10]
+    over_c_r = bridge[:, 11] != 0
+    leaky_zero_r = bridge[:, 12] != 0
+    tok_replica_r = bridge[:, 13] != 0  # existing & ~stored_leaky
+    charged_ldr_r = bridge[:, 14] != 0
+    g_hits_r = bridge[:, 15]
+
+    # GLOBAL non-owner replica read: answer straight from the live entry,
+    # no mutation (reference gubernator.go:178-187). On a miss the request
+    # is processed as if owned (gubernator.go:189-194).
+    gnp_served = gnp & tok_replica_r
+
+    is_creation_leader = is_leader & ~existing_r
 
     # ---- cumulative-attempt prefix within groups --------------------------
-    viable = valid & ~gnp_served & ~leaky_zero
-    eligible = viable & (h > 0) & (h <= R0)
+    viable = valid & ~gnp_served & ~leaky_zero_r
+    eligible = viable & (h > 0) & (h <= R0_r)
     inc = jnp.where(eligible & ~is_creation_leader, h, 0)
     incl1 = _seg_scan(
         is_leader,
         jnp.stack([inc, (viable & (h != 0)).astype(jnp.int32)], axis=-1),
     )
     prefix1 = jnp.where(same_prev[:, None], _shift1(incl1, 0), 0)
-    totals1 = jnp.take(incl1, end_pos, axis=0, indices_are_sorted=True)
     S = prefix1[:, 0]
-    any_hits = totals1[:, 1] > 0
 
     # admission: S + h <= R0, written subtraction-side to stay in int32
     # (eligible already guarantees h <= R0)
-    charged = eligible & ~is_creation_leader & (S <= R0 - h)
-    charged = charged | (is_creation_leader & charged_ldr)
+    charged = eligible & ~is_creation_leader & (S <= R0_r - h)
+    charged = charged | (is_creation_leader & charged_ldr_r)
     # Attempt-inflated budget: used ONLY for the decr predicate below.
     # For CHARGED positions S == the charged-only prefix (once an
     # equal-or-smaller attempt is refused every later one is too), so
@@ -502,7 +569,7 @@ def decide_presorted(
     # the charged-only prefix instead (rem_vis) or refused duplicates
     # would see phantom consumption (sequential-greedy reports the true
     # leftover to refused requests).
-    rem_b = jnp.maximum(R0 - S, 0)
+    rem_b = jnp.maximum(R0_r - S, 0)
 
     # Real (charged-only) depletion prefix: refused duplicates inflate S but
     # consume nothing, so persistence decisions must not use S.
@@ -516,16 +583,32 @@ def decide_presorted(
         is_leader, jnp.stack([inc_chg, decr.astype(jnp.int32)], axis=-1)
     )
     prefix2 = jnp.where(same_prev[:, None], _shift1(incl2, 0), 0)
-    totals2 = jnp.take(incl2, end_pos, axis=0, indices_are_sorted=True)
     S_chg = prefix2[:, 0]
-    total_charged = totals2[:, 0]
-    any_decr = totals2[:, 1] > 0
-    rem_vis = jnp.maximum(R0 - S_chg, 0)  # true budget visible to j
+    rem_vis = jnp.maximum(R0_r - S_chg, 0)  # true budget visible to j
 
-    z = viable & ~eff_leaky & (R0 - S_chg == 0) & ~is_creation_leader
-    _, totals3 = bool_group_reduce(z)
-    any_z = totals3[:, 0] > 0
-    sticky_live = sticky0 | (same_prev & _shift1(z, False))
+    z = viable & ~eff_leaky_r & (R0_r - S_chg == 0) & ~is_creation_leader
+    c3 = jnp.cumsum(z.astype(jnp.int32))
+    sticky_live = sticky0_r | (same_prev & _shift1(z, False))
+
+    # ONE fused gather at the group end positions pulls every group
+    # total the writeback needs (narrow device gathers carry a large
+    # fixed cost; batching columns is nearly free)
+    ends = jnp.take(
+        jnp.concatenate([incl1, incl2, c3[:, None]], axis=1),
+        end_pos_G,
+        axis=0,
+        indices_are_sorted=True,
+    )  # [G, 5]
+    any_hits = ends[:, 1] > 0  # [G]
+    total_charged = ends[:, 2]  # [G]
+    any_decr = ends[:, 3] > 0  # [G]
+    z_lead = jnp.take(
+        jnp.stack([c3, z.astype(jnp.int32)], axis=-1),
+        lead_clip,
+        axis=0,
+        indices_are_sorted=True,
+    )  # [G, 2]
+    any_z = (ends[:, 4] - (z_lead[:, 0] - z_lead[:, 1])) > 0  # [G]
 
     # ---- responses --------------------------------------------------------
     st_cached = jnp.where(sticky_live, OVER, UNDER)
@@ -539,8 +622,8 @@ def decide_presorted(
     tok_remaining = jnp.where(
         rem_vis == 0, 0, jnp.where(charged, rem_vis - h, rem_vis)
     )
-    g_expire_new = jnp.where(existing, g_exp, now + g_durQ)
-    tok_reset = g_expire_new
+    g_expire_new_r = jnp.where(existing_r, g_exp_r, now + g_durQ_r)
+    tok_reset = g_expire_new_r
 
     # leaky, existing-style position: status is computed fresh each call and
     # reset_time only appears on OVER paths (algorithms.go:123-160)
@@ -549,42 +632,43 @@ def decide_presorted(
     lk_remaining = jnp.where(
         rem_vis == 0, 0, jnp.where(charged, rem_vis - h, rem_vis)
     )
-    lk_reset = jnp.where(lk_over, now + rate, 0)
+    lk_reset = jnp.where(lk_over, now + rate_r, 0)
 
-    g_lim_resp = jnp.where(existing, g_limS, g_limQ)
-    status = jnp.where(eff_leaky, lk_status, tok_status)
-    remaining = jnp.where(eff_leaky, lk_remaining, tok_remaining)
-    reset = jnp.where(eff_leaky, lk_reset, tok_reset)
+    g_lim_resp = jnp.where(existing_r, g_limS_r, g_limQ_r)
+    status = jnp.where(eff_leaky_r, lk_status, tok_status)
+    remaining = jnp.where(eff_leaky_r, lk_remaining, tok_remaining)
+    reset = jnp.where(eff_leaky_r, lk_reset, tok_reset)
 
     # creation leader overrides (the branchy creation responses)
-    cl_status = jnp.where(over_c, OVER, UNDER)
+    cl_status = jnp.where(over_c_r, OVER, UNDER)
     cl_remaining = jnp.where(
-        over_c, jnp.where(eff_leaky, 0, g_limQ), g_limQ - g_hits
+        over_c_r, jnp.where(eff_leaky_r, 0, g_limQ_r), g_limQ_r - g_hits_r
     )
-    cl_reset = jnp.where(eff_leaky, 0, now + g_durQ)
+    cl_reset = jnp.where(eff_leaky_r, 0, now + g_durQ_r)
     status = jnp.where(is_creation_leader, cl_status, status)
     remaining = jnp.where(is_creation_leader, cl_remaining, remaining)
     reset = jnp.where(is_creation_leader, cl_reset, reset)
 
     # GLOBAL replica reads return the stored status verbatim
     status = jnp.where(
-        gnp_served, jnp.where(sticky0, OVER, UNDER), status
+        gnp_served, jnp.where(sticky0_r, OVER, UNDER), status
     )
-    remaining = jnp.where(gnp_served, g_rem, remaining)
-    reset = jnp.where(gnp_served, g_exp, reset)
+    remaining = jnp.where(gnp_served, g_rem_r, remaining)
+    reset = jnp.where(gnp_served, g_exp_r, reset)
 
     # leaky zero-limit guard (documented divergence)
-    status = jnp.where(leaky_zero, OVER, status)
-    remaining = jnp.where(leaky_zero, 0, remaining)
-    reset = jnp.where(leaky_zero, now + g_durS, reset)
-    resp_limit = jnp.where(leaky_zero, lim_q, g_lim_resp)
+    status = jnp.where(leaky_zero_r, OVER, status)
+    remaining = jnp.where(leaky_zero_r, 0, remaining)
+    reset = jnp.where(leaky_zero_r, now + g_durS_r, reset)
+    resp_limit = jnp.where(leaky_zero_r, lim_q, g_lim_resp)
 
-    # ---- state writeback: merged whole-bucket-row scatter -----------------
+    # ---- state writeback at [G]: merged whole-bucket-row scatter ----------
     rem_final = R0 - total_charged
 
     sticky_final = sticky0 | any_z
 
     w_leaky = eff_leaky
+    g_expire_new = jnp.where(existing, g_exp, now + g_durQ)
     new_expire = jnp.where(
         w_leaky,
         jnp.where(
@@ -605,9 +689,8 @@ def decide_presorted(
 
     # Groups served entirely from a replica write back identical values
     # (harmless); invalid (padding / non-owned) and zero-guard groups skip
-    # the write. A group's rows share one validity, so gating on the
-    # leader's validity gates the whole group.
-    w_mask = is_leader & valid & ~leaky_zero
+    # the write.
+    w_mask = groups.valid & ~leaky_zero
 
     new_vals = jnp.stack(
         [
@@ -621,11 +704,19 @@ def decide_presorted(
             jnp.zeros_like(fp),
         ],
         axis=-1,
-    )  # [B, LANES]
+    )  # [G, LANES]
 
-    # Delta-add writeback: each writing group leader adds
-    # (new - old) into its way's lanes; disjoint ways compose exactly and
-    # the store keeps its canonical shape (see _writeback_delta_add).
+    # bucket segments over groups (>= 1 group each; groups sharing a
+    # bucket are adjacent because the order is bucket-major)
+    ar_G = jnp.arange(G, dtype=jnp.int32)
+    is_b_leader = jnp.concatenate(
+        [jnp.array([True]), bkt[1:] != bkt[:-1]]
+    )
+    b_end = _segment_ends(is_b_leader, ar_G)
+
+    # Delta-add writeback: each writing group adds (new - old) into its
+    # way's lanes; disjoint ways compose exactly and the store keeps its
+    # canonical shape (see _writeback_delta_add).
     new_data = _writeback_delta_add(
         store.data,
         bkt,
@@ -644,10 +735,10 @@ def decide_presorted(
     )
     stats = BatchStats(
         hits=jnp.sum(
-            jnp.where(is_leader & valid & g_live, 1, 0)
+            jnp.where(groups.valid & g_live, 1, 0)
         ).astype(jnp.int32),
         misses=jnp.sum(
-            jnp.where(is_leader & valid & ~g_live, 1, 0)
+            jnp.where(groups.valid & ~g_live, 1, 0)
         ).astype(jnp.int32),
     )
     return Store(data=new_data), resp, stats

@@ -280,6 +280,46 @@ void guber_unpermute_i32(const int32_t* sorted, const int32_t* order,
   }
 }
 
+// guber_presort + group structure from the sorted key stream: the runs
+// of equal (bucket, fingerprint) ARE the duplicate-key groups whose
+// store I/O the kernel compacts (core/kernels.py BatchGroups), and they
+// fall out of the sort for one extra O(n) pass. group_id_out[i] = group
+// slot of sorted row i; leader_pos_out[g] = first sorted row of group g
+// (only the first *n_groups_out entries are written).
+void guber_presort_grouped(const uint64_t* key_hash, int64_t n,
+                           uint64_t buckets, int32_t* order_out,
+                           int32_t* group_id_out, int32_t* leader_pos_out,
+                           int64_t* n_groups_out) {
+  const uint64_t bmask = buckets - 1;
+  int bucket_bits = 0;
+  while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
+
+  std::vector<uint64_t> keys(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t kh = key_hash[i];
+    uint64_t bkt = splitmix64(kh ^ BUCKET_SALT) & bmask;
+    uint64_t fp = kh >> 32;
+    if (fp == 0) fp = 1;
+    keys[i] = (bkt << 32) | fp;
+  }
+  std::vector<uint64_t> sorted(keys);  // radix_argsort leaves keys sorted,
+  // but the buffer identity depends on pass parity — copy for clarity
+  radix_argsort(sorted, n, 32 + bucket_bits, order_out);
+
+  int64_t g = 0;
+  uint64_t prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t k = keys[order_out[i]];
+    if (i == 0 || k != prev) {
+      leader_pos_out[g] = static_cast<int32_t>(i);
+      ++g;
+      prev = k;
+    }
+    group_id_out[i] = static_cast<int32_t>(g - 1);
+  }
+  *n_groups_out = g;
+}
+
 // Mesh-sharded presort: argsort by (owner_shard, bucket, fingerprint) and
 // per-shard row counts. owner = splitmix64(kh ^ SHARD_SALT) % n_shards —
 // must stay bit-identical to parallel/sharded.py owner_of / owner_of_np.

@@ -124,6 +124,44 @@ def unpermute_i32(sorted_stack: np.ndarray, order: np.ndarray,
 
 
 try:
+    _lib.guber_presort_grouped.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _HAS_PRESORT_GROUPED = True
+except AttributeError:
+    _HAS_PRESORT_GROUPED = False
+
+
+def presort_grouped(key_hash: np.ndarray, buckets: int):
+    """(order int32[n], group_id int32[n], leader_pos int32[n], G) —
+    the presort permutation plus the duplicate-key group structure of
+    the sorted stream (only leader_pos[:G] is meaningful)."""
+    if not _HAS_PRESORT_GROUPED:
+        raise AttributeError(
+            "libguberhash.so predates guber_presort_grouped; rebuild with "
+            "make -C gubernator_tpu/native"
+        )
+    kh = np.ascontiguousarray(key_hash, np.uint64)
+    n = kh.shape[0]
+    order = np.empty(n, np.int32)
+    group_id = np.empty(n, np.int32)
+    leader_pos = np.empty(n, np.int32)
+    G = ctypes.c_int64(0)
+    _lib.guber_presort_grouped(
+        _ptr(kh, ctypes.c_uint64), n, ctypes.c_uint64(buckets),
+        _ptr(order, ctypes.c_int32), _ptr(group_id, ctypes.c_int32),
+        _ptr(leader_pos, ctypes.c_int32), ctypes.byref(G),
+    )
+    return order, group_id, leader_pos, G.value
+
+
+try:
     _lib.guber_presort_sharded.argtypes = [
         ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int64,
