@@ -55,16 +55,29 @@ def _measure(
     seconds: float,
     workers: int = 1,
 ) -> dict:
-    """Run `call(i)` as fast as possible for `seconds` on N workers."""
+    """Run `call(i)` as fast as possible for `seconds` on N workers,
+    recording per-call latency (p50/p99/p99.9 — BASELINE config 3's
+    target is p99 < 1ms under GLOBAL). Latency is sampled every
+    LAT_SAMPLE-th call into a compact double array so instrumentation
+    can't perturb the ops/s headline or grow unbounded on long runs."""
+    from array import array
+
+    LAT_SAMPLE = 8
     stop = time.monotonic() + seconds
     counts = [0] * workers
     errors = [0] * workers
+    lats = [array("d") for _ in range(workers)]
 
     def run(w: int):
         i = 0
+        append = lats[w].append
         while time.monotonic() < stop:
+            sampled = counts[w] % LAT_SAMPLE == 0
+            t0 = time.monotonic() if sampled else 0.0
             try:
                 call(w * 1_000_000 + i)
+                if sampled:
+                    append(time.monotonic() - t0)
                 counts[w] += 1
             except (grpc.RpcError, OSError):
                 # OSError covers urllib/socket failures on the edge path
@@ -90,9 +103,20 @@ def _measure(
         "ops_per_sec": round(n / elapsed, 1),
         "workers": workers,
     }
+    all_lat = sorted(v for per_w in lats for v in per_w)
+    if all_lat:
+        def pct(p: float) -> float:
+            idx = min(len(all_lat) - 1, int(p * (len(all_lat) - 1)))
+            return round(all_lat[idx] * 1e3, 3)
+
+        res["p50_ms"] = pct(0.50)
+        res["p99_ms"] = pct(0.99)
+        res["p999_ms"] = pct(0.999)
     print(
         f"{name:18s} {res['ops_per_sec']:12,.0f} ops/s   "
-        f"({n} ops, {workers} workers, {elapsed:.1f}s)",
+        f"({n} ops, {workers} workers, {elapsed:.1f}s)  "
+        f"p50={res.get('p50_ms', '-')}ms p99={res.get('p99_ms', '-')}ms "
+        f"p99.9={res.get('p999_ms', '-')}ms",
         file=sys.stderr,
     )
     return res
@@ -174,6 +198,19 @@ def main(argv=None) -> int:
         def batched(i: int):
             v1.GetRateLimits(batch)
 
+        # GLOBAL behavior against node 0 (mixed owners: replica answers
+        # locally, hits gossip async) — BASELINE config 3's latency
+        # scenario; its target is p99 < 1ms
+        def global_req(i: int):
+            r = _req(f"g{i % 1000}")
+            r.behavior = gubernator_pb2.GLOBAL
+            return r
+
+        def global_call(i: int):
+            v1.GetRateLimits(
+                gubernator_pb2.GetRateLimitsReq(requests=[global_req(i)])
+            )
+
         # optional: front node 0 with the native edge (HTTP/JSON in C++,
         # batched frames into the same instance) and measure through it
         edge_proc = None
@@ -251,6 +288,7 @@ def main(argv=None) -> int:
             _measure("get_rate_limit", get_rate_limit, args.seconds)
         )
         results.append(_measure("ping", ping, args.seconds))
+        results.append(_measure("global", global_call, args.seconds))
         results.append(
             _measure("thundering_herd", herd, args.seconds, workers=100)
         )

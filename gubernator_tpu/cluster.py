@@ -51,6 +51,17 @@ class LocalCluster:
                 loop.run_until_complete(self._start_all())
             except Exception as e:
                 failure.append(e)
+                # tear down any partially-started servers and mark the
+                # loop dead so a later stop() cannot schedule onto it
+                # and hang (reference cluster_test.go covers exactly the
+                # bad-address startup-failure path)
+                try:
+                    loop.run_until_complete(self._stop_all())
+                except Exception:
+                    pass
+                loop.close()
+                self._loop = None
+                self.servers = []
                 started.set()
                 return
             started.set()
@@ -88,17 +99,25 @@ class LocalCluster:
             await server.start()
             self.servers.append(server)
 
+    async def _stop_all(self) -> None:
+        for s in self.servers:
+            await s.stop()
+
     def stop(self) -> None:
-        if self._loop is None:
+        loop = self._loop
+        if (
+            loop is None
+            or loop.is_closed()
+            or self._thread is None
+            or not self._thread.is_alive()
+        ):
+            # never started, or start failed (runner already cleaned up)
+            self._loop = None
+            self.servers = []
             return
-
-        async def _stop_all():
-            for s in self.servers:
-                await s.stop()
-
-        fut = asyncio.run_coroutine_threadsafe(_stop_all(), self._loop)
+        fut = asyncio.run_coroutine_threadsafe(self._stop_all(), loop)
         fut.result(timeout=30)
-        self._loop.call_soon_threadsafe(self._loop.stop)
+        loop.call_soon_threadsafe(loop.stop)
         self._thread.join(timeout=10)
         self._loop = None
         self.servers = []

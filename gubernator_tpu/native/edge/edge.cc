@@ -142,12 +142,34 @@ struct JsonCursor {
     if (p < end && *p == '"') {  // gateway-style string int64
       std::string s;
       if (!parse_string(s)) return false;
-      out = strtoll(s.c_str(), nullptr, 10);
+      out = strtoll(s.c_str(), nullptr, 10);  // NUL-bounded copy
       return true;
     }
-    char* q = nullptr;
-    out = strtoll(p, &q, 10);
-    if (q == p) return false;
+    // Bounded manual scan: the buffer is only NUL-terminated at the end
+    // of the whole pipelined stream, so a bare strtoll(p) on a body
+    // whose Content-Length truncates mid-number would silently absorb
+    // digits from the NEXT pipelined request. Saturates like strtoll.
+    const char* q = p;
+    bool neg = false;
+    if (q < end && (*q == '-' || *q == '+')) {
+      neg = (*q == '-');
+      ++q;
+    }
+    if (q >= end || *q < '0' || *q > '9') return false;
+    const uint64_t lim =
+        neg ? (uint64_t)INT64_MAX + 1 : (uint64_t)INT64_MAX;
+    uint64_t v = 0;
+    for (; q < end && *q >= '0' && *q <= '9'; ++q) {
+      if (v <= (lim - (uint64_t)(*q - '0')) / 10) {
+        v = v * 10 + (uint64_t)(*q - '0');
+      } else {
+        v = lim;  // saturate, keep consuming digits
+      }
+    }
+    out = neg ? (v >= (uint64_t)INT64_MAX + 1
+                     ? INT64_MIN
+                     : -(int64_t)v)
+              : (int64_t)v;
     p = q;
     return true;
   }
@@ -499,7 +521,11 @@ class Batcher {
 
 // -------------------------------------------------------------- HTTP layer
 
-void http_reply(int fd, int code, const char* reason,
+// Returns false when the reply could not be fully written (e.g. the
+// client stopped reading and SO_SNDTIMEO expired) — the caller must
+// close the connection rather than let a non-reading client pin the
+// thread or desync the stream.
+bool http_reply(int fd, int code, const char* reason,
                 const std::string& body) {
   char hdr[256];
   int n = snprintf(hdr, sizeof hdr,
@@ -507,19 +533,50 @@ void http_reply(int fd, int code, const char* reason,
                    "Content-Type: application/json\r\n"
                    "Content-Length: %zu\r\n\r\n",
                    code, reason, body.size());
-  (void)!write(fd, hdr, (size_t)n);
-  (void)!write(fd, body.data(), body.size());
+  std::string out;
+  out.reserve((size_t)n + body.size());
+  out.append(hdr, (size_t)n);
+  out.append(body);
+  size_t off = 0;
+  while (off < out.size()) {
+    ssize_t w = write(fd, out.data() + off, out.size() - off);
+    if (w <= 0) return false;
+    off += (size_t)w;
+  }
+  return true;
 }
 
+// Thread-per-connection needs bounds or a slow-loris client pins OS
+// threads forever: every accepted socket gets a receive timeout (read()
+// returns EAGAIN and the connection closes) and the total connection
+// count is capped (excess accepts are answered 503 and closed).
+std::atomic<int> g_conns{0};
+int g_max_conns = 4096;
+int g_recv_timeout_s = 60;
+
+struct ConnGuard {
+  ~ConnGuard() { g_conns.fetch_sub(1, std::memory_order_relaxed); }
+};
+
 void serve_connection(int fd, Batcher* batcher) {
+  ConnGuard guard;
   std::string buf;
   char tmp[16384];
   while (true) {
+    // Per-request wall deadline: SO_RCVTIMEO alone only bounds a single
+    // idle read — a client trickling one byte per interval would renew
+    // it forever. A whole request (headers + body) must complete within
+    // the budget or the connection closes.
+    const auto req_deadline = std::chrono::steady_clock::now() +
+                              std::chrono::seconds(g_recv_timeout_s);
+    auto expired = [&] {
+      return std::chrono::steady_clock::now() > req_deadline;
+    };
     // read until end of headers
     size_t hdr_end;
     while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
       ssize_t r = read(fd, tmp, sizeof tmp);
-      if (r <= 0) {
+      if (r <= 0 || expired()) {
         close(fd);
         return;
       }
@@ -557,50 +614,51 @@ void serve_connection(int fd, Batcher* batcher) {
     size_t body_start = hdr_end + 4;
     while (buf.size() < body_start + content_len) {
       ssize_t r = read(fd, tmp, sizeof tmp);
-      if (r <= 0) { close(fd); return; }
+      if (r <= 0 || expired()) { close(fd); return; }
       buf.append(tmp, (size_t)r);
     }
 
     bool is_post_grl = head.rfind("POST /v1/GetRateLimits", 0) == 0;
     bool is_health = head.rfind("GET /v1/HealthCheck", 0) == 0;
+    bool sent;
     if (is_health) {
-      http_reply(fd, 200, "OK",
-                 batcher->backend_ok()
-                     ? "{\"status\": \"healthy\", \"message\": "
-                       "\"edge\", \"peerCount\": 0}"
-                     : "{\"status\": \"unhealthy\", \"message\": "
-                       "\"backend unreachable\", \"peerCount\": 0}");
+      sent = http_reply(fd, 200, "OK",
+                        batcher->backend_ok()
+                            ? "{\"status\": \"healthy\", \"message\": "
+                              "\"edge\", \"peerCount\": 0}"
+                            : "{\"status\": \"unhealthy\", \"message\": "
+                              "\"backend unreachable\", \"peerCount\": 0}");
     } else if (!is_post_grl) {
-      http_reply(fd, 404, "Not Found", "{\"error\": \"not found\"}");
+      sent = http_reply(fd, 404, "Not Found", "{\"error\": \"not found\"}");
     } else {
       Pending p;
-      bool too_long = false;
       if (!parse_get_rate_limits(buf.data() + body_start, content_len,
                                  p.items)) {
-        http_reply(fd, 400, "Bad Request",
-                   "{\"error\": \"malformed JSON\"}");
+        sent = http_reply(fd, 400, "Bad Request",
+                          "{\"error\": \"malformed JSON\"}");
       } else if ([&] {
                    for (const Item& it : p.items)
                      if (it.name.size() > 65535 || it.key.size() > 65535)
                        return true;
                    return false;
                  }()) {
-        too_long = true;
-        http_reply(fd, 400, "Bad Request",
-                   "{\"error\": \"name/unique_key exceeds 65535 "
-                   "bytes\"}");
+        sent = http_reply(fd, 400, "Bad Request",
+                          "{\"error\": \"name/unique_key exceeds 65535 "
+                          "bytes\"}");
       } else if (p.items.empty()) {
-        http_reply(fd, 200, "OK", "{\"responses\": []}");
-      } else if (too_long) {
-        // already replied
+        sent = http_reply(fd, 200, "OK", "{\"responses\": []}");
       } else if (!batcher->submit(&p)) {
-        http_reply(fd, 503, "Service Unavailable",
-                   "{\"error\": \"backend unavailable\"}");
+        sent = http_reply(fd, 503, "Service Unavailable",
+                          "{\"error\": \"backend unavailable\"}");
       } else {
-        http_reply(fd, 200, "OK",
-                   render_responses(p.decisions.data(),
-                                    p.decisions.size()));
+        sent = http_reply(fd, 200, "OK",
+                          render_responses(p.decisions.data(),
+                                           p.decisions.size()));
       }
+    }
+    if (!sent) {  // client stopped reading (SO_SNDTIMEO expired)
+      close(fd);
+      return;
     }
     buf.erase(0, body_start + content_len);
   }
@@ -624,6 +682,10 @@ int main(int argc, char** argv) {
     else if (a == "--batch-limit") batch_limit = atoi(argv[i + 1]);
     else if (a == "--workers")
       workers = std::max(1, atoi(argv[i + 1]));
+    else if (a == "--max-conns")
+      g_max_conns = std::max(1, atoi(argv[i + 1]));
+    else if (a == "--recv-timeout-s")
+      g_recv_timeout_s = std::max(1, atoi(argv[i + 1]));
   }
 
   Batcher batcher(backend, batch_wait_us, batch_limit, workers);
@@ -646,6 +708,21 @@ int main(int argc, char** argv) {
     int fd = accept(srv, nullptr, nullptr);
     if (fd < 0) continue;
     setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    // receive timeout: a slow-loris / idle keep-alive client gets its
+    // read() failed after --recv-timeout-s and the thread exits
+    timeval tv{};
+    tv.tv_sec = g_recv_timeout_s;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    // send timeout: a client that stops reading its response must fail
+    // the write, not block the thread forever with the conn slot held
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+    if (g_conns.fetch_add(1, std::memory_order_relaxed) >= g_max_conns) {
+      g_conns.fetch_sub(1, std::memory_order_relaxed);
+      http_reply(fd, 503, "Service Unavailable",
+                 "{\"error\": \"connection limit reached\"}");
+      close(fd);
+      continue;
+    }
     std::thread(serve_connection, fd, &batcher).detach();
   }
 }

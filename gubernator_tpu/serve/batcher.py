@@ -15,9 +15,11 @@ giving natural double-buffering: batch N on device while batch N+1 fills.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import List, Optional, Sequence, Tuple
 
 from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
+from gubernator_tpu.serve import metrics
 
 
 class DeviceBatcher:
@@ -32,6 +34,9 @@ class DeviceBatcher:
         self.batch_limit = batch_limit
         self._queue: "asyncio.Queue" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # last backend stats snapshot, for cache_access_count deltas
+        self._last_hits = 0
+        self._last_misses = 0
 
     def start(self) -> None:
         if self._task is None:
@@ -117,6 +122,7 @@ class DeviceBatcher:
             return
         reqs = [r for r, _, _ in decide_items]
         gnp = [g for _, g, _ in decide_items]
+        t0 = time.monotonic()
         try:
             resps = await asyncio.to_thread(self.backend.decide, reqs, gnp)
         except Exception as e:
@@ -124,6 +130,38 @@ class DeviceBatcher:
                 if not fut.done():
                     fut.set_exception(e)
             return
+        # resolve callers FIRST: metrics are best-effort and must never
+        # be able to kill the flusher task (a dead flusher wedges every
+        # future request with no error surfaced)
         for (_, _, fut), resp in zip(decide_items, resps):
             if not fut.done():
                 fut.set_result(resp)
+        try:
+            metrics.DEVICE_BATCH_SIZE.observe(len(reqs))
+            metrics.DEVICE_LAUNCH_MS.observe((time.monotonic() - t0) * 1e3)
+            self._observe_cache_stats()
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+    def _observe_cache_stats(self) -> None:
+        """Forward the backend's monotonic hit/miss counters into
+        cache_access_count{type} (reference cache/lru.go:164-176) as
+        deltas since the last flush. Backends are duck-typed: anything
+        without a dict-shaped stats() is simply not metered."""
+        stats_fn = getattr(self.backend, "stats", None)
+        if stats_fn is None:
+            return
+        s = stats_fn()
+        if not isinstance(s, dict):
+            return
+        hits = int(s.get("hits", s.get("hit", 0)))
+        misses = int(s.get("misses", s.get("miss", 0)))
+        if hits > self._last_hits:
+            metrics.CACHE_ACCESS_COUNT.labels(type="hit").inc(
+                hits - self._last_hits
+            )
+        if misses > self._last_misses:
+            metrics.CACHE_ACCESS_COUNT.labels(type="miss").inc(
+                misses - self._last_misses
+            )
+        self._last_hits, self._last_misses = hits, misses

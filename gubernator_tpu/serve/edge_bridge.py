@@ -38,6 +38,7 @@ from gubernator_tpu.api.types import (
     Algorithm,
     Behavior,
     RateLimitReq,
+    RateLimitResp,
 )
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE
 
@@ -51,26 +52,39 @@ _ITEM_FIX = struct.Struct("<qqqBB")
 _RESP_FIX = struct.Struct("<Bqqq")
 
 
-def decode_request_frame(payload: bytes, n: int) -> List[RateLimitReq]:
-    reqs: List[RateLimitReq] = []
+def decode_request_frame(
+    payload: bytes, n: int
+) -> List[Optional[RateLimitReq]]:
+    """Decode one edge frame. An item whose name/unique_key bytes are not
+    valid UTF-8 decodes to None — the bridge answers it with a per-item
+    error; the edge's minimal JSON parser passes raw bytes through, and
+    one client's garbage must not poison the co-batched requests of
+    OTHER connections by failing the whole frame."""
+    items: List[Optional[RateLimitReq]] = []
     off = 0
     for _ in range(n):
         (name_len,) = struct.unpack_from("<H", payload, off)
         off += 2
-        name = payload[off : off + name_len].decode()
+        raw_name = payload[off : off + name_len]
         off += name_len
         (key_len,) = struct.unpack_from("<H", payload, off)
         off += 2
-        key = payload[off : off + key_len].decode()
+        raw_key = payload[off : off + key_len]
         off += key_len
         hits, limit, duration, algo, behavior = _ITEM_FIX.unpack_from(
             payload, off
         )
         off += _ITEM_FIX.size
+        try:
+            name = raw_name.decode()
+            key = raw_key.decode()
+        except UnicodeDecodeError:
+            items.append(None)
+            continue
         # clamp unknown enum bytes to the default, matching the daemon's
         # JSON gateway (server._enum_val) — one bad client item must not
         # poison the co-batched requests of other connections
-        reqs.append(
+        items.append(
             RateLimitReq(
                 name=name,
                 unique_key=key,
@@ -85,7 +99,7 @@ def decode_request_frame(payload: bytes, n: int) -> List[RateLimitReq]:
         )
     if off != len(payload):
         raise ValueError("trailing bytes in request frame")
-    return reqs
+    return items
 
 
 def encode_response_frame(resps) -> bytes:
@@ -133,17 +147,27 @@ class EdgeBridge:
                     "<I", await reader.readexactly(4)
                 )
                 payload = await reader.readexactly(plen)
-                reqs = decode_request_frame(payload, n)
+                decoded = decode_request_frame(payload, n)
+                good = [r for r in decoded if r is not None]
                 # the edge caps frames at its batch limit, but two large
                 # co-batched requests can still exceed the instance's
                 # MAX_BATCH_SIZE — split instead of erroring the frame
-                resps = []
-                for i in range(0, len(reqs), MAX_BATCH_SIZE):
-                    resps.extend(
+                good_resps = []
+                for i in range(0, len(good), MAX_BATCH_SIZE):
+                    good_resps.extend(
                         await self.instance.get_rate_limits(
-                            reqs[i : i + MAX_BATCH_SIZE]
+                            good[i : i + MAX_BATCH_SIZE]
                         )
                     )
+                it = iter(good_resps)
+                resps = [
+                    next(it)
+                    if r is not None
+                    else RateLimitResp(
+                        error="name or unique_key is not valid UTF-8"
+                    )
+                    for r in decoded
+                ]
                 writer.write(encode_response_frame(resps))
                 await writer.drain()
         except (asyncio.IncompleteReadError, ConnectionResetError):

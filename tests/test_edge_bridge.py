@@ -1,0 +1,112 @@
+"""Edge-bridge frame protocol unit tests (no native binary needed).
+
+The C++ edge passes client bytes through its minimal JSON parser
+verbatim, so the Python bridge is the first place invalid UTF-8 can
+surface; one client's garbage must fail only its own item, never the
+co-batched requests of other connections (ADVICE r1 medium).
+"""
+
+import asyncio
+import struct
+
+from gubernator_tpu.api.types import RateLimitResp, Status
+from gubernator_tpu.serve.edge_bridge import (
+    MAGIC_REQ,
+    MAGIC_RESP,
+    EdgeBridge,
+    decode_request_frame,
+    encode_response_frame,
+)
+
+
+def _item(name: bytes, key: bytes, hits=1, limit=5, duration=1000,
+          algo=0, behavior=0) -> bytes:
+    return (
+        struct.pack("<H", len(name)) + name
+        + struct.pack("<H", len(key)) + key
+        + struct.pack("<qqqBB", hits, limit, duration, algo, behavior)
+    )
+
+
+def _frame(items) -> bytes:
+    payload = b"".join(items)
+    return struct.pack("<II", MAGIC_REQ, len(items)) + struct.pack(
+        "<I", len(payload)
+    ) + payload
+
+
+BAD = b"\xff\xfe\x80"  # not valid UTF-8
+
+
+def test_decode_isolates_invalid_utf8_items():
+    items = [
+        _item(b"api", b"good-1"),
+        _item(b"api", BAD),
+        _item(BAD, b"good-key"),
+        _item(b"api", b"good-2"),
+    ]
+    payload = b"".join(items)
+    decoded = decode_request_frame(payload, 4)
+    assert decoded[0] is not None and decoded[0].unique_key == "good-1"
+    assert decoded[1] is None
+    assert decoded[2] is None
+    assert decoded[3] is not None and decoded[3].unique_key == "good-2"
+
+
+def test_bridge_answers_bad_item_without_failing_frame():
+    """A frame mixing a bad-UTF-8 item with good ones must answer ALL
+    items: per-item error for the bad one, real decisions for the rest."""
+
+    class FakeInstance:
+        async def get_rate_limits(self, reqs):
+            return [
+                RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=r.limit,
+                    remaining=r.limit - r.hits, reset_time=123,
+                )
+                for r in reqs
+            ]
+
+    async def run():
+        path = "/tmp/guber-bridge-utf8-test.sock"
+        bridge = EdgeBridge(FakeInstance(), path)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            writer.write(_frame([
+                _item(b"api", b"ok-1"),
+                _item(b"api", BAD),
+                _item(b"api", b"ok-2"),
+            ]))
+            await writer.drain()
+            magic, n = struct.unpack("<II", await reader.readexactly(8))
+            assert magic == MAGIC_RESP and n == 3
+            out = []
+            for _ in range(n):
+                st, limit, rem, reset = struct.unpack(
+                    "<Bqqq", await reader.readexactly(25)
+                )
+                (elen,) = struct.unpack("<H", await reader.readexactly(2))
+                err = (await reader.readexactly(elen)).decode()
+                out.append((st, limit, rem, reset, err))
+            writer.close()
+            return out
+        finally:
+            await bridge.stop()
+
+    out = asyncio.run(run())
+    assert out[0] == (0, 5, 4, 123, "")
+    assert out[2] == (0, 5, 4, 123, "")
+    assert "UTF-8" in out[1][4] and out[1][1] == 0
+
+
+def test_response_roundtrip():
+    resps = [
+        RateLimitResp(status=Status.OVER_LIMIT, limit=9, remaining=0,
+                      reset_time=42, error="boom"),
+    ]
+    raw = encode_response_frame(resps)
+    magic, n = struct.unpack_from("<II", raw)
+    assert magic == MAGIC_RESP and n == 1
+    st, limit, rem, reset = struct.unpack_from("<Bqqq", raw, 8)
+    assert (st, limit, rem, reset) == (1, 9, 0, 42)

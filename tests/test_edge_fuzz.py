@@ -1,0 +1,324 @@
+"""Malformed-input fuzz for the native edge (VERDICT r1 weak #9).
+
+Drives the real guber-edge binary (hand-rolled HTTP/1.1 + JSON parsing)
+with a corpus of hostile inputs — truncated bodies, numbers cut by
+Content-Length, huge headers, invalid UTF-8, overflow numbers, chunked
+encoding, connection floods, slow-loris — against an in-test bridge
+backend, asserting: no crash/hang, no wrong-but-200, and no frame
+desync (a well-formed request after garbage still gets a correct
+answer on a fresh connection).
+"""
+
+import asyncio
+import json
+import pathlib
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.api.types import RateLimitResp, Status
+from gubernator_tpu.serve.edge_bridge import EdgeBridge
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+
+pytestmark = pytest.mark.skipif(
+    not EDGE_BIN.exists(),
+    reason="edge binary not built (make -C gubernator_tpu/native/edge)",
+)
+
+PORT = 19285
+SOCK = "/tmp/guber-edge-fuzz.sock"
+
+
+class FakeInstance:
+    """Answers every request UNDER_LIMIT with remaining = limit - hits."""
+
+    async def get_rate_limits(self, reqs):
+        return [
+            RateLimitResp(
+                status=Status.UNDER_LIMIT,
+                limit=r.limit,
+                remaining=r.limit - r.hits,
+                reset_time=1700000000000,
+            )
+            for r in reqs
+        ]
+
+
+@pytest.fixture(scope="module")
+def edge():
+    pathlib.Path(SOCK).unlink(missing_ok=True)
+    loop = asyncio.new_event_loop()
+    bridge = EdgeBridge(FakeInstance(), SOCK)
+
+    def run():
+        asyncio.set_event_loop(loop)
+        loop.run_until_complete(bridge.start())
+        loop.run_forever()
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    for _ in range(50):
+        if pathlib.Path(SOCK).exists():
+            break
+        time.sleep(0.05)
+    proc = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(PORT), "--backend", SOCK,
+         "--batch-wait-us", "200", "--max-conns", "64",
+         "--recv-timeout-s", "1"],
+        stdout=sys.stderr, stderr=subprocess.STDOUT,
+    )
+    # wait for the edge to listen
+    for _ in range(100):
+        try:
+            with socket.create_connection(("127.0.0.1", PORT), 0.2):
+                break
+        except OSError:
+            time.sleep(0.05)
+    else:
+        proc.kill()
+        raise RuntimeError("edge did not listen")
+    yield proc
+    proc.terminate()
+    proc.wait(timeout=5)
+
+    async def shutdown():
+        await bridge.stop()
+        loop.stop()
+
+    loop.call_soon_threadsafe(lambda: loop.create_task(shutdown()))
+    t.join(timeout=5)
+
+
+def raw_roundtrip(data: bytes, timeout=5.0, expect_reply=True) -> bytes:
+    with socket.create_connection(("127.0.0.1", PORT), timeout) as s:
+        s.settimeout(timeout)
+        s.sendall(data)
+        buf = b""
+        try:
+            while True:
+                b = s.recv(65536)
+                if not b:
+                    break
+                buf += b
+                hdr_end = buf.find(b"\r\n\r\n")
+                if hdr_end < 0:
+                    continue
+                head = buf[:hdr_end].lower()
+                pos = head.find(b"content-length:")
+                if pos < 0:
+                    break
+                clen = int(head[pos + 15:].split(b"\r\n")[0])
+                if len(buf) >= hdr_end + 4 + clen:
+                    break
+        except socket.timeout:
+            if expect_reply:
+                raise
+        return buf
+
+
+def good_request(key="ok", hits=1, limit=5) -> bytes:
+    body = json.dumps({
+        "requests": [
+            {"name": "fz", "uniqueKey": key, "hits": hits,
+             "limit": limit, "duration": 60000}
+        ]
+    }).encode()
+    return (
+        b"POST /v1/GetRateLimits HTTP/1.1\r\nContent-Length: "
+        + str(len(body)).encode() + b"\r\n\r\n" + body
+    )
+
+
+def assert_edge_alive():
+    """A clean request on a fresh connection still gets a correct 200."""
+    out = raw_roundtrip(good_request())
+    assert b"200 OK" in out and b"UNDER_LIMIT" in out, out
+
+
+def test_clean_request_baseline(edge):
+    assert_edge_alive()
+
+
+def test_malformed_json_bodies(edge):
+    corpus = [
+        b"{",
+        b"}",
+        b"[]",
+        b"{\"requests\": [",
+        b"{\"requests\": [{]}",
+        b"\x00\x01\x02\x03",
+        b"{\"requests\": [{\"name\": \"a\"",
+        b"{\"requests\": [{\"hits\": }]}",
+        b"{\"requests\": [{\"hits\": --3}]}",
+        b"{\"requests\": \"not-a-list\"}",
+        b'{"requests": [{"name": "\\u12"}]}',  # truncated \\u escape
+        b'{"requests": [{"name": "' + b"\xff\xfe" + b'"}]}',
+    ]
+    for body in corpus:
+        req = (
+            b"POST /v1/GetRateLimits HTTP/1.1\r\nContent-Length: "
+            + str(len(body)).encode() + b"\r\n\r\n" + body
+        )
+        out = raw_roundtrip(req)
+        # malformed JSON must 400 (or answer with per-item semantics for
+        # the UTF-8 case) — never crash, never desync
+        assert out.startswith(b"HTTP/1.1"), (body, out)
+        assert b"200 OK" in out or b"400" in out, (body, out)
+    assert_edge_alive()
+
+
+def test_number_truncated_by_content_length_no_bleed(edge):
+    """Content-Length cuts the body mid-number; the digits of a SECOND
+    pipelined request must not be absorbed into the first (old strtoll
+    bug) and the stream must stay frame-consistent."""
+    body1 = b'{"requests": [{"name": "fz", "uniqueKey": "t", "hits": 12'
+    req1 = (
+        b"POST /v1/GetRateLimits HTTP/1.1\r\nContent-Length: "
+        + str(len(body1)).encode() + b"\r\n\r\n" + body1
+    )
+    # pipelined second request, fully well-formed
+    data = req1 + good_request(key="after-truncation")
+    with socket.create_connection(("127.0.0.1", PORT), 5) as s:
+        s.settimeout(5)
+        s.sendall(data)
+        buf = b""
+        deadline = time.monotonic() + 5
+        while buf.count(b"HTTP/1.1") < 2 and time.monotonic() < deadline:
+            try:
+                b = s.recv(65536)
+            except socket.timeout:
+                break
+            if not b:
+                break
+            buf += b
+    # first reply: 400 malformed; second reply: correct 200
+    assert b"400" in buf, buf
+    assert buf.count(b"HTTP/1.1 200") == 1 and b"UNDER_LIMIT" in buf, buf
+
+
+def test_overflow_and_negative_numbers(edge):
+    body = json.dumps({
+        "requests": [
+            {"name": "fz", "uniqueKey": "of1",
+             "hits": 1, "limit": 99999999999999999999999999999,
+             "duration": 60000},
+            {"name": "fz", "uniqueKey": "of2", "hits": -5,
+             "limit": -99999999999999999999999999999,
+             "duration": 60000},
+        ]
+    }).encode()
+    req = (
+        b"POST /v1/GetRateLimits HTTP/1.1\r\nContent-Length: "
+        + str(len(body)).encode() + b"\r\n\r\n" + body
+    )
+    out = raw_roundtrip(req)
+    # saturated int64s flow through; the edge must answer 200 with two
+    # items, not crash or mangle the frame
+    assert b"200 OK" in out, out
+    assert_edge_alive()
+
+
+def test_huge_header_rejected(edge):
+    data = b"POST /v1/GetRateLimits HTTP/1.1\r\nX-Filler: " + b"a" * (17 << 20)
+    with socket.create_connection(("127.0.0.1", PORT), 10) as s:
+        s.settimeout(10)
+        try:
+            s.sendall(data)
+            # server should close without a reply once past the cap
+            b = s.recv(4096)
+            assert b == b"" or b.startswith(b"HTTP/1.1")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # server closed mid-send: the cap worked
+    assert_edge_alive()
+
+
+def test_chunked_encoding_rejected(edge):
+    data = (
+        b"POST /v1/GetRateLimits HTTP/1.1\r\n"
+        b"Transfer-Encoding: chunked\r\n\r\n"
+        b"5\r\nhello\r\n0\r\n\r\n"
+    )
+    out = raw_roundtrip(data)
+    assert b"411" in out, out
+    assert_edge_alive()
+
+
+def test_oversized_body_rejected(edge):
+    req = (
+        b"POST /v1/GetRateLimits HTTP/1.1\r\nContent-Length: "
+        + str(20 << 20).encode() + b"\r\n\r\n"
+    )
+    out = raw_roundtrip(req)
+    assert b"413" in out, out
+    assert_edge_alive()
+
+
+def test_slow_loris_times_out(edge):
+    """A connection that trickles an incomplete header must be closed by
+    the receive timeout (--recv-timeout-s 1), not pin a thread forever."""
+    with socket.create_connection(("127.0.0.1", PORT), 5) as s:
+        s.settimeout(5)
+        s.sendall(b"POST /v1/GetRate")
+        t0 = time.monotonic()
+        b = s.recv(4096)  # server closes -> b'' (or reset)
+        assert b == b"", b
+        assert time.monotonic() - t0 < 4
+    assert_edge_alive()
+
+
+def test_byte_trickle_hits_request_deadline(edge):
+    """Trickling bytes fast enough to renew SO_RCVTIMEO must still be
+    cut off by the per-request wall deadline (a slow-loris variant)."""
+    with socket.create_connection(("127.0.0.1", PORT), 5) as s:
+        s.settimeout(5)
+        t0 = time.monotonic()
+        closed = False
+        for _ in range(12):  # one byte every 0.3s for up to 3.6s
+            try:
+                s.sendall(b"P")
+            except (BrokenPipeError, ConnectionResetError):
+                closed = True
+                break
+            try:
+                s.settimeout(0.3)
+                b = s.recv(64)
+                if b == b"":
+                    closed = True
+                    break
+            except socket.timeout:
+                pass
+        assert closed, "trickling client outlived the request deadline"
+        assert time.monotonic() - t0 < 5
+    assert_edge_alive()
+
+
+def test_connection_cap(edge):
+    """Connections beyond --max-conns are answered 503 and closed."""
+    conns = []
+    got_503 = False
+    try:
+        for _ in range(80):  # cap is 64
+            s = socket.create_connection(("127.0.0.1", PORT), 2)
+            s.settimeout(2)
+            conns.append(s)
+        # the newest connections should have been rejected; probe them
+        for s in reversed(conns):
+            try:
+                b = s.recv(4096)
+            except socket.timeout:
+                continue
+            if b"503" in b:
+                got_503 = True
+                break
+    finally:
+        for s in conns:
+            s.close()
+    assert got_503
+    time.sleep(1.2)  # let rejected/idle conns drain before other tests
+    assert_edge_alive()
