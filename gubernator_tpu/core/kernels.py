@@ -261,13 +261,19 @@ def _writeback_delta_add(
 
     Way-disjointness is guaranteed, not assumed:
     - two found-groups can never share a way (one tag per way);
-    - a miss-group's eviction way is DROPPED (entry simply not persisted
-      this batch) if any found-group in the same bucket matches it, or an
-      earlier miss-group already claimed it. A dropped create costs brief
+    - miss-groups are RANKED within their bucket and the k-th one claims
+      the k-th EMPTY way, so simultaneous fresh keys colliding in one
+      bucket all persist as long as empty ways remain (the r1 design let
+      only the first write and silently dropped the rest — measured ~50%
+      of creations lost in a cold-start storm on dense buckets);
+    - only the rank-0 miss of a bucket with NO empty way may evict (the
+      earliest-expiry way), and not if a found-group writes that way
+      this batch; later-ranked misses drop. A dropped create costs brief
       over-admission for that key — the same contract as reference LRU
-      eviction / restart state loss (architecture.md:5-11) — and is
-      vanishingly rare at sane load factors (needs >=2 fresh keys
-      colliding in one bucket in one batch).
+      eviction / restart state loss (architecture.md:5-11) — and now
+      happens only once a bucket's EMPTY ways are exhausted within the
+      batch (occupied ways + concurrent fresh keys > ways), instead of
+      on any same-batch collision.
     """
     B = bkt.shape[0]
     buckets, W = data.shape
@@ -277,13 +283,15 @@ def _writeback_delta_add(
     way_ids = jnp.arange(ways, dtype=jnp.int32)[None, :]
     miss_w = write_item & ~found
     found_w = write_item & found
-    onehotM = (miss_w[:, None] & (eway[:, None] == way_ids)).astype(jnp.int32)
     onehotF = (found_w[:, None] & (fway[:, None] == way_ids)).astype(
         jnp.int32
     )
 
-    # bucket-segment prefix/total machinery over [B, 2*ways] in ONE cumsum
-    stacked = jnp.concatenate([onehotM, onehotF], axis=1)
+    # bucket-segment prefix/total machinery over [B, 1+ways] in ONE
+    # cumsum: col 0 ranks miss-writers, cols 1.. count found-writers/way
+    stacked = jnp.concatenate(
+        [miss_w.astype(jnp.int32)[:, None], onehotF], axis=1
+    )
     c = jnp.cumsum(stacked, axis=0)
     before = c - stacked
     b_leader_pos = lax.cummax(jnp.where(is_b_leader, ar, 0))
@@ -295,18 +303,28 @@ def _writeback_delta_add(
         jnp.take(c, b_end, axis=0, indices_are_sorted=True) - start_excl
     )
 
-    # conflict tests for miss-writers, selected at eway via one-hot dot
-    ohM_b = onehotM != 0
-    earlier_miss = jnp.sum(
-        jnp.where(ohM_b, prefix[:, :ways], 0), axis=1
+    rank = prefix[:, 0]  # earlier miss-writers in my bucket
+    empty = cand[:, :, L_TAG] == 0  # [*, ways] pre-write, bucket-uniform
+    cumempty = jnp.cumsum(empty.astype(jnp.int32), axis=1)
+    n_empty = cumempty[:, -1]
+    # the (rank)-th empty way (0-indexed) of my bucket
+    pick = empty & (cumempty == (rank + 1)[:, None])
+    has_empty = rank < n_empty
+    eway_sel = jnp.where(
+        has_empty, jnp.argmax(pick, axis=1).astype(jnp.int32), eway
     )
-    found_any = jnp.sum(
-        jnp.where(ohM_b, totals[:, ways:], 0), axis=1
+    # eviction fallback: conflict if any found-group WRITES my victim way
+    f_tot = totals[:, 1:]  # [*, ways] found-writer count per way
+    fconf = (
+        jnp.sum(
+            jnp.where(eway_sel[:, None] == way_ids, f_tot, 0), axis=1
+        )
+        > 0
     )
-    dropped = miss_w & ((earlier_miss > 0) | (found_any > 0))
+    dropped = miss_w & ~has_empty & ((rank > 0) | fconf)
 
     writer = found_w | (miss_w & ~dropped)
-    way = jnp.where(found, fway, eway)
+    way = jnp.where(found, fway, eway_sel)
 
     # old entry lanes at the destination way (vector selects; ways static)
     old8 = cand[:, 0]

@@ -89,6 +89,12 @@ class ServerConfig:
     # discovery
     etcd_endpoints: List[str] = field(default_factory=list)
     etcd_prefix: str = "/gubernator-tpu/peers/"
+    # etcd TLS bundle (reference GUBER_ETCD_TLS_*,
+    # cmd/gubernator/config.go:149-192): paths to PEM files; ca alone
+    # verifies the server, cert+key add mutual TLS
+    etcd_tls_cert: str = ""
+    etcd_tls_key: str = ""
+    etcd_tls_ca: str = ""
     k8s_namespace: str = ""
     k8s_pod_ip: str = ""
     k8s_pod_port: str = ""
@@ -106,6 +112,18 @@ class ServerConfig:
         if self.etcd_endpoints and self.k8s_endpoints_selector:
             raise ValueError(
                 "choose either etcd or kubernetes discovery, not both"
+            )
+        if bool(self.etcd_tls_cert) != bool(self.etcd_tls_key):
+            raise ValueError(
+                "GUBER_ETCD_TLS_CERT and GUBER_ETCD_TLS_KEY must be set "
+                "together"
+            )
+        if self.etcd_tls_cert and not self.etcd_tls_ca:
+            # python-etcd3 requires ca_cert whenever a client cert pair
+            # is used; fail here with a clear message instead of at pool
+            # startup with an opaque library error
+            raise ValueError(
+                "GUBER_ETCD_TLS_CERT/KEY also require GUBER_ETCD_TLS_CA"
             )
         from gubernator_tpu.serve.logging_setup import parse_level
 
@@ -198,6 +216,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         peers=peers,
         etcd_endpoints=etcd,
         etcd_prefix=_get(env, "GUBER_ETCD_PREFIX", "/gubernator-tpu/peers/"),
+        etcd_tls_cert=_get(env, "GUBER_ETCD_TLS_CERT"),
+        etcd_tls_key=_get(env, "GUBER_ETCD_TLS_KEY"),
+        etcd_tls_ca=_get(env, "GUBER_ETCD_TLS_CA"),
         k8s_namespace=_get(env, "GUBER_K8S_NAMESPACE"),
         k8s_pod_ip=_get(env, "GUBER_K8S_POD_IP"),
         k8s_pod_port=_get(env, "GUBER_K8S_POD_PORT"),

@@ -62,20 +62,41 @@ class EtcdPool:
         prefix: str,
         advertise: str,
         on_update: OnUpdate,
+        tls_cert: str = "",
+        tls_key: str = "",
+        tls_ca: str = "",
+        client=None,
     ):
-        try:
-            import etcd3  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "etcd discovery requires the 'etcd3' package, which is not "
-                "available in this image; use GUBER_PEERS (static) or "
-                "kubernetes discovery"
-            ) from e
-        import etcd3
+        """`client` injects a pre-built etcd3-compatible client (tests use
+        fakes; production leaves it None). The TLS bundle mirrors the
+        reference's GUBER_ETCD_TLS_* surface
+        (cmd/gubernator/config.go:149-192): ca alone enables server
+        verification; mutual TLS needs all three (python-etcd3 requires
+        ca_cert with a client cert pair)."""
+        if client is None:
+            try:
+                import etcd3  # noqa: F401
+            except ImportError as e:
+                raise RuntimeError(
+                    "etcd discovery requires the 'etcd3' package, which is "
+                    "not available in this image; use GUBER_PEERS (static) "
+                    "or kubernetes discovery"
+                ) from e
+            import etcd3
 
-        self._etcd3 = etcd3
-        host, _, port = endpoints[0].rpartition(":")
-        self.client = etcd3.client(host=host, port=int(port or 2379))
+            host, _, port = endpoints[0].rpartition(":")
+            kwargs: dict = {}
+            if tls_ca:
+                kwargs["ca_cert"] = tls_ca
+            if tls_cert or tls_key:
+                if not (tls_cert and tls_key):
+                    raise ValueError(
+                        "etcd TLS requires both cert and key (got only one)"
+                    )
+                kwargs["cert_cert"] = tls_cert
+                kwargs["cert_key"] = tls_key
+            client = etcd3.client(host=host, port=int(port or 2379), **kwargs)
+        self.client = client
         self.prefix = prefix
         self.advertise = advertise
         self.on_update = on_update
@@ -141,6 +162,14 @@ class EtcdPool:
         await self.on_update(peers)
 
     async def close(self) -> None:
+        # cancel the blocking watch FIRST or its worker thread outlives
+        # the pool (the iterator blocks between events)
+        cancel = getattr(self, "_cancel_watch", None)
+        if cancel is not None:
+            try:
+                cancel()
+            except Exception:
+                pass
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
@@ -166,20 +195,31 @@ class K8sPool:
         pod_ip: str,
         pod_port: str,
         on_update: OnUpdate,
+        api=None,
+        watch=None,
     ):
-        try:
-            import kubernetes  # noqa: F401
-        except ImportError as e:
-            raise RuntimeError(
-                "kubernetes discovery requires the 'kubernetes' package, "
-                "which is not available in this image; use GUBER_PEERS "
-                "(static) or etcd discovery"
-            ) from e
-        import kubernetes
+        """`api`/`watch` inject pre-built client objects (tests use
+        fakes); production loads the in-cluster config. Inject both or
+        neither — a partial injection would silently rebuild the other
+        from the real cluster."""
+        if (api is None) != (watch is None):
+            raise ValueError("inject both api and watch, or neither")
+        if api is None:
+            try:
+                import kubernetes  # noqa: F401
+            except ImportError as e:
+                raise RuntimeError(
+                    "kubernetes discovery requires the 'kubernetes' "
+                    "package, which is not available in this image; use "
+                    "GUBER_PEERS (static) or etcd discovery"
+                ) from e
+            import kubernetes
 
-        kubernetes.config.load_incluster_config()
-        self.api = kubernetes.client.CoreV1Api()
-        self.watch = kubernetes.watch.Watch()
+            kubernetes.config.load_incluster_config()
+            api = kubernetes.client.CoreV1Api()
+            watch = kubernetes.watch.Watch()
+        self.api = api
+        self.watch = watch
         self.namespace = namespace
         self.selector = selector
         self.pod_ip = pod_ip
@@ -226,6 +266,15 @@ class K8sPool:
         await self.on_update(peers)
 
     async def close(self) -> None:
+        # stop the blocking HTTP watch FIRST or its worker thread stays
+        # in the long-poll and later calls into a dead event loop (same
+        # invariant as EtcdPool.close)
+        stop = getattr(self.watch, "stop", None)
+        if stop is not None:
+            try:
+                stop()
+            except Exception:
+                pass
         if self._task is not None:
             self._task.cancel()
             try:

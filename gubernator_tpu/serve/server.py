@@ -378,6 +378,9 @@ class Server:
                 prefix=self.conf.etcd_prefix,
                 advertise=advertise,
                 on_update=self._on_peers,
+                tls_cert=self.conf.etcd_tls_cert,
+                tls_key=self.conf.etcd_tls_key,
+                tls_ca=self.conf.etcd_tls_ca,
             )
             await self._pool.start()
         elif self.conf.k8s_endpoints_selector:

@@ -1,0 +1,259 @@
+"""Discovery pool tests with fake etcd3/kubernetes clients.
+
+Round 1 shipped serve/discovery.py with zero executed lines (the client
+libraries are absent in this image) — exactly the code that breaks in
+production: lease-loss re-register, blocking-watch-on-worker-thread,
+run_coroutine_threadsafe bridging, k8s stream handling. The pools accept
+injected clients, so everything here runs against fakes (reference
+behaviors: etcd.go:36-316, kubernetes.go:56-157).
+"""
+
+import asyncio
+import sys
+import threading
+import types
+
+import pytest
+
+from gubernator_tpu.serve.discovery import EtcdPool, K8sPool, StaticPool
+
+
+class FakeLease:
+    def __init__(self, pool):
+        self.pool = pool
+        self.refreshes = 0
+
+    def refresh(self):
+        if self.pool.lease_dead:
+            raise RuntimeError("lease expired")
+        self.refreshes += 1
+
+
+class FakeEtcd:
+    """Minimal etcd3-compatible fake: kv store + prefix watch."""
+
+    def __init__(self):
+        self.kv = {}
+        self.lease_dead = False
+        self.leases = []
+        self.registers = 0
+        self._event = threading.Event()
+        self._watch_cancelled = threading.Event()
+
+    # -- client surface used by EtcdPool --------------------------------
+    def lease(self, ttl):
+        self.registers += 1
+        lease = FakeLease(self)
+        self.leases.append(lease)
+        return lease
+
+    def put(self, key, value, lease=None):
+        self.kv[key] = value
+        self._event.set()
+
+    def delete(self, key):
+        self.kv.pop(key, None)
+        self._event.set()
+
+    def get_prefix(self, prefix):
+        return [
+            (v.encode() if isinstance(v, str) else v, k)
+            for k, v in sorted(self.kv.items())
+            if k.startswith(prefix)
+        ]
+
+    def watch_prefix(self, prefix):
+        def events():
+            while not self._watch_cancelled.is_set():
+                if self._event.wait(0.05):
+                    self._event.clear()
+                    yield object()  # event payload is unused
+
+        return events(), self._watch_cancelled.set
+
+    # -- test helpers ----------------------------------------------------
+    def external_put(self, key, value):
+        self.kv[key] = value
+        self._event.set()
+
+
+def run_pool_test(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=20))
+
+
+def test_static_pool_marks_owner():
+    seen = []
+
+    async def on_update(peers):
+        seen.append(peers)
+
+    async def main():
+        pool = StaticPool(["a:1", "b:2"], "b:2", on_update)
+        await pool.start()
+        await pool.close()
+
+    run_pool_test(main())
+    assert len(seen) == 1
+    assert [(p.address, p.is_owner) for p in seen[0]] == [
+        ("a:1", False), ("b:2", True),
+    ]
+
+
+def test_etcd_register_watch_and_close():
+    fake = FakeEtcd()
+    updates = []
+
+    async def on_update(peers):
+        updates.append(sorted((p.address, p.is_owner) for p in peers))
+
+    async def main():
+        pool = EtcdPool(
+            ["etcd:2379"], "/guber/", "me:81", on_update, client=fake
+        )
+        await pool.start()
+        # registration: own key under the prefix, bound to a lease
+        assert fake.kv == {"/guber/me:81": "me:81"}
+        assert fake.registers == 1
+        # a peer joining fires the watch -> full peer snapshot pushed
+        fake.external_put("/guber/peer:82", "peer:82")
+        for _ in range(100):
+            if len(updates) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert updates[-1] == [("me:81", True), ("peer:82", False)]
+        await pool.close()
+        # close deregisters (reference etcd.go: delete on shutdown)
+        assert "/guber/me:81" not in fake.kv
+
+    run_pool_test(main())
+    assert updates[0] == [("me:81", True)]
+
+
+def test_etcd_lease_loss_reregisters():
+    fake = FakeEtcd()
+
+    async def on_update(peers):
+        pass
+
+    async def main():
+        pool = EtcdPool(
+            ["etcd:2379"], "/guber/", "me:81", on_update, client=fake
+        )
+        pool.LEASE_TTL_S = 0.09  # fast keepalive cadence for the test
+        await pool.start()
+        assert fake.registers == 1
+        fake.lease_dead = True  # refresh now raises -> re-register
+        for _ in range(100):
+            if fake.registers >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert fake.registers >= 2, "lease loss did not re-register"
+        await pool.close()
+
+    run_pool_test(main())
+
+
+def test_etcd_tls_kwargs_thread_into_client(monkeypatch, tmp_path):
+    """GUBER_ETCD_TLS_* must reach etcd3.client as its TLS kwargs
+    (reference cmd/gubernator/config.go:149-192 loads the bundle)."""
+    captured = {}
+
+    def fake_client(**kwargs):
+        captured.update(kwargs)
+        return FakeEtcd()
+
+    fake_mod = types.ModuleType("etcd3")
+    fake_mod.client = fake_client
+    monkeypatch.setitem(sys.modules, "etcd3", fake_mod)
+
+    async def on_update(peers):
+        pass
+
+    EtcdPool(
+        ["etcd.internal:2379"], "/guber/", "me:81", on_update,
+        tls_cert="/pki/cert.pem", tls_key="/pki/key.pem",
+        tls_ca="/pki/ca.pem",
+    )
+    assert captured == {
+        "host": "etcd.internal", "port": 2379,
+        "ca_cert": "/pki/ca.pem", "cert_cert": "/pki/cert.pem",
+        "cert_key": "/pki/key.pem",
+    }
+
+    with pytest.raises((ValueError, RuntimeError)):
+        EtcdPool(
+            ["etcd:2379"], "/guber/", "me:81", on_update,
+            tls_cert="/pki/cert.pem",  # key missing
+        )
+
+
+def test_etcd_tls_config_env_parse():
+    from gubernator_tpu.serve.config import config_from_env
+
+    env = {
+        "GUBER_GRPC_ADDRESS": "127.0.0.1:81",
+        "GUBER_ETCD_ENDPOINTS": "etcd:2379",
+        "GUBER_ETCD_TLS_CERT": "/pki/c.pem",
+        "GUBER_ETCD_TLS_KEY": "/pki/k.pem",
+        "GUBER_ETCD_TLS_CA": "/pki/ca.pem",
+    }
+    conf = config_from_env(env)
+    assert conf.etcd_tls_cert == "/pki/c.pem"
+    assert conf.etcd_tls_key == "/pki/k.pem"
+    assert conf.etcd_tls_ca == "/pki/ca.pem"
+
+    env["GUBER_ETCD_TLS_KEY"] = ""
+    with pytest.raises(ValueError):
+        config_from_env(env)
+
+
+class FakeEndpoints:
+    def __init__(self, ips):
+        addr = [types.SimpleNamespace(ip=ip) for ip in ips]
+        self.subsets = [types.SimpleNamespace(addresses=addr)]
+
+
+class FakeK8sWatch:
+    def __init__(self, batches):
+        self.batches = batches
+        self.stopped = threading.Event()
+
+    def stream(self, fn, namespace, label_selector):
+        for ips in self.batches:
+            yield {"object": FakeEndpoints(ips)}
+        # keep the stream open like a real watch, but stoppable so the
+        # test's executor threads can shut down
+        self.stopped.wait(timeout=30)
+
+
+def test_k8s_pool_pushes_endpoints_and_marks_self():
+    updates = []
+
+    async def on_update(peers):
+        updates.append(sorted((p.address, p.is_owner) for p in peers))
+
+    watch = FakeK8sWatch([["10.0.0.1"], ["10.0.0.1", "10.0.0.2"]])
+
+    async def main():
+        pool = K8sPool(
+            namespace="default",
+            selector="app=guber",
+            pod_ip="10.0.0.2",
+            pod_port="81",
+            on_update=on_update,
+            api=types.SimpleNamespace(
+                list_namespaced_endpoints=lambda *a, **k: None
+            ),
+            watch=watch,
+        )
+        await pool.start()
+        for _ in range(100):
+            if len(updates) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        await pool.close()
+        watch.stopped.set()  # release the blocked stream thread
+
+    run_pool_test(main())
+    assert updates[0] == [("10.0.0.1:81", False)]
+    assert updates[1] == [("10.0.0.1:81", False), ("10.0.0.2:81", True)]
