@@ -37,30 +37,55 @@ def log(*a):
 
 
 R = 8  # distinct pre-staged batches cycled through every scenario
+S_DEFAULT = 2048  # steps fused per device call: amortizes the remote
+# tunnel's ~100ms per-call latency to ~50us/batch (see bench.py)
 
 
-def _zipf_batches(
-    key_space, buckets, B, rng=None, gnp=False, algo_mode="mixed", limit=None
-):
-    """(BatchRequest [R,B], sorted zipf ids): presorted zipf traffic —
-    the one key/limit/sort recipe every scenario shares."""
-    import jax.numpy as jnp
-
-    from gubernator_tpu.core.kernels import BatchRequest
-    from gubernator_tpu.core.store import group_sort_key_np
-
+def _zipf_key_hashes(key_space, B, rng=None):
+    """(zipf ids [R,B], key hashes [R,B]) — the one zipf key recipe every
+    scenario shares (bit-identical across scenarios for comparability)."""
     rng = rng or np.random.default_rng(42)
     zipf = rng.zipf(1.2, size=(R, B)) % key_space
     key_hash = (
         (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
         ^ np.uint64(0xDEADBEEFCAFEF00D)
     )
+    return zipf, key_hash
+
+
+def _scenario_steps():
+    """Fused steps per device call: full depth on real chips, a short
+    functional loop on the virtual CPU mesh."""
+    import jax
+
+    return S_DEFAULT if jax.devices()[0].platform == "tpu" else 32
+
+
+def _zipf_batches(
+    key_space, buckets, B, rng=None, gnp=False, algo_mode="mixed", limit=None
+):
+    """(BatchRequest [R,B], BatchGroups [R,...], sorted zipf ids):
+    presorted zipf traffic + duplicate-key group structure — the one
+    key/limit/sort recipe every scenario shares (same helpers serving
+    uses: engine._presort_grouped / build_groups)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gubernator_tpu.core.engine import (
+        _presort_grouped,
+        build_groups,
+        choose_bucket,
+        group_rungs,
+    )
+    from gubernator_tpu.core.kernels import BatchRequest
+
+    rng = rng or np.random.default_rng(42)
+    zipf, key_hash = _zipf_key_hashes(key_space, B, rng)
     limit = np.full((R, B), limit) if limit else rng.integers(
         10, 10_000, (R, B)
     )
-    order = np.argsort(
-        group_sort_key_np(key_hash, buckets), axis=1, kind="stable"
-    )
+    grouped = [_presort_grouped(key_hash[r], buckets) for r in range(R)]
+    order = np.stack([g[0] for g in grouped])
     key_hash = np.take_along_axis(key_hash, order, axis=1)
     zipf_s = np.take_along_axis(zipf, order, axis=1)
     limit = np.take_along_axis(limit, order, axis=1)
@@ -70,6 +95,14 @@ def _zipf_batches(
         algo = np.ones((R, B), np.int32)
     else:
         algo = (zipf_s % 2).astype(np.int32)
+    G = choose_bucket(group_rungs(B), max(g[3] for g in grouped))
+    groups = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack(xs)),
+        *[
+            build_groups(key_hash[r], gid, lp, g_real, B, B, G)
+            for r, (_o, gid, lp, g_real) in enumerate(grouped)
+        ],
+    )
     return BatchRequest(
         key_hash=jnp.asarray(key_hash),
         hits=jnp.ones((R, B), jnp.int32),
@@ -78,26 +111,27 @@ def _zipf_batches(
         algo=jnp.asarray(algo),
         gnp=jnp.full((R, B), gnp, bool),
         valid=jnp.ones((R, B), bool),
-    ), zipf_s
+    ), groups, zipf_s
 
 
-def _time_steps(stepped, store, reqs, B, S, reps=3):
-    """Best-of-reps decisions/s for a jitted S-step loop (warm-up run
-    first; store threads through via donation)."""
-    import jax
-
-    store, acc = stepped(store, reqs)
-    jax.block_until_ready(acc)
+def _time_steps(stepped, store, reqs, groups, B, S, reps=3):
+    """Best-of-reps decisions/s for a jitted S-step loop. The loop's
+    scalar accumulator is FETCHED as the barrier — block_until_ready can
+    return early through the remote-device tunnel (see bench.py)."""
+    store, acc = stepped(store, reqs, groups)
+    int(acc)
     best = float("inf")
     for _ in range(reps):
         t = time.monotonic()
-        store, acc = stepped(store, reqs)
-        jax.block_until_ready(acc)
+        store, acc = stepped(store, reqs, groups)
+        int(acc)  # hard barrier
         best = min(best, time.monotonic() - t)
     return S * B / best
 
 
-def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
+def _measure_kernel(
+    store_cfg, key_space, algo_mode, B=16384, S=None, reps=3
+):
     """Decisions/s for the presorted kernel over `key_space` keys."""
     import jax
     import jax.numpy as jnp
@@ -106,21 +140,25 @@ def _measure_kernel(store_cfg, key_space, algo_mode, B=16384, S=256, reps=3):
     from gubernator_tpu.core.kernels import decide_presorted
     from gubernator_tpu.core.store import new_store
 
+    S = S if S is not None else _scenario_steps()
     store = new_store(store_cfg)
-    reqs, _ = _zipf_batches(key_space, store_cfg.slots, B, algo_mode=algo_mode)
+    reqs, groups, _ = _zipf_batches(
+        key_space, store_cfg.slots, B, algo_mode=algo_mode
+    )
     t0 = jnp.int32(1000)
 
-    def steps(store, reqs):
+    def steps(store, reqs, groups):
         def body(i, carry):
             store, acc = carry
             r = jax.tree.map(lambda x: x[i % R], reqs)
-            store, resp, _ = decide_presorted(store, r, t0 + i)
+            g = jax.tree.map(lambda x: x[i % R], groups)
+            store, resp, _ = decide_presorted(store, r, t0 + i, g)
             return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
 
         return lax.fori_loop(0, S, body, (store, jnp.zeros((), jnp.int32)))
 
     stepped = jax.jit(steps, donate_argnums=(0,))
-    return _time_steps(stepped, store, reqs, B, S, reps)
+    return _time_steps(stepped, store, reqs, groups, B, S, reps)
 
 
 def scenario_token_1k():
@@ -140,23 +178,27 @@ def scenario_leaky_100k():
 
 
 def scenario_global_mesh():
-    """GLOBAL over a key-sharded mesh, fused on-device: every step
-    answers a batch of replica/owner reads against each chip's store
-    shard and combines with one psum; every 8th step runs the gossip
-    collective (owner peek + psum broadcast + replica upsert), i.e. a
-    sync interval of 8 batch windows (reference global.go's async
-    aggregate -> owner -> broadcast loop as collectives)."""
-    import functools
-
+    """GLOBAL over a key-sharded mesh, fused on-device: the host routes
+    each batch's rows to their owner chips (batch-axis sharding — each
+    chip evaluates only its ~B/n rows), every step answers
+    replica/owner reads against the chip's store shard, and every 8th
+    step runs the gossip collective (owner peek + psum broadcast +
+    replica upsert), i.e. a sync interval of 8 batch windows (reference
+    global.go's async aggregate -> owner -> broadcast loop as
+    collectives)."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+    from gubernator_tpu.core.engine import choose_bucket
+    from gubernator_tpu.core.kernels import decide_presorted
     from gubernator_tpu.core.store import StoreConfig, new_store
     from gubernator_tpu.parallel.sharded import (
-        _shard_decide,
         _shard_sync_globals,
+        owner_of_np,
+        pad_request_sharded,
+        sub_batch_ladder,
     )
 
     devs = jax.devices()
@@ -164,20 +206,50 @@ def scenario_global_mesh():
     mesh = Mesh(np.asarray(devs), ("shard",))
     cfg = StoreConfig(rows=16, slots=1 << 13)
 
-    B, KEYS, S = 16384, 100_000, 256
-    # token-only GLOBAL replica-read traffic over the shared zipf recipe
-    # fixed limit=1000 keeps this metric comparable across runs
-    reqs, _ = _zipf_batches(
-        KEYS, cfg.slots, B, gnp=True, algo_mode="token", limit=1000
+    B, KEYS = 16384, 100_000
+    S = _scenario_steps()
+    # token-only GLOBAL replica-read traffic, fixed limit=1000 keeps
+    # this metric comparable across runs
+    _zipf, key_hash = _zipf_key_hashes(KEYS, B)
+    # one shared per-shard rung across the staged batches
+    max_count = max(
+        int(np.bincount(owner_of_np(key_hash[r], n), minlength=n).max())
+        for r in range(R)
     )
-    g_kh = reqs.key_hash[0, :1024]
+    ladder = sub_batch_ladder((64, 256, 1024, 4096, 16384))
+    rung = choose_bucket(ladder, max_count)
+    ones = np.ones(B, np.int64)
+    staged = [
+        pad_request_sharded(
+            (rung,), cfg.slots, n, key_hash[r], ones, ones * 1000,
+            ones * 60_000, np.zeros(B, np.int32), np.ones(B, bool),
+        )[0]
+        for r in range(R)
+    ]
+    # [R, n, B_sub] -> [n, R, B_sub]: shard axis leads for P("shard")
+    reqs = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack(xs).swapaxes(0, 1)), *staged
+    )
+    # gossip keys must honor decide_presorted's (bucket, fp) sort
+    # contract — raw-value np.sort would hand unsorted bucket streams to
+    # indices_are_sorted gathers (silent corruption on TPU)
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    g_pick = key_hash[0, :1024]
+    g_kh = jnp.asarray(
+        g_pick[np.argsort(group_sort_key_np(g_pick, cfg.slots),
+                          kind="stable")]
+    )
     t0 = jnp.int32(1000)
 
-    def body_all(store, reqs):
+    def body_all(store, reqs, g_kh):
         def body(i, carry):
             store, acc = carry
-            r = jax.tree.map(lambda x: x[i % R], reqs)
-            store, resp, _ = _shard_decide(store, r, t0 + i, n_shards=n)
+            r = jax.tree.map(lambda x: x[0, i % R], reqs)
+            st, resp, _ = decide_presorted(
+                jax.tree.map(lambda x: x[0], store), r, t0 + i
+            )
+            store = jax.tree.map(lambda x: x[None], st)
 
             def do_sync(store):
                 store2, _resp = _shard_sync_globals(
@@ -195,14 +267,18 @@ def scenario_global_mesh():
             store = lax.cond(i % 8 == 7, do_sync, lambda s: s, store)
             return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
 
-        return lax.fori_loop(0, S, body, (store, jnp.zeros((), jnp.int32)))
+        store, acc = lax.fori_loop(
+            0, S, body, (store, jnp.zeros((), jnp.int32))
+        )
+        return store, jax.lax.psum(acc, "shard")
 
     stepped = jax.jit(
         jax.shard_map(
             body_all,
             mesh=mesh,
-            in_specs=(P("shard"), P()),
+            in_specs=(P("shard"), P("shard"), P()),
             out_specs=(P("shard"), P()),
+            check_vma=False,  # psum output IS replicated
         ),
         donate_argnums=(0,),
     )
@@ -217,7 +293,7 @@ def scenario_global_mesh():
     )
     return (
         f"global_mesh_{n}dev_psum_gossip",
-        _time_steps(stepped, store, reqs, B, S),
+        _time_steps(stepped, store, reqs, g_kh, B, S),
     )
 
 
