@@ -351,4 +351,53 @@ void guber_presort_sharded(const uint64_t* key_hash, int64_t n,
   radix_argsort(keys, n, 32 + bucket_bits + shard_bits, order_out);
 }
 
+// guber_presort_sharded + per-shard group structure: groups are runs of
+// equal (owner, bucket, fp) composite keys in the sorted stream (shard
+// boundaries break groups automatically — the owner rides the top sort
+// bits). group_id_out[i] = GLOBAL group index of sorted row i;
+// leader_pos_out[g] = first sorted row of global group g;
+// group_counts_out[s] = number of groups owned by shard s.
+void guber_presort_sharded_grouped(
+    const uint64_t* key_hash, int64_t n, uint64_t buckets,
+    uint64_t n_shards, int32_t* order_out, int64_t* counts_out,
+    int32_t* group_id_out, int32_t* leader_pos_out,
+    int64_t* group_counts_out) {
+  const uint64_t bmask = buckets - 1;
+  int bucket_bits = 0;
+  while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
+  int shard_bits = 1;
+  while ((1ULL << shard_bits) < n_shards) ++shard_bits;
+
+  for (uint64_t s = 0; s < n_shards; ++s) {
+    counts_out[s] = 0;
+    group_counts_out[s] = 0;
+  }
+
+  std::vector<uint64_t> keys(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t kh = key_hash[i];
+    uint64_t owner = splitmix64(kh ^ SHARD_SALT) % n_shards;
+    ++counts_out[owner];
+    uint64_t bkt = splitmix64(kh ^ BUCKET_SALT) & bmask;
+    uint64_t fp = kh >> 32;
+    if (fp == 0) fp = 1;
+    keys[i] = (owner << (32 + bucket_bits)) | (bkt << 32) | fp;
+  }
+  std::vector<uint64_t> sorted(keys);
+  radix_argsort(sorted, n, 32 + bucket_bits + shard_bits, order_out);
+
+  int64_t g = 0;
+  uint64_t prev = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t k = keys[order_out[i]];
+    if (i == 0 || k != prev) {
+      leader_pos_out[g] = static_cast<int32_t>(i);
+      ++group_counts_out[k >> (32 + bucket_bits)];
+      ++g;
+      prev = k;
+    }
+    group_id_out[i] = static_cast<int32_t>(g - 1);
+  }
+}
+
 }  // extern "C"

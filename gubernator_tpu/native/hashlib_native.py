@@ -239,6 +239,52 @@ def presort(key_hash: np.ndarray, buckets: int) -> np.ndarray:
     return out
 
 
+try:
+    _lib.guber_presort_sharded_grouped.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int64,
+        ctypes.c_uint64,
+        ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int64),
+    ]
+    _HAS_PRESORT_SHARDED_GROUPED = True
+except AttributeError:
+    _HAS_PRESORT_SHARDED_GROUPED = False
+
+
+def presort_sharded_grouped(key_hash: np.ndarray, buckets: int,
+                            n_shards: int):
+    """(order, counts, group_id, leader_pos, group_counts) — the sharded
+    presort plus per-shard duplicate-key group structure. group_id[i] is
+    the GLOBAL group index of sorted row i; leader_pos[:sum(group_counts)]
+    holds each global group's first sorted row; group_counts[s] counts
+    shard s's groups."""
+    if not _HAS_PRESORT_SHARDED_GROUPED:
+        raise AttributeError(
+            "libguberhash.so predates guber_presort_sharded_grouped; "
+            "rebuild with make -C gubernator_tpu/native"
+        )
+    kh = np.ascontiguousarray(key_hash, np.uint64)
+    n = kh.shape[0]
+    order = np.empty(n, np.int32)
+    counts = np.empty(n_shards, np.int64)
+    group_id = np.empty(n, np.int32)
+    leader_pos = np.empty(n, np.int32)
+    group_counts = np.empty(n_shards, np.int64)
+    _lib.guber_presort_sharded_grouped(
+        _ptr(kh, ctypes.c_uint64), n, ctypes.c_uint64(buckets),
+        ctypes.c_uint64(n_shards), _ptr(order, ctypes.c_int32),
+        _ptr(counts, ctypes.c_int64), _ptr(group_id, ctypes.c_int32),
+        _ptr(leader_pos, ctypes.c_int32),
+        _ptr(group_counts, ctypes.c_int64),
+    )
+    return order, counts, group_id, leader_pos, group_counts
+
+
 def presort_sharded(key_hash: np.ndarray, buckets: int, n_shards: int):
     """(order int32[n], counts int64[n_shards]) — stable argsort by
     (owner_shard, bucket, fingerprint) plus per-shard row counts. The

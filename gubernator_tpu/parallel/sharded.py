@@ -50,6 +50,7 @@ from gubernator_tpu.core.engine import (
     pad_to_bucket,
 )
 from gubernator_tpu.core.kernels import (
+    BatchGroups,
     BatchRequest,
     BatchResponse,
     decide_presorted,
@@ -78,27 +79,30 @@ def owner_of_np(key_hash: np.ndarray, n_shards: int) -> np.ndarray:
     )
 
 
-def _local_decide(store: Store, req: BatchRequest, now):
+def _local_decide(store: Store, req: BatchRequest, groups, now):
     """Per-device body under shard_map: store AND batch are this device's
     shards. The host routed every request row to its owner chip
     (pad_request_sharded), so each chip runs the plain single-device
     kernel on its own sub-batch — no collective on the decide path, the
     mesh analogue of the reference forwarding only owned keys to a peer
-    (reference peers.go:111-207). Responses + stats pack into one int32
-    row per shard (one host transfer total)."""
+    (reference peers.go:111-207) — with its own per-shard duplicate-key
+    group structure (store I/O at unique-key granularity, see
+    kernels.BatchGroups). Responses + stats pack into one int32 row per
+    shard (one host transfer total)."""
     store = jax.tree.map(lambda x: x[0], store)  # [1, r, s] -> [r, s]
     req = jax.tree.map(lambda x: x[0], req)  # [1, B_sub] -> [B_sub]
-    new_store_shard, resp, stats = decide_presorted(store, req, now)
+    groups = jax.tree.map(lambda x: x[0], groups)
+    new_store_shard, resp, stats = decide_presorted(store, req, now, groups)
     packed = pack_outputs(resp, stats)
     return jax.tree.map(lambda x: x[None], new_store_shard), packed[None]
 
 
-def _local_decide_gathered(store: Store, req: BatchRequest, now):
+def _local_decide_gathered(store: Store, req: BatchRequest, groups, now):
     """_local_decide + one all_gather of the packed response rows: when
     the mesh spans processes the serving host cannot fetch follower
     shards directly, so the responses ride the compiled collective path
     (ICI within a host, DCN between hosts) and come out replicated."""
-    store, packed = _local_decide(store, req, now)
+    store, packed = _local_decide(store, req, groups, now)
     return store, jax.lax.all_gather(packed[0], "shard")
 
 
@@ -121,14 +125,46 @@ def _np_presort_sharded(
     return order, counts
 
 
+def _np_presort_sharded_grouped(
+    key_hash: np.ndarray, store_buckets: int, n_shards: int
+):
+    """Numpy fallback for the native sharded+grouped presort."""
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    owner = owner_of_np(key_hash, n_shards)
+    bucket_bits = max(int(store_buckets).bit_length() - 1, 1)
+    comp = (
+        owner.astype(np.uint64) << np.uint64(32 + bucket_bits)
+    ) | group_sort_key_np(key_hash, store_buckets)
+    order = np.argsort(comp, kind="stable").astype(np.int32)
+    counts = np.bincount(owner, minlength=n_shards).astype(np.int64)
+    s = comp[order]
+    n = s.shape[0]
+    is_leader = np.empty(n, bool)
+    if n:
+        is_leader[0] = True
+        np.not_equal(s[1:], s[:-1], out=is_leader[1:])
+    group_id = np.cumsum(is_leader).astype(np.int32) - 1
+    leader_pos = np.flatnonzero(is_leader).astype(np.int32)
+    g_owner = (s[leader_pos] >> np.uint64(32 + bucket_bits)).astype(np.int64)
+    group_counts = np.bincount(g_owner, minlength=n_shards).astype(np.int64)
+    return order, counts, group_id, leader_pos, group_counts
+
+
 try:  # native radix presort with shard partitioning (guberhash.cc)
     from gubernator_tpu.native import hashlib_native as _hn
 
     if not _hn._HAS_PRESORT_SHARDED:
         raise AttributeError("guber_presort_sharded missing")
     _presort_sharded = _hn.presort_sharded
+    _presort_sharded_grouped = (
+        _hn.presort_sharded_grouped
+        if _hn._HAS_PRESORT_SHARDED_GROUPED
+        else _np_presort_sharded_grouped
+    )
 except (ImportError, AttributeError, OSError):  # pragma: no cover
     _presort_sharded = _np_presort_sharded
+    _presort_sharded_grouped = _np_presort_sharded_grouped
 
 
 def sub_batch_ladder(buckets: Sequence[int]) -> tuple:
@@ -159,6 +195,7 @@ def pad_request_sharded(
     duration: np.ndarray,
     algo: np.ndarray,
     gnp: np.ndarray,
+    with_groups: bool = False,
 ):
     """Partition a batch into per-shard sub-batches: the mesh sibling of
     engine.pad_request_sorted. One (owner, bucket, fp) radix sort makes
@@ -167,17 +204,21 @@ def pad_request_sharded(
     count) whose row s is shard s's sub-batch padded by repeating its
     last row with valid=False (preserving the monotonic bucket stream).
 
-    Returns (req, order, take_idx):
+    Returns (req, order, take_idx) — plus `groups` when with_groups:
     - req: BatchRequest of [n_shards, B_sub] arrays, batch-axis shardable
       P("shard") — row s belongs on chip s.
     - order[k]: caller index of the k-th row in global sorted order.
     - take_idx[k]: flattened [n_shards*B_sub] device position of that row.
+    - groups: BatchGroups of [n_shards, ...] arrays (per-shard
+      duplicate-key structure, indices LOCAL to each shard's sub-batch)
+      so each chip's store I/O runs at unique-key granularity.
     Unpermute responses with `out[order] = resp_flat[take_idx]`.
     """
     from gubernator_tpu.core.engine import (
         _sat_duration as sat_dur,
         _sat_i32 as sat_i32,
         choose_bucket,
+        group_rungs,
     )
 
     n = key_hash.shape[0]
@@ -193,8 +234,23 @@ def pad_request_sharded(
             gnp=np.zeros((n_shards, B0), bool),
             valid=np.zeros((n_shards, B0), bool),
         )
-        return req, np.empty(0, np.int32), np.empty(0, np.int64)
-    order, counts = _presort_sharded(key_hash, store_buckets, n_shards)
+        empty = (req, np.empty(0, np.int32), np.empty(0, np.int64))
+        if with_groups:
+            G0 = group_rungs(B0)[0]
+            return (*empty, BatchGroups(
+                key_hash=np.zeros((n_shards, G0), np.uint64),
+                leader_pos=np.full((n_shards, G0), B0, np.int32),
+                end_pos=np.full((n_shards, G0), B0 - 1, np.int32),
+                valid=np.zeros((n_shards, G0), bool),
+                group_id=np.zeros((n_shards, B0), np.int32),
+            ))
+        return empty
+    if with_groups:
+        order, counts, gid_g, lp_g, gcounts = _presort_sharded_grouped(
+            key_hash, store_buckets, n_shards
+        )
+    else:
+        order, counts = _presort_sharded(key_hash, store_buckets, n_shards)
     counts32 = counts.astype(np.int64)
     starts = np.zeros(n_shards + 1, np.int64)
     np.cumsum(counts32, out=starts[1:])
@@ -227,7 +283,41 @@ def pad_request_sharded(
     # global sorted position k lives at device cell (shard_of_k, k-start)
     shard_of_k = np.repeat(np.arange(n_shards, dtype=np.int64), counts32)
     take_idx = shard_of_k * B_sub + (np.arange(n, dtype=np.int64) - starts[shard_of_k])
-    return req, order, take_idx
+    if not with_groups:
+        return req, order, take_idx
+
+    # per-shard group structure with LOCAL indices (each shard's kernel
+    # sees only its own [B_sub] sub-batch); padding conventions come from
+    # the single source of truth, engine.build_groups, called per shard.
+    # Global group ids are contiguous in shard order (shard boundaries
+    # break groups), so shard s's groups are exactly
+    # gstarts[s]..gstarts[s+1] and its first group id IS gstarts[s].
+    from gubernator_tpu.core.engine import build_groups
+
+    gstarts = np.zeros(n_shards + 1, np.int64)
+    np.cumsum(gcounts, out=gstarts[1:])
+    G_sub = choose_bucket(
+        group_rungs(B_sub), max(int(gcounts.max()), 1)
+    )
+    per_shard = []
+    for s in range(n_shards):
+        gc = int(gcounts[s])
+        cs = int(counts32[s])
+        per_shard.append(
+            build_groups(
+                req.key_hash[s],
+                gid_g[starts[s] : starts[s] + cs] - int(gstarts[s]),
+                lp_g[gstarts[s] : gstarts[s] + gc] - int(starts[s]),
+                gc,
+                cs,
+                B_sub,
+                G_sub,
+            )
+        )
+    groups = BatchGroups(
+        *(np.stack(leaves) for leaves in zip(*per_shard))
+    )
+    return req, order, take_idx, groups
 
 
 def _shard_sync_globals(
@@ -339,7 +429,7 @@ class MeshEngine:
             jax.shard_map(
                 _local_decide_gathered if span else _local_decide,
                 mesh=self.mesh,
-                in_specs=(P("shard"), P("shard"), P()),
+                in_specs=(P("shard"), P("shard"), P("shard"), P()),
                 out_specs=(P("shard"), P() if span else P("shard")),
                 # the all_gather output IS replicated, but the static
                 # varying-axis check can't prove it — disable just there
@@ -402,7 +492,7 @@ class MeshEngine:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
-        req, order, take_idx = pad_request_sharded(
+        req, order, take_idx, groups = pad_request_sharded(
             self.sub_buckets,
             self.config.slots,
             self.n,
@@ -412,9 +502,10 @@ class MeshEngine:
             duration,
             algo,
             gnp,
+            with_groups=True,
         )
         B_sub = req.key_hash.shape[1]
-        self.store, packed = self._step(self.store, req, e_now)
+        self.store, packed = self._step(self.store, req, groups, e_now)
         packed = np.asarray(jax.device_get(packed))  # [n_shards, 4*B_sub+2]
         self.stats.hits += int(packed[:, 4 * B_sub].sum())
         self.stats.misses += int(packed[:, 4 * B_sub + 1].sum())

@@ -180,6 +180,8 @@ class MeshBackend:
         # a batch with exactly `r` keys owned by every shard. Driving the
         # public decide_arrays keeps this lockstep-safe for the multi-host
         # engine (followers replay the same call).
+        from gubernator_tpu.core.engine import group_rungs
+
         n = self.engine.n
         rungs = self.engine.sub_buckets
         rng = np.random.default_rng(0xB007)
@@ -189,14 +191,21 @@ class MeshBackend:
         owners = owner_of_np(pool, n)
         per_shard = [pool[owners == s] for s in range(n)]
         for r in rungs:
-            k = np.concatenate([p[:r] for p in per_shard])
-            ones = np.ones(k.shape[0], np.int64)
-            self.engine.decide_arrays(
-                key_hash=k, hits=ones, limit=ones * 10, duration=ones * 1000,
-                algo=np.zeros(k.shape[0], np.int32),
-                gnp=np.zeros(k.shape[0], bool),
-                now=now,
-            )
+            # one XLA program per (sub-batch rung, group rung) pair:
+            # craft per-shard batches whose unique-key count hits each
+            # group rung (g == r is the all-unique case)
+            for g in group_rungs(r):
+                k = np.concatenate(
+                    [np.resize(p[:g], r) for p in per_shard]
+                )
+                ones = np.ones(k.shape[0], np.int64)
+                self.engine.decide_arrays(
+                    key_hash=k, hits=ones, limit=ones * 10,
+                    duration=ones * 1000,
+                    algo=np.zeros(k.shape[0], np.int32),
+                    gnp=np.zeros(k.shape[0], bool),
+                    now=now,
+                )
         # broadcast-receive + gossip collective programs per host rung
         for b in self.engine.buckets:
             k = np.arange(1, b + 1, dtype=np.uint64)

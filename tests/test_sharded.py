@@ -178,6 +178,38 @@ def test_sync_globals_leaky_preserves_owner_state(mesh_engine):
     assert (int(status[0]), int(remaining[0])) == (int(Status.OVER_LIMIT), 0)
 
 
+def test_mesh_grouped_subbatches_match_oracle(mesh_engine):
+    """Duplicate-heavy batch large enough that per-shard sub-batches use
+    a COMPACT group rung (G_sub < B_sub) — the mesh sibling of the
+    engine's unique-key store-I/O compaction — must still match the
+    exact oracle row for row."""
+    rng = random.Random(11)
+    cache = LRUCache()
+    keys = [f"grp:{i}" for i in range(120)]
+    now = T0
+    for step in range(3):
+        now += 20
+        batch_keys = [rng.choice(keys) for _ in range(1600)]
+        reqs = [
+            RateLimitReq(
+                name="mesh-g", unique_key=k, hits=1, limit=40,
+                duration=60_000,
+            )
+            for k in batch_keys
+        ]
+        a = arrays_for(reqs)
+        status, limit, remaining, reset = mesh_engine.decide_arrays(
+            now=now, **a
+        )
+        for i, r in enumerate(reqs):
+            want = get_rate_limit(cache, r, now=now)
+            got = (status[i], limit[i], remaining[i], reset[i])
+            expect = (
+                int(want.status), want.limit, want.remaining, want.reset_time
+            )
+            assert got == expect, f"step={step} i={i} req={r}"
+
+
 def test_mesh_duplicate_keys_one_batch(mesh_engine):
     reqs = [
         RateLimitReq(
