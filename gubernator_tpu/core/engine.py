@@ -235,6 +235,26 @@ def choose_bucket(buckets: Sequence[int], n: int) -> int:
     return buckets[i]
 
 
+def extend_ladder(buckets: Sequence[int], n: int) -> Sequence[int]:
+    """`buckets`, continued past its top rung up to n with the ladder's
+    1.5x-midpoint progression (hi*1.5, hi*2, hi*3, hi*4, ...) when n
+    overflows it. MeshEngine serves batches beyond its configured ladder
+    this way — per-shard sub-batching is exactly what makes an oversized
+    batch affordable — while repeat overflows reuse O(log) compiled
+    shapes instead of one XLA program per distinct size. TpuEngine does
+    NOT use this: its ladder stays a hard cap sized to the serving
+    batcher's device batch limit (buckets_for_limit)."""
+    p = max(buckets)
+    if n <= p:
+        return buckets
+    rungs = sorted(buckets)
+    while p < n:
+        half_up = p * 3 // 2
+        p = half_up if n <= half_up else p * 2
+        rungs.append(p)
+    return tuple(rungs)
+
+
 def pad_to_bucket(buckets: Sequence[int], n: int, *arrs):
     """Pad (array, dtype) pairs to the chosen bucket; returns
     (padded_arrays..., valid_mask)."""

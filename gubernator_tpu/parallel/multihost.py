@@ -104,6 +104,8 @@ class StepPipe:
     def await_acks(self) -> None:
         for s in self.socks:
             m = _recv_msg(s)
+            if m.get("kind") == "nack":
+                raise RuntimeError(f"follower rejected step: {m.get('error')}")
             if m.get("kind") != "ack":
                 raise RuntimeError(f"unexpected follower reply: {m}")
 
@@ -153,6 +155,24 @@ class MultiHostMeshEngine:
         self.pipe = (
             StepPipe(followers) if (self.is_leader and followers) else None
         )
+        if self.pipe:
+            # Config handshake: every process derives batch-padding shapes
+            # independently from its own ladder, and the lockstep shard_map
+            # requires those shapes to be IDENTICAL across processes. A
+            # mismatch used to surface only as a distributed shape
+            # divergence mid-serving (or, before the skew-overflow
+            # fallback, an incidental choose_bucket error during warmup
+            # replay); verify it explicitly at connect time instead.
+            self.pipe.broadcast({"kind": "hello", "config": self._config()})
+            self.pipe.await_acks()
+
+    def _config(self) -> dict:
+        return {
+            "buckets": tuple(self.inner.buckets),
+            "sub_buckets": tuple(self.inner.sub_buckets),
+            "store": (self.inner.config.rows, self.inner.config.slots),
+            "n_shards": self.inner.n,
+        }
 
     @property
     def buckets(self):
@@ -275,7 +295,19 @@ class MultiHostMeshEngine:
             kind = msg.pop("kind")
             if kind == "shutdown":
                 break
-            if kind == "decide":
+            if kind == "hello":
+                want, have = msg["config"], self._config()
+                if want != have:
+                    err = (
+                        "leader/follower config mismatch (batch shapes "
+                        f"would diverge in lockstep): leader={want} "
+                        f"follower={have}"
+                    )
+                    # nack first so the leader's await_acks surfaces the
+                    # diagnostic instead of an opaque closed-pipe error
+                    _send_msg(conn, {"kind": "nack", "error": err})
+                    raise RuntimeError(err)
+            elif kind == "decide":
                 self.inner.decide_arrays(**msg)
             elif kind == "reset":
                 self.inner.reset()

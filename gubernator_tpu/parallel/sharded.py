@@ -46,6 +46,7 @@ from gubernator_tpu.core.engine import (
     EngineStats,
     EpochClock,
     _sat_i32,
+    extend_ladder,
     pad_request_sorted,
     pad_to_bucket,
 )
@@ -254,7 +255,12 @@ def pad_request_sharded(
     counts32 = counts.astype(np.int64)
     starts = np.zeros(n_shards + 1, np.int64)
     np.cumsum(counts32, out=starts[1:])
-    B_sub = choose_bucket(buckets, max(int(counts32.max()), 1))
+    maxc = max(int(counts32.max()), 1)
+    # a shard can draw more rows than the ladder's top rung when the
+    # caller's batch exceeds max(buckets) — unreachable through the
+    # serving tier (the batcher caps batches at the ladder top) but
+    # supported for library callers: extend, don't raise
+    B_sub = choose_bucket(extend_ladder(buckets, maxc), maxc)
 
     # src[s, j]: index into the sorted arrays for padded cell (s, j) —
     # clamped to the shard's last real row (repeat-pad); empty shards
@@ -540,7 +546,7 @@ class MeshEngine:
 
         self._engine_now(millisecond_now() if now is None else now)
         kh, lim, rem, rst, over, valid = pad_to_bucket(
-            self.buckets,
+            extend_ladder(self.buckets, n),
             n,
             (key_hash, np.uint64),
             (_sat_i32(limit), np.int32),
@@ -569,7 +575,7 @@ class MeshEngine:
             algo = np.zeros(n, np.int32)
         e_now = self._engine_now(now)
         req, _order = pad_request_sorted(
-            self.buckets,
+            extend_ladder(self.buckets, n),
             self.config.slots,
             key_hash,
             np.zeros(n, np.int64),

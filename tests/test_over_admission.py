@@ -20,7 +20,6 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.core.cache import LRUCache
 from gubernator_tpu.core.engine import TpuEngine
-from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.oracle import get_rate_limit
 from gubernator_tpu.core.store import StoreConfig
 
@@ -36,7 +35,14 @@ def _over_admission_rate(n_keys: int, capacity_cfg: StoreConfig,
     rng = np.random.default_rng(7)
 
     keys = [f"oa:{i}" for i in range(n_keys)]
-    hashes_all = slot_hash_batch(keys)
+    # Deterministic synthetic slot hashes instead of slot_hash_batch: the
+    # native build hashes with XXH64, the fallback with blake2b, and the
+    # pinned rates below must not depend on which one is loaded (bucket
+    # collision patterns differ per hash function). The engine only needs
+    # keys[i] <-> hashes_all[i] to be a stable injection.
+    hashes_all = np.random.default_rng(11).integers(
+        0, 1 << 63, size=n_keys, dtype=np.uint64
+    ) << np.uint64(1) | np.uint64(1)
 
     over_admit = 0
     total = 0

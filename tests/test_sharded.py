@@ -128,6 +128,51 @@ def test_batch_is_sharded_not_replicated(mesh_engine):
     assert (back == hashes).all()
 
 
+def test_skewed_shard_overflows_ladder_gracefully(mesh_engine):
+    """A batch larger than the ladder's top rung, worst-case skewed
+    (every key below owned by shard 0), must extend the rung progression
+    dynamically and still match the oracle — not raise. The serving tier
+    never sends such a batch (the batcher caps it at the ladder top), but
+    library callers can, and per-shard counts additionally depend on the
+    slot-hash backend (XXH64 native vs blake2b fallback), so the engine
+    cannot treat the ladder as a hard bound."""
+    top = max(mesh_engine.sub_buckets)
+    n = top + 40
+    pool = np.random.default_rng(3).integers(
+        1, 1 << 63, size=16 * n, dtype=np.uint64
+    )
+    mine = pool[owner_of_np(pool, mesh_engine.n) == 0]
+    assert mine.shape[0] >= n
+    kh = mine[:n]
+    cache = LRUCache()
+    status, _, remaining, _ = mesh_engine.decide_arrays(
+        kh,
+        np.ones(n, np.int64),
+        np.full(n, 10, np.int64),
+        np.full(n, 60_000, np.int64),
+        np.zeros(n, np.int32),
+        np.zeros(n, bool),
+        T0,
+    )
+    r = RateLimitReq(name="skew", unique_key="k", hits=1, limit=10,
+                     duration=60_000)
+    want = get_rate_limit(cache, r, now=T0)
+    assert (status == int(want.status)).all()
+    assert (remaining == want.remaining).all()
+    # the paired GLOBAL calls must accept the same oversized batch
+    mesh_engine.sync_globals(
+        kh, np.full(n, 10, np.int64), np.full(n, 60_000, np.int64), now=T0
+    )
+    mesh_engine.update_globals(
+        kh,
+        np.full(n, 10, np.int64),
+        np.full(n, 9, np.int64),
+        np.full(n, T0 + 60_000, np.int64),
+        np.zeros(n, bool),
+        now=T0,
+    )
+
+
 def test_sync_globals_installs_replicas_on_all_shards(mesh_engine):
     reqs = [
         RateLimitReq(
