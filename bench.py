@@ -43,10 +43,9 @@ def main():
 
     B = 32768  # requests per batch (reference hard cap is 1000/RPC; the
     # device batch coalesces many RPCs, serve/batcher.py). Larger batches
-    # amortize the gather/scatter fixed costs (~195us/op): measured
-    # 27.3M @ 16k, 31.5M @ 32k (~1.0ms/batch — the serving latency
-    # envelope), 34.2M @ 64k, 35.5M @ 128k (throughput-only; 3.7ms
-    # batches). 32k keeps the flagship number consistent with the p99
+    # amortize the gather/scatter fixed costs: measured 37.5M @ 32k with
+    # the b/4 group rung (~0.87ms/batch — inside the serving latency
+    # envelope). 32k keeps the flagship number consistent with the p99
     # < 1ms serving story.
     R = 8  # distinct pre-staged batches cycled through
     S = 1024  # decide steps fused into one device program (large S

@@ -160,10 +160,21 @@ def build_groups(
 def group_rungs(b: int) -> tuple:
     """Group-count padding rungs for a request bucket of size b: G <= n
     always, and real traffic is duplicate-heavy (zipf batches measure
-    G/B ~ 0.26, landing in the 3b/8 rung), so one compact rung plus the
-    full-size fallback capture most of the win for a single extra XLA
-    program per request bucket at warmup."""
-    return tuple(sorted({min(b, max(64, (3 * b) // 8)), b}))
+    G/B ~ 0.23-0.26), so compact rungs at b/4 and 3b/8 plus the full-size
+    fallback capture most of the win for two extra XLA programs per
+    request bucket at warmup. The b/4 rung matters at the flagship batch:
+    32k-row zipf batches carry ~7.4k unique keys, and padding their store
+    I/O to 12288 instead of 8192 costs ~12% of the whole kernel
+    (scripts/profile_decide.py)."""
+    return tuple(
+        sorted(
+            {
+                min(b, max(64, b // 4)),
+                min(b, max(64, (3 * b) // 8)),
+                b,
+            }
+        )
+    )
 
 _I32_SAT = COUNTER_MAX
 

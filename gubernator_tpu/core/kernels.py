@@ -32,7 +32,13 @@ Data-movement design (the performance core):
   max so refused oversized hits can never wrap (saturation only engages
   when the true sum already exceeds any representable budget, where
   refusal is the correct answer regardless). Boolean group reductions ride
-  plain int32 cumsums.
+  plain int32 cumsums. Measured dead end (v5e, r2): replacing these
+  scans with global cumsums + leader-row gathers loses 35-100% in every
+  variant tried (int64 cumsum overflows scoped VMEM at B=32k; digit-split
+  int32 cumsums with int32 lexicographic compares, and scatter-add
+  group-ANY flags, are each individually faster in isolation but slower
+  in-kernel) — the associative scan's log-steps fuse with surrounding
+  elementwise work while reduce-window cumsum lowering does not.
 
 Intra-batch duplicate keys
 --------------------------
