@@ -3,7 +3,10 @@
 Run as: python tests/_multihost_runner.py <role> <coordinator> <step_port>
 Role "leader" drives rate-limit traffic over a 2-process global mesh and
 asserts the decisions; role "follower" runs the lockstep loop. Leader
-prints LEADER-OK on success.
+prints LEADER-OK on success. Roles "leader-mismatch"/"follower-mismatch"
+exercise the connect-time config handshake: the follower is constructed
+with a different bucket ladder and both sides must fail loudly with the
+mismatch diagnostic (no hang, no silent shape divergence).
 """
 
 import sys
@@ -23,12 +26,33 @@ def main():
     from gubernator_tpu.core.store import StoreConfig
     import numpy as np
 
-    pid = 0 if role == "leader" else 1
+    pid = 0 if role.startswith("leader") else 1
     initialize_distributed(coordinator, num_processes=2, process_id=pid)
     assert len(jax.devices()) == 2, jax.devices()
 
     cfg = StoreConfig(rows=16, slots=1 << 8)
     T0 = 1_700_000_000_000
+
+    if role == "follower-mismatch":
+        eng = MultiHostMeshEngine(cfg, buckets=(32,))  # leader has (16,)
+        try:
+            eng.follower_loop(f"127.0.0.1:{step_port}")
+        except RuntimeError as e:
+            assert "config mismatch" in str(e), e
+            print("FOLLOWER-MISMATCH-OK", flush=True)
+            return
+        raise SystemExit("follower accepted a mismatched leader config")
+
+    if role == "leader-mismatch":
+        try:
+            MultiHostMeshEngine(
+                cfg, followers=[f"127.0.0.1:{step_port}"], buckets=(16,)
+            )
+        except RuntimeError as e:
+            assert "config mismatch" in str(e), e
+            print("LEADER-MISMATCH-OK", flush=True)
+            return
+        raise SystemExit("leader handshake accepted a mismatched follower")
 
     if role == "follower":
         eng = MultiHostMeshEngine(cfg, buckets=(16,))

@@ -24,10 +24,12 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_mesh():
-    # no pytest-timeout in this image (the mark would be inert); the
-    # subprocess communicate(timeout=...) calls below are the real
-    # watchdog — worst case ~180s, then kill + fail with both logs
+def _run_pair(leader_role: str, follower_role: str, leader_timeout: float):
+    """Spawn a (leader, follower) runner pair and return their outputs.
+
+    No pytest-timeout in this image (the mark would be inert); the
+    communicate(timeout=...) calls are the real watchdog — on expiry both
+    processes are killed and the test fails with both logs."""
     coord = f"127.0.0.1:{_free_port()}"
     step_port = str(_free_port())
     runner = str(ROOT / "tests" / "_multihost_runner.py")
@@ -37,17 +39,17 @@ def test_two_process_mesh():
     env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
 
     follower = subprocess.Popen(
-        [sys.executable, runner, "follower", coord, step_port],
+        [sys.executable, runner, follower_role, coord, step_port],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=ROOT, env=env,
     )
     leader = subprocess.Popen(
-        [sys.executable, runner, "leader", coord, step_port],
+        [sys.executable, runner, leader_role, coord, step_port],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=ROOT, env=env,
     )
     try:
-        l_out, _ = leader.communicate(timeout=150)
+        l_out, _ = leader.communicate(timeout=leader_timeout)
         f_out, _ = follower.communicate(timeout=30)
     except subprocess.TimeoutExpired:
         leader.kill()
@@ -55,10 +57,29 @@ def test_two_process_mesh():
         l_out = leader.communicate()[0]
         f_out = follower.communicate()[0]
         pytest.fail(f"timeout\nleader:\n{l_out}\nfollower:\n{f_out}")
+    return leader.returncode, l_out, follower.returncode, f_out
 
-    assert leader.returncode == 0 and "LEADER-OK" in l_out, (
+
+def test_two_process_mesh():
+    l_rc, l_out, f_rc, f_out = _run_pair("leader", "follower", 150)
+    assert l_rc == 0 and "LEADER-OK" in l_out, (
         f"leader failed:\n{l_out}\nfollower:\n{f_out}"
     )
-    assert follower.returncode == 0 and "FOLLOWER-OK" in f_out, (
+    assert f_rc == 0 and "FOLLOWER-OK" in f_out, (
         f"follower failed:\n{f_out}"
+    )
+
+
+def test_config_mismatch_fails_loudly_at_connect():
+    """A follower constructed with a different bucket ladder must be
+    rejected by the connect-time handshake on BOTH sides with the
+    mismatch diagnostic — not hang or diverge later in lockstep."""
+    l_rc, l_out, f_rc, f_out = _run_pair(
+        "leader-mismatch", "follower-mismatch", 60
+    )
+    assert l_rc == 0 and "LEADER-MISMATCH-OK" in l_out, (
+        f"leader:\n{l_out}\nfollower:\n{f_out}"
+    )
+    assert f_rc == 0 and "FOLLOWER-MISMATCH-OK" in f_out, (
+        f"follower:\n{f_out}"
     )
