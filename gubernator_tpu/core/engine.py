@@ -409,16 +409,21 @@ class TpuEngine:
 
     # -- public API ---------------------------------------------------------
 
-    def get_rate_limits(
+    def get_rate_limits_submit(
         self,
         reqs: Sequence[RateLimitReq],
         now: Optional[int] = None,
         gnp: Optional[Sequence[bool]] = None,
-    ) -> List[RateLimitResp]:
-        """Decide a batch. `gnp[i]` marks GLOBAL non-owner replica reads."""
+    ):
+        """Request-object sibling of decide_submit: convert + presort +
+        dispatch one batch without waiting. Returns an opaque handle for
+        get_rate_limits_wait, or None for an empty batch. Like
+        decide_submit, the store update is effective immediately, so the
+        caller may submit the next batch while the device computes this
+        one (the serving batcher's pipelining)."""
         n = len(reqs)
         if n == 0:
-            return []
+            return None
         if now is None:
             now = millisecond_now()
 
@@ -431,10 +436,16 @@ class TpuEngine:
         gnp_arr = (
             np.asarray(gnp, bool) if gnp is not None else np.zeros(n, bool)
         )
-
-        status, rlimit, remaining, reset = self.decide_arrays(
+        return self.decide_submit(
             hashes, hits, limit, duration, algo, gnp_arr, now
         )
+
+    def get_rate_limits_wait(self, handle) -> List[RateLimitResp]:
+        """Fetch + convert the responses for a get_rate_limits_submit
+        handle."""
+        if handle is None:
+            return []
+        status, rlimit, remaining, reset = self.decide_wait(handle)
         return [
             RateLimitResp(
                 status=Status(int(status[i])),
@@ -442,8 +453,19 @@ class TpuEngine:
                 remaining=int(remaining[i]),
                 reset_time=int(reset[i]),
             )
-            for i in range(n)
+            for i in range(status.shape[0])
         ]
+
+    def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        now: Optional[int] = None,
+        gnp: Optional[Sequence[bool]] = None,
+    ) -> List[RateLimitResp]:
+        """Decide a batch. `gnp[i]` marks GLOBAL non-owner replica reads."""
+        return self.get_rate_limits_wait(
+            self.get_rate_limits_submit(reqs, now=now, gnp=gnp)
+        )
 
     def _engine_now(self, now: int) -> np.int32:
         e, delta, reset_required = self.clock.advance(now)

@@ -12,9 +12,14 @@ implement it:
   jax.distributed processes (parallel/multihost.py); only the leader
   process serves, followers run the lockstep step loop.
 
-All three are driven from the single serving event loop / batcher task, so
-none of them need internal locking (the reference instead serializes on a
-cache mutex, gubernator.go:237).
+All backends are driven from the single serving event loop / batcher task.
+Concurrency contract with the pipelined batcher: decide_submit calls are
+strictly serialized, decide_wait calls are strictly serialized, but one
+decide_wait (in a fetch worker thread) may overlap the NEXT
+decide_submit/update_globals — safe because a wait touches only its
+handle and the engine's stats counters, never the store or clock. Keep
+that split when adding backend state; no other locking exists anywhere
+(the reference instead serializes on a cache mutex, gubernator.go:237).
 """
 
 from __future__ import annotations
@@ -83,6 +88,17 @@ class TpuBackend:
 
     def decide(self, reqs, gnp, now=None):
         return self.engine.get_rate_limits(reqs, now=now, gnp=list(gnp))
+
+    def decide_submit(self, reqs, gnp, now=None):
+        """Presort + dispatch without waiting (see engine.decide_submit);
+        the batcher pipelines the next batch's host work against this
+        batch's device time through this split."""
+        return self.engine.get_rate_limits_submit(
+            reqs, now=now, gnp=list(gnp)
+        )
+
+    def decide_wait(self, handle):
+        return self.engine.get_rate_limits_wait(handle)
 
     def update_globals(self, updates, now=None):
         self.engine.update_globals(list(updates), now=now)

@@ -10,6 +10,14 @@ the backend, so no locks exist anywhere on the hot path.
 The backend call itself runs in a worker thread (it blocks on the device);
 the event loop keeps accepting requests for the *next* batch meanwhile,
 giving natural double-buffering: batch N on device while batch N+1 fills.
+
+Backends exposing decide_submit/decide_wait (the device backends) get one
+more level of pipelining: the flusher submits batch N+1 (host presort +
+async dispatch) while batch N's device fetch is still in flight, so
+sustained throughput tracks max(host work, device time) per batch instead
+of their sum. At most two batches are in flight (the previous fetch is
+awaited before a third submit); fetches resolve in order, so the backend
+still sees strictly serialized submits and serialized waits.
 """
 
 from __future__ import annotations
@@ -34,6 +42,9 @@ class DeviceBatcher:
         self.batch_limit = batch_limit
         self._queue: "asyncio.Queue" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
+        # in-flight fetch of the previously submitted batch (pipelined
+        # backends only); its task resolves that batch's futures itself
+        self._pending: Optional[asyncio.Task] = None
         # last backend stats snapshot, for cache_access_count deltas
         self._last_hits = 0
         self._last_misses = 0
@@ -50,6 +61,9 @@ class DeviceBatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
+        if self._pending is not None:
+            await self._pending  # drain the in-flight fetch gracefully
+            self._pending = None
 
     async def decide(
         self, reqs: Sequence[RateLimitReq], gnp: Sequence[bool]
@@ -78,32 +92,48 @@ class DeviceBatcher:
         while True:
             item = await self._queue.get()
             batch: List[Tuple] = [item]
-            # Opportunistic drain: everything already enqueued rides this
-            # launch. While the backend is busy in _flush, new arrivals
-            # accumulate in the queue, so batches grow with load on their
-            # own ("batch while busy") and a solo request never waits.
-            while len(batch) < self.batch_limit:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except asyncio.QueueEmpty:
-                    break
-            # Optional fixed window (reference BatchWait semantics,
-            # peers.go:143-172) for staggered arrivals while idle.
-            if self.batch_wait > 0:
-                deadline = loop.time() + self.batch_wait
+            try:
+                # Opportunistic drain: everything already enqueued rides
+                # this launch. While the backend is busy in _flush, new
+                # arrivals accumulate in the queue, so batches grow with
+                # load on their own ("batch while busy") and a solo
+                # request never waits.
                 while len(batch) < self.batch_limit:
-                    timeout = deadline - loop.time()
-                    if timeout <= 0:
-                        break
                     try:
-                        batch.append(
-                            await asyncio.wait_for(
-                                self._queue.get(), timeout
-                            )
-                        )
-                    except asyncio.TimeoutError:
+                        batch.append(self._queue.get_nowait())
+                    except asyncio.QueueEmpty:
                         break
-            await self._flush(batch)
+                # Optional fixed window (reference BatchWait semantics,
+                # peers.go:143-172) for staggered arrivals while idle.
+                if self.batch_wait > 0:
+                    deadline = loop.time() + self.batch_wait
+                    while len(batch) < self.batch_limit:
+                        timeout = deadline - loop.time()
+                        if timeout <= 0:
+                            break
+                        try:
+                            batch.append(
+                                await asyncio.wait_for(
+                                    self._queue.get(), timeout
+                                )
+                            )
+                        except asyncio.TimeoutError:
+                            break
+                await self._flush(batch)
+            except asyncio.CancelledError:
+                # stop() anywhere in the collect/flush path: every caller
+                # in this batch and still enqueued gets an error, never a
+                # hang. Items a flush step already resolved, or handed to
+                # the _pending fetch chain, were removed from `batch` (or
+                # have done futures, which _fail skips).
+                exc = RuntimeError("batcher stopped mid-batch")
+                self._fail(batch, exc)
+                while True:
+                    try:
+                        self._fail([self._queue.get_nowait()], exc)
+                    except asyncio.QueueEmpty:
+                        break
+                raise
 
     async def _flush(self, batch) -> None:
         decide_items = [b for b in batch if b[0] != "globals"]
@@ -117,19 +147,105 @@ class DeviceBatcher:
             except Exception as e:
                 if not fut.done():
                     fut.set_exception(e)
+            # a cancel mid-call propagates to _run's handler, which fails
+            # this and every remaining item in the batch
 
         if not decide_items:
             return
         reqs = [r for r, _, _ in decide_items]
         gnp = [g for _, g, _ in decide_items]
         t0 = time.monotonic()
-        try:
-            resps = await asyncio.to_thread(self.backend.decide, reqs, gnp)
-        except Exception as e:
-            for _, _, fut in decide_items:
-                if not fut.done():
-                    fut.set_exception(e)
+        submit = getattr(self.backend, "decide_submit", None)
+        if submit is None:
+            # non-pipelined backend: one blocking decide per batch (a
+            # cancel mid-call is handled by _run; the worker thread
+            # finishes on its own and to_thread discards its result)
+            try:
+                resps = await asyncio.to_thread(
+                    self.backend.decide, reqs, gnp
+                )
+            except Exception as e:
+                self._fail(decide_items, e)
+                return
+            self._resolve(decide_items, resps, time.monotonic() - t0)
             return
+
+        # pipelined path: submit now (host presort + async dispatch);
+        # fetch in a background task so the flusher can collect and
+        # submit the NEXT batch while the device computes this one.
+        # shield: a stop() mid-submit must not strand these futures —
+        # the submit thread finishes either way (the store mutation has
+        # already been dispatched), so fail the batch and propagate.
+        submit_fut = asyncio.ensure_future(
+            asyncio.to_thread(submit, reqs, gnp)
+        )
+        try:
+            handle = await asyncio.shield(submit_fut)
+        except asyncio.CancelledError:
+            # consume the shielded submit's outcome so an exception is
+            # not logged as unretrieved at GC; a returned handle is
+            # abandoned — the dispatched batch's store mutation stands,
+            # the same contract as a crash after dispatch. _run's handler
+            # fails the batch's futures.
+            submit_fut.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+            raise
+        except Exception as e:
+            self._fail(decide_items, e)
+            return
+        submit_s = time.monotonic() - t0
+        prev = self._pending
+        task = asyncio.ensure_future(
+            self._finish(prev, handle, decide_items, submit_s)
+        )
+        self._pending = task
+        # drop the reference once done so an idle batcher doesn't pin the
+        # last batch's requests/responses until the next flush
+        task.add_done_callback(
+            lambda t: self._pending is t and setattr(self, "_pending", None)
+        )
+        # this batch now belongs to the _pending fetch chain (stop()
+        # awaits it): a later cancel must not fail its futures from _run
+        batch.clear()
+        if prev is not None:
+            # bound in-flight batches at two, and keep fetches serialized
+            # (the engine's stats mutation stays single-threaded).
+            # shield: a stop() arriving here must not cancel the fetch —
+            # the _pending chain resolves BOTH in-flight batches and
+            # stop() awaits it. (CancelledError is a BaseException, so it
+            # propagates to _run's handler regardless.)
+            try:
+                await asyncio.shield(prev)
+            except Exception:  # pragma: no cover - _finish never raises
+                pass
+
+    async def _finish(self, prev, handle, decide_items, submit_s: float):
+        if prev is not None:
+            try:
+                await prev  # fetches resolve strictly in submit order
+            except Exception:  # pragma: no cover - _finish never raises
+                pass
+        t1 = time.monotonic()
+        try:
+            resps = await asyncio.to_thread(self.backend.decide_wait, handle)
+        except Exception as e:
+            self._fail(decide_items, e)
+            return
+        # own cost only: host submit + own fetch span (which starts once
+        # the previous batch's fetch finished) — NOT the time spent
+        # queued behind the previous batch, which would double-count
+        # device time under steady pipelining
+        self._resolve(
+            decide_items, resps, submit_s + (time.monotonic() - t1)
+        )
+
+    def _fail(self, decide_items, exc: BaseException) -> None:
+        for _, _, fut in decide_items:
+            if not fut.done():
+                fut.set_exception(exc)
+
+    def _resolve(self, decide_items, resps, launch_s: float) -> None:
         # resolve callers FIRST: metrics are best-effort and must never
         # be able to kill the flusher task (a dead flusher wedges every
         # future request with no error surfaced)
@@ -137,8 +253,8 @@ class DeviceBatcher:
             if not fut.done():
                 fut.set_result(resp)
         try:
-            metrics.DEVICE_BATCH_SIZE.observe(len(reqs))
-            metrics.DEVICE_LAUNCH_MS.observe((time.monotonic() - t0) * 1e3)
+            metrics.DEVICE_BATCH_SIZE.observe(len(decide_items))
+            metrics.DEVICE_LAUNCH_MS.observe(launch_s * 1e3)
             self._observe_cache_stats()
         except Exception:  # pragma: no cover - defensive
             pass

@@ -1,0 +1,209 @@
+"""DeviceBatcher unit tests: the pipelined submit/wait flusher.
+
+The serving claim under test: with a backend exposing
+decide_submit/decide_wait, the flusher submits batch N+1 while batch N's
+fetch is still in flight (throughput tracks max(host, device) per batch,
+not the sum), submits stay strictly serialized, a failed fetch fails only
+its own batch, and backends without the split still work unchanged.
+"""
+
+import asyncio
+import threading
+
+import pytest
+
+from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
+from gubernator_tpu.serve.batcher import DeviceBatcher
+
+
+def _req(i: int) -> RateLimitReq:
+    return RateLimitReq(
+        name="b", unique_key=f"k{i}", hits=1, limit=10, duration=1000
+    )
+
+
+class PipelinedFake:
+    """Records submit/wait interleaving; waits block until released."""
+
+    def __init__(self):
+        self.submits = []
+        self.waits = []
+        self.releases = {}
+        self.lock = threading.Lock()
+        self.concurrent_submits = 0
+        self.fail_wait_for = set()
+
+    def decide_submit(self, reqs, gnp, now=None):
+        with self.lock:
+            self.concurrent_submits += 1
+            assert self.concurrent_submits == 1, "submits must serialize"
+        try:
+            idx = len(self.submits)
+            self.submits.append([r.unique_key for r in reqs])
+            self.releases[idx] = threading.Event()
+            return (idx, list(reqs))
+        finally:
+            with self.lock:
+                self.concurrent_submits -= 1
+
+    def decide_wait(self, handle):
+        idx, reqs = handle
+        assert self.releases[idx].wait(timeout=30), (
+            f"fetch {idx} never released"
+        )
+        self.waits.append(idx)
+        if idx in self.fail_wait_for:
+            raise RuntimeError(f"fetch {idx} failed")
+        return [RateLimitResp(limit=r.limit, remaining=7) for r in reqs]
+
+
+@pytest.fixture()
+def loop_run():
+    def run(coro):
+        return asyncio.run(coro)
+
+    return run
+
+
+def test_pipelined_overlap_and_order(loop_run):
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(be, batch_wait=0, batch_limit=1)
+        b.start()
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        # first batch submitted; its fetch now blocks on releases[0]
+        while len(be.submits) < 1:
+            await asyncio.sleep(0.001)
+        t2 = asyncio.ensure_future(b.decide([_req(2)], [False]))
+        # the second batch must be SUBMITTED while fetch 0 is in flight —
+        # this is the pipelining property
+        while len(be.submits) < 2:
+            await asyncio.sleep(0.001)
+        assert be.waits == []  # nothing fetched yet
+        be.releases[0].set()
+        r1 = await t1
+        be.releases[1].set()
+        r2 = await t2
+        assert [r.remaining for r in r1] == [7]
+        assert [r.remaining for r in r2] == [7]
+        assert be.waits == [0, 1]  # fetches resolved in submit order
+        await b.stop()
+
+    loop_run(scenario())
+
+
+def test_failed_fetch_fails_only_its_batch(loop_run):
+    async def scenario():
+        be = PipelinedFake()
+        be.fail_wait_for.add(0)
+        b = DeviceBatcher(be, batch_wait=0, batch_limit=1)
+        b.start()
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        while len(be.submits) < 1:
+            await asyncio.sleep(0.001)
+        be.releases[0].set()
+        with pytest.raises(RuntimeError, match="fetch 0 failed"):
+            await t1
+        # the flusher survives: the next batch decides normally
+        t2 = asyncio.ensure_future(b.decide([_req(2)], [False]))
+        while len(be.submits) < 2:
+            await asyncio.sleep(0.001)
+        be.releases[1].set()
+        r2 = await t2
+        assert [r.remaining for r in r2] == [7]
+        await b.stop()
+
+    loop_run(scenario())
+
+
+def test_stop_with_two_batches_in_flight(loop_run):
+    """stop() while batch N is fetching and batch N+1 is already
+    submitted (the flusher parked awaiting the previous fetch) must
+    resolve BOTH batches' callers and return cleanly — not strand
+    futures or re-raise CancelledError out of stop()."""
+
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(be, batch_wait=0, batch_limit=1)
+        b.start()
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        # wait until batch 1 is OWNED by the fetch chain (submits alone
+        # can be observed before the flusher receives the handle, and a
+        # stop() landing in that window legitimately fails the batch)
+        while b._pending is None:
+            await asyncio.sleep(0.001)
+        p1 = b._pending
+        t2 = asyncio.ensure_future(b.decide([_req(2)], [False]))
+        while b._pending is p1:
+            await asyncio.sleep(0.001)
+        stop_task = asyncio.ensure_future(b.stop())
+        await asyncio.sleep(0.01)  # let the cancel land mid-pipeline
+        be.releases[0].set()
+        be.releases[1].set()
+        await stop_task  # must not raise
+        r1, r2 = await t1, await t2
+        assert [r.remaining for r in r1] == [7]
+        assert [r.remaining for r in r2] == [7]
+        assert be.waits == [0, 1]
+
+    loop_run(scenario())
+
+
+def test_stop_drains_inflight_fetch(loop_run):
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(be, batch_wait=0, batch_limit=1)
+        b.start()
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        while b._pending is None:  # fetch chain owns the batch
+            await asyncio.sleep(0.001)
+        be.releases[0].set()
+        # stop() must await the in-flight fetch so t1 resolves, not hang
+        await b.stop()
+        r1 = await t1
+        assert [r.remaining for r in r1] == [7]
+
+    loop_run(scenario())
+
+
+def test_stop_fails_requests_parked_in_collect_window(loop_run):
+    """stop() while the flusher is still collecting (parked in the
+    batch_wait window with one request already popped from the queue)
+    must fail that caller with an error — not strand it forever."""
+
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(be, batch_wait=5.0, batch_limit=100)
+        b.start()
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        await asyncio.sleep(0.05)  # flusher now parked in the window
+        assert be.submits == []  # nothing flushed yet
+        await b.stop()
+        with pytest.raises(RuntimeError, match="stopped mid-batch"):
+            await t1
+
+    loop_run(scenario())
+
+
+class BlockingFake:
+    """A backend with only the blocking decide() — the fallback path."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def decide(self, reqs, gnp, now=None):
+        self.calls += 1
+        return [RateLimitResp(limit=r.limit, remaining=3) for r in reqs]
+
+
+def test_non_pipelined_backend_fallback(loop_run):
+    async def scenario():
+        be = BlockingFake()
+        b = DeviceBatcher(be, batch_wait=0, batch_limit=8)
+        b.start()
+        out = await b.decide([_req(i) for i in range(5)], [False] * 5)
+        assert [r.remaining for r in out] == [3] * 5
+        assert be.calls == 1  # coalesced into one backend call
+        await b.stop()
+
+    loop_run(scenario())
