@@ -14,6 +14,7 @@
 //
 // Build: make -C gubernator_tpu/native   (or scripts in repo Makefile)
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -204,6 +205,68 @@ void radix_argsort(std::vector<uint64_t>& keys, int64_t n, int total_bits,
   std::memcpy(order_out, idx.data(), n * sizeof(int32_t));
 }
 
+// Bucket-major counting argsort for bucket spaces that fit a direct
+// histogram (bucket_bits <= 16): ONE stable counting pass on the bucket
+// index, then a per-bucket stable fingerprint sort for the rare
+// multi-key buckets (store load factors keep mean keys/bucket around 1,
+// and duplicate rows of ONE key share a fingerprint, so most bucket runs
+// are fp-uniform and skip the sort entirely). Output is bit-identical to
+// the 3-pass radix on (bucket<<32 | fp) — fp ascending within a bucket,
+// ties in input order — at ~3x less memory traffic for B=32k.
+// Returns false (untouched outputs) when the bucket space is too large;
+// callers fall back to radix_argsort. fp_out/ends_out are scratch the
+// grouped variant reuses: fp per INPUT row, and each bucket's sorted-run
+// END offset.
+bool counting_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
+                      int32_t* order_out, std::vector<uint32_t>& fp_out,
+                      std::vector<uint32_t>& ends_out) {
+  if (buckets > (1ULL << 16)) return false;
+  const uint64_t bmask = buckets - 1;
+  fp_out.resize(n);
+  ends_out.assign(buckets, 0);
+  static thread_local std::vector<uint32_t> bk;
+  bk.resize(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t kh = key_hash[i];
+    uint32_t b = static_cast<uint32_t>(splitmix64(kh ^ BUCKET_SALT) & bmask);
+    uint32_t f = static_cast<uint32_t>(kh >> 32);
+    if (f == 0) f = 1;
+    bk[i] = b;
+    fp_out[i] = f;
+    ++ends_out[b];
+  }
+  uint32_t sum = 0;
+  for (uint64_t b = 0; b < buckets; ++b) {  // counts -> start offsets
+    uint32_t c = ends_out[b];
+    ends_out[b] = sum;
+    sum += c;
+  }
+  for (int64_t i = 0; i < n; ++i) {  // stable scatter; starts -> ends
+    order_out[ends_out[bk[i]]++] = static_cast<int32_t>(i);
+  }
+  int64_t s = 0;
+  for (uint64_t b = 0; b < buckets; ++b) {
+    const int64_t e = ends_out[b];
+    if (e - s > 1) {
+      const uint32_t f0 = fp_out[order_out[s]];
+      bool uniform = true;
+      for (int64_t i = s + 1; i < e; ++i) {
+        if (fp_out[order_out[i]] != f0) {
+          uniform = false;
+          break;
+        }
+      }
+      if (!uniform) {
+        std::stable_sort(
+            order_out + s, order_out + e,
+            [&](int32_t a, int32_t c) { return fp_out[a] < fp_out[c]; });
+      }
+    }
+    s = e;
+  }
+  return true;
+}
+
 }  // namespace
 
 extern "C" {
@@ -212,6 +275,10 @@ extern "C" {
 // buckets must be a power of two. Stable (equal keys keep input order).
 void guber_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
                    int32_t* order_out) {
+  {
+    static thread_local std::vector<uint32_t> fp, ends;
+    if (counting_presort(key_hash, n, buckets, order_out, fp, ends)) return;
+  }
   const uint64_t bmask = buckets - 1;
   int bucket_bits = 0;
   while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
@@ -290,6 +357,33 @@ void guber_presort_grouped(const uint64_t* key_hash, int64_t n,
                            uint64_t buckets, int32_t* order_out,
                            int32_t* group_id_out, int32_t* leader_pos_out,
                            int64_t* n_groups_out) {
+  {
+    static thread_local std::vector<uint32_t> fp, ends;
+    if (counting_presort(key_hash, n, buckets, order_out, fp, ends)) {
+      // groups are runs of equal fp within a bucket run (two distinct
+      // key hashes sharing (bucket, fp) merge into one group — exactly
+      // the composite-key behavior of the radix path, and of the store,
+      // whose tag IS the fp)
+      int64_t g = 0;
+      int64_t s = 0;
+      for (uint64_t b = 0; b < buckets; ++b) {
+        const int64_t e = ends[b];
+        int64_t i = s;
+        while (i < e) {
+          const uint32_t f = fp[order_out[i]];
+          leader_pos_out[g] = static_cast<int32_t>(i);
+          while (i < e && fp[order_out[i]] == f) {
+            group_id_out[i] = static_cast<int32_t>(g);
+            ++i;
+          }
+          ++g;
+        }
+        s = e;
+      }
+      *n_groups_out = g;
+      return;
+    }
+  }
   const uint64_t bmask = buckets - 1;
   int bucket_bits = 0;
   while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
