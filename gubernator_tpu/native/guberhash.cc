@@ -205,53 +205,37 @@ void radix_argsort(std::vector<uint64_t>& keys, int64_t n, int total_bits,
   std::memcpy(order_out, idx.data(), n * sizeof(int32_t));
 }
 
-// Bucket-major counting argsort for bucket spaces that fit a direct
-// histogram (bucket_bits <= 16): ONE stable counting pass on the bucket
-// index, then a per-bucket stable fingerprint sort for the rare
-// multi-key buckets (store load factors keep mean keys/bucket around 1,
-// and duplicate rows of ONE key share a fingerprint, so most bucket runs
-// are fp-uniform and skip the sort entirely). Output is bit-identical to
-// the 3-pass radix on (bucket<<32 | fp) — fp ascending within a bucket,
-// ties in input order — at ~3x less memory traffic for B=32k.
-// Returns false (untouched outputs) when the bucket space is too large;
-// callers fall back to radix_argsort. fp_out/ends_out are scratch the
-// grouped variant reuses: fp per INPUT row, and each bucket's sorted-run
-// END offset.
-bool counting_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
-                      int32_t* order_out, std::vector<uint32_t>& fp_out,
-                      std::vector<uint32_t>& ends_out) {
-  if (buckets > (1ULL << 16)) return false;
-  const uint64_t bmask = buckets - 1;
-  fp_out.resize(n);
-  ends_out.assign(buckets, 0);
-  static thread_local std::vector<uint32_t> bk;
-  bk.resize(n);
-  for (int64_t i = 0; i < n; ++i) {
-    uint64_t kh = key_hash[i];
-    uint32_t b = static_cast<uint32_t>(splitmix64(kh ^ BUCKET_SALT) & bmask);
-    uint32_t f = static_cast<uint32_t>(kh >> 32);
-    if (f == 0) f = 1;
-    bk[i] = b;
-    fp_out[i] = f;
-    ++ends_out[b];
-  }
+// Run-major counting argsort for composite run keys (shard/bucket bits)
+// that fit a direct histogram: ONE stable counting pass on the run key,
+// then a per-run stable fingerprint sort for the rare multi-key runs
+// (store load factors keep mean keys/bucket around 1, and duplicate
+// rows of ONE key share a fingerprint, so most runs are fp-uniform and
+// skip the sort entirely). Output is bit-identical to the LSD radix on
+// (run_key<<32 | fp) — fp ascending within a run, ties in input order —
+// at ~3x less memory traffic for B=32k. skey/fp are per INPUT row;
+// ends_out receives each run's END offset in the sorted order.
+void counting_argsort_fp(const uint32_t* skey, const uint32_t* fp,
+                         int64_t n, uint64_t space, int32_t* order_out,
+                         std::vector<uint32_t>& ends_out) {
+  ends_out.assign(space, 0);
+  for (int64_t i = 0; i < n; ++i) ++ends_out[skey[i]];
   uint32_t sum = 0;
-  for (uint64_t b = 0; b < buckets; ++b) {  // counts -> start offsets
+  for (uint64_t b = 0; b < space; ++b) {  // counts -> start offsets
     uint32_t c = ends_out[b];
     ends_out[b] = sum;
     sum += c;
   }
   for (int64_t i = 0; i < n; ++i) {  // stable scatter; starts -> ends
-    order_out[ends_out[bk[i]]++] = static_cast<int32_t>(i);
+    order_out[ends_out[skey[i]]++] = static_cast<int32_t>(i);
   }
   int64_t s = 0;
-  for (uint64_t b = 0; b < buckets; ++b) {
+  for (uint64_t b = 0; b < space; ++b) {
     const int64_t e = ends_out[b];
     if (e - s > 1) {
-      const uint32_t f0 = fp_out[order_out[s]];
+      const uint32_t f0 = fp[order_out[s]];
       bool uniform = true;
       for (int64_t i = s + 1; i < e; ++i) {
-        if (fp_out[order_out[i]] != f0) {
+        if (fp[order_out[i]] != f0) {
           uniform = false;
           break;
         }
@@ -259,11 +243,85 @@ bool counting_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
       if (!uniform) {
         std::stable_sort(
             order_out + s, order_out + e,
-            [&](int32_t a, int32_t c) { return fp_out[a] < fp_out[c]; });
+            [&](int32_t a, int32_t c) { return fp[a] < fp[c]; });
       }
     }
     s = e;
   }
+}
+
+// Histograms above this are slower to zero than the radix passes save.
+constexpr uint64_t COUNTING_SPACE_MAX = 1ULL << 16;
+// The sharded composite (owner|bucket) key gets a larger cap: the bigger
+// memset trades against skipping 3-4 radix passes instead of 2-3.
+constexpr uint64_t SHARDED_COUNTING_SPACE_MAX = 1ULL << 18;
+
+// Build the sharded run keys (owner << bucket_bits | bucket), per-row
+// fingerprints, and per-shard row counts in one pass.
+void build_sharded_keys(const uint64_t* key_hash, int64_t n, uint64_t bmask,
+                        int bucket_bits, uint64_t n_shards,
+                        int64_t* counts_out, std::vector<uint32_t>& sk,
+                        std::vector<uint32_t>& fp) {
+  sk.resize(n);
+  fp.resize(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t kh = key_hash[i];
+    uint64_t owner = splitmix64(kh ^ SHARD_SALT) % n_shards;
+    ++counts_out[owner];
+    uint64_t bkt = splitmix64(kh ^ BUCKET_SALT) & bmask;
+    sk[i] = static_cast<uint32_t>((owner << bucket_bits) | bkt);
+    uint32_t f = static_cast<uint32_t>(kh >> 32);
+    if (f == 0) f = 1;
+    fp[i] = f;
+  }
+}
+
+// Walk the sorted runs emitting duplicate-key groups (fp-runs within a
+// run key). When group_counts_out is non-null, each group also counts
+// toward its owning shard (owner = run_key >> bucket_bits). Returns the
+// group count.
+int64_t emit_groups(const std::vector<uint32_t>& ends, uint64_t space,
+                    const std::vector<uint32_t>& fp, const int32_t* order,
+                    int32_t* group_id_out, int32_t* leader_pos_out,
+                    int64_t* group_counts_out, int bucket_bits) {
+  int64_t g = 0;
+  int64_t s = 0;
+  for (uint64_t r = 0; r < space; ++r) {
+    const int64_t e = ends[r];
+    int64_t i = s;
+    while (i < e) {
+      const uint32_t f = fp[order[i]];
+      leader_pos_out[g] = static_cast<int32_t>(i);
+      if (group_counts_out) ++group_counts_out[r >> bucket_bits];
+      while (i < e && fp[order[i]] == f) {
+        group_id_out[i] = static_cast<int32_t>(g);
+        ++i;
+      }
+      ++g;
+    }
+    s = e;
+  }
+  return g;
+}
+
+// Single-device composite (bucket | fp) fast path; false -> radix.
+bool counting_presort(const uint64_t* key_hash, int64_t n, uint64_t buckets,
+                      int32_t* order_out, std::vector<uint32_t>& fp_out,
+                      std::vector<uint32_t>& ends_out) {
+  if (buckets > COUNTING_SPACE_MAX) return false;
+  const uint64_t bmask = buckets - 1;
+  fp_out.resize(n);
+  static thread_local std::vector<uint32_t> bk;
+  bk.resize(n);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t kh = key_hash[i];
+    bk[i] = static_cast<uint32_t>(splitmix64(kh ^ BUCKET_SALT) & bmask);
+    uint32_t f = static_cast<uint32_t>(kh >> 32);
+    if (f == 0) f = 1;
+    fp_out[i] = f;
+  }
+  counting_argsort_fp(bk.data(), fp_out.data(), n, buckets, order_out,
+                      ends_out);
   return true;
 }
 
@@ -364,23 +422,8 @@ void guber_presort_grouped(const uint64_t* key_hash, int64_t n,
       // key hashes sharing (bucket, fp) merge into one group — exactly
       // the composite-key behavior of the radix path, and of the store,
       // whose tag IS the fp)
-      int64_t g = 0;
-      int64_t s = 0;
-      for (uint64_t b = 0; b < buckets; ++b) {
-        const int64_t e = ends[b];
-        int64_t i = s;
-        while (i < e) {
-          const uint32_t f = fp[order_out[i]];
-          leader_pos_out[g] = static_cast<int32_t>(i);
-          while (i < e && fp[order_out[i]] == f) {
-            group_id_out[i] = static_cast<int32_t>(g);
-            ++i;
-          }
-          ++g;
-        }
-        s = e;
-      }
-      *n_groups_out = g;
+      *n_groups_out = emit_groups(ends, buckets, fp, order_out,
+                                  group_id_out, leader_pos_out, nullptr, 0);
       return;
     }
   }
@@ -432,6 +475,15 @@ void guber_presort_sharded(const uint64_t* key_hash, int64_t n,
 
   for (uint64_t s = 0; s < n_shards; ++s) counts_out[s] = 0;
 
+  if ((n_shards << bucket_bits) <= SHARDED_COUNTING_SPACE_MAX) {
+    static thread_local std::vector<uint32_t> sk, fp, ends;
+    build_sharded_keys(key_hash, n, bmask, bucket_bits, n_shards,
+                       counts_out, sk, fp);
+    counting_argsort_fp(sk.data(), fp.data(), n, n_shards << bucket_bits,
+                        order_out, ends);
+    return;
+  }
+
   std::vector<uint64_t> keys(n);
   for (int64_t i = 0; i < n; ++i) {
     uint64_t kh = key_hash[i];
@@ -465,6 +517,17 @@ void guber_presort_sharded_grouped(
   for (uint64_t s = 0; s < n_shards; ++s) {
     counts_out[s] = 0;
     group_counts_out[s] = 0;
+  }
+
+  if ((n_shards << bucket_bits) <= SHARDED_COUNTING_SPACE_MAX) {
+    static thread_local std::vector<uint32_t> sk, fp, ends;
+    build_sharded_keys(key_hash, n, bmask, bucket_bits, n_shards,
+                       counts_out, sk, fp);
+    const uint64_t space = n_shards << bucket_bits;
+    counting_argsort_fp(sk.data(), fp.data(), n, space, order_out, ends);
+    emit_groups(ends, space, fp, order_out, group_id_out, leader_pos_out,
+                group_counts_out, bucket_bits);
+    return;
   }
 
   std::vector<uint64_t> keys(n);

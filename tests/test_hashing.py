@@ -62,9 +62,10 @@ def test_mix64_avalanche():
 
 
 def test_native_presort_matches_numpy():
-    """The C radix presort must order exactly like the numpy reference
-    (stable argsort of group_sort_key_np) — decide_presorted's caller
-    contract depends on it."""
+    """The C presort must order exactly like the numpy reference (stable
+    argsort of group_sort_key_np) — decide_presorted's caller contract
+    depends on it. The bucket sizes cover BOTH native paths: the
+    counting-sort fast path (<= 2^16 buckets) and the radix fallback."""
     hashlib_native = pytest.importorskip(
         "gubernator_tpu.native.hashlib_native"
     )
@@ -72,7 +73,7 @@ def test_native_presort_matches_numpy():
 
     rng = np.random.default_rng(3)
     for n in (0, 1, 7, 1000, 16384):
-        for buckets in (1 << 10, 1 << 15, 1 << 21):
+        for buckets in (1 << 10, 1 << 15, 1 << 16, 1 << 21):
             kh = rng.integers(0, 2**63, n, dtype=np.int64).astype(np.uint64)
             # force duplicates (stability matters)
             if n > 10:
@@ -82,3 +83,50 @@ def test_native_presort_matches_numpy():
             )
             got = hashlib_native.presort(kh, buckets)
             assert (want == got).all(), (n, buckets)
+
+
+def test_native_presort_grouped_matches_numpy():
+    """Grouped + sharded native presorts must match their numpy twins
+    bit for bit (order, group ids, leader positions, shard/group counts)
+    across both the counting and radix paths, including non-power-of-two
+    shard counts."""
+    hashlib_native = pytest.importorskip(
+        "gubernator_tpu.native.hashlib_native"
+    )
+    from gubernator_tpu.core.engine import _np_presort_grouped
+    from gubernator_tpu.parallel.sharded import (
+        _np_presort_sharded,
+        _np_presort_sharded_grouped,
+    )
+
+    rng = np.random.default_rng(5)
+    for n in (0, 1, 9, 1000, 8192):
+        for buckets in (1 << 10, 1 << 16, 1 << 21):
+            kh = rng.integers(0, 2**63, n, dtype=np.int64).astype(np.uint64)
+            if n > 10:
+                kh[n // 2 :] = kh[: n - n // 2]
+            o1, g1, l1, G1 = _np_presort_grouped(kh, buckets)
+            o2, g2, l2, G2 = hashlib_native.presort_grouped(kh, buckets)
+            assert G1 == G2, (n, buckets)
+            assert (np.asarray(o2) == o1).all(), (n, buckets)
+            assert (np.asarray(g2)[:n] == g1).all(), (n, buckets)
+            assert (np.asarray(l2)[:G1] == l1).all(), (n, buckets)
+            for shards in (1, 2, 8, 13):
+                so1, c1 = _np_presort_sharded(kh, buckets, shards)
+                so2, c2 = hashlib_native.presort_sharded(kh, buckets, shards)
+                assert (np.asarray(so2) == so1).all(), (n, buckets, shards)
+                assert (np.asarray(c2) == c1).all(), (n, buckets, shards)
+                r1 = _np_presort_sharded_grouped(kh, buckets, shards)
+                r2 = hashlib_native.presort_sharded_grouped(
+                    kh, buckets, shards
+                )
+                G = r1[3].shape[0]
+                assert (np.asarray(r2[0]) == r1[0]).all(), (n, buckets, shards)
+                assert (np.asarray(r2[1]) == r1[1]).all(), (n, buckets, shards)
+                assert (np.asarray(r2[2])[:n] == r1[2]).all(), (
+                    n, buckets, shards,
+                )
+                assert (np.asarray(r2[3])[:G] == r1[3]).all(), (
+                    n, buckets, shards,
+                )
+                assert (np.asarray(r2[4]) == r1[4]).all(), (n, buckets, shards)
