@@ -668,14 +668,33 @@ void serve_connection(int fd, Batcher* batcher) {
 
 #include <chrono>
 
+static const char kUsage[] =
+    "guber-edge: native HTTP/JSON front door for gubernator-tpu\n"
+    "  --listen PORT          TCP port to serve HTTP on (default 8080)\n"
+    "  --backend PATH         daemon's edge unix socket "
+    "(default /tmp/guber-edge.sock)\n"
+    "  --batch-wait-us N      cross-connection batch window (default 500)\n"
+    "  --batch-limit N        max requests per backend frame (default 1000)\n"
+    "  --workers N            pipelined backend connections (default 2)\n"
+    "  --max-conns N          client connection cap (default 4096)\n"
+    "  --recv-timeout-s N     per-read client timeout (default 30)\n";
+
 int main(int argc, char** argv) {
   int port = 8080;
   std::string backend = "/tmp/guber-edge.sock";
   int batch_wait_us = 500;
   int batch_limit = 1000;
   int workers = 2;
-  for (int i = 1; i + 1 < argc; i += 2) {
+  for (int i = 1; i < argc; i += 2) {
     std::string a = argv[i];
+    if (a == "--help" || a == "-h") {
+      fputs(kUsage, stdout);
+      return 0;
+    }
+    if (i + 1 >= argc) {
+      fprintf(stderr, "missing value for %s\n%s", a.c_str(), kUsage);
+      return 2;
+    }
     if (a == "--listen") port = atoi(argv[i + 1]);
     else if (a == "--backend") backend = argv[i + 1];
     else if (a == "--batch-wait-us") batch_wait_us = atoi(argv[i + 1]);
@@ -686,10 +705,15 @@ int main(int argc, char** argv) {
       g_max_conns = std::max(1, atoi(argv[i + 1]));
     else if (a == "--recv-timeout-s")
       g_recv_timeout_s = std::max(1, atoi(argv[i + 1]));
+    else {
+      // a typo'd flag silently ignored would serve with defaults — fail
+      fprintf(stderr, "unknown flag %s\n%s", a.c_str(), kUsage);
+      return 2;
+    }
   }
 
-  Batcher batcher(backend, batch_wait_us, batch_limit, workers);
-
+  // bind BEFORE spawning the batcher's worker threads: returning with
+  // joinable threads in Batcher's vector would std::terminate
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
   setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
@@ -701,6 +725,8 @@ int main(int argc, char** argv) {
     perror("bind/listen");
     return 1;
   }
+
+  Batcher batcher(backend, batch_wait_us, batch_limit, workers);
   fprintf(stderr, "guber-edge listening on :%d backend=%s\n", port,
           backend.c_str());
   fflush(stderr);
