@@ -30,6 +30,7 @@
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <deque>
 #include <mutex>
@@ -679,6 +680,16 @@ static const char kUsage[] =
     "  --max-conns N          client connection cap (default 4096)\n"
     "  --recv-timeout-s N     per-read client timeout (default 30)\n";
 
+// Strict non-negative integer parse: a typo'd VALUE ("80O0", "abc")
+// must fail loudly, not atoi-truncate into serving the wrong port.
+static bool parse_int_flag(const char* v, int* out) {
+  char* end = nullptr;
+  long x = strtol(v, &end, 10);
+  if (end == v || *end != '\0' || x < 0 || x > (1L << 30)) return false;
+  *out = static_cast<int>(x);
+  return true;
+}
+
 int main(int argc, char** argv) {
   int port = 8080;
   std::string backend = "/tmp/guber-edge.sock";
@@ -695,19 +706,28 @@ int main(int argc, char** argv) {
       fprintf(stderr, "missing value for %s\n%s", a.c_str(), kUsage);
       return 2;
     }
-    if (a == "--listen") port = atoi(argv[i + 1]);
-    else if (a == "--backend") backend = argv[i + 1];
-    else if (a == "--batch-wait-us") batch_wait_us = atoi(argv[i + 1]);
-    else if (a == "--batch-limit") batch_limit = atoi(argv[i + 1]);
-    else if (a == "--workers")
-      workers = std::max(1, atoi(argv[i + 1]));
-    else if (a == "--max-conns")
-      g_max_conns = std::max(1, atoi(argv[i + 1]));
-    else if (a == "--recv-timeout-s")
-      g_recv_timeout_s = std::max(1, atoi(argv[i + 1]));
-    else {
+    const char* v = argv[i + 1];
+    bool ok = true;
+    if (a == "--listen") ok = parse_int_flag(v, &port);
+    else if (a == "--backend") backend = v;
+    else if (a == "--batch-wait-us") ok = parse_int_flag(v, &batch_wait_us);
+    else if (a == "--batch-limit") ok = parse_int_flag(v, &batch_limit);
+    else if (a == "--workers") {
+      ok = parse_int_flag(v, &workers);
+      workers = std::max(1, workers);
+    } else if (a == "--max-conns") {
+      ok = parse_int_flag(v, &g_max_conns);
+      g_max_conns = std::max(1, g_max_conns);
+    } else if (a == "--recv-timeout-s") {
+      ok = parse_int_flag(v, &g_recv_timeout_s);
+      g_recv_timeout_s = std::max(1, g_recv_timeout_s);
+    } else {
       // a typo'd flag silently ignored would serve with defaults — fail
       fprintf(stderr, "unknown flag %s\n%s", a.c_str(), kUsage);
+      return 2;
+    }
+    if (!ok) {
+      fprintf(stderr, "bad value for %s: %s\n%s", a.c_str(), v, kUsage);
       return 2;
     }
   }

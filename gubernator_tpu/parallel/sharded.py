@@ -197,6 +197,7 @@ def pad_request_sharded(
     algo: np.ndarray,
     gnp: np.ndarray,
     with_groups: bool = False,
+    group_rung: Optional[int] = None,
 ):
     """Partition a batch into per-shard sub-batches: the mesh sibling of
     engine.pad_request_sorted. One (owner, bucket, fp) radix sort makes
@@ -213,6 +214,9 @@ def pad_request_sharded(
     - groups: BatchGroups of [n_shards, ...] arrays (per-shard
       duplicate-key structure, indices LOCAL to each shard's sub-batch)
       so each chip's store I/O runs at unique-key granularity.
+    `group_rung` overrides the G rung choice (must hold every shard's
+    group count) — callers staging SEVERAL batches into one stacked
+    array pass a shared rung so the BatchGroups shapes line up.
     Unpermute responses with `out[order] = resp_flat[take_idx]`.
     """
     from gubernator_tpu.core.engine import (
@@ -302,9 +306,17 @@ def pad_request_sharded(
 
     gstarts = np.zeros(n_shards + 1, np.int64)
     np.cumsum(gcounts, out=gstarts[1:])
-    G_sub = choose_bucket(
-        group_rungs(B_sub), max(int(gcounts.max()), 1)
-    )
+    if group_rung is not None:
+        if group_rung < int(gcounts.max()):
+            raise ValueError(
+                f"group_rung {group_rung} < max shard group count "
+                f"{int(gcounts.max())}"
+            )
+        G_sub = group_rung
+    else:
+        G_sub = choose_bucket(
+            group_rungs(B_sub), max(int(gcounts.max()), 1)
+        )
     per_shard = []
     for s in range(n_shards):
         gc = int(gcounts[s])

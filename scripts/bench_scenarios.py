@@ -219,16 +219,31 @@ def scenario_global_mesh():
     ladder = sub_batch_ladder((64, 256, 1024, 4096, 16384))
     rung = choose_bucket(ladder, max_count)
     ones = np.ones(B, np.int64)
-    staged = [
-        pad_request_sharded(
+    # with_groups: the serving mesh path (MeshEngine.decide_arrays) runs
+    # all store I/O at unique-key granularity; the scenario must measure
+    # the same kernel, not the 2x-slower ungrouped compat path. Stage
+    # twice: the first pass learns each batch's natural G rung, the
+    # second pins every batch to the shared max so the stacked
+    # BatchGroups shapes line up (padding conventions stay inside
+    # pad_request_sharded / engine.build_groups — the single source of
+    # truth).
+    def stage(r, G=None):
+        return pad_request_sharded(
             (rung,), cfg.slots, n, key_hash[r], ones, ones * 1000,
             ones * 60_000, np.zeros(B, np.int32), np.ones(B, bool),
-        )[0]
-        for r in range(R)
-    ]
-    # [R, n, B_sub] -> [n, R, B_sub]: shard axis leads for P("shard")
+            with_groups=True, group_rung=G,
+        )
+
+    G_shared = max(stage(r)[3].leader_pos.shape[-1] for r in range(R))
+    staged = [stage(r, G_shared) for r in range(R)]
+    # [R, n, ...] -> [n, R, ...]: shard axis leads for P("shard")
     reqs = jax.tree.map(
-        lambda *xs: jnp.asarray(np.stack(xs).swapaxes(0, 1)), *staged
+        lambda *xs: jnp.asarray(np.stack(xs).swapaxes(0, 1)),
+        *[s[0] for s in staged],
+    )
+    groups = jax.tree.map(
+        lambda *xs: jnp.asarray(np.stack(xs).swapaxes(0, 1)),
+        *[s[3] for s in staged],
     )
     # gossip keys must honor decide_presorted's (bucket, fp) sort
     # contract — raw-value np.sort would hand unsorted bucket streams to
@@ -242,12 +257,15 @@ def scenario_global_mesh():
     )
     t0 = jnp.int32(1000)
 
-    def body_all(store, reqs, g_kh):
+    def body_all(store, reqs, aux):
+        groups, g_kh = aux
+
         def body(i, carry):
             store, acc = carry
             r = jax.tree.map(lambda x: x[0, i % R], reqs)
+            g = jax.tree.map(lambda x: x[0, i % R], groups)
             st, resp, _ = decide_presorted(
-                jax.tree.map(lambda x: x[0], store), r, t0 + i
+                jax.tree.map(lambda x: x[0], store), r, t0 + i, g
             )
             store = jax.tree.map(lambda x: x[None], st)
 
@@ -276,7 +294,7 @@ def scenario_global_mesh():
         jax.shard_map(
             body_all,
             mesh=mesh,
-            in_specs=(P("shard"), P("shard"), P()),
+            in_specs=(P("shard"), P("shard"), (P("shard"), P())),
             out_specs=(P("shard"), P()),
             check_vma=False,  # psum output IS replicated
         ),
@@ -293,7 +311,7 @@ def scenario_global_mesh():
     )
     return (
         f"global_mesh_{n}dev_psum_gossip",
-        _time_steps(stepped, store, reqs, g_kh, B, S),
+        _time_steps(stepped, store, reqs, (groups, g_kh), B, S),
     )
 
 
