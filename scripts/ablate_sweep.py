@@ -6,10 +6,13 @@ Diagnostic script — not part of the product surface.
 
 import functools
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def log(*a):
@@ -138,17 +141,21 @@ def main():
 
             @functools.partial(jax.jit, donate_argnums=(0,))
             def steps(x, comb_arr, fn=fn):
-                return lax.fori_loop(
+                x = lax.fori_loop(
                     0, S, lambda i, x: fn(x, comb_arr), x)
+                # loop-dependent scalar: fetching it is the hard barrier
+                # (through the remote-device tunnel block_until_ready can
+                # return before the fused loop finishes — see bench.py)
+                return x, x[0, 0]
 
             x = jnp.asarray(data)
-            x = steps(x, d_comb)
-            jax.block_until_ready(x)
+            x, acc = steps(x, d_comb)
+            int(acc)
             ts = []
             for _ in range(4):
                 t0 = time.monotonic()
-                x = steps(x, d_comb)
-                jax.block_until_ready(x)
+                x, acc = steps(x, d_comb)
+                int(acc)
                 ts.append(time.monotonic() - t0)
             us = min(ts) / S * 1e6
             results[f"T{tile_rows}_{mode}"] = round(us, 1)

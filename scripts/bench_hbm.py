@@ -15,10 +15,13 @@ Measures, at the production store geometry [32768, 128] int32 (16 MiB):
 
 import functools
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def log(*a):

@@ -8,10 +8,13 @@ B way-disjoint delta rows into their (sorted) buckets of the
 """
 
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def log(*a):
