@@ -54,14 +54,15 @@ def _hist_count(h) -> float:
     return 0.0
 
 
-def owner_index(key: str) -> int:
+def owner_index(key: str, addresses=None) -> int:
     """Which cluster node owns this ring key (hash.go successor rule)."""
-    points = sorted((ring_hash(a), a) for a in ADDRESSES)
+    addresses = ADDRESSES if addresses is None else addresses
+    points = sorted((ring_hash(a), a) for a in addresses)
     h = ring_hash(key)
     for point, addr in points:
         if point >= h:
-            return ADDRESSES.index(addr)
-    return ADDRESSES.index(points[0][1])
+            return addresses.index(addr)
+    return addresses.index(points[0][1])
 
 
 def test_health_check(cluster):
@@ -425,3 +426,56 @@ def test_device_and_cache_metrics_observed(cluster):
         if s.name.endswith("_total") and s.value > 0
     }
     assert "/pb.gubernator.V1/GetRateLimits" in found, found
+
+
+def test_dead_owner_forward_fails_per_item():
+    """A forwarded request whose owner peer has DIED (accepted into the
+    ring at set_peers time, gone at RPC time) must come back as a
+    per-item error response; co-batched keys owned by live nodes decide
+    normally. The reference fans a batch send error back to every
+    waiting request the same way (peers.go:183-195)."""
+    import socket
+
+    def free_ports(n):
+        socks = [socket.socket() for _ in range(n)]
+        try:
+            for s in socks:
+                s.bind(("127.0.0.1", 0))
+            return [s.getsockname()[1] for s in socks]
+        finally:
+            for s in socks:
+                s.close()
+
+    addresses = [f"127.0.0.1:{p}" for p in free_ports(3)]
+    c = LocalCluster(addresses)  # exact backend: fast start/stop
+    c.start()
+    try:
+        def owned_by(node_i):
+            for n in range(10_000):
+                k = f"deadfwd_{n}"
+                hk = RateLimitReq(name="dead", unique_key=k).hash_key()
+                if owner_index(hk, addresses) == node_i:
+                    return k
+            raise AssertionError("no key found")
+
+        dead_key = owned_by(2)
+        live_key = owned_by(0)
+        # kill node 2's server; nodes 0/1 still list it as a peer
+        c.run(c.servers[2].stop())
+
+        with V1Client(c.peer_at(0)) as client:
+            resps = client.get_rate_limits(
+                [
+                    RateLimitReq(name="dead", unique_key=dead_key,
+                                 hits=1, limit=5, duration=SECOND),
+                    RateLimitReq(name="dead", unique_key=live_key,
+                                 hits=1, limit=5, duration=SECOND),
+                ],
+                timeout=15,
+            )
+        assert "while fetching rate limit" in resps[0].error, resps[0]
+        assert resps[1].error == ""
+        assert resps[1].status == Status.UNDER_LIMIT
+        assert resps[1].remaining == 4
+    finally:
+        c.stop()
