@@ -1,0 +1,68 @@
+"""Shared asyncio batching primitives for the serving tier.
+
+Both micro-batchers — the device batcher (serve/batcher.py) and the peer
+forwarding client (serve/peers.py) — coalesce queued work the same way:
+the first item blocks, everything already enqueued drains immediately,
+then an optional fixed window (the reference's BatchWait semantics,
+peers.go:143-172) collects stragglers. The collect loop and its
+cancellation-race handling live here so a fix lands in one place.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+
+async def pop_with_deadline(queue: "asyncio.Queue", timeout: float):
+    """queue.get bounded by `timeout`; None on expiry. Race-safe where
+    bare `wait_for(queue.get(), ...)` is not: when the window closes (or
+    the caller is cancelled) just as an item arrives, the item is
+    returned / handed back instead of silently dropped — a dropped
+    item's caller would await its future forever. No await happens in the
+    exception paths: while the getter is still PENDING, Queue.get keeps
+    the item in the queue (it only pops at get_nowait after its waiter
+    fires), so cancelling a pending getter loses nothing; only a DONE
+    getter holds an item, and that is recovered synchronously."""
+    getter = asyncio.ensure_future(queue.get())
+    try:
+        return await asyncio.wait_for(asyncio.shield(getter), timeout)
+    except asyncio.TimeoutError:
+        if getter.done() and not getter.cancelled():
+            return getter.result()  # raced: completed as the window shut
+        getter.cancel()
+        return None
+    except asyncio.CancelledError:
+        if getter.done() and not getter.cancelled():
+            # hand the raced item back for the owner's cancel-drain loop
+            queue.put_nowait(getter.result())
+        else:
+            getter.cancel()
+        raise
+
+
+async def collect_batch(
+    queue: "asyncio.Queue", limit: int, wait: float, into: list
+) -> list:
+    """Collect one coalesced batch INTO the caller's list (so a cancel
+    mid-collect leaves the partial batch visible to the caller's drain
+    handler — a local list would be lost with the exception). Blocks for
+    the first item, drains everything already enqueued, then waits out
+    the optional `wait` window for stragglers."""
+    into.append(await queue.get())
+    while len(into) < limit:
+        try:
+            into.append(queue.get_nowait())
+        except asyncio.QueueEmpty:
+            break
+    if wait > 0:
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + wait
+        while len(into) < limit:
+            timeout = deadline - loop.time()
+            if timeout <= 0:
+                break
+            item = await pop_with_deadline(queue, timeout)
+            if item is None:
+                break
+            into.append(item)
+    return into

@@ -28,6 +28,7 @@ from typing import List, Optional, Sequence, Tuple
 
 from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
 from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve.aio import collect_batch
 
 
 class DeviceBatcher:
@@ -88,37 +89,20 @@ class DeviceBatcher:
         await fut
 
     async def _run(self) -> None:
-        loop = asyncio.get_running_loop()
         while True:
-            item = await self._queue.get()
-            batch: List[Tuple] = [item]
+            batch: List[Tuple] = []
             try:
-                # Opportunistic drain: everything already enqueued rides
-                # this launch. While the backend is busy in _flush, new
-                # arrivals accumulate in the queue, so batches grow with
-                # load on their own ("batch while busy") and a solo
-                # request never waits.
-                while len(batch) < self.batch_limit:
-                    try:
-                        batch.append(self._queue.get_nowait())
-                    except asyncio.QueueEmpty:
-                        break
-                # Optional fixed window (reference BatchWait semantics,
-                # peers.go:143-172) for staggered arrivals while idle.
-                if self.batch_wait > 0:
-                    deadline = loop.time() + self.batch_wait
-                    while len(batch) < self.batch_limit:
-                        timeout = deadline - loop.time()
-                        if timeout <= 0:
-                            break
-                        try:
-                            batch.append(
-                                await asyncio.wait_for(
-                                    self._queue.get(), timeout
-                                )
-                            )
-                        except asyncio.TimeoutError:
-                            break
+                # Everything already enqueued rides this launch; while
+                # the backend is busy in _flush, new arrivals accumulate
+                # in the queue, so batches grow with load on their own
+                # ("batch while busy") and a solo request only waits the
+                # optional batch_wait window. The collect runs INSIDE
+                # the try (and is cancellation-race-safe, serve/aio.py):
+                # a cancel must reach the drain handler below with every
+                # collected item visible, or a caller would hang.
+                await collect_batch(
+                    self._queue, self.batch_limit, self.batch_wait, batch
+                )
                 await self._flush(batch)
             except asyncio.CancelledError:
                 # stop() anywhere in the collect/flush path: every caller

@@ -27,6 +27,7 @@ from gubernator_tpu.api.grpc_glue import PeersV1Stub
 from gubernator_tpu.api.proto.gen import peers_pb2
 from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.core.hashing import ring_hash
+from gubernator_tpu.serve.aio import collect_batch
 from gubernator_tpu.serve.config import BehaviorConfig
 
 
@@ -48,8 +49,10 @@ class PeerClient:
             asyncio.Queue()
         )
         self._flusher: Optional[asyncio.Task] = None
+        self._closed = False
 
     def connect(self) -> None:
+        self._closed = False  # (re)opening
         if self.channel is None:
             # grpc.aio dials lazily and accepts any string, so validate
             # the target's SYNTAX eagerly. This mirrors the reference,
@@ -68,6 +71,11 @@ class PeerClient:
             self._flusher = asyncio.ensure_future(self._run())
 
     async def close(self) -> None:
+        # before cancelling the flusher: an enqueue AFTER its cancel-time
+        # queue drain would land in a queue nothing reads — the flag makes
+        # late forwards (a caller holding this peer across set_peers)
+        # fail fast instead
+        self._closed = True
         if self._flusher is not None:
             self._flusher.cancel()
             try:
@@ -84,6 +92,10 @@ class PeerClient:
     async def get_peer_rate_limit(self, r: RateLimitReq) -> RateLimitResp:
         """Forward one request; batches unless NO_BATCHING
         (reference peers.go:73-90)."""
+        if self._closed:
+            raise RuntimeError(
+                f"peer client for '{self.host}' is closed"
+            )
         if r.behavior in (Behavior.BATCHING, Behavior.GLOBAL):
             fut: asyncio.Future = asyncio.get_running_loop().create_future()
             self._queue.put_nowait((r, fut))
@@ -130,29 +142,32 @@ class PeerClient:
         configured window (batch_wait=0 disables even that)."""
         while True:
             batch: List[Tuple[RateLimitReq, asyncio.Future]] = []
-            item = await self._queue.get()
-            batch.append(item)
-            while len(batch) < self.conf.batch_limit:
-                try:
-                    batch.append(self._queue.get_nowait())
-                except asyncio.QueueEmpty:
-                    break
-            if self.conf.batch_wait > 0:
-                deadline = (
-                    asyncio.get_running_loop().time() + self.conf.batch_wait
+            try:
+                await collect_batch(
+                    self._queue,
+                    self.conf.batch_limit,
+                    self.conf.batch_wait,
+                    batch,
                 )
-                while len(batch) < self.conf.batch_limit:
-                    timeout = deadline - asyncio.get_running_loop().time()
-                    if timeout <= 0:
-                        break
+                await self._send_batch(batch)
+            except asyncio.CancelledError:
+                # close() (e.g. set_peers replacing this peer) mid-collect
+                # or mid-send: every caller parked on a queued future gets
+                # an error, never a hang
+                exc = RuntimeError(
+                    f"peer client for '{self.host}' closed mid-batch"
+                )
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                while True:
                     try:
-                        item = await asyncio.wait_for(
-                            self._queue.get(), timeout=timeout
-                        )
-                    except asyncio.TimeoutError:
+                        _, fut = self._queue.get_nowait()
+                    except asyncio.QueueEmpty:
                         break
-                    batch.append(item)
-            await self._send_batch(batch)
+                    if not fut.done():
+                        fut.set_exception(exc)
+                raise
 
     async def _send_batch(self, batch) -> None:
         reqs = [r for r, _ in batch]

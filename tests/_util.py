@@ -1,0 +1,15 @@
+"""Shared test helpers."""
+
+import socket
+
+
+def free_ports(n):
+    """n distinct ephemeral localhost ports (bind-then-release)."""
+    socks = [socket.socket() for _ in range(n)]
+    try:
+        for s in socks:
+            s.bind(("127.0.0.1", 0))
+        return [s.getsockname()[1] for s in socks]
+    finally:
+        for s in socks:
+            s.close()

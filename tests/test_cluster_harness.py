@@ -16,15 +16,7 @@ from gubernator_tpu.api.grpc_glue import V1Stub
 from gubernator_tpu.api.proto.gen import gubernator_pb2
 
 
-def _free_ports(n):
-    socks = [socket.socket() for _ in range(n)]
-    try:
-        for s in socks:
-            s.bind(("127.0.0.1", 0))
-        return [s.getsockname()[1] for s in socks]
-    finally:
-        for s in socks:
-            s.close()
+from _util import free_ports as _free_ports
 
 
 def test_start_serves_and_stop_terminates():

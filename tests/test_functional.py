@@ -434,17 +434,7 @@ def test_dead_owner_forward_fails_per_item():
     per-item error response; co-batched keys owned by live nodes decide
     normally. The reference fans a batch send error back to every
     waiting request the same way (peers.go:183-195)."""
-    import socket
-
-    def free_ports(n):
-        socks = [socket.socket() for _ in range(n)]
-        try:
-            for s in socks:
-                s.bind(("127.0.0.1", 0))
-            return [s.getsockname()[1] for s in socks]
-        finally:
-            for s in socks:
-                s.close()
+    from _util import free_ports
 
     addresses = [f"127.0.0.1:{p}" for p in free_ports(3)]
     c = LocalCluster(addresses)  # exact backend: fast start/stop
