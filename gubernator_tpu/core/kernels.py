@@ -39,6 +39,8 @@ Data-movement design (the performance core):
   group-ANY flags, are each individually faster in isolation but slower
   in-kernel) — the associative scan's log-steps fuse with surrounding
   elementwise work while reduce-window cumsum lowering does not.
+  Likewise the static per-way select chains below beat a
+  jnp.take_along_axis gather along the way axis by ~15% whole-kernel.
 
 Intra-batch duplicate keys
 --------------------------
