@@ -28,8 +28,21 @@ class LocalCluster:
         backend_factory: Optional[Callable[[], object]] = None,
         global_sync_wait: float = 0.05,  # fast gossip for tests
         device_batch_wait: float = 0.0,
+        http_addresses: Optional[Sequence[str]] = None,
     ):
+        """`http_addresses` (parallel to `addresses`) additionally serves
+        each node's HTTP JSON gateway — the harness default is gRPC-only
+        like the reference's (cluster.go)."""
         self.addresses = list(addresses)
+        self.http_addresses = (
+            list(http_addresses) if http_addresses else [""] * len(addresses)
+        )
+        if len(self.http_addresses) != len(self.addresses):
+            # zip would silently truncate and leave nodes never started
+            raise ValueError(
+                f"http_addresses ({len(self.http_addresses)}) must match "
+                f"addresses ({len(self.addresses)})"
+            )
         self.servers: List[Server] = []
         self._backend_factory = backend_factory
         self._global_sync_wait = global_sync_wait
@@ -77,10 +90,10 @@ class LocalCluster:
             raise failure[0]
 
     async def _start_all(self) -> None:
-        for addr in self.addresses:
+        for addr, http_addr in zip(self.addresses, self.http_addresses):
             conf = ServerConfig(
                 grpc_address=addr,
-                http_address="",  # gRPC only in the harness
+                http_address=http_addr,
                 advertise_address=addr,
                 behaviors=BehaviorConfig(
                     global_sync_wait=self._global_sync_wait

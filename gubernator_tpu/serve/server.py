@@ -235,24 +235,44 @@ class Server:
     async def _http_get_rate_limits(self, request: web.Request):
         try:
             body = await request.json()
-        except json.JSONDecodeError:
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            # JSONDecodeError for bad JSON; UnicodeDecodeError for a
+            # non-UTF-8 body (raised by aiohttp's .text() underneath)
             return web.json_response({"error": "invalid json"}, status=400)
-        reqs = []
-        for item in body.get("requests", []):
-            pb = gubernator_pb2.RateLimitReq(
-                name=item.get("name", ""),
-                unique_key=item.get("uniqueKey", item.get("unique_key", "")),
-                hits=int(item.get("hits", 0)),
-                limit=int(item.get("limit", 0)),
-                duration=int(item.get("duration", 0)),
-                algorithm=_enum_val(
-                    gubernator_pb2.Algorithm, item.get("algorithm", 0)
-                ),
-                behavior=_enum_val(
-                    gubernator_pb2.Behavior, item.get("behavior", 0)
-                ),
+        # shape-validate before field access: a JSON array or scalar body
+        # (or a non-list "requests") must be a 400, not an unhandled
+        # AttributeError turned 500
+        if not isinstance(body, dict) or not isinstance(
+            body.get("requests", []), list
+        ):
+            return web.json_response(
+                {"error": "body must be an object with a 'requests' list"},
+                status=400,
             )
-            reqs.append(convert.req_from_pb(pb))
+        reqs = []
+        try:
+            for item in body.get("requests", []):
+                pb = gubernator_pb2.RateLimitReq(
+                    name=item.get("name", ""),
+                    unique_key=item.get(
+                        "uniqueKey", item.get("unique_key", "")
+                    ),
+                    hits=int(item.get("hits", 0)),
+                    limit=int(item.get("limit", 0)),
+                    duration=int(item.get("duration", 0)),
+                    algorithm=_enum_val(
+                        gubernator_pb2.Algorithm, item.get("algorithm", 0)
+                    ),
+                    behavior=_enum_val(
+                        gubernator_pb2.Behavior, item.get("behavior", 0)
+                    ),
+                )
+                reqs.append(convert.req_from_pb(pb))
+        except (AttributeError, TypeError, ValueError) as e:
+            # non-object items, non-numeric int64 fields, bad enum names
+            return web.json_response(
+                {"error": f"invalid request item: {e}"}, status=400
+            )
         try:
             resps = await self.instance.get_rate_limits(reqs)
         except BatchTooLargeError as e:

@@ -1,0 +1,108 @@
+"""HTTP JSON gateway tests: the grpc-gateway-compatible REST surface.
+
+The reference exposes `POST /v1/GetRateLimits` and `GET /v1/HealthCheck`
+through grpc-gateway (reference gubernator.pb.gw.go) plus `/metrics`;
+this drives the aiohttp twin (serve/server.py) over real sockets —
+field-name conversion (camelCase), string-encoded int64s, per-item
+errors, malformed-body handling, and the observability routes.
+"""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from _util import free_ports
+from gubernator_tpu.cluster import LocalCluster
+
+
+@pytest.fixture(scope="module")
+def http_node():
+    (g, h) = free_ports(2)
+    c = LocalCluster(
+        [f"127.0.0.1:{g}"], http_addresses=[f"127.0.0.1:{h}"]
+    )
+    c.start()
+    yield f"http://127.0.0.1:{h}"
+    c.stop()
+
+
+def _post(base, path, body, timeout=10):
+    req = urllib.request.Request(
+        base + path,
+        body if isinstance(body, bytes) else json.dumps(body).encode(),
+        {"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_get_rate_limits_json_round_trip(http_node):
+    body = {
+        "requests": [
+            {
+                "name": "gw",
+                "uniqueKey": "account:7",  # camelCase, as grpc-gateway
+                "hits": "1",  # string int64, as grpc-gateway emits
+                "limit": 2,
+                "duration": 60000,
+                "algorithm": "TOKEN_BUCKET",
+            }
+        ]
+    }
+    r1 = _post(http_node, "/v1/GetRateLimits", body)["responses"][0]
+    assert r1["status"] == "UNDER_LIMIT"
+    assert r1["remaining"] == "1"  # int64s come back as strings
+    assert r1["limit"] == "2"
+    r2 = _post(http_node, "/v1/GetRateLimits", body)["responses"][0]
+    r3 = _post(http_node, "/v1/GetRateLimits", body)["responses"][0]
+    assert (r2["remaining"], r3["status"]) == ("0", "OVER_LIMIT")
+
+
+def test_per_item_validation_errors(http_node):
+    body = {
+        "requests": [
+            {"name": "", "uniqueKey": "k", "hits": 1, "limit": 5,
+             "duration": 1000},
+            {"name": "gw2", "uniqueKey": "", "hits": 1, "limit": 5,
+             "duration": 1000},
+            {"name": "gw2", "uniqueKey": "ok", "hits": 1, "limit": 5,
+             "duration": 1000},
+        ]
+    }
+    out = _post(http_node, "/v1/GetRateLimits", body)["responses"]
+    assert "namespace" in out[0]["error"]
+    assert "unique_key" in out[1]["error"]
+    assert out[2]["error"] == ""
+
+
+def test_malformed_body_is_client_error(http_node):
+    for payload in (
+        b"{not json",
+        b"[]",
+        b'{"requests": "nope"}',
+        b'{"requests": [42]}',
+        b'{"requests": [{"name": "a", "uniqueKey": "b", "hits": "zz"}]}',
+        b"\xff\xfe\x00bad utf8",
+    ):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _post(http_node, "/v1/GetRateLimits", payload)
+        assert 400 <= e.value.code < 500, payload
+
+
+def test_health_and_metrics_routes(http_node):
+    with urllib.request.urlopen(
+        http_node + "/v1/HealthCheck", timeout=10
+    ) as r:
+        h = json.loads(r.read())
+    assert h["status"] == "healthy"
+    assert h["peerCount"] == 1
+    with urllib.request.urlopen(http_node + "/metrics", timeout=10) as r:
+        text = r.read().decode()
+    assert "grpc_request_duration_milliseconds" in text
+    with urllib.request.urlopen(
+        http_node + "/v1/debug/stats", timeout=10
+    ) as r:
+        stats = json.loads(r.read())
+    assert "distinct_keys_estimate" in json.dumps(stats)
