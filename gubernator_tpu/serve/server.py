@@ -166,6 +166,17 @@ class Server:
     # -- lifecycle ----------------------------------------------------------
 
     async def start(self) -> None:
+        try:
+            await self._start_inner()
+        except Exception:
+            # a partial start (bind failure, bad static peer, edge socket
+            # in use, ...) must not leak the instance's already-running
+            # tasks: the caller's loop may close next, and a still-pending
+            # flusher dies with "Task was destroyed but it is pending"
+            await self.stop()
+            raise
+
+    async def _start_inner(self) -> None:
         warmup = getattr(self.backend, "warmup", None)
         if warmup is not None:
             # compile every device-batch bucket before accepting traffic;
