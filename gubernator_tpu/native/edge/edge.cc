@@ -735,6 +735,10 @@ int main(int argc, char** argv) {
   // bind BEFORE spawning the batcher's worker threads: returning with
   // joinable threads in Batcher's vector would std::terminate
   int srv = socket(AF_INET, SOCK_STREAM, 0);
+  if (srv < 0) {
+    perror("socket");
+    return 1;
+  }
   int one = 1;
   setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
   sockaddr_in addr{};

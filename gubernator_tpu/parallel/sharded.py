@@ -35,6 +35,7 @@ carries the host-level ring's.
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Optional, Sequence, Tuple
 
 import jax
@@ -62,6 +63,27 @@ from gubernator_tpu.core.kernels import (
 from gubernator_tpu.core.store import Store, StoreConfig, mix64, new_store
 
 _SHARD_SALT = np.uint64(0xA24BAED4963EE407)
+
+_log = logging.getLogger("gubernator.sharded")
+_warned_ladder_overflow = False
+
+
+def _warn_ladder_overflow(top: int, n: int) -> None:
+    """One-time attribution for the multi-second stall a first oversized
+    batch causes: extending the ladder compiles a fresh XLA program
+    mid-call (library-only path — the serving batcher caps batches at
+    the ladder top, so it never gets here)."""
+    global _warned_ladder_overflow
+    if not _warned_ladder_overflow:
+        _warned_ladder_overflow = True
+        _log.warning(
+            "batch of %d exceeds the configured ladder top %d: extending "
+            "the rung ladder triggers a fresh XLA compilation (tens of "
+            "seconds on TPU) for this and each new overflow size — size "
+            "the `buckets` ladder to your peak batch to avoid the stall",
+            n,
+            top,
+        )
 
 
 def owner_of(key_hash: jax.Array, n_shards: int) -> jax.Array:
@@ -264,6 +286,8 @@ def pad_request_sharded(
     # caller's batch exceeds max(buckets) — unreachable through the
     # serving tier (the batcher caps batches at the ladder top) but
     # supported for library callers: extend, don't raise
+    if maxc > max(buckets):
+        _warn_ladder_overflow(max(buckets), maxc)
     B_sub = choose_bucket(extend_ladder(buckets, maxc), maxc)
 
     # src[s, j]: index into the sorted arrays for padded cell (s, j) —
@@ -557,6 +581,8 @@ class MeshEngine:
         from gubernator_tpu.api.types import millisecond_now
 
         self._engine_now(millisecond_now() if now is None else now)
+        if n > max(self.buckets):
+            _warn_ladder_overflow(max(self.buckets), n)
         kh, lim, rem, rst, over, valid = pad_to_bucket(
             extend_ladder(self.buckets, n),
             n,
@@ -586,6 +612,8 @@ class MeshEngine:
         if algo is None:
             algo = np.zeros(n, np.int32)
         e_now = self._engine_now(now)
+        if n > max(self.buckets):
+            _warn_ladder_overflow(max(self.buckets), n)
         req, _order = pad_request_sorted(
             extend_ladder(self.buckets, n),
             self.config.slots,

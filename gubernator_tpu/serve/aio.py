@@ -22,7 +22,14 @@ async def pop_with_deadline(queue: "asyncio.Queue", timeout: float):
     exception paths: while the getter is still PENDING, Queue.get keeps
     the item in the queue (it only pops at get_nowait after its waiter
     fires), so cancelling a pending getter loses nothing; only a DONE
-    getter holds an item, and that is recovered synchronously."""
+    getter holds an item, and that is recovered synchronously.
+
+    CAVEAT: the cancel-path hand-back uses put_nowait, which appends at
+    the TAIL — the raced item loses its FIFO position behind later
+    arrivals. Both current callers only cancel during teardown, where
+    every queued item is failed regardless of order; a future caller
+    that cancels mid-stream and cares about ordering must not reuse
+    this helper as-is."""
     getter = asyncio.ensure_future(queue.get())
     try:
         return await asyncio.wait_for(asyncio.shield(getter), timeout)

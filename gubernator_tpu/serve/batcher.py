@@ -49,12 +49,18 @@ class DeviceBatcher:
         # last backend stats snapshot, for cache_access_count deltas
         self._last_hits = 0
         self._last_misses = 0
+        # set before the flusher is cancelled: a decide()/update_globals()
+        # after stop() would otherwise enqueue into a queue no flusher
+        # reads and await a future that never resolves (same guard as
+        # PeerClient._closed)
+        self._closed = False
 
     def start(self) -> None:
         if self._task is None:
             self._task = asyncio.ensure_future(self._run())
 
     async def stop(self) -> None:
+        self._closed = True
         if self._task is not None:
             self._task.cancel()
             try:
@@ -72,6 +78,8 @@ class DeviceBatcher:
         """Submit requests; resolves when their device batch completes."""
         if not reqs:
             return []
+        if self._closed:
+            raise RuntimeError("DeviceBatcher is stopped")
         loop = asyncio.get_running_loop()
         futs = []
         for r, g in zip(reqs, gnp):
@@ -83,6 +91,8 @@ class DeviceBatcher:
     async def update_globals(self, updates) -> None:
         """Replica installs funnel through the same flusher queue so the
         backend stays single-threaded."""
+        if self._closed:
+            raise RuntimeError("DeviceBatcher is stopped")
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._queue.put_nowait(("globals", updates, fut))

@@ -207,3 +207,20 @@ def test_non_pipelined_backend_fallback(loop_run):
         await b.stop()
 
     loop_run(scenario())
+
+
+def test_decide_after_stop_raises(loop_run):
+    """A closed batcher fails fast instead of enqueueing into a queue no
+    flusher reads (the caller would await a future that never resolves)."""
+
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(be, batch_wait=0)
+        b.start()
+        await b.stop()
+        with pytest.raises(RuntimeError, match="stopped"):
+            await b.decide([_req(0)], [False])
+        with pytest.raises(RuntimeError, match="stopped"):
+            await b.update_globals([("k", RateLimitResp(limit=1))])
+
+    loop_run(scenario())
