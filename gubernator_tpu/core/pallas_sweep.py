@@ -27,20 +27,27 @@ the MXU's bf16 pass precision, so each int32 delta is split into four
 8-bit halves (each exact in bf16), matmul'd separately, and recombined
 in int32 (wrap-safe: the shifted sums reassemble delta mod 2^32).
 
-STATUS (r2, measured with a hard scalar-fetch barrier — see
-scripts/bench_hbm.py): bit-exact on v5e, cross-tile DMA prefetch added
-(tile t-1 prefetches tile t's first update chunk, so per-tile DMA issue
-latency is hidden), TILE_ROWS/CHUNK parameterized. Still ~15% slower
-than the XLA scatter at B=16k — and the measurements show WHY, which is
-the durable lesson: on this chip XLA's own elementwise pass over the
-16 MiB store runs at only ~180 GB/s effective, a bare pallas identity
-sweep costs ~260us, and the scatter's 351us is therefore ~2.6x off the
-*achievable* floor, not the ~15x the HBM spec sheet suggested. Any
-full-store sweep pays >=260us of streaming before doing work, so at
-production load factors (touched rows ~ half the store) the scatter's
-touched-rows-only traffic wins structurally. The sweep only pays off
-when updates are dense relative to the store (B approaching the bucket
-count); it stays the opt-in GUBER_WRITEBACK=sweep path.
+STATUS (r3, measured on v5e — scripts/bench_sweep_regime.py): bit-exact,
+cross-tile DMA prefetch in place, TILE_ROWS/CHUNK parameterized. The r2
+lesson stands in spirit for the flagship regime: any full-store sweep
+pays the ~260us streaming floor before doing work (XLA's elementwise
+pass over the 16 MiB store runs at only ~180 GB/s effective), and below
+density ~2 the two paths trade within noise (sweep +7% at density 0.5,
+-23% at density 1.0 — regime-dependent, not a clean win either way).
+The dense regime the r2 note hypothesized is REAL and now measured:
+
+  buckets    B      density  scatter  sweep   speedup
+  32768    16384     0.5     364us    341us   1.07x
+  32768    32768     1.0     372us    486us   0.77x
+   8192    16384     2       320us    315us   1.02x
+   4096    16384     4       342us    299us   1.14x
+   2048    16384     8       268us    209us   1.28x
+   4096    32768     8       482us    361us   1.34x
+
+Small-store / big-batch deployments (B >= ~4x bucket count) get
+1.14-1.34x from the sweep, so GUBER_WRITEBACK=auto (the default,
+kernels._use_sweep_writeback) selects it exactly there; =sweep/=scatter
+force a path.
 
 Because the update stream is bucket-sorted, rows DMA'd beyond the tile's
 [lo, hi) range map outside [0, TILE_ROWS) and one-hot to zero — the

@@ -1,0 +1,110 @@
+"""Writeback regime grid: XLA scatter vs pallas sweep across store
+density (B updates / buckets rows).
+
+The sweep module's STATUS note claims the sweep "only pays off when
+updates are dense relative to the store (B approaching the bucket
+count)" — this script measures that claim instead of asserting it: for
+each (buckets, B) the measured op is kernels._writeback_delta_add's
+final step (way-disjoint delta-row add, sorted indices), same harness as
+scripts/bench_writeback.py. Prints one JSON line per regime to stdout.
+"""
+
+import functools
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def run_regime(buckets: int, B: int, S: int = 512):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from gubernator_tpu.core.pallas_sweep import _apply_inline
+
+    rng = np.random.default_rng(5)
+    data = rng.integers(
+        -(2**31), 2**31 - 1, (buckets, 128), dtype=np.int64
+    ).astype(np.int32)
+    # B sorted updates over the bucket space, way-disjoint within a bucket
+    # (the writeback contract); cap way index at 16
+    bkt = np.sort(rng.integers(0, buckets, B)).astype(np.int32)
+    drow = np.zeros((B, 128), np.int32)
+    run = 0
+    vals = rng.integers(-1000, 1000, (B, 8)).astype(np.int32)
+    for i in range(B):
+        run = run + 1 if i and bkt[i] == bkt[i - 1] else 0
+        w = run % 16
+        drow[i, w * 8 : (w + 1) * 8] = vals[i]
+
+    want = data.copy()
+    np.add.at(want, bkt, drow)
+    d_bkt = jnp.asarray(bkt)
+    d_drow = jnp.asarray(drow)
+
+    def scatter_apply(x, bkt, drow):
+        return x.at[bkt].add(drow, indices_are_sorted=True)
+
+    out = {"buckets": buckets, "B": B, "density": round(B / buckets, 3)}
+    for name, fn in (
+        ("scatter", scatter_apply),
+        ("sweep", lambda x, bkt, drow: _apply_inline(x, bkt, drow)),
+    ):
+        got = jax.jit(fn)(jnp.asarray(data), d_bkt, d_drow)
+        np.testing.assert_array_equal(np.asarray(got), want, err_msg=name)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def steps(x, bkt, drow, fn=fn):
+            def body(i, x):
+                return fn(x, bkt, drow)
+
+            return lax.fori_loop(0, S, body, x)
+
+        x = jnp.asarray(data)
+        x = steps(x, d_bkt, d_drow)
+        jax.block_until_ready(x)
+        times = []
+        for _ in range(3):
+            t = time.monotonic()
+            x = steps(x, d_bkt, d_drow)
+            jax.block_until_ready(x)
+            times.append(time.monotonic() - t)
+        us = min(times) / S * 1e6
+        out[name] = round(us, 1)
+        log(f"  {name}: {us:.1f} us/step (B={B}, store {buckets}x128)")
+    out["sweep_speedup"] = round(out["scatter"] / out["sweep"], 2)
+    print(json.dumps(out), flush=True)
+    return out
+
+
+def main():
+    import jax
+
+    import gubernator_tpu  # noqa: F401 (x64 on)
+
+    dev = jax.devices()[0]
+    log(f"device: {dev.platform} ({dev.device_kind})")
+    grid = [
+        (1 << 15, 16384),  # flagship-ish: density 0.5 (STATUS regime)
+        (1 << 15, 32768),  # density 1.0 at the flagship store
+        (8192, 16384),  # density 2
+        (4096, 16384),  # density 4
+        (2048, 16384),  # density 8
+        (4096, 32768),  # density 8, bigger batch
+    ]
+    for buckets, B in grid:
+        log(f"regime buckets={buckets} B={B}")
+        run_regime(buckets, B)
+
+
+if __name__ == "__main__":
+    main()

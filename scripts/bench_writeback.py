@@ -1,93 +1,29 @@
 """Writeback microbench on the real device: XLA scatter-add vs the pallas
-store sweep (GUBER_WRITEBACK=sweep), plus bit-exactness of the compiled
-sweep. Prints one line per variant to stderr and a JSON summary to stdout.
+store sweep at the flagship store geometry, plus bit-exactness of the
+compiled sweep. Prints one JSON line to stdout.
 
-The measured op is exactly kernels._writeback_delta_add's final step: add
-B way-disjoint delta rows into their (sorted) buckets of the
-[buckets, 128] store.
+Thin wrapper over scripts/bench_sweep_regime.py's shared harness (one
+timing methodology, measured once per fix) pinned at the single regime
+the r2 STATUS note measured: buckets=32768, B=16384. Run
+bench_sweep_regime.py for the full density grid.
 """
 
-import json
 import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-
-def log(*a):
-    print(*a, file=sys.stderr, flush=True)
+from bench_sweep_regime import log, run_regime  # noqa: E402
 
 
 def main():
-    import functools
-
     import jax
-    import jax.numpy as jnp
-    from jax import lax
 
     import gubernator_tpu  # noqa: F401 (x64 on)
-    from gubernator_tpu.core.pallas_sweep import _apply_inline
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
-
-    buckets, B, S = 1 << 15, 16384, 512
-    rng = np.random.default_rng(5)
-    data = rng.integers(-2**31, 2**31 - 1, (buckets, 128), dtype=np.int64
-                        ).astype(np.int32)
-    # ~16k zipf-ish updates over the bucket space, sorted, way-disjoint
-    bkt = np.sort(rng.integers(0, buckets, B)).astype(np.int32)
-    drow = np.zeros((B, 128), np.int32)
-    run = 0
-    vals = rng.integers(-1000, 1000, (B, 8)).astype(np.int32)
-    for i in range(B):
-        run = run + 1 if i and bkt[i] == bkt[i - 1] else 0
-        w = run % 16
-        drow[i, w * 8:(w + 1) * 8] = vals[i]
-
-    want = data.copy()
-    np.add.at(want, bkt, drow)
-
-    d_bkt = jnp.asarray(bkt)
-    d_drow = jnp.asarray(drow)
-
-    def scatter_apply(x, bkt, drow):
-        return x.at[bkt].add(drow, indices_are_sorted=True)
-
-    variants = {
-        "scatter": scatter_apply,
-        "sweep": lambda x, bkt, drow: _apply_inline(x, bkt, drow),
-    }
-    results = {}
-    for name, fn in variants.items():
-        # correctness (single step, compiled)
-        got = jax.jit(fn)(jnp.asarray(data), d_bkt, d_drow)
-        np.testing.assert_array_equal(np.asarray(got), want, err_msg=name)
-
-        @functools.partial(jax.jit, donate_argnums=(0,))
-        def steps(x, bkt, drow, fn=fn):
-            def body(i, x):
-                return fn(x, bkt, drow)
-            return lax.fori_loop(0, S, body, x)
-
-        x = jnp.asarray(data)
-        x = steps(x, d_bkt, d_drow)  # compile
-        jax.block_until_ready(x)
-        times = []
-        for _ in range(5):
-            t = time.monotonic()
-            x = steps(x, d_bkt, d_drow)
-            jax.block_until_ready(x)
-            times.append(time.monotonic() - t)
-        us = min(times) / S * 1e6
-        results[name] = round(us, 1)
-        log(f"{name}: {us:.1f} us/step (B={B}, store {buckets}x128)")
-
-    results["speedup"] = round(results["scatter"] / results["sweep"], 2)
-    print(json.dumps(results), flush=True)
+    run_regime(1 << 15, 16384, S=512)
 
 
 if __name__ == "__main__":

@@ -448,3 +448,26 @@ def test_pallas_sweep_matches_scatter():
         interpret=True,
     )
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_writeback_auto_selection(monkeypatch):
+    """GUBER_WRITEBACK=auto (default) picks the pallas sweep exactly in
+    its measured winning regime (B >= 4x bucket count, see
+    scripts/bench_sweep_regime.py) and the scatter elsewhere; explicit
+    values force a path."""
+    from gubernator_tpu.core.kernels import _use_sweep_writeback
+
+    monkeypatch.delenv("GUBER_WRITEBACK", raising=False)
+    # flagship store (32k buckets, 32k batch): density 1 -> scatter
+    assert not _use_sweep_writeback(1 << 15, 128, 1 << 15)
+    # dense small-store regime: density >= 4 -> sweep
+    assert _use_sweep_writeback(2048, 128, 16384)
+    assert _use_sweep_writeback(4096, 128, 32768)
+    # shape constraints still gate the sweep even in its regime
+    assert not _use_sweep_writeback(2048, 64, 16384)  # W != 128
+    assert not _use_sweep_writeback(100, 128, 16384)  # buckets % 128
+
+    monkeypatch.setenv("GUBER_WRITEBACK", "scatter")
+    assert not _use_sweep_writeback(2048, 128, 16384)
+    monkeypatch.setenv("GUBER_WRITEBACK", "sweep")
+    assert _use_sweep_writeback(1 << 15, 128, 16384)
