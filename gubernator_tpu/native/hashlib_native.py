@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import ctypes
 import pathlib
+import threading
 from typing import List
 
 import numpy as np
@@ -307,3 +308,194 @@ def presort_sharded(key_hash: np.ndarray, buckets: int, n_shards: int):
         counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
     )
     return order, counts
+
+
+try:
+    _u64p = ctypes.POINTER(ctypes.c_uint64)
+    _i64p = ctypes.POINTER(ctypes.c_int64)
+    _u8p = ctypes.POINTER(ctypes.c_uint8)
+    _lib.guber_prep_sharded.restype = ctypes.c_int64
+    _lib.guber_prep_sharded.argtypes = [
+        _u64p, _i64p, _i64p, _i64p, _i32p, _u8p,          # inputs
+        ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64,  # n, buckets, ns
+        _i64p, ctypes.c_int64, ctypes.c_int64,            # rungs, n_rungs, g_override
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,  # clips
+        _i32p, _i64p, _i64p,                              # order, counts, picked
+        _u64p, _i32p, _i32p, _i32p, _i32p, _u8p, _u8p,    # fields
+        _u64p, _i32p, _i32p, _u8p, _i32p,                 # groups
+        _i64p,                                            # take_idx
+    ]
+    _lib.guber_prep_threads.restype = ctypes.c_int64
+    _lib.guber_unflatten_resp.argtypes = [
+        _i32p, _i32p, _i64p, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, _i32p,
+    ]
+    _HAS_PREP = True
+except AttributeError:
+    _HAS_PREP = False
+
+
+def prep_threads() -> int:
+    """Effective prep thread-pool width (GUBER_PREP_THREADS env,
+    default hardware_concurrency; resolved once per process)."""
+    if not _HAS_PREP:
+        return 1
+    return int(_lib.guber_prep_threads())
+
+
+class _PrepBuffers:
+    """Reusable output buffers for prep_sharded, flip-flopped across
+    calls. Fresh np.empty per call costs ~0.5-1ms of soft page faults at
+    32k batches (every large allocation is a new zeroed mmap); reusing
+    warm pages removes that entirely. TWO generations alternate so the
+    pipelined engine (at most two batches in flight, submits serialized
+    — serve/batcher.py) never sees generation k's arrays overwritten
+    before its wait: generation k is reused no earlier than submit k+2,
+    by which point fetch k has completed."""
+
+    _SPECS = (
+        ("order", np.int32), ("counts", np.int64), ("take", np.int64),
+        ("kh", np.uint64), ("hits", np.int32), ("limit", np.int32),
+        ("dur", np.int32), ("algo", np.int32), ("gnp", np.uint8),
+        ("valid", np.uint8), ("gid", np.int32), ("gkh", np.uint64),
+        ("glead", np.int32), ("gend", np.int32), ("gvalid", np.uint8),
+    )
+
+    def __init__(self):
+        self._gens = [{}, {}]
+        self._flip = 0
+
+    def take(self, sizes: dict) -> dict:
+        gen = self._gens[self._flip]
+        self._flip ^= 1
+        out = {}
+        for name, dtype in self._SPECS:
+            need = sizes[name]
+            cur = gen.get(name)
+            if cur is None or cur.shape[0] < need:
+                cur = np.empty(need, dtype)
+                gen[name] = cur
+            out[name] = cur
+        return out
+
+
+class _PrepBuffersTL(threading.local):
+    """Per-thread buffer sets: K concurrent prep workers (the batcher's
+    prep pool) each flip-flop their own generations, so one worker's
+    in-flight batch is never overwritten by another's call."""
+
+    def __init__(self):
+        self.bufs = _PrepBuffers()
+
+
+_prep_buffers_tl = _PrepBuffersTL()
+
+
+def prep_sharded(
+    key_hash, hits, limit, duration, algo, gnp,
+    buckets: int, n_shards: int, rungs, g_override: int,
+    lo: int, hi: int, dlo: int, dhi: int,
+):
+    """One-call sharded batch prep (guber_prep_sharded): presort by
+    (owner, bucket, fingerprint), duplicate-key group structure with
+    engine.build_groups conventions, and all six clipped+padded device
+    fields as [n_shards, B_sub] arrays. Returns
+    (order, counts, take_idx, fields_dict, groups_dict, B_sub, G_sub).
+    Raises ValueError when g_override can't hold a shard's group count
+    (mirrors pad_request_sharded's numpy path).
+
+    LIFETIME: returned arrays are views into flip-flopped reusable
+    buffers — valid until the SECOND-next prep_sharded call (matches the
+    pipelined engine's two-in-flight bound). Callers keeping results
+    longer must copy."""
+    if not _HAS_PREP:
+        raise AttributeError(
+            "libguberhash.so predates guber_prep_sharded; rebuild with "
+            "make -C gubernator_tpu/native"
+        )
+    kh = np.ascontiguousarray(key_hash, np.uint64)
+    hits = np.ascontiguousarray(hits, np.int64)
+    limit = np.ascontiguousarray(limit, np.int64)
+    duration = np.ascontiguousarray(duration, np.int64)
+    algo = np.ascontiguousarray(algo, np.int32)
+    gnp = np.ascontiguousarray(np.asarray(gnp, bool).view(np.uint8))
+    n = kh.shape[0]
+    rungs = np.ascontiguousarray(rungs, np.int64)
+    # B_sub <= smallest rung covering n (shard counts never exceed n)
+    alloc_idx = int(np.searchsorted(rungs, min(n, int(rungs[-1]))))
+    B_alloc = int(rungs[min(alloc_idx, rungs.shape[0] - 1)])
+    if g_override > 0:
+        B_alloc = max(B_alloc, int(g_override))
+
+    nb = n_shards * B_alloc
+    buf = _prep_buffers_tl.bufs.take(dict(
+        order=n, counts=n_shards, take=n,
+        kh=nb, hits=nb, limit=nb, dur=nb, algo=nb, gnp=nb, valid=nb,
+        gid=nb, gkh=nb, glead=nb, gend=nb, gvalid=nb,
+    ))
+    order = buf["order"][:n]
+    counts = buf["counts"][:n_shards]
+    picked = np.empty(2, np.int64)
+    take_idx = buf["take"][:n]
+    kh_o, hi_o, li_o, du_o = buf["kh"], buf["hits"], buf["limit"], buf["dur"]
+    al_o, gn_o, va_o, gi_o = buf["algo"], buf["gnp"], buf["valid"], buf["gid"]
+    gk_o, gl_o, ge_o, gv_o = buf["gkh"], buf["glead"], buf["gend"], buf["gvalid"]
+
+    rc = _lib.guber_prep_sharded(
+        _ptr(kh, ctypes.c_uint64), _ptr(hits, ctypes.c_int64),
+        _ptr(limit, ctypes.c_int64), _ptr(duration, ctypes.c_int64),
+        _ptr(algo, ctypes.c_int32), _ptr(gnp, ctypes.c_uint8),
+        n, ctypes.c_uint64(buckets), n_shards,
+        _ptr(rungs, ctypes.c_int64), rungs.shape[0], g_override,
+        lo, hi, dlo, dhi,
+        _ptr(order, ctypes.c_int32), _ptr(counts, ctypes.c_int64),
+        _ptr(picked, ctypes.c_int64),
+        _ptr(kh_o, ctypes.c_uint64), _ptr(hi_o, ctypes.c_int32),
+        _ptr(li_o, ctypes.c_int32), _ptr(du_o, ctypes.c_int32),
+        _ptr(al_o, ctypes.c_int32), _ptr(gn_o, ctypes.c_uint8),
+        _ptr(va_o, ctypes.c_uint8),
+        _ptr(gk_o, ctypes.c_uint64), _ptr(gl_o, ctypes.c_int32),
+        _ptr(ge_o, ctypes.c_int32), _ptr(gv_o, ctypes.c_uint8),
+        _ptr(gi_o, ctypes.c_int32),
+        _ptr(take_idx, ctypes.c_int64),
+    )
+    if rc == -2:
+        raise ValueError(
+            f"group_rung {g_override} < max shard group count"
+        )
+    if rc != 0:
+        raise RuntimeError(f"guber_prep_sharded failed: rc={rc}")
+    B, G = int(picked[0]), int(picked[1])
+
+    def view2(a, w):
+        return a[: n_shards * w].reshape(n_shards, w)
+
+    fields = dict(
+        key_hash=view2(kh_o, B), hits=view2(hi_o, B),
+        limit=view2(li_o, B), duration=view2(du_o, B),
+        algo=view2(al_o, B), gnp=view2(gn_o, B).view(bool),
+        valid=view2(va_o, B).view(bool),
+    )
+    groups = dict(
+        key_hash=view2(gk_o, G), leader_pos=view2(gl_o, G),
+        end_pos=view2(ge_o, G), valid=view2(gv_o, G).view(bool),
+        group_id=view2(gi_o, B),
+    )
+    return order, counts, take_idx, fields, groups, B, G
+
+
+def unflatten_resp(packed, order, counts, n: int) -> np.ndarray:
+    """[4, n] response columns from a mesh packed matrix
+    ([n_shards, 4*B_sub + k] int32): the native twin of
+    `out[order] = flat[take_idx]` per column."""
+    packed = np.ascontiguousarray(packed, np.int32)
+    n_shards, stride = packed.shape
+    b_sub = (stride - 2) // 4
+    counts = np.ascontiguousarray(counts, np.int64)
+    out = np.empty((4, n), np.int32)
+    _lib.guber_unflatten_resp(
+        _ptr(packed, ctypes.c_int32), _ptr(order, ctypes.c_int32),
+        _ptr(counts, ctypes.c_int64), n, n_shards, b_sub, stride,
+        _ptr(out, ctypes.c_int32),
+    )
+    return out

@@ -185,9 +185,11 @@ try:  # native radix presort with shard partitioning (guberhash.cc)
         if _hn._HAS_PRESORT_SHARDED_GROUPED
         else _np_presort_sharded_grouped
     )
+    _prep_native = _hn.prep_sharded if _hn._HAS_PREP else None
 except (ImportError, AttributeError, OSError):  # pragma: no cover
     _presort_sharded = _np_presort_sharded
     _presort_sharded_grouped = _np_presort_sharded_grouped
+    _prep_native = None
 
 
 def sub_batch_ladder(buckets: Sequence[int]) -> tuple:
@@ -272,6 +274,40 @@ def pad_request_sharded(
                 group_id=np.zeros((n_shards, B0), np.int32),
             ))
         return empty
+    if _prep_native is not None and with_groups:
+        # one-call native prep: presort + groups + marshal fused (3.6x
+        # the numpy path on one core, thread-parallel on real hosts —
+        # guberhash.cc guber_prep_sharded). Bit-identical to the numpy
+        # path below (pinned by tests/test_prep_native.py). Gated to
+        # with_groups (the decide path): only it owns the two-in-flight
+        # contract the flip-flopped prep buffers rely on.
+        from gubernator_tpu.core.engine import dense_ladder_extension
+        from gubernator_tpu.core.store import (
+            COUNTER_MAX,
+            MAX_DURATION_MS,
+            TIME_FLOOR,
+        )
+
+        rungs = np.asarray(dense_ladder_extension(buckets, n), np.int64)
+        order, counts, take_idx, fields, groups_d, B_sub, _G = (
+            _prep_native(
+                key_hash, hits, limit, duration, algo, gnp,
+                store_buckets, n_shards, rungs,
+                int(group_rung) if group_rung else 0,
+                -COUNTER_MAX, COUNTER_MAX, TIME_FLOOR, MAX_DURATION_MS,
+            )
+        )
+        if int(counts.max()) > max(buckets):
+            _warn_ladder_overflow(max(buckets), int(counts.max()))
+        req = BatchRequest(**fields)
+        return req, order, take_idx, BatchGroups(
+            key_hash=groups_d["key_hash"],
+            leader_pos=groups_d["leader_pos"],
+            end_pos=groups_d["end_pos"],
+            valid=groups_d["valid"],
+            group_id=groups_d["group_id"],
+        )
+
     if with_groups:
         order, counts, gid_g, lp_g, gcounts = _presort_sharded_grouped(
             key_hash, store_buckets, n_shards
@@ -522,7 +558,7 @@ class MeshEngine:
             self.store = rebase_jit(self.store, np.int32(delta))
         return e
 
-    def decide_arrays(
+    def decide_submit(
         self,
         key_hash: np.ndarray,
         hits: np.ndarray,
@@ -531,7 +567,13 @@ class MeshEngine:
         algo: np.ndarray,
         gnp: np.ndarray,
         now: int,
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    ):
+        """Presort/shard + dispatch one batch WITHOUT waiting — the mesh
+        sibling of TpuEngine.decide_submit. The store update threads
+        through the jitted step immediately, so the caller may prep the
+        next batch while every chip computes this one (the serving
+        batcher's pipelining; MeshBackend exposes this split). Returns
+        an opaque handle for decide_wait."""
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
         req, order, take_idx, groups = pad_request_sharded(
@@ -548,20 +590,67 @@ class MeshEngine:
         )
         B_sub = req.key_hash.shape[1]
         self.store, packed = self._step(self.store, req, groups, e_now)
+        # epoch captured at submit: a later submit may rebase before this
+        # batch's wait (same contract as TpuEngine.decide_submit)
+        return (packed, order, take_idx, n, B_sub, self.clock.epoch)
+
+    def decide_wait(
+        self, handle
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Fetch + unflatten the responses for a decide_submit handle."""
+        packed, order, take_idx, n, B_sub, epoch = handle
         packed = np.asarray(jax.device_get(packed))  # [n_shards, 4*B_sub+2]
         self.stats.hits += int(packed[:, 4 * B_sub].sum())
         self.stats.misses += int(packed[:, 4 * B_sub + 1].sum())
         self.stats.batches += 1
 
-        def unflatten(col0):
-            flat = packed[:, col0 * B_sub : (col0 + 1) * B_sub].reshape(-1)
-            out = np.empty(n, flat.dtype)
-            out[order] = flat[take_idx]
-            return out
+        if _prep_native is not None and n > 0:
+            # native one-pass unflatten of all four response columns
+            from gubernator_tpu.native.hashlib_native import unflatten_resp
 
-        status, rlimit, remaining, reset = (unflatten(c) for c in range(4))
-        reset = self.clock.from_engine(reset)
+            # per-shard counts fall out of take_idx: it is strictly
+            # increasing and cell (s, j) flattens to s*B_sub + j, so
+            # shard boundaries are one binary search each
+            bounds = np.searchsorted(
+                take_idx, np.arange(1, self.n + 1) * B_sub, side="left"
+            )
+            counts = np.diff(np.concatenate(([0], bounds))).astype(
+                np.int64
+            )
+            u = unflatten_resp(packed, order, counts, n)
+            status, rlimit, remaining, reset = u[0], u[1], u[2], u[3]
+        else:
+
+            def unflatten(col0):
+                flat = packed[
+                    :, col0 * B_sub : (col0 + 1) * B_sub
+                ].reshape(-1)
+                out = np.empty(n, flat.dtype)
+                out[order] = flat[take_idx]
+                return out
+
+            status, rlimit, remaining, reset = (
+                unflatten(c) for c in range(4)
+            )
+        r = np.asarray(reset, np.int64)
+        reset = np.where(r == 0, 0, r + epoch)
         return status, rlimit, remaining, reset
+
+    def decide_arrays(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        algo: np.ndarray,
+        gnp: np.ndarray,
+        now: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        return self.decide_wait(
+            self.decide_submit(
+                key_hash, hits, limit, duration, algo, gnp, now
+            )
+        )
 
     def update_globals(
         self,

@@ -132,26 +132,27 @@ class MeshBackend:
 
             engine = MeshEngine(store, devices=devices, buckets=buckets)
         self.engine = engine
+        if not hasattr(engine, "decide_submit"):
+            # lockstep wrappers (multihost) have no split — a None
+            # attribute makes the batcher fall back to blocking decide
+            self.decide_submit = None
+            self.decide_wait = None
 
-    def decide(self, reqs, gnp, now=None):
+    def _arrays(self, reqs, gnp):
         import numpy as np
 
-        from gubernator_tpu.api.types import millisecond_now
-
         n = len(reqs)
-        if n == 0:
-            return []
-        if now is None:
-            now = millisecond_now()
-        status, limit, remaining, reset = self.engine.decide_arrays(
+        return dict(
             key_hash=self._hash([r.hash_key() for r in reqs]),
             hits=np.fromiter((r.hits for r in reqs), np.int64, n),
             limit=np.fromiter((r.limit for r in reqs), np.int64, n),
             duration=np.fromiter((r.duration for r in reqs), np.int64, n),
             algo=np.fromiter((int(r.algorithm) for r in reqs), np.int32, n),
             gnp=np.asarray(list(gnp), bool),
-            now=now,
         )
+
+    @staticmethod
+    def _to_resps(status, limit, remaining, reset):
         return [
             RateLimitResp(
                 status=Status(int(status[i])),
@@ -159,8 +160,40 @@ class MeshBackend:
                 remaining=int(remaining[i]),
                 reset_time=int(reset[i]),
             )
-            for i in range(n)
+            for i in range(status.shape[0])
         ]
+
+    def decide(self, reqs, gnp, now=None):
+        from gubernator_tpu.api.types import millisecond_now
+
+        if len(reqs) == 0:
+            return []
+        if now is None:
+            now = millisecond_now()
+        return self._to_resps(
+            *self.engine.decide_arrays(now=now, **self._arrays(reqs, gnp))
+        )
+
+    def decide_submit(self, reqs, gnp, now=None):
+        """Shard + dispatch without waiting (MeshEngine.decide_submit):
+        gives the mesh backend the same host/device pipelining the
+        single-chip backend has — the batcher preps batch N+1 while the
+        whole mesh computes batch N. Only offered when the engine has
+        the split (the multihost lockstep wrapper does not)."""
+        from gubernator_tpu.api.types import millisecond_now
+
+        if len(reqs) == 0:
+            return None
+        if now is None:
+            now = millisecond_now()
+        return self.engine.decide_submit(
+            now=now, **self._arrays(reqs, gnp)
+        )
+
+    def decide_wait(self, handle):
+        if handle is None:
+            return []
+        return self._to_resps(*self.engine.decide_wait(handle))
 
     def update_globals(self, updates, now=None):
         np = self._np

@@ -23,6 +23,7 @@ still sees strictly serialized submits and serialized waits.
 from __future__ import annotations
 
 import asyncio
+import concurrent.futures
 import time
 from typing import List, Optional, Sequence, Tuple
 
@@ -46,6 +47,15 @@ class DeviceBatcher:
         # in-flight fetch of the previously submitted batch (pipelined
         # backends only); its task resolves that batch's futures itself
         self._pending: Optional[asyncio.Task] = None
+        # ONE dedicated submit thread (not the shared to_thread pool):
+        # the native prep keeps per-thread reusable buffers and scratch
+        # (hashlib_native._PrepBuffersTL, C++ thread_locals), so letting
+        # submits hop across the default executor's up-to-32 threads
+        # would multiply resident warm buffers by the executor width for
+        # a pipeline that never has more than two batches in flight
+        self._submit_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="guber-submit"
+        )
         # last backend stats snapshot, for cache_access_count deltas
         self._last_hits = 0
         self._last_misses = 0
@@ -71,6 +81,7 @@ class DeviceBatcher:
         if self._pending is not None:
             await self._pending  # drain the in-flight fetch gracefully
             self._pending = None
+        self._submit_pool.shutdown(wait=False)
 
     async def decide(
         self, reqs: Sequence[RateLimitReq], gnp: Sequence[bool]
@@ -170,8 +181,9 @@ class DeviceBatcher:
         # shield: a stop() mid-submit must not strand these futures —
         # the submit thread finishes either way (the store mutation has
         # already been dispatched), so fail the batch and propagate.
+        loop = asyncio.get_running_loop()
         submit_fut = asyncio.ensure_future(
-            asyncio.to_thread(submit, reqs, gnp)
+            loop.run_in_executor(self._submit_pool, submit, reqs, gnp)
         )
         try:
             handle = await asyncio.shield(submit_fut)

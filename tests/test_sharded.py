@@ -266,3 +266,55 @@ def test_mesh_duplicate_keys_one_batch(mesh_engine):
     status, _, remaining, _ = mesh_engine.decide_arrays(now=T0, **a)
     assert list(remaining) == [2, 1, 0, 0, 0]
     assert list(status) == [0, 0, 0, 1, 1]
+
+
+def test_mesh_submit_wait_pipelined(mesh_engine):
+    """Two decide batches in flight (the batcher's pipelining bound):
+    submits strictly ordered, waits resolve each batch correctly, and the
+    store threads through — batch 2 sees batch 1's charges."""
+    reqs = [
+        RateLimitReq(
+            name="pipe", unique_key=f"k{i % 7}", hits=1, limit=4,
+            duration=60_000,
+        )
+        for i in range(21)
+    ]
+    a = arrays_for(reqs)
+    h1 = mesh_engine.decide_submit(now=T0, **a)
+    h2 = mesh_engine.decide_submit(now=T0, **a)  # before h1's wait
+    s1, _, r1, _ = mesh_engine.decide_wait(h1)
+    s2, _, r2, _ = mesh_engine.decide_wait(h2)
+    # 7 keys x 3 dups per batch, limit 4: batch 1 ends remaining=1 per
+    # key; batch 2 charges once more then hits the limit
+    for k in range(7):
+        rows = [i for i in range(21) if i % 7 == k]
+        assert [int(r1[i]) for i in rows] == [3, 2, 1]
+        assert [int(s1[i]) for i in rows] == [0, 0, 0]
+        assert [int(r2[i]) for i in rows] == [0, 0, 0]
+        assert [int(s2[i]) for i in rows] == [0, 1, 1]
+
+
+def test_mesh_wait_uses_submit_time_epoch(mesh_engine):
+    """A rebase between submit and wait must not skew the in-flight
+    batch's reset_time: the handle carries its submit-time epoch (same
+    contract as TpuEngine.decide_submit)."""
+    from gubernator_tpu.core.store import REBASE_AT
+
+    reqs = [
+        RateLimitReq(
+            name="epoch", unique_key="x", hits=1, limit=5,
+            duration=60_000,
+        )
+    ]
+    a = arrays_for(reqs)
+    h1 = mesh_engine.decide_submit(now=T0, **a)
+    # advance the clock past the rebase threshold mid-flight
+    h2 = mesh_engine.decide_submit(now=T0 + REBASE_AT + 1000, **a)
+    _, _, _, reset1 = mesh_engine.decide_wait(h1)
+    _, _, _, reset2 = mesh_engine.decide_wait(h2)
+    # batch 1 converts against ITS epoch even though a rebase happened
+    # before its wait
+    assert int(reset1[0]) == T0 + 60_000
+    # the 12-day jump rebased batch 1's window to expired, so batch 2
+    # recreates it at the new now (state-loss-on-jump contract)
+    assert int(reset2[0]) == T0 + REBASE_AT + 1000 + 60_000
