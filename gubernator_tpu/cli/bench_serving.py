@@ -150,8 +150,16 @@ def main(argv=None) -> int:
 
         backend_factory = lambda: ExactBackend(100_000)  # noqa: E731
 
+    # node 0 also serves the Python HTTP/JSON gateway so the edge's
+    # front-door multiplier is a measured comparison, not a claim
+    # (gated on --edge: gRPC-only runs must not fail on a busy port)
+    http_addresses = [""] * args.nodes
+    if args.edge:
+        http_addresses[0] = "127.0.0.1:19978"
     cluster = LocalCluster(
-        ADDRESSES[: args.nodes], backend_factory=backend_factory
+        ADDRESSES[: args.nodes],
+        backend_factory=backend_factory,
+        http_addresses=http_addresses,
     )
     print("starting cluster...", file=sys.stderr)
     cluster.start()
@@ -276,6 +284,20 @@ def main(argv=None) -> int:
                 )
                 urllib.request.urlopen(req, timeout=10).read()
 
+            # same workload against node 0's Python HTTP gateway: the
+            # apples-to-apples denominator for the edge multiplier
+            def through_python_http(i: int):
+                req = urllib.request.Request(
+                    "http://127.0.0.1:19978/v1/GetRateLimits",
+                    data=edge_body,
+                    headers={"Content-Type": "application/json"},
+                )
+                urllib.request.urlopen(req, timeout=10).read()
+
+            results.append(
+                _measure("python_http_front_door", through_python_http,
+                         args.seconds, workers=16)
+            )
             results.append(
                 _measure("edge_front_door", through_edge, args.seconds,
                          workers=16)

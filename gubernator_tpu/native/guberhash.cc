@@ -754,7 +754,6 @@ int64_t guber_prep_sharded(
   const uint64_t bmask = buckets - 1;
   int bucket_bits = 0;
   while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
-  const int key_bits = 32 + bucket_bits;
 
   PrepPool& pool = PrepPool::inst();
   const int T = pool.lanes();
@@ -818,10 +817,9 @@ int64_t guber_prep_sharded(
   }
 
   if (dbg) t1 = now_us();
-  int64_t maxc = 1, maxg_cap = 0;
+  int64_t maxc = 1;
   for (int64_t s = 0; s < n_shards; ++s)
     if (counts_out[s] > maxc) maxc = counts_out[s];
-  (void)maxg_cap;
   const int64_t B = pick_rung(rungs, n_rungs, maxc);
   if (B < 0) return -1;
 
@@ -858,7 +856,6 @@ int64_t guber_prep_sharded(
   constexpr int DIGIT = 12;
   constexpr int64_t DMASK = (1 << DIGIT) - 1;
   const int passes = (bucket_bits + DIGIT - 1) / DIGIT;
-  (void)key_bits;
   std::atomic<int64_t> next_shard{0};
   pool.run([&](int, int) {
     // (key, idx) pair radix: keys stream sequentially each pass and the

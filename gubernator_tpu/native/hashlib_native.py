@@ -484,13 +484,15 @@ def prep_sharded(
     return order, counts, take_idx, fields, groups, B, G
 
 
-def unflatten_resp(packed, order, counts, n: int) -> np.ndarray:
+def unflatten_resp(packed, order, counts, n: int, b_sub: int) -> np.ndarray:
     """[4, n] response columns from a mesh packed matrix
-    ([n_shards, 4*B_sub + k] int32): the native twin of
-    `out[order] = flat[take_idx]` per column."""
+    ([n_shards, 4*b_sub + stats] int32): the native twin of
+    `out[order] = flat[take_idx]` per column. `b_sub` comes from the
+    caller's handle — inferring it from the stride would silently skew
+    every column if the stats tail ever grew."""
     packed = np.ascontiguousarray(packed, np.int32)
     n_shards, stride = packed.shape
-    b_sub = (stride - 2) // 4
+    assert stride >= 4 * b_sub, (stride, b_sub)
     counts = np.ascontiguousarray(counts, np.int64)
     out = np.empty((4, n), np.int32)
     _lib.guber_unflatten_resp(

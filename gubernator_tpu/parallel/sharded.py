@@ -617,7 +617,7 @@ class MeshEngine:
             counts = np.diff(np.concatenate(([0], bounds))).astype(
                 np.int64
             )
-            u = unflatten_resp(packed, order, counts, n)
+            u = unflatten_resp(packed, order, counts, n, B_sub)
             status, rlimit, remaining, reset = u[0], u[1], u[2], u[3]
         else:
 

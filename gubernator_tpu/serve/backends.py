@@ -37,6 +37,15 @@ from gubernator_tpu.core.store import StoreConfig
 class ExactBackend:
     """Host-memory exact semantics (reference algorithms over an LRU)."""
 
+    # decide() is microseconds of pure-Python dict work: running it via
+    # asyncio.to_thread costs two thread handoffs per batch (~0.3-0.5ms
+    # of the GLOBAL p50 on a contended host) for zero overlap benefit.
+    # The batcher executes inline on the event loop when this is set —
+    # the reference likewise answers local cache hits synchronously
+    # (gubernator.go:236-251). Device backends keep the thread hop: they
+    # BLOCK on the device and would stall the loop.
+    inline_decide = True
+
     def __init__(self, cache_size: int = 50_000):
         self.cache = LRUCache(cache_size)
 

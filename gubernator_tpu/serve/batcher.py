@@ -64,6 +64,15 @@ class DeviceBatcher:
         # reads and await a future that never resolves (same guard as
         # PeerClient._closed)
         self._closed = False
+        # inline backends (host-memory decide, microseconds of work) can
+        # take a same-task fast path when nothing is queued or flushing:
+        # the decide runs synchronously in the caller's handler, skipping
+        # the queue + flusher-task round trip (~0.2ms of single-request
+        # latency). Safe because the loop can't interleave: the check and
+        # the call have no await between them, and the flusher only runs
+        # when the queue is non-empty (then _flushing covers the rest).
+        self._inline = bool(getattr(backend, "inline_decide", False))
+        self._flushing = False
 
     def start(self) -> None:
         if self._task is None:
@@ -91,6 +100,23 @@ class DeviceBatcher:
             return []
         if self._closed:
             raise RuntimeError("DeviceBatcher is stopped")
+        if (
+            self._inline
+            and not self._flushing
+            and self._queue.empty()
+            and self._task is not None
+        ):
+            t0 = time.monotonic()
+            resps = self.backend.decide(list(reqs), [bool(g) for g in gnp])
+            try:
+                metrics.DEVICE_BATCH_SIZE.observe(len(resps))
+                metrics.DEVICE_LAUNCH_MS.observe(
+                    (time.monotonic() - t0) * 1e3
+                )
+                self._observe_cache_stats()
+            except Exception:  # pragma: no cover - defensive
+                pass
+            return resps
         loop = asyncio.get_running_loop()
         futs = []
         for r, g in zip(reqs, gnp):
@@ -124,7 +150,11 @@ class DeviceBatcher:
                 await collect_batch(
                     self._queue, self.batch_limit, self.batch_wait, batch
                 )
-                await self._flush(batch)
+                self._flushing = True
+                try:
+                    await self._flush(batch)
+                finally:
+                    self._flushing = False
             except asyncio.CancelledError:
                 # stop() anywhere in the collect/flush path: every caller
                 # in this batch and still enqueued gets an error, never a
@@ -144,9 +174,15 @@ class DeviceBatcher:
         decide_items = [b for b in batch if b[0] != "globals"]
         global_items = [b for b in batch if b[0] == "globals"]
 
+        inline = self._inline
         for _, updates, fut in global_items:
             try:
-                await asyncio.to_thread(self.backend.update_globals, updates)
+                if inline:
+                    self.backend.update_globals(updates)
+                else:
+                    await asyncio.to_thread(
+                        self.backend.update_globals, updates
+                    )
                 if not fut.done():
                     fut.set_result(None)
             except Exception as e:
@@ -164,11 +200,17 @@ class DeviceBatcher:
         if submit is None:
             # non-pipelined backend: one blocking decide per batch (a
             # cancel mid-call is handled by _run; the worker thread
-            # finishes on its own and to_thread discards its result)
+            # finishes on its own and to_thread discards its result).
+            # Host backends marked inline_decide run right here on the
+            # loop — their decide is microseconds of dict work and the
+            # to_thread handoff would dominate the request latency.
             try:
-                resps = await asyncio.to_thread(
-                    self.backend.decide, reqs, gnp
-                )
+                if inline:
+                    resps = self.backend.decide(reqs, gnp)
+                else:
+                    resps = await asyncio.to_thread(
+                        self.backend.decide, reqs, gnp
+                    )
             except Exception as e:
                 self._fail(decide_items, e)
                 return
