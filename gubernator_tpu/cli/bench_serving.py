@@ -36,6 +36,20 @@ from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2
 from gubernator_tpu.cluster import LocalCluster
 
 ADDRESSES = [f"127.0.0.1:{p}" for p in range(9980, 9986)]
+PYTHON_HTTP_ADDR = "127.0.0.1:19978"  # node 0's gateway under --edge
+
+
+def _front_door_call(url: str, body: bytes):
+    """One HTTP POST closure per front door (python gateway / C++ edge)."""
+    import urllib.request
+
+    def call(i: int):
+        req = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        urllib.request.urlopen(req, timeout=10).read()
+
+    return call
 
 
 def _req(key: str) -> gubernator_pb2.RateLimitReq:
@@ -155,7 +169,7 @@ def main(argv=None) -> int:
     # (gated on --edge: gRPC-only runs must not fail on a busy port)
     http_addresses = [""] * args.nodes
     if args.edge:
-        http_addresses[0] = "127.0.0.1:19978"
+        http_addresses[0] = PYTHON_HTTP_ADDR
     cluster = LocalCluster(
         ADDRESSES[: args.nodes],
         backend_factory=backend_factory,
@@ -276,27 +290,21 @@ def main(argv=None) -> int:
                 }
             ).encode()
 
-            def through_edge(i: int):
-                req = urllib.request.Request(
-                    f"http://127.0.0.1:{edge_port}/v1/GetRateLimits",
-                    data=edge_body,
-                    headers={"Content-Type": "application/json"},
-                )
-                urllib.request.urlopen(req, timeout=10).read()
+            through_edge = _front_door_call(
+                f"http://127.0.0.1:{edge_port}/v1/GetRateLimits", edge_body
+            )
 
             # same workload against node 0's Python HTTP gateway: the
             # apples-to-apples denominator for the edge multiplier
-            def through_python_http(i: int):
-                req = urllib.request.Request(
-                    "http://127.0.0.1:19978/v1/GetRateLimits",
-                    data=edge_body,
-                    headers={"Content-Type": "application/json"},
-                )
-                urllib.request.urlopen(req, timeout=10).read()
-
             results.append(
-                _measure("python_http_front_door", through_python_http,
-                         args.seconds, workers=16)
+                _measure(
+                    "python_http_front_door",
+                    _front_door_call(
+                        f"http://{PYTHON_HTTP_ADDR}/v1/GetRateLimits",
+                        edge_body,
+                    ),
+                    args.seconds, workers=16,
+                )
             )
             results.append(
                 _measure("edge_front_door", through_edge, args.seconds,

@@ -65,14 +65,18 @@ class DeviceBatcher:
         # PeerClient._closed)
         self._closed = False
         # inline backends (host-memory decide, microseconds of work) can
-        # take a same-task fast path when nothing is queued or flushing:
-        # the decide runs synchronously in the caller's handler, skipping
-        # the queue + flusher-task round trip (~0.2ms of single-request
-        # latency). Safe because the loop can't interleave: the check and
-        # the call have no await between them, and the flusher only runs
-        # when the queue is non-empty (then _flushing covers the rest).
+        # take a same-task fast path when nothing is queued, collected,
+        # or flushing: the decide runs synchronously in the caller's
+        # handler, skipping the queue + flusher-task round trip (~0.2ms
+        # of single-request latency). Safe because the loop can't
+        # interleave between the check and the call (no await), and all
+        # three places earlier work can hide are checked: the queue
+        # (not yet collected), _live_batch (collected by the flusher's
+        # collect_batch — possibly parked in a batch_wait straggler
+        # window — but not yet flushed), and _flushing (mid-flush).
         self._inline = bool(getattr(backend, "inline_decide", False))
         self._flushing = False
+        self._live_batch: List = []
 
     def start(self) -> None:
         if self._task is None:
@@ -103,6 +107,7 @@ class DeviceBatcher:
         if (
             self._inline
             and not self._flushing
+            and not self._live_batch
             and self._queue.empty()
             and self._task is not None
         ):
@@ -138,6 +143,10 @@ class DeviceBatcher:
     async def _run(self) -> None:
         while True:
             batch: List[Tuple] = []
+            # visible to the inline fast path: items drained into this
+            # list during a batch_wait window are "earlier work" a fast
+            # decide must not overtake
+            self._live_batch = batch
             try:
                 # Everything already enqueued rides this launch; while
                 # the backend is busy in _flush, new arrivals accumulate

@@ -1,19 +1,28 @@
-"""Two-process multi-host mesh exercise (spawned by test_multihost.py).
+"""Multi-process multi-host mesh exercise (spawned by test_multihost.py).
 
-Run as: python tests/_multihost_runner.py <role> <coordinator> <step_port>
-Role "leader" drives rate-limit traffic over a 2-process global mesh and
-asserts the decisions; role "follower" runs the lockstep loop. Leader
-prints LEADER-OK on success. Roles "leader-mismatch"/"follower-mismatch"
-exercise the connect-time config handshake: the follower is constructed
-with a different bucket ladder and both sides must fail loudly with the
-mismatch diagnostic (no hang, no silent shape divergence).
+Run as:
+  python tests/_multihost_runner.py <role> <coordinator> <step_ports> \
+      <process_id> <num_processes>
+
+`step_ports` is comma-separated: the leader connects to one port per
+follower; a follower listens on its own (single) entry. Devices per
+process come from XLA_FLAGS --xla_force_host_platform_device_count in the
+environment (1 if unset), so one runner covers 2x1, 2x4, and 4x2
+topologies. Role "leader" drives rate-limit traffic over the global mesh
+and asserts decisions, ownership spread across every shard, gossip
+convergence, and the process-major device ordering the scaling model
+relies on (parallel/multihost.py module docstring); it prints LEADER-OK
+plus a `TOPO shards=<n> b_sub=<B>` work line for the cross-topology
+flatness check. Roles "leader-mismatch"/"follower-mismatch" exercise the
+connect-time config handshake.
 """
 
 import sys
 
 
 def main():
-    role, coordinator, step_port = sys.argv[1], sys.argv[2], sys.argv[3]
+    role, coordinator, step_ports, pid_s, nprocs_s = sys.argv[1:6]
+    pid, nprocs = int(pid_s), int(nprocs_s)
 
     import jax
 
@@ -26,9 +35,7 @@ def main():
     from gubernator_tpu.core.store import StoreConfig
     import numpy as np
 
-    pid = 0 if role.startswith("leader") else 1
-    initialize_distributed(coordinator, num_processes=2, process_id=pid)
-    assert len(jax.devices()) == 2, jax.devices()
+    initialize_distributed(coordinator, num_processes=nprocs, process_id=pid)
 
     cfg = StoreConfig(rows=16, slots=1 << 8)
     T0 = 1_700_000_000_000
@@ -36,7 +43,7 @@ def main():
     if role == "follower-mismatch":
         eng = MultiHostMeshEngine(cfg, buckets=(32,))  # leader has (16,)
         try:
-            eng.follower_loop(f"127.0.0.1:{step_port}")
+            eng.follower_loop(f"127.0.0.1:{step_ports}")
         except RuntimeError as e:
             assert "config mismatch" in str(e), e
             print("FOLLOWER-MISMATCH-OK", flush=True)
@@ -46,7 +53,9 @@ def main():
     if role == "leader-mismatch":
         try:
             MultiHostMeshEngine(
-                cfg, followers=[f"127.0.0.1:{step_port}"], buckets=(16,)
+                cfg,
+                followers=[f"127.0.0.1:{p}" for p in step_ports.split(",")],
+                buckets=(16,),
             )
         except RuntimeError as e:
             assert "config mismatch" in str(e), e
@@ -54,32 +63,57 @@ def main():
             return
         raise SystemExit("leader handshake accepted a mismatched follower")
 
+    # the scaling-model claim (multihost.py docstring): jax device order
+    # is process-major, so a reduction's intra-host hops ride ICI before
+    # the host-level combine crosses DCN. Assert it in EVERY process.
+    devs = jax.devices()
+    proc_of = [d.process_index for d in devs]
+    assert proc_of == sorted(proc_of), f"not process-major: {proc_of}"
+    per = len(devs) // nprocs
+    for p in range(nprocs):
+        block = proc_of[p * per : (p + 1) * per]
+        assert block == [p] * per, f"process {p} devices not contiguous: {proc_of}"
+
     if role == "follower":
         eng = MultiHostMeshEngine(cfg, buckets=(16,))
-        eng.follower_loop(f"127.0.0.1:{step_port}")
+        eng.follower_loop(f"127.0.0.1:{step_ports}")
         print("FOLLOWER-OK", flush=True)
         return
 
     eng = MultiHostMeshEngine(
-        cfg, followers=[f"127.0.0.1:{step_port}"], buckets=(16,)
+        cfg,
+        followers=[f"127.0.0.1:{p}" for p in step_ports.split(",")],
+        buckets=(16,),
     )
+    n_shards = eng.n
+    assert n_shards == len(devs), (n_shards, devs)
 
     from gubernator_tpu.core.hashing import slot_hash_batch
-    from gubernator_tpu.parallel.sharded import owner_of_np
+    from gubernator_tpu.parallel.sharded import owner_of_np, pad_request_sharded
 
-    # enough keys that both shards (one device per process) own some
-    keys = [f"mh:{i}" for i in range(12)]
+    # enough keys that EVERY shard owns some
+    keys = [f"mh:{i}" for i in range(16 * n_shards)]
     kh = slot_hash_batch(keys)
-    owners = owner_of_np(kh, 2)
-    assert set(owners.tolist()) == {0, 1}, "keys must span both hosts"
+    owners = owner_of_np(kh, n_shards)
+    assert set(owners.tolist()) == set(range(n_shards)), (
+        f"keys must span all {n_shards} shards: {sorted(set(owners.tolist()))}"
+    )
 
-    ones = np.ones(len(keys), np.int64)
+    n = len(keys)
+    ones = np.ones(n, np.int64)
     limit = ones * 2
     dur = ones * 60_000
-    algo = np.zeros(len(keys), np.int32)
-    gnp = np.zeros(len(keys), bool)
+    algo = np.zeros(n, np.int32)
+    gnp = np.zeros(n, bool)
 
-    # two charges then OVER, across both shards, via the global-mesh psum
+    # cross-topology work line: padded per-shard sub-batch for this batch
+    req, _o, _t, _g = pad_request_sharded(
+        eng.sub_buckets, cfg.slots, n_shards, kh, ones, limit, dur, algo,
+        gnp, with_groups=True,
+    )
+    print(f"TOPO shards={n_shards} b_sub={req.key_hash.shape[1]}", flush=True)
+
+    # two charges then OVER, across every shard, via the global-mesh psum
     s1, _, r1, _ = eng.decide_arrays(kh, ones, limit, dur, algo, gnp, T0)
     assert (s1 == 0).all() and (r1 == 1).all(), (s1, r1)
     s2, _, r2, _ = eng.decide_arrays(kh, ones, limit, dur, algo, gnp, T0 + 1)
@@ -89,21 +123,20 @@ def main():
 
     # GLOBAL gossip collective: owner peek + broadcast + replica install
     eng.sync_globals(kh, limit, dur, T0 + 3)
-    # replica reads answer from installed state everywhere
     s4, _, r4, _ = eng.decide_arrays(
-        kh, np.zeros(len(keys), np.int64), limit, dur, algo,
-        np.ones(len(keys), bool), T0 + 4,
+        kh, np.zeros(n, np.int64), limit, dur, algo,
+        np.ones(n, bool), T0 + 4,
     )
-    assert (s4 == 1).all(), s4  # all shards report the OVER status
+    assert (s4 == 1).all(), s4  # every shard reports the OVER status
 
     # broadcast-install path (UpdatePeerGlobals receive side)
     eng.update_globals(
         kh, ones * 9, ones * 7, ones * (T0 + 60_000),
-        np.zeros(len(keys), bool), now=T0 + 5,
+        np.zeros(n, bool), now=T0 + 5,
     )
     s5, l5, r5, _ = eng.decide_arrays(
-        kh, np.zeros(len(keys), np.int64), ones * 9, dur, algo,
-        np.ones(len(keys), bool), T0 + 6,
+        kh, np.zeros(n, np.int64), ones * 9, dur, algo,
+        np.ones(n, bool), T0 + 6,
     )
     assert (r5 == 7).all() and (l5 == 9).all(), (l5, r5)
 

@@ -224,3 +224,43 @@ def test_decide_after_stop_raises(loop_run):
             await b.update_globals([("k", RateLimitResp(limit=1))])
 
     loop_run(scenario())
+
+
+def test_inline_fast_path_never_overtakes_collected_items(loop_run):
+    """An inline decide must not run ahead of work the flusher already
+    drained into its batch while parked in a batch_wait straggler
+    window (the queue looks empty then, but earlier work exists)."""
+
+    class InlineRecorder:
+        inline_decide = True
+
+        def __init__(self):
+            self.order = []
+
+        def decide(self, reqs, gnp):
+            self.order.append(("D", [r.unique_key for r in reqs]))
+            return [RateLimitResp(limit=r.limit) for r in reqs]
+
+        def update_globals(self, updates):
+            self.order.append(("U", [k for k, _ in updates]))
+
+    async def scenario():
+        be = InlineRecorder()
+        b = DeviceBatcher(be, batch_wait=0.2, batch_limit=100)
+        b.start()
+        # U enters the queue; the flusher drains it into its batch and
+        # parks in the 200ms straggler window (queue now empty)
+        u_task = asyncio.ensure_future(
+            b.update_globals([("k", RateLimitResp(limit=1))])
+        )
+        await asyncio.sleep(0.05)
+        assert b._live_batch, "flusher should hold U in its open batch"
+        # D arrives mid-window: the fast path must refuse; D coalesces
+        # into the same batch and executes AFTER U
+        resps = await b.decide([_req(1)], [False])
+        await u_task
+        await b.stop()
+        assert resps[0].limit == 10
+        assert [kind for kind, _ in be.order] == ["U", "D"], be.order
+
+    loop_run(scenario())

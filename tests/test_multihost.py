@@ -1,13 +1,17 @@
-"""Multi-host mesh e2e: two real OS processes, one global jax mesh.
+"""Multi-host mesh e2e: several real OS processes, one global jax mesh.
 
-Spawns a leader and a follower (tests/_multihost_runner.py), each with
-one CPU device, joined via jax.distributed; the leader drives decide /
-sync_globals / update_globals batches whose psum collectives cross the
-process boundary (gloo over TCP — the CPU stand-in for DCN), with the
-lockstep step pipe keeping both controllers issuing identical programs.
+Spawns a leader plus followers (tests/_multihost_runner.py), each process
+holding one or more CPU devices, joined via jax.distributed; the leader
+drives decide / sync_globals / update_globals batches whose psum
+collectives cross the process boundary (gloo over TCP — the CPU stand-in
+for DCN), with the lockstep step pipe keeping every controller issuing
+identical programs. Topologies beyond 2x1 exercise the v5e-32 shape:
+multiple devices per process with the process-major mesh ordering the
+scaling model relies on, asserted inside every runner process.
 """
 
 import os
+import re
 import socket
 import subprocess
 import sys
@@ -24,62 +28,136 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _run_pair(leader_role: str, follower_role: str, leader_timeout: float):
-    """Spawn a (leader, follower) runner pair and return their outputs.
+def _run_group(
+    nprocs: int,
+    devs_per_proc: int,
+    leader_timeout: float,
+    leader_role: str = "leader",
+    follower_role: str = "follower",
+):
+    """Spawn a leader + (nprocs-1) followers; return everyone's output.
 
     No pytest-timeout in this image (the mark would be inert); the
-    communicate(timeout=...) calls are the real watchdog — on expiry both
-    processes are killed and the test fails with both logs."""
+    communicate(timeout=...) calls are the real watchdog — on expiry all
+    processes are killed and the test fails with every log."""
     coord = f"127.0.0.1:{_free_port()}"
-    step_port = str(_free_port())
+    step_ports = [str(_free_port()) for _ in range(nprocs - 1)]
     runner = str(ROOT / "tests" / "_multihost_runner.py")
     env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)  # one device per process, no forced count
+    env.pop("XLA_FLAGS", None)
+    if devs_per_proc > 1:
+        env["XLA_FLAGS"] = (
+            f"--xla_force_host_platform_device_count={devs_per_proc}"
+        )
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = str(ROOT) + os.pathsep + env.get("PYTHONPATH", "")
 
-    follower = subprocess.Popen(
-        [sys.executable, runner, follower_role, coord, step_port],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=ROOT, env=env,
-    )
+    followers = [
+        subprocess.Popen(
+            [sys.executable, runner, follower_role, coord, port,
+             str(fpid + 1), str(nprocs)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            cwd=ROOT, env=env,
+        )
+        for fpid, port in enumerate(step_ports)
+    ]
     leader = subprocess.Popen(
-        [sys.executable, runner, leader_role, coord, step_port],
+        [sys.executable, runner, leader_role, coord, ",".join(step_ports),
+         "0", str(nprocs)],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         cwd=ROOT, env=env,
     )
     try:
         l_out, _ = leader.communicate(timeout=leader_timeout)
-        f_out, _ = follower.communicate(timeout=30)
+        f_outs = [f.communicate(timeout=30)[0] for f in followers]
     except subprocess.TimeoutExpired:
         leader.kill()
-        follower.kill()
+        for f in followers:
+            f.kill()
         l_out = leader.communicate()[0]
-        f_out = follower.communicate()[0]
-        pytest.fail(f"timeout\nleader:\n{l_out}\nfollower:\n{f_out}")
-    return leader.returncode, l_out, follower.returncode, f_out
+        f_outs = [f.communicate()[0] for f in followers]
+        pytest.fail(
+            "timeout\nleader:\n%s\nfollowers:\n%s" % (l_out, "\n".join(f_outs))
+        )
+    return (
+        leader.returncode, l_out,
+        [f.returncode for f in followers], f_outs,
+    )
+
+
+def _assert_ok(l_rc, l_out, f_rcs, f_outs):
+    assert l_rc == 0 and "LEADER-OK" in l_out, (
+        f"leader failed:\n{l_out}\nfollowers:\n" + "\n".join(f_outs)
+    )
+    for rc, out in zip(f_rcs, f_outs):
+        assert rc == 0 and "FOLLOWER-OK" in out, f"follower failed:\n{out}"
+
+
+def _work(l_out: str) -> int:
+    m = re.search(r"TOPO shards=(\d+) b_sub=(\d+)", l_out)
+    assert m, l_out
+    return int(m.group(1)) * int(m.group(2))
+
+
+# each topology spawns nprocs jax processes on one core — run each ONCE
+# and share the outputs between its own test and the flatness check
+_RESULTS = {}
+
+
+def _group(nprocs: int, devs: int, timeout: float = 300):
+    key = (nprocs, devs)
+    if key not in _RESULTS:
+        _RESULTS[key] = _run_group(nprocs, devs, timeout)
+    return _RESULTS[key]
 
 
 def test_two_process_mesh():
-    l_rc, l_out, f_rc, f_out = _run_pair("leader", "follower", 150)
-    assert l_rc == 0 and "LEADER-OK" in l_out, (
-        f"leader failed:\n{l_out}\nfollower:\n{f_out}"
-    )
-    assert f_rc == 0 and "FOLLOWER-OK" in f_out, (
-        f"follower failed:\n{f_out}"
-    )
+    l_rc, l_out, f_rcs, f_outs = _group(2, 1, 150)
+    _assert_ok(l_rc, l_out, f_rcs, f_outs)
+
+
+def test_two_procs_four_devices_each():
+    """2 hosts x 4 chips: the multi-device-per-process form of the
+    v5e-32 story — 8 global shards, process-major ordering asserted in
+    both processes, batch rows spread across all 8."""
+    l_rc, l_out, f_rcs, f_outs = _group(2, 4)
+    _assert_ok(l_rc, l_out, f_rcs, f_outs)
+
+
+def test_four_procs_two_devices_each():
+    """4 hosts x 2 chips: more processes than the lockstep pipe has ever
+    seen — 3 followers acking every step, 8 global shards."""
+    l_rc, l_out, f_rcs, f_outs = _group(4, 2)
+    _assert_ok(l_rc, l_out, f_rcs, f_outs)
+
+
+def test_cross_topology_work_flatness():
+    """Mesh-scaling-style check across process topologies: per-row padded
+    work (n_shards * B_sub / real rows) for the same rows-per-shard load
+    must stay within 2x across 2x1, 2x4, and 4x2 — sharding across more
+    processes/devices must not inflate total padded rows superlinearly."""
+    results = {}
+    for nprocs, devs in ((2, 1), (2, 4), (4, 2)):
+        l_rc, l_out, f_rcs, f_outs = _group(nprocs, devs)
+        _assert_ok(l_rc, l_out, f_rcs, f_outs)
+        shards = nprocs * devs
+        results[(nprocs, devs)] = _work(l_out) / (16 * shards)
+    worst = max(results.values()) / min(results.values())
+    assert worst <= 2.0, results
 
 
 def test_config_mismatch_fails_loudly_at_connect():
     """A follower constructed with a different bucket ladder must be
     rejected by the connect-time handshake on BOTH sides with the
     mismatch diagnostic — not hang or diverge later in lockstep."""
-    l_rc, l_out, f_rc, f_out = _run_pair(
-        "leader-mismatch", "follower-mismatch", 60
+    l_rc, l_out, f_rcs, f_outs = _run_group(
+        2, 1, 60,
+        leader_role="leader-mismatch", follower_role="follower-mismatch",
     )
     assert l_rc == 0 and "LEADER-MISMATCH-OK" in l_out, (
-        f"leader:\n{l_out}\nfollower:\n{f_out}"
+        f"leader:\n{l_out}\nfollowers:\n" + "\n".join(f_outs)
     )
-    assert f_rc == 0 and "FOLLOWER-MISMATCH-OK" in f_out, (
-        f"follower:\n{f_out}"
-    )
+    for rc, out in zip(f_rcs, f_outs):
+        assert rc == 0 and "FOLLOWER-MISMATCH-OK" in out, (
+            f"follower:\n{out}"
+        )
