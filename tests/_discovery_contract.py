@@ -1,0 +1,100 @@
+"""The discovery client API contract: the exact calls serve/discovery.py
+makes on etcd3 / kubernetes clients, as bindable call shapes.
+
+This is the single source the drift checks assert against from BOTH
+directions (r2 verdict: the fakes encoded the builder's assumed API
+shapes and had never met the real libraries):
+
+- tests/test_discovery.py asserts the FAKES accept exactly these calls;
+- tests/test_discovery_real.py (gated on the real packages being
+  installed) asserts the REAL libraries accept them too.
+
+Pinned against python-etcd3 0.12.x and kubernetes>=24 (see
+pyproject.toml [project.optional-dependencies] discovery). Prose
+documentation: docs/discovery_api_contract.md.
+
+Each entry: method name -> (positional_args, keyword_args) exactly as
+the production code calls it. A library or fake whose signature cannot
+bind the call shape has drifted.
+"""
+
+import inspect
+
+_SENTINEL = object()
+
+# etcd3.client(...) constructor: every argument passed by keyword
+# (discovery.py EtcdPool.__init__)
+ETCD_CLIENT_CTOR_CALL = (
+    (),
+    {
+        "host": "127.0.0.1",
+        "port": 2379,
+        "ca_cert": "ca.pem",
+        "cert_cert": "c.pem",
+        "cert_key": "k.pem",
+    },
+)
+
+# methods EtcdPool calls on the client object, with their call shapes
+ETCD_CLIENT_CALLS = {
+    # self._lease = client.lease(LEASE_TTL_S)
+    "lease": ((30,), {}),
+    # client.put(key, value, lease=lease)
+    "put": (("k", "v"), {"lease": _SENTINEL}),
+    # client.delete(key)
+    "delete": (("k",), {}),
+    # client.get_prefix(prefix) -> iterable of (value_bytes, metadata);
+    # ONLY element [0] (the value bytes) is consumed
+    "get_prefix": (("p",), {}),
+    # client.watch_prefix(prefix) -> (events_iterator, cancel_callable)
+    "watch_prefix": (("p",), {}),
+}
+
+# methods EtcdPool calls on the lease object
+ETCD_LEASE_CALLS = {
+    "refresh": ((), {}),
+}
+
+# kubernetes surface K8sPool uses
+K8S_API_CALLS = {
+    # api.list_namespaced_endpoints(namespace, label_selector=...)
+    # (called through watch.stream, which forwards args verbatim)
+    "list_namespaced_endpoints": (("ns",), {"label_selector": "app=x"}),
+}
+K8S_WATCH_CALLS = {
+    # watch.stream(func, namespace, label_selector=...) yields events
+    # shaped {"object": V1Endpoints}
+    "stream": ((_SENTINEL, "ns"), {"label_selector": "app=x"}),
+    # watch.stop() ends the blocking stream
+    "stop": ((), {}),
+}
+# attribute path K8sPool reads off each event object:
+#   endpoints.subsets[].addresses[].ip
+K8S_ENDPOINTS_ATTRS = ("subsets", "addresses", "ip")
+
+
+def assert_binds(fn, call, where: str, unbound: bool = False) -> None:
+    """The production call shape must bind to fn's signature. `unbound`
+    prepends a self placeholder (for checking class-level functions)."""
+    args, kwargs = call
+    if unbound:
+        args = (_SENTINEL,) + tuple(args)
+    try:
+        inspect.signature(fn).bind(*args, **kwargs)
+    except TypeError as e:
+        raise AssertionError(
+            f"{where}: production call shape args={args} kwargs="
+            f"{sorted(kwargs)} does not bind to signature "
+            f"{inspect.signature(fn)} — the discovery contract "
+            f"(tests/_discovery_contract.py) and the implementation have "
+            f"drifted: {e}"
+        ) from None
+
+
+def assert_object_implements(
+    obj, calls: dict, where: str, unbound: bool = False
+) -> None:
+    for name, call in calls.items():
+        fn = getattr(obj, name, None)
+        assert callable(fn), f"{where}: missing method {name}()"
+        assert_binds(fn, call, f"{where}.{name}", unbound=unbound)

@@ -257,3 +257,31 @@ def test_k8s_pool_pushes_endpoints_and_marks_self():
     run_pool_test(main())
     assert updates[0] == [("10.0.0.1:81", False)]
     assert updates[1] == [("10.0.0.1:81", False), ("10.0.0.2:81", True)]
+
+
+def test_fakes_match_discovery_contract():
+    """Both-direction drift guard (r2 verdict #5): the fakes must accept
+    exactly the call shapes production makes — the same shapes
+    tests/test_discovery_real.py pins on the REAL etcd3/kubernetes
+    libraries when they are installed. A fake that grows out of sync
+    with the contract fails here; a library that moves fails there."""
+    from tests._discovery_contract import (
+        ETCD_CLIENT_CALLS,
+        ETCD_LEASE_CALLS,
+        K8S_WATCH_CALLS,
+        assert_object_implements,
+    )
+
+    fake = FakeEtcd()
+    assert_object_implements(fake, ETCD_CLIENT_CALLS, "FakeEtcd")
+    assert_object_implements(fake.lease(30), ETCD_LEASE_CALLS, "FakeLease")
+    watch = FakeK8sWatch([])
+    assert_object_implements(
+        watch, {"stream": K8S_WATCH_CALLS["stream"]}, "FakeK8sWatch"
+    )
+    # FakeK8sWatch models stop via its stopped event (K8sPool.close calls
+    # watch.stop when present; the fake documents the divergence by
+    # construction) and the watch_prefix fake must return the
+    # (iterator, cancel) pair shape
+    events, cancel = fake.watch_prefix("/p/")
+    assert callable(cancel) and hasattr(events, "__iter__")

@@ -98,3 +98,162 @@ def test_over_admission_bounded(n_keys, max_rate):
     assert rate <= max_rate, (
         f"over-admission {rate:.4f} exceeds {max_rate} at {n_keys} keys"
     )
+
+
+def _bucket_of(kh: np.ndarray, slots: int) -> np.ndarray:
+    """The store's OWN bucket derivation (group_sort_key's high bits), so
+    the crafted collisions track any future change to the store's
+    hashing instead of silently spreading across buckets."""
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    return (group_sort_key_np(kh, slots) >> np.uint64(32)).astype(np.int64)
+
+
+def _colliding_hashes(slots: int, bucket: int, count: int) -> np.ndarray:
+    """Distinct synthetic key hashes that all land in `bucket` of a
+    `slots`-bucket store (and carry distinct fingerprints)."""
+    rng = np.random.default_rng(0xC0111DE)
+    out = []
+    fps = set()
+    while len(out) < count:
+        kh = rng.integers(1, 1 << 63, size=4096, dtype=np.uint64)
+        for h in kh[_bucket_of(kh, slots) == bucket]:
+            fp = int(h) >> 32
+            if fp and fp not in fps:  # distinct store tags
+                fps.add(fp)
+                out.append(int(h))
+                if len(out) == count:
+                    break
+    arr = np.asarray(out, np.uint64)
+    # the attack is vacuous unless the keys REALLY collide per the
+    # store's own derivation
+    assert (_bucket_of(arr, slots) == bucket).all()
+    return arr
+
+
+def _decide(engine, kh, now, limit=3):
+    n = kh.shape[0]
+    status, _, _, _ = engine.decide_arrays(
+        kh,
+        np.ones(n, np.int64),
+        np.full(n, limit, np.int64),
+        np.full(n, 10_000_000, np.int64),
+        np.zeros(n, np.int32),
+        np.zeros(n, bool),
+        now,
+    )
+    return status
+
+
+def test_adversarial_bucket_collision_within_ways_is_exact():
+    """16 distinct keys crafted into ONE 16-way bucket exactly fill it:
+    no eviction, zero over-admission — the set-associative geometry
+    absorbs the collision attack up to its way count."""
+    slots = 64
+    engine = TpuEngine(StoreConfig(rows=16, slots=slots), buckets=(64,))
+    kh = _colliding_hashes(slots, bucket=5, count=16)
+    now = T0
+    over = 0
+    for step in range(8):  # limit=3: steps 0-2 admit, 3+ must refuse
+        now += 50
+        status = _decide(engine, kh, now)
+        want_over = step >= 3
+        if want_over:
+            over += int((status == int(Status.UNDER_LIMIT)).sum())
+    assert over == 0, f"{over} over-admissions with <=16 colliding keys"
+
+
+def test_adversarial_bucket_collision_beyond_ways_bounded():
+    """32 distinct keys into one 16-way bucket, every batch: the worst
+    adversarial shape for the store — each batch evicts up to 16 live
+    windows, so evicted keys get fresh windows on revisit. This pins the
+    MEASURED worst-case rate (and documents it): over-admission stays
+    confined to the attacked bucket and is bounded by its eviction
+    churn, not amplified store-wide."""
+    slots = 64
+    engine = TpuEngine(StoreConfig(rows=16, slots=slots), buckets=(64,))
+    cache = LRUCache(1 << 30)
+    kh = _colliding_hashes(slots, bucket=5, count=32)
+    keys = [f"adv:{i}" for i in range(32)]
+    now = T0
+    over = total = 0
+    for step in range(20):
+        now += 50
+        status = _decide(engine, kh, now)
+        for i in range(32):
+            r = RateLimitReq(
+                name="adv", unique_key=keys[i], hits=1, limit=3,
+                duration=10_000_000, algorithm=Algorithm.TOKEN_BUCKET,
+            )
+            want = get_rate_limit(cache, r, now=now)
+            total += 1
+            if (
+                status[i] == int(Status.UNDER_LIMIT)
+                and want.status == Status.OVER_LIMIT
+            ):
+                over += 1
+    rate = over / total
+    # 2x overcommit on one bucket loses up to half the windows per
+    # round; the measured steady rate is ~0.4-0.55 of the attacked
+    # keys' requests (0.425 on the pinned seed). This is the documented worst case for a targeted
+    # collision attack — the reference's LRU at equal capacity likewise
+    # sheds state under adversarial churn (architecture.md:5-11); the
+    # blast radius here is ONE bucket, not the whole cache.
+    assert rate <= 0.65, f"collision-attack over-admission {rate:.3f}"
+    # and a control key in another bucket stays exact throughout
+    control = _colliding_hashes(slots, bucket=9, count=1)
+    ctrl_cache = LRUCache(1 << 30)
+    for step in range(6):
+        now += 50
+        status = _decide(engine, control, now)
+        r = RateLimitReq(
+            name="adv", unique_key="control", hits=1, limit=3,
+            duration=10_000_000, algorithm=Algorithm.TOKEN_BUCKET,
+        )
+        want = get_rate_limit(ctrl_cache, r, now=now)
+        assert int(status[0]) == int(want.status), (step, status, want)
+
+
+def test_adversarial_cold_storm_revisit():
+    """All-distinct cold storm: drive victims to OVER_LIMIT, flood the
+    whole store with fresh distinct keys (4x capacity), then revisit the
+    victims. Evicted victims get fresh windows — up to 100% of them
+    re-admit, the same state-loss contract as the reference's LRU
+    evicting at capacity (architecture.md:5-11). The pinned facts: the
+    storm itself admits every fresh key exactly once (no phantom
+    refusals), and revisit over-admission is bounded by the eviction
+    count, not amplified beyond it."""
+    slots = 64
+    cap = 16 * slots
+    engine = TpuEngine(StoreConfig(rows=16, slots=slots), buckets=(1024,))
+    rng = np.random.default_rng(0x57012)
+    victims = (
+        rng.integers(1, 1 << 63, size=64, dtype=np.uint64)
+        | np.uint64(1)
+    )
+    now = T0
+    # exhaust the victims (limit=3): 3 admits then OVER
+    for step in range(4):
+        now += 50
+        status = _decide(engine, victims, now)
+    assert (status == int(Status.OVER_LIMIT)).all()
+
+    # storm: 4x capacity of distinct never-seen keys, each exactly once
+    for wave in range(8):
+        now += 50
+        storm = rng.integers(1, 1 << 63, size=cap // 2, dtype=np.uint64)
+        s = _decide(engine, storm, now, limit=3)
+        # fresh distinct keys must all admit (a refusal here would be
+        # phantom OVER-refusal, the opposite failure mode)
+        frac_admit = (s == int(Status.UNDER_LIMIT)).mean()
+        assert frac_admit > 0.99, frac_admit
+
+    # revisit: evicted victims re-admit (state loss), surviving ones
+    # still refuse; none may answer anything but UNDER/OVER
+    now += 50
+    status = _decide(engine, victims, now)
+    readmitted = (status == int(Status.UNDER_LIMIT)).mean()
+    # the documented expectation: a 4x-capacity storm evicts most of the
+    # store, so MOST victims re-admit; if this ever drops near zero the
+    # eviction policy changed and the README contract must be revisited
+    assert readmitted >= 0.5, readmitted
