@@ -43,7 +43,7 @@ namespace {
 // ---------------------------------------------------------------- protocol
 
 constexpr uint32_t kMagicReq = 0x31424547;   // 'GEB1'
-constexpr uint32_t kMagicResp = 0x32424547;  // 'GEB2'
+constexpr uint32_t kMagicResp = 0x33424547;  // 'GEB3'
 
 struct Item {
   std::string name;
@@ -61,6 +61,8 @@ struct Decision {
   int64_t remaining = 0;
   int64_t reset_time = 0;
   std::string error;
+  std::string owner;  // metadata["owner"] for forwarded keys (parity
+  // with the gRPC/gateway surface, reference gubernator.go:151)
 };
 
 void put_u16(std::string& b, uint16_t v) { b.append((char*)&v, 2); }
@@ -318,7 +320,13 @@ std::string render_responses(const Decision* d, size_t n) {
     out += num;
     out += "\", \"error\": \"";
     json_escape(out, d[i].error);
-    out += "\", \"metadata\": {}}";
+    if (d[i].owner.empty()) {
+      out += "\", \"metadata\": {}}";
+    } else {
+      out += "\", \"metadata\": {\"owner\": \"";
+      json_escape(out, d[i].owner);
+      out += "\"}}";
+    }
   }
   out += "]}";
   return out;
@@ -446,6 +454,10 @@ class Batcher {
       if (!recv_all(fd, (char*)&elen, 2)) return false;
       all[i].error.resize(elen);
       if (elen && !recv_all(fd, all[i].error.data(), elen)) return false;
+      uint16_t olen;
+      if (!recv_all(fd, (char*)&olen, 2)) return false;
+      all[i].owner.resize(olen);
+      if (olen && !recv_all(fd, all[i].owner.data(), olen)) return false;
     }
     size_t off = 0;
     for (Pending* p : batch) {

@@ -16,9 +16,12 @@ Frame protocol (little-endian, lengths in bytes):
       item: u16 name_len | name | u16 key_len | key |
             i64 hits | i64 limit | i64 duration | u8 algorithm |
             u8 behavior
-  response frame:  u32 magic 'GEB2' | u32 n | n x item
+  response frame:  u32 magic 'GEB3' | u32 n | n x item
       item: u8 status | i64 limit | i64 remaining | i64 reset_time |
-            u16 error_len | error
+            u16 error_len | error | u16 owner_len | owner
+      (owner = metadata["owner"] for forwarded keys, empty otherwise;
+      added in GEB3 — the magic bump makes a version mismatch fail the
+      roundtrip loudly instead of desyncing the stream)
 
 One frame in flight per connection; the edge opens `--workers`
 backend connections (default 2) whose batches round-trip concurrently,
@@ -45,7 +48,7 @@ from gubernator_tpu.serve.config import MAX_BATCH_SIZE
 log = logging.getLogger("gubernator_tpu.edge")
 
 MAGIC_REQ = 0x31424547  # 'GEB1' little-endian
-MAGIC_RESP = 0x32424547  # 'GEB2'
+MAGIC_RESP = 0x33424547  # 'GEB3' (owner field added r3)
 
 _HDR = struct.Struct("<II")
 _ITEM_FIX = struct.Struct("<qqqBB")
@@ -106,6 +109,10 @@ def encode_response_frame(resps) -> bytes:
     parts = [_HDR.pack(MAGIC_RESP, len(resps))]
     for r in resps:
         err = r.error.encode()
+        # metadata["owner"] rides the frame so forwarded responses keep
+        # parity with the gRPC/gateway surface (reference
+        # gubernator.go:151 sets it on every response)
+        owner = r.metadata.get("owner", "").encode()
         parts.append(
             _RESP_FIX.pack(
                 int(r.status), r.limit, r.remaining, r.reset_time
@@ -113,6 +120,8 @@ def encode_response_frame(resps) -> bytes:
         )
         parts.append(struct.pack("<H", len(err)))
         parts.append(err)
+        parts.append(struct.pack("<H", len(owner)))
+        parts.append(owner)
     return b"".join(parts)
 
 

@@ -88,6 +88,8 @@ def test_bridge_answers_bad_item_without_failing_frame():
                 )
                 (elen,) = struct.unpack("<H", await reader.readexactly(2))
                 err = (await reader.readexactly(elen)).decode()
+                (olen,) = struct.unpack("<H", await reader.readexactly(2))
+                await reader.readexactly(olen)  # owner (unused here)
                 out.append((st, limit, rem, reset, err))
             writer.close()
             return out
@@ -103,10 +105,17 @@ def test_bridge_answers_bad_item_without_failing_frame():
 def test_response_roundtrip():
     resps = [
         RateLimitResp(status=Status.OVER_LIMIT, limit=9, remaining=0,
-                      reset_time=42, error="boom"),
+                      reset_time=42, error="boom",
+                      metadata={"owner": "10.0.0.3:81"}),
     ]
     raw = encode_response_frame(resps)
     magic, n = struct.unpack_from("<II", raw)
     assert magic == MAGIC_RESP and n == 1
     st, limit, rem, reset = struct.unpack_from("<Bqqq", raw, 8)
     assert (st, limit, rem, reset) == (1, 9, 0, 42)
+    off = 8 + 25
+    (elen,) = struct.unpack_from("<H", raw, off)
+    assert raw[off + 2 : off + 2 + elen] == b"boom"
+    off += 2 + elen
+    (olen,) = struct.unpack_from("<H", raw, off)
+    assert raw[off + 2 : off + 2 + olen] == b"10.0.0.3:81"

@@ -1,0 +1,173 @@
+"""Functional cluster scenarios THROUGH the native edge (r2 verdict #8).
+
+The C++ edge was fuzz-tested standalone but had never fronted a node in
+the multi-node functional suite. Here a 3-node in-process cluster runs
+with node 0 fronted by guber-edge (HTTP/JSON -> unix-socket frames ->
+node 0's instance), and the reference's forwarding and GLOBAL behaviors
+are exercised end to end through the edge: a non-owned key forwarded to
+its owner over real gRPC, state shared with direct access to the owner
+node, and a GLOBAL key's stale-then-synced replica sequence
+(reference functional_test.go:271-311).
+
+Skipped when the edge binary is not built.
+"""
+
+import json
+import pathlib
+import subprocess
+import time
+import urllib.request
+
+import pytest
+
+from gubernator_tpu.api.proto.gen import gubernator_pb2
+from gubernator_tpu.api.grpc_glue import V1Stub
+from gubernator_tpu.cluster import LocalCluster
+from gubernator_tpu.serve.backends import ExactBackend
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+SOCK = "/tmp/guber-functional-edge.sock"
+EDGE_PORT = 19283
+ADDRS = [f"127.0.0.1:{p}" for p in range(9820, 9823)]
+
+pytestmark = pytest.mark.skipif(
+    not EDGE_BIN.exists(),
+    reason="edge binary not built (make -C gubernator_tpu/native/edge)",
+)
+
+
+def _post_edge(body: dict) -> dict:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{EDGE_PORT}/v1/GetRateLimits",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=10) as r:
+        return json.loads(r.read())
+
+
+async def _attach_bridge(server):
+    from gubernator_tpu.serve.edge_bridge import EdgeBridge
+
+    bridge = EdgeBridge(server.instance, SOCK)
+    await bridge.start()
+    return bridge
+
+
+@pytest.fixture(scope="module")
+def edge_cluster():
+    try:
+        pathlib.Path(SOCK).unlink()
+    except FileNotFoundError:
+        pass
+    cluster = LocalCluster(
+        ADDRS, backend_factory=lambda: ExactBackend(10_000)
+    )
+    cluster.start()
+    bridge = cluster.run(_attach_bridge(cluster.servers[0]))
+    edge = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(EDGE_PORT), "--backend", SOCK],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    deadline = time.monotonic() + 10
+    import socket as _socket
+
+    ready = False
+    while time.monotonic() < deadline:
+        if edge.poll() is not None:
+            out = edge.stdout.read()
+            cluster.run(bridge.stop())
+            cluster.stop()
+            pytest.fail(f"guber-edge died at startup:\n{out}")
+        try:
+            _socket.create_connection(
+                ("127.0.0.1", EDGE_PORT), timeout=1
+            ).close()
+            ready = True
+            break
+        except OSError:
+            time.sleep(0.05)
+    if not ready:
+        edge.kill()
+        cluster.run(bridge.stop())
+        cluster.stop()
+        pytest.fail("guber-edge never started listening")
+    try:
+        yield cluster
+    finally:
+        edge.kill()
+        edge.wait(timeout=5)
+        cluster.run(bridge.stop())
+        cluster.stop()
+
+
+def _key_owned_by_other_node(cluster, name: str) -> str:
+    """A unique_key NOT owned by node 0 (so the edge's node forwards)."""
+    inst = cluster.servers[0].instance
+    for i in range(1000):
+        key = f"fk-{i}"
+        peer = inst.get_peer(f"{name}_{key}")
+        if not peer.is_owner:
+            return key
+    raise AssertionError("no forwarded key found in 1000 tries")
+
+
+def test_forwarded_key_through_edge(edge_cluster):
+    """Edge -> node 0 -> owner peer over real gRPC: transitions
+    1 -> 0 -> OVER arrive through the edge, and the owner's own gRPC
+    surface sees the same consumed state (one shared window)."""
+    key = _key_owned_by_other_node(edge_cluster, "edgefwd")
+    body = {
+        "requests": [
+            {"name": "edgefwd", "uniqueKey": key, "hits": 1,
+             "limit": 2, "duration": 60_000}
+        ]
+    }
+    out1 = _post_edge(body)["responses"][0]
+    assert out1.get("status", "UNDER_LIMIT") == "UNDER_LIMIT"
+    assert int(out1["remaining"]) == 1
+    # forwarded responses carry the owner metadata like the gRPC path
+    assert out1.get("metadata", {}).get("owner") in ADDRS[1:], out1
+    out2 = _post_edge(body)["responses"][0]
+    assert int(out2["remaining"]) == 0
+    out3 = _post_edge(body)["responses"][0]
+    assert out3.get("status") == "OVER_LIMIT"
+
+    # the owner node's direct gRPC surface shares the same window
+    import grpc
+
+    owner_addr = out1["metadata"]["owner"]
+    stub = V1Stub(grpc.insecure_channel(owner_addr))
+    r = gubernator_pb2.RateLimitReq(
+        name="edgefwd", unique_key=key, hits=0, limit=2, duration=60_000
+    )
+    peek = stub.GetRateLimits(
+        gubernator_pb2.GetRateLimitsReq(requests=[r])
+    ).responses[0]
+    assert peek.remaining == 0
+    assert peek.status == gubernator_pb2.OVER_LIMIT
+
+
+def test_global_through_edge(edge_cluster):
+    """GLOBAL key through the edge: replica answers locally, async hits
+    gossip to the owner, broadcast comes back — the reference's
+    stale-then-synced contract through the native front door."""
+    key = _key_owned_by_other_node(edge_cluster, "edgeglob")
+    body = {
+        "requests": [
+            {"name": "edgeglob", "uniqueKey": key, "hits": 1,
+             "limit": 5, "duration": 60_000, "behavior": "GLOBAL"}
+        ]
+    }
+    seq = []
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        seq.append(int(_post_edge(body)["responses"][0]["remaining"]))
+        if seq[-1] <= 2:  # gossip applied at least two earlier hits
+            break
+        time.sleep(0.4)
+    # first answer is the locally-processed miss (4); convergence pulls
+    # the replica's remaining down as the owner's broadcasts land
+    assert seq[0] == 4, seq
+    assert seq[-1] <= 2, f"gossip never converged through the edge: {seq}"
