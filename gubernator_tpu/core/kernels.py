@@ -41,6 +41,14 @@ Data-movement design (the performance core):
   elementwise work while reduce-window cumsum lowering does not.
   Likewise the static per-way select chains below beat a
   jnp.take_along_axis gather along the way axis by ~15% whole-kernel.
+  Measured dead end (v5e, r3): moving the presort ON-device (to let the
+  mesh host ship raw unsorted batches and shuffle via all_to_all, MoE
+  dispatch style) is ruled out by lax.sort cost — a u64/i32
+  sort_key_val measures 1.4-1.9ms at B=4k-32k (verified with an
+  order-sensitive consumer; with permutation-invariant consumers XLA
+  deletes the sort and the probe reads ~0us), i.e. 3-4x the ENTIRE
+  decide kernel. Host presort + thread-pooled native prep stays the
+  design.
 
 Intra-batch duplicate keys
 --------------------------
