@@ -160,15 +160,19 @@ def build_groups(
 def group_rungs(b: int) -> tuple:
     """Group-count padding rungs for a request bucket of size b: G <= n
     always, and real traffic is duplicate-heavy (zipf batches measure
-    G/B ~ 0.23-0.26), so compact rungs at b/4 and 3b/8 plus the full-size
-    fallback capture most of the win for two extra XLA programs per
-    request bucket at warmup. The b/4 rung matters at the flagship batch:
-    32k-row zipf batches carry ~7.4k unique keys, and padding their store
-    I/O to 12288 instead of 8192 costs ~12% of the whole kernel
-    (scripts/profile_decide.py)."""
+    G/B ~ 0.23-0.26), so compact rungs at 15b/64, b/4 and 3b/8 plus the
+    full-size fallback capture most of the win for three extra XLA
+    programs per request bucket at warmup. The fine low rungs matter at
+    the flagship batch: 32k-row zipf batches carry ~7.4-7.6k unique
+    keys; padding their store I/O to 12288 instead of 8192 costs ~12%
+    of the whole kernel, and the r3-added 15b/64 rung (7680) over 8192
+    bought another ~5% — 918 -> 814 us/batch, 40.3M decisions/s
+    (scripts/profile_decide.py; bench.py). MUST stay in lockstep with
+    guberhash.cc group_rungs_c (the native prep's twin)."""
     return tuple(
         sorted(
             {
+                min(b, max(64, (15 * b) // 64)),
                 min(b, max(64, b // 4)),
                 min(b, max(64, (3 * b) // 8)),
                 b,

@@ -683,19 +683,21 @@ class PrepPool {
   int lanes_ = 1;
 };
 
-// group_rungs twin (core/engine.py group_rungs): {b/4, 3b/8, b} floored,
-// min 64, deduped ascending. Returns count; writes into out[3].
-inline int group_rungs_c(int64_t b, int64_t out[3]) {
-  int64_t a = b < 64 ? b : (b / 4 < 64 ? 64 : b / 4);
-  if (a > b) a = b;
-  int64_t c = b < 64 ? b : ((3 * b) / 8 < 64 ? 64 : (3 * b) / 8);
-  if (c > b) c = b;
-  int64_t v[3] = {a, c, b};
-  // insertion sort + dedup (3 elements)
-  for (int i = 1; i < 3; ++i)
+// group_rungs twin (core/engine.py group_rungs): {15b/64, b/4, 3b/8, b}
+// floored, min 64, deduped ascending. Returns count; writes into out[4].
+// MUST stay in lockstep with the Python ladder — the native prep picks
+// its G rung here and the bit-identity tests compare against Python.
+inline int group_rungs_c(int64_t b, int64_t out[4]) {
+  auto rung = [b](int64_t num, int64_t den) {
+    int64_t r = b < 64 ? b : ((num * b) / den < 64 ? 64 : (num * b) / den);
+    return r > b ? b : r;
+  };
+  int64_t v[4] = {rung(15, 64), rung(1, 4), rung(3, 8), b};
+  // insertion sort + dedup (4 elements)
+  for (int i = 1; i < 4; ++i)
     for (int j = i; j > 0 && v[j] < v[j - 1]; --j) std::swap(v[j], v[j - 1]);
   int k = 0;
-  for (int i = 0; i < 3; ++i)
+  for (int i = 0; i < 4; ++i)
     if (k == 0 || v[i] != out[k - 1]) out[k++] = v[i];
   return k;
 }
@@ -988,7 +990,7 @@ int64_t guber_prep_sharded(
     if (g_override < maxg) return -2;
     G = g_override;
   } else {
-    int64_t gr[3];
+    int64_t gr[4];
     const int ng = group_rungs_c(B, gr);
     G = pick_rung(gr, ng, maxg);
     if (G < 0) return -1;  // unreachable: top rung is B >= maxc >= maxg
