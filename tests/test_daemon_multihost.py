@@ -1,0 +1,211 @@
+"""Daemon-level multihost coverage (previously untested: the engine-level
+lockstep suite never exercised cli/daemon.py's GUBER_DIST_* wiring).
+
+- Fail-fast validation: the misconfigurations that would otherwise
+  deadlock a whole mesh inside a collective (leader without
+  backend=multihost, follower count mismatch, follower without a step
+  listener) must exit with a diagnostic BEFORE joining jax.distributed.
+- Full 2-daemon e2e: a leader daemon serving real gRPC over a 2-process
+  global mesh with a follower daemon in lockstep — rate-limit
+  transitions, health, graceful SIGTERM on both.
+"""
+
+import os
+import pathlib
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import grpc
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _clean_env(**extra) -> dict:
+    """Ambient env minus any stray GUBER_* vars (a developer shell's
+    GUBER_DIST_STEP_LISTEN would defeat the fail-fast assertions)."""
+    env = {k: v for k, v in os.environ.items() if not k.startswith("GUBER_")}
+    env["PYTHONPATH"] = str(ROOT)
+    env.update(extra)
+    return env
+
+
+def _run_daemon_env(env_lines, timeout=30):
+    """Run the daemon with a config file; return (rc, output)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".conf", delete=False) as f:
+        f.write("\n".join(env_lines) + "\n")
+        path = f.name
+    try:
+        env = _clean_env()
+        out = subprocess.run(
+            [sys.executable, "-m", "gubernator_tpu.cli.daemon",
+             "--config", path],
+            capture_output=True, text=True, timeout=timeout, cwd=ROOT,
+            env=env,
+        )
+        return out.returncode, out.stdout + out.stderr
+    finally:
+        os.unlink(path)
+
+
+def test_leader_requires_multihost_backend():
+    rc, out = _run_daemon_env([
+        "GUBER_GRPC_ADDRESS=127.0.0.1:0",
+        "GUBER_BACKEND=exact",
+        "GUBER_DIST_COORDINATOR=127.0.0.1:1",
+        "GUBER_DIST_NUM_PROCESSES=2",
+        "GUBER_DIST_PROCESS_ID=0",
+        "GUBER_DIST_FOLLOWERS=127.0.0.1:2",
+    ])
+    assert rc != 0
+    assert "GUBER_BACKEND=multihost" in out, out[-500:]
+
+
+def test_leader_follower_count_must_match():
+    rc, out = _run_daemon_env([
+        "GUBER_GRPC_ADDRESS=127.0.0.1:0",
+        "GUBER_BACKEND=multihost",
+        "GUBER_DIST_COORDINATOR=127.0.0.1:1",
+        "GUBER_DIST_NUM_PROCESSES=3",
+        "GUBER_DIST_PROCESS_ID=0",
+        "GUBER_DIST_FOLLOWERS=127.0.0.1:2",
+    ])
+    assert rc != 0
+    assert "implies" in out and "2 followers" in out, out[-500:]
+
+
+def test_follower_requires_step_listen():
+    rc, out = _run_daemon_env([
+        "GUBER_GRPC_ADDRESS=127.0.0.1:0",
+        "GUBER_DIST_COORDINATOR=127.0.0.1:1",
+        "GUBER_DIST_NUM_PROCESSES=2",
+        "GUBER_DIST_PROCESS_ID=1",
+    ])
+    assert rc != 0
+    assert "GUBER_DIST_STEP_LISTEN" in out, out[-500:]
+
+
+def test_two_daemon_multihost_e2e():
+    """Leader daemon + follower daemon as REAL processes: gRPC serving
+    over a 2-process jax.distributed mesh with the lockstep pipe, tiny
+    bucket ladder (GUBER_DEVICE_BATCH_LIMIT=64) so CPU warmup stays
+    fast. Asserts decisions, health, and graceful SIGTERM shutdown."""
+    coord_port = _free_port()
+    step_port = _free_port()
+    grpc_port = _free_port()
+    base = _clean_env(
+        GUBER_JAX_PLATFORM="cpu",
+        GUBER_DIST_COORDINATOR=f"127.0.0.1:{coord_port}",
+        GUBER_DIST_NUM_PROCESSES="2",
+        GUBER_DEVICE_BATCH_LIMIT="64",
+        GUBER_STORE_SLOTS="256",
+    )
+    # daemon logs go to files, not pipes: an undrained pipe filling its
+    # ~64KB buffer would block the daemon mid-warmup and masquerade as a
+    # startup timeout
+    import tempfile
+
+    l_log = tempfile.NamedTemporaryFile(
+        "w+", suffix=".leader.log", delete=False
+    )
+    f_log = tempfile.NamedTemporaryFile(
+        "w+", suffix=".follower.log", delete=False
+    )
+    follower = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+        env=dict(
+            base,
+            GUBER_DIST_PROCESS_ID="1",
+            GUBER_DIST_STEP_LISTEN=f"127.0.0.1:{step_port}",
+        ),
+        stdout=f_log, stderr=subprocess.STDOUT, cwd=ROOT,
+    )
+    leader = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+        env=dict(
+            base,
+            GUBER_BACKEND="multihost",
+            GUBER_DIST_PROCESS_ID="0",
+            GUBER_DIST_FOLLOWERS=f"127.0.0.1:{step_port}",
+            GUBER_GRPC_ADDRESS=f"127.0.0.1:{grpc_port}",
+            GUBER_PEERS=f"127.0.0.1:{grpc_port}",
+            GUBER_ADVERTISE_ADDRESS=f"127.0.0.1:{grpc_port}",
+        ),
+        stdout=l_log, stderr=subprocess.STDOUT, cwd=ROOT,
+    )
+
+    def _logs():
+        l_log.flush()
+        f_log.flush()
+        return (
+            pathlib.Path(l_log.name).read_text()[-2000:],
+            pathlib.Path(f_log.name).read_text()[-2000:],
+        )
+
+    def _fail(msg):
+        leader.kill()
+        follower.kill()
+        leader.wait(timeout=10)
+        follower.wait(timeout=10)
+        l_out, f_out = _logs()
+        pytest.fail(f"{msg}\nleader:\n{l_out}\nfollower:\n{f_out}")
+
+    try:
+        from gubernator_tpu.api.grpc_glue import V1Stub
+        from gubernator_tpu.api.proto.gen import gubernator_pb2
+
+        chan = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
+        stub = V1Stub(chan)
+        deadline = time.monotonic() + 240  # warmup compiles the ladder
+        hc = None
+        while time.monotonic() < deadline:
+            if leader.poll() is not None or follower.poll() is not None:
+                _fail("a daemon died during startup")
+            try:
+                hc = stub.HealthCheck(
+                    gubernator_pb2.HealthCheckReq(), timeout=2
+                )
+                break
+            except grpc.RpcError:
+                time.sleep(1.0)
+        if hc is None:
+            _fail("leader gRPC never became healthy")
+        assert hc.status == "healthy", hc
+
+        r = gubernator_pb2.RateLimitReq(
+            name="mh-daemon", unique_key="k", hits=1, limit=2,
+            duration=60_000,
+        )
+        seq = []
+        for _ in range(3):
+            resp = stub.GetRateLimits(
+                gubernator_pb2.GetRateLimitsReq(requests=[r]), timeout=30
+            ).responses[0]
+            seq.append((resp.status, resp.remaining))
+        assert seq == [(0, 1), (0, 0), (1, 0)], seq
+
+        # graceful shutdown: SIGTERM the leader; its pipe close releases
+        # the follower, then SIGTERM the follower if it lingers
+        leader.send_signal(signal.SIGTERM)
+        l_rc = leader.wait(timeout=60)
+        f_rc = follower.wait(timeout=30)  # pipe close ends follower_loop
+        assert l_rc == 0, (l_rc, _logs()[0])
+        assert f_rc == 0, (f_rc, _logs()[1])
+    finally:
+        for p in (leader, follower):
+            if p.poll() is None:
+                p.kill()
+                p.wait(timeout=10)
+        os.unlink(l_log.name)
+        os.unlink(f_log.name)
