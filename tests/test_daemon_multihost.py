@@ -7,13 +7,13 @@ lockstep suite never exercised cli/daemon.py's GUBER_DIST_* wiring).
   listener) must exit with a diagnostic BEFORE joining jax.distributed.
 - Full 2-daemon e2e: a leader daemon serving real gRPC over a 2-process
   global mesh with a follower daemon in lockstep — rate-limit
-  transitions, health, graceful SIGTERM on both.
+  transitions, health, graceful leader SIGTERM whose pipe close must
+  release the follower (both exit 0).
 """
 
 import os
 import pathlib
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -21,13 +21,9 @@ import time
 import grpc
 import pytest
 
+from tests._util import free_ports
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
 
 
 def _clean_env(**extra) -> dict:
@@ -101,9 +97,7 @@ def test_two_daemon_multihost_e2e():
     over a 2-process jax.distributed mesh with the lockstep pipe, tiny
     bucket ladder (GUBER_DEVICE_BATCH_LIMIT=64) so CPU warmup stays
     fast. Asserts decisions, health, and graceful SIGTERM shutdown."""
-    coord_port = _free_port()
-    step_port = _free_port()
-    grpc_port = _free_port()
+    coord_port, step_port, grpc_port = free_ports(3)
     base = _clean_env(
         GUBER_JAX_PLATFORM="cpu",
         GUBER_DIST_COORDINATOR=f"127.0.0.1:{coord_port}",
@@ -195,11 +189,15 @@ def test_two_daemon_multihost_e2e():
             seq.append((resp.status, resp.remaining))
         assert seq == [(0, 1), (0, 0), (1, 0)], seq
 
-        # graceful shutdown: SIGTERM the leader; its pipe close releases
-        # the follower, then SIGTERM the follower if it lingers
+        # graceful shutdown: SIGTERM the leader; its pipe close must end
+        # the follower_loop on its own (that release IS what this
+        # asserts — a lingering follower is the regression)
         leader.send_signal(signal.SIGTERM)
         l_rc = leader.wait(timeout=60)
-        f_rc = follower.wait(timeout=30)  # pipe close ends follower_loop
+        try:
+            f_rc = follower.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            _fail("follower not released by the leader's pipe close")
         assert l_rc == 0, (l_rc, _logs()[0])
         assert f_rc == 0, (f_rc, _logs()[1])
     finally:
