@@ -21,7 +21,7 @@ import time
 import grpc
 import pytest
 
-from tests._util import free_ports
+from _util import free_ports
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 

@@ -265,7 +265,7 @@ def test_fakes_match_discovery_contract():
     tests/test_discovery_real.py pins on the REAL etcd3/kubernetes
     libraries when they are installed. A fake that grows out of sync
     with the contract fails here; a library that moves fails there."""
-    from tests._discovery_contract import (
+    from _discovery_contract import (
         ETCD_CLIENT_CALLS,
         ETCD_LEASE_CALLS,
         K8S_WATCH_CALLS,

@@ -16,7 +16,7 @@ import os
 
 import pytest
 
-from tests._discovery_contract import (
+from _discovery_contract import (
     ETCD_CLIENT_CALLS,
     ETCD_CLIENT_CTOR_CALL,
     ETCD_LEASE_CALLS,

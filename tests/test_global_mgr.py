@@ -1,0 +1,199 @@
+"""GlobalManager unit tests with a fake instance — direct coverage of
+the gossip loops that e2e tests only exercise as a black box (reference
+global.go:29-232 behaviors):
+
+- per-key hit aggregation before a flush (one forwarded request carries
+  the summed hits),
+- immediate flush at global_batch_limit vs coalescing window below it,
+- broadcast dedup (last queued state wins per key), owner-peer skip,
+- one failing peer must not block the others or kill the loop.
+"""
+
+import asyncio
+from dataclasses import dataclass, field
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
+from gubernator_tpu.serve.config import BehaviorConfig
+from gubernator_tpu.serve.global_mgr import GlobalManager
+
+
+def _req(key: str, hits=1, behavior=Behavior.GLOBAL) -> RateLimitReq:
+    return RateLimitReq(
+        name="gm", unique_key=key, hits=hits, limit=10, duration=60_000,
+        behavior=behavior,
+    )
+
+
+@dataclass
+class FakePeer:
+    host: str
+    is_owner: bool = False
+    fail: bool = False
+    hit_batches: list = field(default_factory=list)
+    update_batches: list = field(default_factory=list)
+
+    async def get_peer_rate_limits(self, reqs):
+        if self.fail:
+            raise RuntimeError(f"{self.host} unreachable")
+        self.hit_batches.append(list(reqs))
+        return [RateLimitResp(limit=r.limit) for r in reqs]
+
+    async def update_peer_globals(self, updates):
+        if self.fail:
+            raise RuntimeError(f"{self.host} unreachable")
+        self.update_batches.append(list(updates))
+
+
+class FakeInstance:
+    """Key ownership by prefix: key 'a…' -> peer A, 'b…' -> peer B…"""
+
+    def __init__(self, peers):
+        self.peers = peers
+        self.decided = []
+
+    def get_peer(self, hash_key):
+        # hash_key is "name_uniquekey"; route on the unique key's first char
+        first = hash_key.split("_", 1)[1][0]
+        return self.peers[first]
+
+    def peer_list(self):
+        return list(self.peers.values())
+
+    async def decide_local(self, reqs, gnp):
+        self.decided.append(list(reqs))
+        return [
+            RateLimitResp(limit=r.limit, remaining=r.limit - 3)
+            for r in reqs
+        ]
+
+
+def _conf(**kw):
+    base = dict(
+        global_sync_wait=0.02, global_batch_limit=1000, global_timeout=2.0
+    )
+    base.update(kw)
+    return BehaviorConfig(**base)
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=20))
+
+
+def test_hits_aggregate_per_key_before_flush():
+    peers = {"a": FakePeer("A"), "b": FakePeer("B", is_owner=True)}
+    inst = FakeInstance(peers)
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        # three hits on one key + one on another, all owned by peer A —
+        # queued within one window so they coalesce into ONE flush
+        gm.queue_hit(_req("a1", hits=2))
+        gm.queue_hit(_req("a1", hits=3))
+        gm.queue_hit(_req("a2", hits=1))
+        for _ in range(200):
+            if peers["a"].hit_batches:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert len(peers["a"].hit_batches) == 1
+    sent = {r.unique_key: r.hits for r in peers["a"].hit_batches[0]}
+    assert sent == {"a1": 5, "a2": 1}  # summed per key (global.go:78-86)
+    assert peers["b"].hit_batches == []  # nothing owned by B was queued
+
+
+def test_batch_limit_flushes_without_window():
+    peers = {"a": FakePeer("A"), "b": FakePeer("B", is_owner=True)}
+    inst = FakeInstance(peers)
+
+    async def main():
+        # window absurdly long: only the batch-limit path can flush
+        gm = GlobalManager(
+            _conf(global_sync_wait=30.0, global_batch_limit=3), inst
+        )
+        gm.start()
+        for i in range(3):
+            gm.queue_hit(_req(f"a{i}"))
+        for _ in range(200):
+            if peers["a"].hit_batches:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert len(peers["a"].hit_batches) == 1
+    assert len(peers["a"].hit_batches[0]) == 3
+
+
+def test_broadcast_dedup_last_wins_and_skips_owner():
+    peers = {
+        "a": FakePeer("A"),
+        "b": FakePeer("B", is_owner=True),
+        "c": FakePeer("C"),
+    }
+    inst = FakeInstance(peers)
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        gm.queue_update(_req("a1", hits=1))
+        gm.queue_update(_req("a1", hits=9))  # same key dedups
+        gm.queue_update(_req("c7", hits=1))
+        for _ in range(200):
+            if peers["a"].update_batches and peers["c"].update_batches:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    # the owner peer (self) is never broadcast to (global.go:215-229)
+    assert peers["b"].update_batches == []
+    # both non-owners got ONE batch of the two deduped keys
+    for p in ("a", "c"):
+        assert len(peers[p].update_batches) == 1
+        keys = sorted(k for k, _ in peers[p].update_batches[0])
+        assert keys == ["gm_a1", "gm_c7"]
+    # the peek decide was a zero-hit non-GLOBAL read (global.go:200-203)
+    (peek_batch,) = inst.decided
+    assert all(r.hits == 0 for r in peek_batch)
+    assert all(r.behavior == Behavior.BATCHING for r in peek_batch)
+
+
+def test_failing_peer_does_not_block_others_or_kill_loops():
+    peers = {
+        "a": FakePeer("A", fail=True),
+        "b": FakePeer("B", is_owner=True),
+        "c": FakePeer("C"),
+    }
+    inst = FakeInstance(peers)
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        gm.queue_hit(_req("a1"))  # flush to A raises
+        gm.queue_hit(_req("c1"))  # must still reach C
+        for _ in range(200):
+            if peers["c"].hit_batches:
+                break
+            await asyncio.sleep(0.01)
+        # broadcast path: A fails, C still receives
+        gm.queue_update(_req("c2"))
+        for _ in range(200):
+            if peers["c"].update_batches:
+                break
+            await asyncio.sleep(0.01)
+        # loops survived both errors: another hit still flushes
+        gm.queue_hit(_req("c3"))
+        for _ in range(200):
+            if len(peers["c"].hit_batches) >= 2:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert len(peers["c"].hit_batches) >= 2
+    assert peers["c"].update_batches, "broadcast blocked by failing peer"

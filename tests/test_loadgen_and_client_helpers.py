@@ -45,7 +45,7 @@ def test_loadgen_against_cluster(capsys):
     import asyncio
 
     from gubernator_tpu.cli import loadgen
-    from tests._util import free_ports
+    from _util import free_ports
 
     cluster = LocalCluster(
         [f"127.0.0.1:{p}" for p in free_ports(2)],

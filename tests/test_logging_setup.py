@@ -1,0 +1,35 @@
+"""logging_setup parity tests (reference logging/logging.go:25-54)."""
+
+import pytest
+
+
+def test_logging_setup_levels_and_json():
+    """logging_setup parity with the reference's logrus surface
+    (logging/logging.go:25-54): every logrus spelling parses, unknown
+    names raise, and the JSON formatter emits one object per line with
+    the category field."""
+    import json as _json
+    import logging as _logging
+
+    from gubernator_tpu.serve.logging_setup import JsonFormatter, parse_level
+
+    for name, want in [
+        ("panic", _logging.CRITICAL), ("fatal", _logging.CRITICAL),
+        ("error", _logging.ERROR), ("warning", _logging.WARNING),
+        ("warn", _logging.WARNING), ("info", _logging.INFO),
+        ("debug", _logging.DEBUG), ("trace", _logging.DEBUG),
+        (" INFO ", _logging.INFO),  # trimmed + case-insensitive
+    ]:
+        assert parse_level(name) == want, name
+    with pytest.raises(ValueError, match="unknown log level"):
+        parse_level("loud")
+
+    rec = _logging.LogRecord(
+        name="gubernator_tpu.instance", level=_logging.WARNING,
+        pathname=__file__, lineno=1, msg="peer %s down", args=("x:1",),
+        exc_info=None,
+    )
+    out = _json.loads(JsonFormatter().format(rec))
+    assert out["level"] == "warning"
+    assert out["category"] == "gubernator_tpu.instance"
+    assert out["msg"] == "peer x:1 down"
