@@ -11,6 +11,7 @@ Instance.set_peers, and graceful shutdown.
 from __future__ import annotations
 
 import asyncio
+import os
 import json
 import logging
 import time
@@ -198,6 +199,27 @@ class Server:
             )
         await self.grpc_server.start()
         log.info("gRPC listening on %s", self.conf.grpc_address)
+        try:
+            from gubernator_tpu.native import hashlib_native as _hn
+
+            has_prep = getattr(_hn, "_HAS_PREP", False)
+        except (ImportError, AttributeError, OSError):
+            # same fallback envelope as the engine's import (a
+            # present-but-unloadable .so must not abort startup — the
+            # numpy paths serve fine)
+            has_prep = False
+        if has_prep:
+            log.info(
+                "native prep: %d thread(s) (GUBER_PREP_THREADS), "
+                "writeback=%s (GUBER_WRITEBACK)",
+                _hn.prep_threads(),
+                os.environ.get("GUBER_WRITEBACK", "auto"),
+            )
+        else:
+            log.info(
+                "native prep library not built/loadable; numpy "
+                "fallbacks active"
+            )
 
         if self.conf.http_address:
             await self._start_http()
