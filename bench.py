@@ -47,7 +47,13 @@ def main():
     # the b/4 group rung (~0.87ms/batch — inside the serving latency
     # envelope). 32k keeps the flagship number consistent with the p99
     # < 1ms serving story.
-    R = 8  # distinct pre-staged batches cycled through
+    R = 8  # distinct pre-staged batches cycled through. The per-step
+    # i%R dynamic-slice of the staged [R, B] arrays costs ~145us/batch
+    # (measured r3: R=1 runs 716us/batch vs R=8's 861) — kept
+    # DELIBERATELY: each step must consume a fresh input buffer the way
+    # serving consumes each batch's host transfer, and with R=1 XLA can
+    # hoist loop-invariant key-derived work (bucket/fingerprint of an
+    # unchanging key array), overstating steady-state throughput.
     S = 1024  # decide steps fused into one device program (large S
     # amortizes the ~100ms per-call latency of a tunnel-attached device
     # to ~100us/call; on directly-attached hardware it changes nothing)
