@@ -159,10 +159,21 @@ def main(argv=None) -> int:
     args = parser.parse_args(argv)
 
     backend_factory = None
-    if args.backend != "tpu":
+    if args.backend == "exact":
         from gubernator_tpu.serve.backends import ExactBackend
 
         backend_factory = lambda: ExactBackend(100_000)  # noqa: E731
+    elif args.backend == "mesh":
+        from gubernator_tpu.core.store import StoreConfig
+        from gubernator_tpu.serve.backends import MeshBackend
+
+        backend_factory = lambda: MeshBackend(  # noqa: E731
+            StoreConfig(rows=16, slots=1 << 12)
+        )
+    elif args.backend != "tpu":
+        # an unknown name silently benching the wrong backend would
+        # publish numbers under a false label
+        parser.error(f"unknown --backend {args.backend!r}")
 
     # node 0 also serves the Python HTTP/JSON gateway so the edge's
     # front-door multiplier is a measured comparison, not a claim
