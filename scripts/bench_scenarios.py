@@ -153,7 +153,14 @@ def _measure_kernel(
             r = jax.tree.map(lambda x: x[i % R], reqs)
             g = jax.tree.map(lambda x: x[i % R], groups)
             store, resp, _ = decide_presorted(store, r, t0 + i, g)
-            return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
+            # consume EVERY response field (status-only reductions let
+            # XLA DCE the remaining/reset/limit math — measured ~10%
+            # inflation; same fix as bench.py r3)
+            acc = acc + jnp.sum(resp.status, dtype=jnp.int32) + jnp.sum(
+                resp.remaining ^ resp.reset_time ^ resp.limit,
+                dtype=jnp.int32,
+            )
+            return store, acc
 
         return lax.fori_loop(0, S, body, (store, jnp.zeros((), jnp.int32)))
 
@@ -283,7 +290,12 @@ def scenario_global_mesh():
                 return store2
 
             store = lax.cond(i % 8 == 7, do_sync, lambda s: s, store)
-            return store, acc + jnp.sum(resp.status, dtype=jnp.int32)
+            # full-consumption checksum (see single-device steps above)
+            acc = acc + jnp.sum(resp.status, dtype=jnp.int32) + jnp.sum(
+                resp.remaining ^ resp.reset_time ^ resp.limit,
+                dtype=jnp.int32,
+            )
+            return store, acc
 
         store, acc = lax.fori_loop(
             0, S, body, (store, jnp.zeros((), jnp.int32))
