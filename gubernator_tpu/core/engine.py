@@ -440,13 +440,40 @@ def unpermute_responses(order: np.ndarray, sorted_arrays):
 
 
 class EngineStats:
+    """Monotonic counters. Batch results land via add_batch under a lock:
+    with fetch_depth > 1 the batcher completes several decide_waits
+    concurrently (serve/batcher.py), and unlocked += would drop counts."""
+
     def __init__(self):
+        import threading
+
         self.hits = 0
         self.misses = 0
         self.batches = 0
+        # over-admission signals (kernels.BatchStats dropped/evictions)
+        self.dropped = 0
+        self.evictions = 0
+        self._lock = threading.Lock()
+
+    def add_batch(
+        self, hits: int, misses: int, dropped: int = 0, evictions: int = 0
+    ) -> None:
+        with self._lock:
+            self.hits += hits
+            self.misses += misses
+            self.dropped += dropped
+            self.evictions += evictions
+            self.batches += 1
 
     def snapshot(self):
-        return dict(hits=self.hits, misses=self.misses, batches=self.batches)
+        with self._lock:
+            return dict(
+                hits=self.hits,
+                misses=self.misses,
+                batches=self.batches,
+                dropped=self.dropped,
+                evictions=self.evictions,
+            )
 
 
 class TpuEngine:
@@ -581,9 +608,12 @@ class TpuEngine:
         """Fetch + unpermute the responses for a decide_submit handle."""
         packed, order, n, B, epoch = handle
         packed = np.asarray(jax.device_get(packed))
-        self.stats.hits += int(packed[4 * B])
-        self.stats.misses += int(packed[4 * B + 1])
-        self.stats.batches += 1
+        self.stats.add_batch(
+            int(packed[4 * B]),
+            int(packed[4 * B + 1]),
+            int(packed[4 * B + 2]),
+            int(packed[4 * B + 3]),
+        )
         # responses come back in sorted order; one pass unpermutes (the
         # [4, B] view of the packed transfer is zero-copy)
         if _marshal is not None:
@@ -592,9 +622,7 @@ class TpuEngine:
             )
             status, rlimit, remaining, reset = u[0], u[1], u[2], u[3]
         else:
-            s_status, s_lim, s_rem, s_reset, _h, _m = unpack_outputs(
-                packed, B
-            )
+            s_status, s_lim, s_rem, s_reset = unpack_outputs(packed, B)[:4]
             status, rlimit, remaining, reset = unpermute_responses(
                 order, (s_status, s_lim, s_rem, s_reset)
             )

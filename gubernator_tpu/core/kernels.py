@@ -180,6 +180,12 @@ class BatchResponse(NamedTuple):
 class BatchStats(NamedTuple):
     hits: jax.Array  # int32 scalar: groups answered from live state
     misses: jax.Array  # int32 scalar: groups created/recreated
+    # over-admission signals (reference exposes cache_size against a
+    # known max, cache/lru.go:56-59; a slot store at capacity instead
+    # silently sheds state, so these MUST be observable — /metrics
+    # exports them as store_dropped_creates_total / store_evictions_total)
+    dropped: jax.Array  # int32 scalar: creates lost to way exhaustion
+    evictions: jax.Array  # int32 scalar: live entries overwritten
 
 
 
@@ -269,9 +275,11 @@ def _writeback_delta_add(
     cand: jax.Array,  # int32[B, ways, LANES] pre-write bucket contents
     is_b_leader: jax.Array,  # bool[B] first item of its bucket segment
     b_end: jax.Array,  # int32[B] inclusive end of the bucket segment
-) -> jax.Array:
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Apply per-entry updates as ONE scatter-ADD of delta rows — no
-    cross-group merge pass at all.
+    cross-group merge pass at all. Returns (new_data, n_dropped,
+    n_evicted): creates lost to way exhaustion and occupied ways
+    overwritten, the store's over-admission signals.
 
     Each designated writer adds (new_vals - old_entry_lanes) into its
     way's lanes of its bucket row; all other positions add zero rows at
@@ -346,6 +354,11 @@ def _writeback_delta_add(
         > 0
     )
     dropped = miss_w & ~has_empty & ((rank > 0) | fconf)
+    # a miss that writes with no empty way left overwrites the
+    # earliest-expiry occupant: that's an eviction (lazy-expired entries
+    # are indistinguishable from live ones here — counting them is the
+    # conservative direction for an over-admission alarm)
+    evicted = miss_w & ~has_empty & ~dropped
 
     writer = found_w | (miss_w & ~dropped)
     way = jnp.where(found, fway, eway_sel)
@@ -361,11 +374,17 @@ def _writeback_delta_add(
         dmask[:, :, None], delta8[:, None, :], 0
     ).reshape(B, W)
 
+    n_dropped = jnp.sum(dropped).astype(jnp.int32)
+    n_evicted = jnp.sum(evicted).astype(jnp.int32)
     if _use_sweep_writeback(buckets, W, B):
         from gubernator_tpu.core.pallas_sweep import _apply_inline
 
-        return _apply_inline(data, bkt, drow)
-    return data.at[bkt].add(drow, indices_are_sorted=True)
+        return _apply_inline(data, bkt, drow), n_dropped, n_evicted
+    return (
+        data.at[bkt].add(drow, indices_are_sorted=True),
+        n_dropped,
+        n_evicted,
+    )
 
 
 def decide_presorted(
@@ -759,7 +778,7 @@ def decide_presorted(
     # Delta-add writeback: each writing group adds (new - old) into its
     # way's lanes; disjoint ways compose exactly and the store keeps its
     # canonical shape (see _writeback_delta_add).
-    new_data = _writeback_delta_add(
+    new_data, n_dropped, n_evicted = _writeback_delta_add(
         store.data,
         bkt,
         w_mask,
@@ -782,6 +801,8 @@ def decide_presorted(
         misses=jnp.sum(
             jnp.where(groups.valid & ~g_live, 1, 0)
         ).astype(jnp.int32),
+        dropped=n_dropped,
+        evictions=n_evicted,
     )
     return Store(data=new_data), resp, stats
 
@@ -914,41 +935,51 @@ def upsert_globals(
     )
     is_b_leader = ~b_same_prev
     b_end = _segment_ends(is_b_leader, ar)
-    return Store(
-        data=_writeback_delta_add(
-            store.data,
-            bkt,
-            writer,
-            found,
-            fway,
-            eway,
-            new_vals,
-            cand,
-            is_b_leader,
-            b_end,
-        )
+    # drop/eviction counts are discarded on this path: replica installs
+    # shed REPLICA state (re-creatable from the next broadcast), not the
+    # owner-side admission state the over-admission alarm watches
+    new_data, _n_dropped, _n_evicted = _writeback_delta_add(
+        store.data,
+        bkt,
+        writer,
+        found,
+        fway,
+        eway,
+        new_vals,
+        cand,
+        is_b_leader,
+        b_end,
     )
+    return Store(data=new_data)
+
+
+# scalar tail of the packed transfer: hits, misses, dropped, evictions
+PACKED_STATS = 4
 
 
 def pack_outputs(resp: BatchResponse, stats: BatchStats) -> jax.Array:
-    """Responses + stats as ONE int32[4*B+2] array: remote/tunneled
-    devices charge per transfer, so hosts fetch a single array and split
-    with unpack_outputs (measured 320ms -> 114ms per 1k batch through
-    the axon tunnel; locally it removes five dispatch round-trips)."""
+    """Responses + stats as ONE int32[4*B+PACKED_STATS] array:
+    remote/tunneled devices charge per transfer, so hosts fetch a single
+    array and split with unpack_outputs (measured 320ms -> 114ms per 1k
+    batch through the axon tunnel; locally it removes five dispatch
+    round-trips)."""
     return jnp.concatenate(
         [
             resp.status,
             resp.limit,
             resp.remaining,
             resp.reset_time,
-            jnp.stack([stats.hits, stats.misses]),
+            jnp.stack(
+                [stats.hits, stats.misses, stats.dropped, stats.evictions]
+            ),
         ]
     )
 
 
 def unpack_outputs(packed, B: int):
-    """(status, limit, remaining, reset_time, hits, misses) from a
-    pack_outputs array (host-side numpy or device array)."""
+    """(status, limit, remaining, reset_time, hits, misses, dropped,
+    evictions) from a pack_outputs array (host-side numpy or device
+    array)."""
     return (
         packed[0:B],
         packed[B : 2 * B],
@@ -956,6 +987,8 @@ def unpack_outputs(packed, B: int):
         packed[3 * B : 4 * B],
         packed[4 * B],
         packed[4 * B + 1],
+        packed[4 * B + 2],
+        packed[4 * B + 3],
     )
 
 

@@ -19,6 +19,7 @@
 //                   [--batch-wait-us 500] [--batch-limit 1000]
 
 #include <arpa/inet.h>
+#include <csignal>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
@@ -27,6 +28,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <chrono>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdio>
@@ -36,6 +38,7 @@
 #include <mutex>
 #include <string>
 #include <thread>
+#include <unordered_map>
 #include <vector>
 
 namespace {
@@ -677,13 +680,17 @@ void serve_connection(int fd, Batcher* batcher) {
   }
 }
 
+// gRPC/HTTP2 terminator (serve_grpc_connection + HPACK + proto codec);
+// shares Item/Decision/Batcher above, hence the in-namespace include
+#include "h2_grpc.inc"
+
 }  // namespace
 
-#include <chrono>
-
 static const char kUsage[] =
-    "guber-edge: native HTTP/JSON front door for gubernator-tpu\n"
+    "guber-edge: native HTTP/JSON + gRPC front door for gubernator-tpu\n"
     "  --listen PORT          TCP port to serve HTTP on (default 8080)\n"
+    "  --grpc-listen PORT     TCP port to serve gRPC (h2c) on "
+    "(default 0 = off)\n"
     "  --backend PATH         daemon's edge unix socket "
     "(default /tmp/guber-edge.sock)\n"
     "  --batch-wait-us N      cross-connection batch window (default 500)\n"
@@ -703,7 +710,12 @@ static bool parse_int_flag(const char* v, int* out) {
 }
 
 int main(int argc, char** argv) {
+  // a client that resets its connection mid-write must fail that write
+  // (EPIPE), not SIGPIPE-kill the whole edge — e.g. the GOAWAY sent
+  // while tearing down an h2 connection the peer already closed
+  signal(SIGPIPE, SIG_IGN);
   int port = 8080;
+  int grpc_port = 0;
   std::string backend = "/tmp/guber-edge.sock";
   int batch_wait_us = 500;
   int batch_limit = 1000;
@@ -721,6 +733,7 @@ int main(int argc, char** argv) {
     const char* v = argv[i + 1];
     bool ok = true;
     if (a == "--listen") ok = parse_int_flag(v, &port);
+    else if (a == "--grpc-listen") ok = parse_int_flag(v, &grpc_port);
     else if (a == "--backend") backend = v;
     else if (a == "--batch-wait-us") ok = parse_int_flag(v, &batch_wait_us);
     else if (a == "--batch-limit") ok = parse_int_flag(v, &batch_limit);
@@ -762,29 +775,64 @@ int main(int argc, char** argv) {
     return 1;
   }
 
+  // gRPC listener binds up front too (fail fast on a taken port)
+  int grpc_srv = -1;
+  if (grpc_port > 0) {
+    grpc_srv = socket(AF_INET, SOCK_STREAM, 0);
+    if (grpc_srv < 0) {
+      perror("socket");
+      return 1;
+    }
+    setsockopt(grpc_srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in gaddr{};
+    gaddr.sin_family = AF_INET;
+    gaddr.sin_addr.s_addr = htonl(INADDR_ANY);
+    gaddr.sin_port = htons((uint16_t)grpc_port);
+    if (bind(grpc_srv, (sockaddr*)&gaddr, sizeof gaddr) != 0 ||
+        listen(grpc_srv, 512) != 0) {
+      perror("bind/listen (grpc)");
+      return 1;
+    }
+  }
+
   Batcher batcher(backend, batch_wait_us, batch_limit, workers);
-  fprintf(stderr, "guber-edge listening on :%d backend=%s\n", port,
+  fprintf(stderr, "guber-edge listening on :%d%s backend=%s\n", port,
+          grpc_port > 0
+              ? (" grpc=:" + std::to_string(grpc_port)).c_str()
+              : "",
           backend.c_str());
   fflush(stderr);
-  while (true) {
-    int fd = accept(srv, nullptr, nullptr);
-    if (fd < 0) continue;
-    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
-    // receive timeout: a slow-loris / idle keep-alive client gets its
-    // read() failed after --recv-timeout-s and the thread exits
-    timeval tv{};
-    tv.tv_sec = g_recv_timeout_s;
-    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-    // send timeout: a client that stops reading its response must fail
-    // the write, not block the thread forever with the conn slot held
-    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
-    if (g_conns.fetch_add(1, std::memory_order_relaxed) >= g_max_conns) {
-      g_conns.fetch_sub(1, std::memory_order_relaxed);
-      http_reply(fd, 503, "Service Unavailable",
-                 "{\"error\": \"connection limit reached\"}");
-      close(fd);
-      continue;
+
+  auto accept_loop = [&one](int lsrv, Batcher* b, bool grpc) {
+    while (true) {
+      int fd = accept(lsrv, nullptr, nullptr);
+      if (fd < 0) continue;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      // receive timeout: a slow-loris / idle keep-alive client gets its
+      // read() failed after --recv-timeout-s and the thread exits. The
+      // same timeout bounds gRPC connections (gRPC clients keep
+      // connections alive with PINGs well inside any sane timeout).
+      timeval tv{};
+      tv.tv_sec = g_recv_timeout_s;
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+      // send timeout: a client that stops reading its response must
+      // fail the write, not block the thread forever
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
+      if (g_conns.fetch_add(1, std::memory_order_relaxed) >= g_max_conns) {
+        g_conns.fetch_sub(1, std::memory_order_relaxed);
+        if (!grpc)
+          http_reply(fd, 503, "Service Unavailable",
+                     "{\"error\": \"connection limit reached\"}");
+        close(fd);  // gRPC: plain close; client sees connection refused
+        continue;
+      }
+      std::thread(grpc ? serve_grpc_connection : serve_connection, fd, b)
+          .detach();
     }
-    std::thread(serve_connection, fd, &batcher).detach();
+  };
+
+  if (grpc_srv >= 0) {
+    std::thread(accept_loop, grpc_srv, &batcher, true).detach();
   }
+  accept_loop(srv, &batcher, false);
 }

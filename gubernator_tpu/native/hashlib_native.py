@@ -343,15 +343,35 @@ def prep_threads() -> int:
     return int(_lib.guber_prep_threads())
 
 
+_PREP_GENS = 2
+
+
+def set_prep_generations(gens: int) -> None:
+    """Size the prep-buffer ring (serve/batcher.py's fetch_depth sets
+    gens = depth + 1 at construction, before traffic). Generation k is
+    reused at the k+gens'th prep call on the same thread.
+
+    NOTE the ring is NOT what guarantees in-flight correctness under the
+    batcher's out-of-order fetch pipeline — no fixed depth could (a
+    stalled fetch can be outrun by later submits without bound). The
+    guarantees are: (a) decide handles COPY the order/take views they
+    keep (sharded.decide_submit), and (b) jax commits host inputs during
+    dispatch, before submit returns (verified by mutate-after-dispatch).
+    The deeper ring is defense-in-depth for PJRT backends whose dispatch
+    might stage host buffers lazily. Threads pick the new width up on
+    their next prep call."""
+    global _PREP_GENS
+    _PREP_GENS = max(2, int(gens))
+
+
 class _PrepBuffers:
-    """Reusable output buffers for prep_sharded, flip-flopped across
-    calls. Fresh np.empty per call costs ~0.5-1ms of soft page faults at
+    """Reusable output buffers for prep_sharded, rotated across calls.
+    Fresh np.empty per call costs ~0.5-1ms of soft page faults at
     32k batches (every large allocation is a new zeroed mmap); reusing
-    warm pages removes that entirely. TWO generations alternate so the
-    pipelined engine (at most two batches in flight, submits serialized
-    — serve/batcher.py) never sees generation k's arrays overwritten
-    before its wait: generation k is reused no earlier than submit k+2,
-    by which point fetch k has completed."""
+    warm pages removes that entirely. The ring holds _PREP_GENS
+    generations (default two: at most two batches in flight, submits
+    serialized — serve/batcher.py) so a pipelined engine never sees
+    generation k's arrays overwritten before its wait."""
 
     _SPECS = (
         ("order", np.int32), ("counts", np.int64), ("take", np.int64),
@@ -362,12 +382,16 @@ class _PrepBuffers:
     )
 
     def __init__(self):
-        self._gens = [{}, {}]
+        self._gens: list = []
         self._flip = 0
 
     def take(self, sizes: dict) -> dict:
+        if len(self._gens) != _PREP_GENS:
+            # ring width changed (set_prep_generations) or first use
+            self._gens = [{} for _ in range(_PREP_GENS)]
+            self._flip = 0
         gen = self._gens[self._flip]
-        self._flip ^= 1
+        self._flip = (self._flip + 1) % len(self._gens)
         out = {}
         for name, dtype in self._SPECS:
             need = sizes[name]
@@ -404,10 +428,11 @@ def prep_sharded(
     Raises ValueError when g_override can't hold a shard's group count
     (mirrors pad_request_sharded's numpy path).
 
-    LIFETIME: returned arrays are views into flip-flopped reusable
-    buffers — valid until the SECOND-next prep_sharded call (matches the
-    pipelined engine's two-in-flight bound). Callers keeping results
-    longer must copy."""
+    LIFETIME: returned arrays are views into a reusable buffer ring —
+    valid until the _PREP_GENS'th next prep_sharded call on the same
+    thread (default 2; see set_prep_generations). Callers keeping
+    results past that — e.g. decide handles under a deep fetch
+    pipeline — must copy."""
     if not _HAS_PREP:
         raise AttributeError(
             "libguberhash.so predates guber_prep_sharded; rebuild with "

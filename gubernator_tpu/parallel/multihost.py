@@ -28,9 +28,7 @@ crosses DCN — jax device order (process-major) does this by default.
 
 from __future__ import annotations
 
-import io
 import logging
-import pickle
 import socket
 import struct
 from typing import List, Optional, Sequence
@@ -39,14 +37,164 @@ import numpy as np
 
 log = logging.getLogger("gubernator_tpu.multihost")
 
-_MAGIC = b"GMH1"
+# Wire format GMH2: a typed, gadget-free codec. GMH1 framed pickle, which
+# hands arbitrary code execution to anything that can reach a follower's
+# listen port — a strictly worse trust posture than the reference's
+# insecure-but-parse-safe protobuf peer channel (reference
+# peers.go:130-139). Step messages are only flat dicts of scalars, strings,
+# int tuples, one nested config dict, and dense numpy arrays, so a
+# six-tag TLV encoding covers the whole surface with no deserialization
+# gadget: decode constructs nothing but bytes, ints, str, tuple, dict and
+# whitelisted-dtype ndarrays.
+_MAGIC = b"GMH2"
+
+_T_NONE, _T_INT, _T_STR, _T_ARR, _T_TUPLE, _T_DICT = range(6)
+
+# dtype whitelist — everything the step pipe ever carries. Explicit
+# little-endian codes so a mixed-endian cluster fails loudly at the
+# codec, not silently in the kernels.
+_DTYPES = {
+    0: np.dtype("<u8"),  # key_hash
+    1: np.dtype("<i8"),  # hits/limit/duration/remaining/reset_time
+    2: np.dtype("<i4"),  # algo
+    3: np.dtype("|b1"),  # gnp/is_over
+}
+_DTYPE_CODES = {dt: code for code, dt in _DTYPES.items()}
+
+_MAX_DEPTH = 4  # message dict -> config dict -> tuples; headroom of one
+_MAX_ITEMS = 4096  # fields per dict / elements per tuple
+_MAX_STR = 1 << 20
+_MAX_ARR_BYTES = 1 << 31
+
+
+def _encode_value(out: bytearray, v, depth: int = 0) -> None:
+    if depth > _MAX_DEPTH:
+        raise ValueError("step message nests too deep to encode")
+    if v is None:
+        out.append(_T_NONE)
+    elif isinstance(v, (int, np.integer)) and not isinstance(v, bool):
+        out.append(_T_INT)
+        out += struct.pack("<q", int(v))
+    elif isinstance(v, str):
+        b = v.encode()
+        if len(b) > _MAX_STR:
+            raise ValueError("string field too large for step pipe")
+        out.append(_T_STR)
+        out += struct.pack("<I", len(b))
+        out += b
+    elif isinstance(v, np.ndarray):
+        dt = v.dtype.newbyteorder("<") if v.dtype.byteorder == ">" else v.dtype
+        arr = np.ascontiguousarray(v, dtype=dt)
+        code = _DTYPE_CODES.get(arr.dtype)
+        if code is None:
+            raise ValueError(f"dtype {arr.dtype} not in step-pipe whitelist")
+        if arr.ndim > 4:
+            raise ValueError("array rank > 4 on step pipe")
+        out.append(_T_ARR)
+        out.append(code)
+        out.append(arr.ndim)
+        for d in arr.shape:
+            out += struct.pack("<I", d)
+        out += arr.tobytes()
+    elif isinstance(v, tuple):
+        if len(v) > _MAX_ITEMS:
+            raise ValueError("tuple too long for step pipe")
+        out.append(_T_TUPLE)
+        out += struct.pack("<I", len(v))
+        for e in v:
+            _encode_value(out, e, depth + 1)
+    elif isinstance(v, dict):
+        if len(v) > _MAX_ITEMS:
+            raise ValueError("dict too large for step pipe")
+        out.append(_T_DICT)
+        out += struct.pack("<I", len(v))
+        for k, e in v.items():
+            kb = str(k).encode()
+            out += struct.pack("<H", len(kb))
+            out += kb
+            _encode_value(out, e, depth + 1)
+    else:
+        raise ValueError(f"type {type(v).__name__} not encodable on step pipe")
+
+
+def _utf8(raw) -> str:
+    # keep the "hostile frame -> ConnectionError" contract airtight
+    try:
+        return str(raw, "utf-8")
+    except UnicodeDecodeError as e:
+        raise ConnectionError(f"invalid utf-8 in step pipe frame: {e}")
+
+
+class _Reader:
+    def __init__(self, buf: bytes):
+        self.buf = memoryview(buf)
+        self.pos = 0
+
+    def take(self, n: int) -> memoryview:
+        if n < 0 or self.pos + n > len(self.buf):
+            raise ConnectionError("step pipe frame truncated")
+        v = self.buf[self.pos : self.pos + n]
+        self.pos += n
+        return v
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+    def unpack(self, fmt: str):
+        (v,) = struct.unpack("<" + fmt, self.take(struct.calcsize(fmt)))
+        return v
+
+
+def _decode_value(r: _Reader, depth: int = 0):
+    if depth > _MAX_DEPTH:
+        raise ConnectionError("step pipe frame nests too deep")
+    tag = r.u8()
+    if tag == _T_NONE:
+        return None
+    if tag == _T_INT:
+        return r.unpack("q")
+    if tag == _T_STR:
+        n = r.unpack("I")
+        if n > _MAX_STR:
+            raise ConnectionError("oversized string in step pipe frame")
+        return _utf8(r.take(n))
+    if tag == _T_ARR:
+        dt = _DTYPES.get(r.u8())
+        if dt is None:
+            raise ConnectionError("unknown dtype in step pipe frame")
+        ndim = r.u8()
+        if ndim > 4:
+            raise ConnectionError("array rank > 4 in step pipe frame")
+        shape = tuple(r.unpack("I") for _ in range(ndim))
+        n_elem = 1
+        for d in shape:
+            n_elem *= d
+        if n_elem * dt.itemsize > _MAX_ARR_BYTES:
+            raise ConnectionError("oversized array in step pipe frame")
+        raw = r.take(n_elem * dt.itemsize)
+        return np.frombuffer(raw, dtype=dt).reshape(shape).copy()
+    if tag == _T_TUPLE:
+        n = r.unpack("I")
+        if n > _MAX_ITEMS:
+            raise ConnectionError("oversized tuple in step pipe frame")
+        return tuple(_decode_value(r, depth + 1) for _ in range(n))
+    if tag == _T_DICT:
+        n = r.unpack("I")
+        if n > _MAX_ITEMS:
+            raise ConnectionError("oversized dict in step pipe frame")
+        d = {}
+        for _ in range(n):
+            klen = r.unpack("H")
+            k = _utf8(r.take(klen))
+            d[k] = _decode_value(r, depth + 1)
+        return d
+    raise ConnectionError(f"unknown tag {tag} in step pipe frame")
 
 
 def _encode_msg(obj: dict) -> bytes:
-    buf = io.BytesIO()
-    pickle.dump(obj, buf, protocol=pickle.HIGHEST_PROTOCOL)
-    payload = buf.getvalue()
-    return _MAGIC + struct.pack("<Q", len(payload)) + payload
+    out = bytearray()
+    _encode_value(out, obj)
+    return _MAGIC + struct.pack("<Q", len(out)) + bytes(out)
 
 
 def _send_msg(sock: socket.socket, obj: dict) -> None:
@@ -68,7 +216,15 @@ def _recv_msg(sock: socket.socket) -> dict:
     if _recv_exact(sock, 4) != _MAGIC:
         raise ConnectionError("step pipe desync")
     (n,) = struct.unpack("<Q", _recv_exact(sock, 8))
-    return pickle.loads(_recv_exact(sock, n))
+    if n > _MAX_ARR_BYTES:
+        raise ConnectionError("oversized step pipe frame")
+    r = _Reader(_recv_exact(sock, n))
+    msg = _decode_value(r)
+    if not isinstance(msg, dict):
+        raise ConnectionError("step pipe frame is not a message dict")
+    if r.pos != len(r.buf):
+        raise ConnectionError("trailing bytes in step pipe frame")
+    return msg
 
 
 class StepPipe:

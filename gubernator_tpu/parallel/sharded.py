@@ -590,6 +590,17 @@ class MeshEngine:
         )
         B_sub = req.key_hash.shape[1]
         self.store, packed = self._step(self.store, req, groups, e_now)
+        if _prep_native is not None:
+            # the native prep returns order/take_idx as VIEWS into its
+            # reusable buffer ring. This handle outlives any fixed ring
+            # depth under the batcher's out-of-order fetch pipeline (a
+            # stalled fetch can be outrun by later submits without
+            # bound), so the handle keeps copies. The device-field views
+            # need no copy: dispatch commits host inputs before _step
+            # returns (verified by mutate-after-dispatch on the tunnel
+            # backend; jax never exposes numpy inputs to later writes).
+            order = order.copy()
+            take_idx = take_idx.copy()
         # epoch captured at submit: a later submit may rebase before this
         # batch's wait (same contract as TpuEngine.decide_submit)
         return (packed, order, take_idx, n, B_sub, self.clock.epoch)
@@ -599,10 +610,14 @@ class MeshEngine:
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
         """Fetch + unflatten the responses for a decide_submit handle."""
         packed, order, take_idx, n, B_sub, epoch = handle
-        packed = np.asarray(jax.device_get(packed))  # [n_shards, 4*B_sub+2]
-        self.stats.hits += int(packed[:, 4 * B_sub].sum())
-        self.stats.misses += int(packed[:, 4 * B_sub + 1].sum())
-        self.stats.batches += 1
+        # [n_shards, 4*B_sub+PACKED_STATS]
+        packed = np.asarray(jax.device_get(packed))
+        self.stats.add_batch(
+            int(packed[:, 4 * B_sub].sum()),
+            int(packed[:, 4 * B_sub + 1].sum()),
+            int(packed[:, 4 * B_sub + 2].sum()),
+            int(packed[:, 4 * B_sub + 3].sum()),
+        )
 
         if _prep_native is not None and n > 0:
             # native one-pass unflatten of all four response columns

@@ -48,28 +48,66 @@ async def pop_with_deadline(queue: "asyncio.Queue", timeout: float):
 
 
 async def collect_batch(
-    queue: "asyncio.Queue", limit: int, wait: float, into: list
+    queue: "asyncio.Queue",
+    limit: int,
+    wait: float,
+    into: list,
+    weight=None,
+    carry: list = None,
 ) -> list:
     """Collect one coalesced batch INTO the caller's list (so a cancel
     mid-collect leaves the partial batch visible to the caller's drain
     handler — a local list would be lost with the exception). Blocks for
     the first item, drains everything already enqueued, then waits out
-    the optional `wait` window for stragglers."""
-    into.append(await queue.get())
-    while len(into) < limit:
+    the optional `wait` window for stragglers.
+
+    `weight` (item -> int) makes `limit` count underlying units instead
+    of queue items — the device batcher enqueues whole request GROUPS
+    (one per RPC) and its limit is in requests. Groups are never split;
+    a group that would push the batch PAST the limit is parked in
+    `carry` (a persistent caller-owned list, drained first next round)
+    so batches never exceed the limit — except a single group bigger
+    than the limit, which ships alone (progress over strictness; the
+    engine's ladder covers MAX_BATCH_SIZE, the per-RPC cap). Callers
+    passing `weight` must pass `carry` and must drain it on teardown."""
+    if weight is None:
+        weight = lambda _i: 1  # noqa: E731
+    total = 0
+    if carry:
+        item = carry.pop()
+        into.append(item)
+        total = weight(item)
+    if not into:
+        into.append(await queue.get())
+        total = weight(into[-1])
+
+    def take(item) -> bool:
+        nonlocal total
+        w = weight(item)
+        if into and total + w > limit:
+            carry.append(item)
+            return False
+        into.append(item)
+        total += w
+        return True
+
+    while total < limit:
         try:
-            into.append(queue.get_nowait())
+            item = queue.get_nowait()
         except asyncio.QueueEmpty:
             break
+        if not take(item):
+            return into
     if wait > 0:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + wait
-        while len(into) < limit:
+        while total < limit:
             timeout = deadline - loop.time()
             if timeout <= 0:
                 break
             item = await pop_with_deadline(queue, timeout)
             if item is None:
                 break
-            into.append(item)
+            if not take(item):
+                return into
     return into

@@ -14,12 +14,13 @@ implement it:
 
 All backends are driven from the single serving event loop / batcher task.
 Concurrency contract with the pipelined batcher: decide_submit calls are
-strictly serialized, decide_wait calls are strictly serialized, but one
-decide_wait (in a fetch worker thread) may overlap the NEXT
-decide_submit/update_globals — safe because a wait touches only its
-handle and the engine's stats counters, never the store or clock. Keep
-that split when adding backend state; no other locking exists anywhere
-(the reference instead serializes on a cache mutex, gubernator.go:237).
+strictly serialized (one submit thread), but up to fetch_depth
+decide_wait calls run CONCURRENTLY on fetch worker threads and may
+overlap later decide_submit/update_globals calls — safe because a wait
+touches only its own handle and the engine's stats counters (which land
+under EngineStats' lock), never the store or clock. Keep that split when
+adding backend state; no other locking exists anywhere (the reference
+instead serializes on a cache mutex, gubernator.go:237).
 """
 
 from __future__ import annotations

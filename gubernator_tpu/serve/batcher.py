@@ -15,9 +15,18 @@ Backends exposing decide_submit/decide_wait (the device backends) get one
 more level of pipelining: the flusher submits batch N+1 (host presort +
 async dispatch) while batch N's device fetch is still in flight, so
 sustained throughput tracks max(host work, device time) per batch instead
-of their sum. At most two batches are in flight (the previous fetch is
-awaited before a third submit); fetches resolve in order, so the backend
-still sees strictly serialized submits and serialized waits.
+of their sum. Up to `fetch_depth` batches may be in flight (default 2):
+submits stay strictly serialized on one thread, but fetches run on a
+fetch_depth-wide pool and may complete out of order — each batch's
+futures resolve independently, and the engines' stats land through a
+lock (core/engine.py EngineStats). Depth 2 is enough when the device is
+co-located (fetch is ~0.1ms over PCIe); a WAN-attached device (this
+image's tunnel: ~130ms/fetch, but >64 fetches pipeline concurrently in
+the same 130ms) needs depth ~16 for the service to run at device rate
+rather than at 1/RTT. The native prep's reusable buffer ring is sized to
+depth+1 generations at construction (hashlib_native.set_prep_generations)
+so no in-flight batch's host arrays are ever overwritten by a later
+submit.
 """
 
 from __future__ import annotations
@@ -32,21 +41,48 @@ from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.aio import collect_batch
 
 
+def _item_weight(item) -> int:
+    """Queue items are whole groups; the batch limit counts underlying
+    requests/updates, not queue entries."""
+    return max(1, len(item[1]))
+
+
 class DeviceBatcher:
     def __init__(
         self,
         backend,
         batch_wait: float = 0.0005,
         batch_limit: int = 1000,
+        fetch_depth: Optional[int] = None,
     ):
+        import os
+
         self.backend = backend
         self.batch_wait = batch_wait
         self.batch_limit = batch_limit
+        if fetch_depth is None:
+            fetch_depth = int(os.environ.get("GUBER_FETCH_DEPTH", "2"))
+        self.fetch_depth = max(1, int(fetch_depth))
         self._queue: "asyncio.Queue" = asyncio.Queue()
         self._task: Optional[asyncio.Task] = None
-        # in-flight fetch of the previously submitted batch (pipelined
-        # backends only); its task resolves that batch's futures itself
-        self._pending: Optional[asyncio.Task] = None
+        # in-flight fetches of submitted batches (pipelined backends
+        # only); each task resolves its own batch's futures. The
+        # semaphore admits a submit only while fewer than fetch_depth
+        # batches are outstanding.
+        self._pending: set = set()
+        self._inflight = asyncio.Semaphore(self.fetch_depth)
+        self._fetch_pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=self.fetch_depth, thread_name_prefix="guber-fetch"
+        )
+        if self.fetch_depth > 1:
+            # size the native prep's buffer ring to the pipeline depth
+            # BEFORE any prep call (see hashlib_native._PrepBuffers)
+            try:
+                from gubernator_tpu.native import hashlib_native
+
+                hashlib_native.set_prep_generations(self.fetch_depth + 1)
+            except Exception:  # pragma: no cover - native lib optional
+                pass
         # ONE dedicated submit thread (not the shared to_thread pool):
         # the native prep keeps per-thread reusable buffers and scratch
         # (hashlib_native._PrepBuffersTL, C++ thread_locals), so letting
@@ -56,9 +92,12 @@ class DeviceBatcher:
         self._submit_pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="guber-submit"
         )
-        # last backend stats snapshot, for cache_access_count deltas
+        # last backend stats snapshot, for cache_access_count /
+        # store_dropped_creates / store_evictions deltas
         self._last_hits = 0
         self._last_misses = 0
+        self._last_dropped = 0
+        self._last_evictions = 0
         # set before the flusher is cancelled: a decide()/update_globals()
         # after stop() would otherwise enqueue into a queue no flusher
         # reads and await a future that never resolves (same guard as
@@ -77,6 +116,9 @@ class DeviceBatcher:
         self._inline = bool(getattr(backend, "inline_decide", False))
         self._flushing = False
         self._live_batch: List = []
+        # one-slot park for a group that would have pushed the previous
+        # batch past batch_limit (aio.collect_batch carry contract)
+        self._carry: List = []
 
     def start(self) -> None:
         if self._task is None:
@@ -91,10 +133,11 @@ class DeviceBatcher:
             except asyncio.CancelledError:
                 pass
             self._task = None
-        if self._pending is not None:
-            await self._pending  # drain the in-flight fetch gracefully
-            self._pending = None
+        for t in list(self._pending):
+            await t  # drain every in-flight fetch gracefully
+        self._pending.clear()
         self._submit_pool.shutdown(wait=False)
+        self._fetch_pool.shutdown(wait=False)
 
     async def decide(
         self, reqs: Sequence[RateLimitReq], gnp: Sequence[bool]
@@ -108,6 +151,7 @@ class DeviceBatcher:
             self._inline
             and not self._flushing
             and not self._live_batch
+            and not self._carry
             and self._queue.empty()
             and self._task is not None
         ):
@@ -122,13 +166,17 @@ class DeviceBatcher:
             except Exception:  # pragma: no cover - defensive
                 pass
             return resps
+        # one queue item + ONE future per caller (an RPC's whole request
+        # list): per-item futures cost ~0.1-0.3ms of event-loop work per
+        # request on a contended host, which at 1000-item batches was
+        # 100-300ms of pure asyncio overhead per RPC — 10x the device
+        # time. Groups are flattened at flush and responses sliced back.
         loop = asyncio.get_running_loop()
-        futs = []
-        for r, g in zip(reqs, gnp):
-            fut = loop.create_future()
-            self._queue.put_nowait((r, bool(g), fut))
-            futs.append(fut)
-        return list(await asyncio.gather(*futs))
+        fut = loop.create_future()
+        self._queue.put_nowait(
+            ("decide", list(reqs), [bool(g) for g in gnp], fut)
+        )
+        return await fut
 
     async def update_globals(self, updates) -> None:
         """Replica installs funnel through the same flusher queue so the
@@ -157,7 +205,8 @@ class DeviceBatcher:
                 # a cancel must reach the drain handler below with every
                 # collected item visible, or a caller would hang.
                 await collect_batch(
-                    self._queue, self.batch_limit, self.batch_wait, batch
+                    self._queue, self.batch_limit, self.batch_wait, batch,
+                    weight=_item_weight, carry=self._carry,
                 )
                 self._flushing = True
                 try:
@@ -172,6 +221,8 @@ class DeviceBatcher:
                 # have done futures, which _fail skips).
                 exc = RuntimeError("batcher stopped mid-batch")
                 self._fail(batch, exc)
+                self._fail(self._carry, exc)  # parked overflow group
+                self._carry.clear()
                 while True:
                     try:
                         self._fail([self._queue.get_nowait()], exc)
@@ -180,7 +231,7 @@ class DeviceBatcher:
                 raise
 
     async def _flush(self, batch) -> None:
-        decide_items = [b for b in batch if b[0] != "globals"]
+        decide_items = [b for b in batch if b[0] == "decide"]
         global_items = [b for b in batch if b[0] == "globals"]
 
         inline = self._inline
@@ -202,8 +253,8 @@ class DeviceBatcher:
 
         if not decide_items:
             return
-        reqs = [r for r, _, _ in decide_items]
-        gnp = [g for _, g, _ in decide_items]
+        reqs = [r for _, rs, _, _ in decide_items for r in rs]
+        gnp = [g for _, _, gs, _ in decide_items for g in gs]
         t0 = time.monotonic()
         submit = getattr(self.backend, "decide_submit", None)
         if submit is None:
@@ -228,7 +279,12 @@ class DeviceBatcher:
 
         # pipelined path: submit now (host presort + async dispatch);
         # fetch in a background task so the flusher can collect and
-        # submit the NEXT batch while the device computes this one.
+        # submit the NEXT batch while the device computes this one. The
+        # semaphore bounds outstanding batches at fetch_depth; fetches
+        # run on the fetch pool and may complete out of order (each
+        # batch's futures are independent). A cancel while waiting for a
+        # slot reaches _run's handler with nothing submitted.
+        await self._inflight.acquire()
         # shield: a stop() mid-submit must not strand these futures —
         # the submit thread finishes either way (the store mutation has
         # already been dispatched), so fail the batch and propagate.
@@ -244,73 +300,67 @@ class DeviceBatcher:
             # abandoned — the dispatched batch's store mutation stands,
             # the same contract as a crash after dispatch. _run's handler
             # fails the batch's futures.
+            self._inflight.release()
             submit_fut.add_done_callback(
                 lambda t: t.cancelled() or t.exception()
             )
             raise
         except Exception as e:
+            self._inflight.release()
             self._fail(decide_items, e)
             return
         submit_s = time.monotonic() - t0
-        prev = self._pending
         task = asyncio.ensure_future(
-            self._finish(prev, handle, decide_items, submit_s)
+            self._finish(handle, decide_items, submit_s)
         )
-        self._pending = task
-        # drop the reference once done so an idle batcher doesn't pin the
-        # last batch's requests/responses until the next flush
-        task.add_done_callback(
-            lambda t: self._pending is t and setattr(self, "_pending", None)
-        )
-        # this batch now belongs to the _pending fetch chain (stop()
-        # awaits it): a later cancel must not fail its futures from _run
+        # hold the reference until done (stop() drains the set); discard
+        # on completion so an idle batcher doesn't pin the last batches'
+        # requests/responses until the next flush
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
+        # this batch now belongs to its fetch task (stop() awaits it): a
+        # later cancel must not fail its futures from _run
         batch.clear()
-        if prev is not None:
-            # bound in-flight batches at two, and keep fetches serialized
-            # (the engine's stats mutation stays single-threaded).
-            # shield: a stop() arriving here must not cancel the fetch —
-            # the _pending chain resolves BOTH in-flight batches and
-            # stop() awaits it. (CancelledError is a BaseException, so it
-            # propagates to _run's handler regardless.)
-            try:
-                await asyncio.shield(prev)
-            except Exception:  # pragma: no cover - _finish never raises
-                pass
 
-    async def _finish(self, prev, handle, decide_items, submit_s: float):
-        if prev is not None:
-            try:
-                await prev  # fetches resolve strictly in submit order
-            except Exception:  # pragma: no cover - _finish never raises
-                pass
+    async def _finish(self, handle, decide_items, submit_s: float):
         t1 = time.monotonic()
+        loop = asyncio.get_running_loop()
         try:
-            resps = await asyncio.to_thread(self.backend.decide_wait, handle)
+            resps = await loop.run_in_executor(
+                self._fetch_pool, self.backend.decide_wait, handle
+            )
         except Exception as e:
             self._fail(decide_items, e)
             return
-        # own cost only: host submit + own fetch span (which starts once
-        # the previous batch's fetch finished) — NOT the time spent
-        # queued behind the previous batch, which would double-count
+        finally:
+            self._inflight.release()
+        # own cost only: host submit + own fetch span — NOT the time
+        # spent queued behind earlier batches, which would double-count
         # device time under steady pipelining
         self._resolve(
             decide_items, resps, submit_s + (time.monotonic() - t1)
         )
 
-    def _fail(self, decide_items, exc: BaseException) -> None:
-        for _, _, fut in decide_items:
+    def _fail(self, items, exc: BaseException) -> None:
+        # both queue item shapes carry their future last
+        for it in items:
+            fut = it[-1]
             if not fut.done():
                 fut.set_exception(exc)
 
     def _resolve(self, decide_items, resps, launch_s: float) -> None:
         # resolve callers FIRST: metrics are best-effort and must never
         # be able to kill the flusher task (a dead flusher wedges every
-        # future request with no error surfaced)
-        for (_, _, fut), resp in zip(decide_items, resps):
+        # future request with no error surfaced). Responses come back
+        # flat in flatten order; slice one span per caller group.
+        k = 0
+        for _, rs, _, fut in decide_items:
+            span = resps[k : k + len(rs)]
+            k += len(rs)
             if not fut.done():
-                fut.set_result(resp)
+                fut.set_result(span)
         try:
-            metrics.DEVICE_BATCH_SIZE.observe(len(decide_items))
+            metrics.DEVICE_BATCH_SIZE.observe(len(resps))
             metrics.DEVICE_LAUNCH_MS.observe(launch_s * 1e3)
             self._observe_cache_stats()
         except Exception:  # pragma: no cover - defensive
@@ -338,3 +388,10 @@ class DeviceBatcher:
                 misses - self._last_misses
             )
         self._last_hits, self._last_misses = hits, misses
+        dropped = int(s.get("dropped", 0))
+        evictions = int(s.get("evictions", 0))
+        if dropped > self._last_dropped:
+            metrics.STORE_DROPPED_CREATES.inc(dropped - self._last_dropped)
+        if evictions > self._last_evictions:
+            metrics.STORE_EVICTIONS.inc(evictions - self._last_evictions)
+        self._last_dropped, self._last_evictions = dropped, evictions

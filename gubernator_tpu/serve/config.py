@@ -82,6 +82,12 @@ class ServerConfig:
     # many seconds after the first arrival (reference BatchWait).
     device_batch_wait: float = 0.0
     device_batch_limit: int = MAX_BATCH_SIZE
+    # in-flight device batches the batcher keeps before stalling submits.
+    # 2 suffices co-located (PCIe fetch ~0.1ms); raise toward ~16 when
+    # the accelerator sits behind a high-latency link (fetches pipeline,
+    # so served throughput ~= depth/RTT batches/s instead of 1/RTT).
+    # None = resolve GUBER_FETCH_DEPTH in the batcher (default 2).
+    device_fetch_depth: Optional[int] = None
 
     # static peers: list of gRPC addresses; advertise address must appear
     peers: List[str] = field(default_factory=list)
@@ -213,6 +219,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         device_batch_limit=_get_int(
             env, "GUBER_DEVICE_BATCH_LIMIT", MAX_BATCH_SIZE
         ),
+        # device_fetch_depth deliberately NOT resolved here: the field's
+        # None default defers to DeviceBatcher, the single owner of the
+        # GUBER_FETCH_DEPTH env read (batcher.py __init__)
         peers=peers,
         etcd_endpoints=etcd,
         etcd_prefix=_get(env, "GUBER_ETCD_PREFIX", "/gubernator-tpu/peers/"),

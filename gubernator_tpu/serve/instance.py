@@ -56,6 +56,7 @@ class Instance:
             backend,
             batch_wait=conf.device_batch_wait,
             batch_limit=conf.device_batch_limit,
+            fetch_depth=getattr(conf, "device_fetch_depth", None),
         )
         self.global_mgr = GlobalManager(conf.behaviors, self)
         self.picker = ConsistentHashPicker()

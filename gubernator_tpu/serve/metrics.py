@@ -66,6 +66,19 @@ DEVICE_LAUNCH_MS = Histogram(
     buckets=(0.05, 0.1, 0.25, 0.5, 1, 2, 5, 10, 25, 100),
     registry=REGISTRY,
 )
+STORE_DROPPED_CREATES = Counter(
+    "store_dropped_creates_total",
+    "Creates lost to bucket way exhaustion (over-admission signal: the "
+    "dropped key is re-admitted fresh on its next batch)",
+    registry=REGISTRY,
+)
+STORE_EVICTIONS = Counter(
+    "store_evictions_total",
+    "Store entries overwritten by the earliest-expiry eviction policy "
+    "(over-admission signal at capacity; reference cache/lru.go:164-176 "
+    "exposes the analogous cache_size-vs-max pressure)",
+    registry=REGISTRY,
+)
 DISTINCT_KEYS = Gauge(
     "distinct_keys_estimate",
     "HyperLogLog estimate of distinct rate-limit keys seen",

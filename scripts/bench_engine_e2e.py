@@ -77,7 +77,9 @@ def main():
 
     t0 = time.monotonic()
     reps = 20
-    fake_packed = np.zeros(4 * B + 2, np.int32)
+    from gubernator_tpu.core.kernels import PACKED_STATS
+
+    fake_packed = np.zeros(4 * B + PACKED_STATS, np.int32)
     for i in range(reps):
         req, order = pad_request_sorted(
             (B,), eng.config.slots, key_hash[i % N_BATCHES], hits, limit,

@@ -1,0 +1,159 @@
+"""Stage-level timing of the device serving path under concurrent load.
+
+Wraps the single-node serving stack (servicer conversion, instance
+routing, batcher, backend submit/wait) with accumulating timers, drives
+16 concurrent 1000-item GetRateLimits clients for a fixed span, and
+prints per-stage totals — the decomposition that says WHERE the
+wall-clock goes when served decisions/s lags the direct-backend rate.
+
+Usage: python scripts/profile_serving_stages.py [--seconds 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import time
+from collections import defaultdict
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+import grpc
+import jax
+
+from gubernator_tpu.cli.bench_serving import _compile_cache_dir
+
+jax.config.update(
+    "jax_compilation_cache_dir", str(_compile_cache_dir().resolve())
+)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+TIMES = defaultdict(float)
+COUNTS = defaultdict(int)
+LOCK = threading.Lock()
+
+
+def timed(name, fn):
+    def wrap(*a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return fn(*a, **kw)
+        finally:
+            dt = time.perf_counter() - t0
+            with LOCK:
+                TIMES[name] += dt
+                COUNTS[name] += 1
+
+    return wrap
+
+
+def timed_async(name, fn):
+    async def wrap(*a, **kw):
+        t0 = time.perf_counter()
+        try:
+            return await fn(*a, **kw)
+        finally:
+            dt = time.perf_counter() - t0
+            with LOCK:
+                TIMES[name] += dt
+                COUNTS[name] += 1
+
+    return wrap
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=10.0)
+    ap.add_argument("--workers", type=int, default=16)
+    ap.add_argument("--fetch-depth", type=int, default=16)
+    args = ap.parse_args()
+
+    import os
+
+    os.environ["GUBER_FETCH_DEPTH"] = str(args.fetch_depth)
+
+    from gubernator_tpu.cluster import LocalCluster
+    from gubernator_tpu.core.store import StoreConfig
+    from gubernator_tpu.serve.backends import MeshBackend
+
+    cluster = LocalCluster(
+        ["127.0.0.1:29461"],
+        backend_factory=lambda: MeshBackend(
+            StoreConfig(rows=16, slots=1 << 12)
+        ),
+    )
+    print("starting (device warmup)...", flush=True)
+    cluster.start(timeout=600)
+    server = cluster.servers[0]
+    inst = server.instance
+    be = server.backend
+
+    # instrument: submit/wait at the backend, decide at the batcher, the
+    # instance entry, and the engine's internal submit pieces
+    be.decide_submit = timed("backend.decide_submit", be.decide_submit)
+    be.decide_wait = timed("backend.decide_wait", be.decide_wait)
+    eng = be.engine
+    eng_inner = getattr(eng, "inner", eng)
+    inst.get_rate_limits = timed_async(
+        "instance.get_rate_limits", inst.get_rate_limits
+    )
+    inst.batcher.decide = timed_async("batcher.decide", inst.batcher.decide)
+    be._arrays = timed("backend._arrays", be._arrays)
+    be._to_resps = timed("backend._to_resps", be._to_resps)
+
+    from gubernator_tpu.api.proto.gen import gubernator_pb2
+    from gubernator_tpu.api.grpc_glue import V1Stub
+
+    batch = gubernator_pb2.GetRateLimitsReq(
+        requests=[
+            gubernator_pb2.RateLimitReq(
+                name="prof", unique_key=f"k{i}", hits=1,
+                limit=1_000_000, duration=10_000,
+            )
+            for i in range(1000)
+        ]
+    )
+
+    stubs = [
+        V1Stub(grpc.insecure_channel("127.0.0.1:29461"))
+        for _ in range(args.workers)
+    ]
+    stop = time.monotonic() + args.seconds
+    ops = [0] * args.workers
+
+    def run(w):
+        while time.monotonic() < stop:
+            stubs[w].GetRateLimits(batch)
+            ops[w] += 1
+
+    print("driving load...", flush=True)
+    t0 = time.monotonic()
+    threads = [
+        threading.Thread(target=run, args=(w,)) for w in range(args.workers)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    n = sum(ops)
+    print(
+        f"\n{n} RPCs in {elapsed:.1f}s = {n/elapsed:.1f} ops/s "
+        f"= {n*1000/elapsed:,.0f} decisions/s"
+    )
+    print(f"{'stage':28s} {'total_s':>8} {'calls':>7} {'ms/call':>9}")
+    for k in sorted(TIMES, key=TIMES.get, reverse=True):
+        print(
+            f"{k:28s} {TIMES[k]:8.2f} {COUNTS[k]:7d} "
+            f"{TIMES[k]/max(COUNTS[k],1)*1e3:9.2f}"
+        )
+    print(f"{'wall':28s} {elapsed:8.2f}")
+    cluster.stop()
+
+
+if __name__ == "__main__":
+    main()
