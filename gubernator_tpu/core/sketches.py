@@ -172,6 +172,12 @@ class TrafficStats:
         self.hll.add_hashes(hashes)
         self.hot.observe(keys)
 
+    def observe_hashes(self, hashes: np.ndarray) -> None:
+        """Hash-only observation (edge fast path: key strings never
+        reach Python). Distinct-key estimation stays exact; hot-key
+        NAMES are unavailable for this traffic by design."""
+        self.hll.add_hashes(hashes)
+
     def snapshot(self, top_n: int = 20) -> dict:
         return {
             "distinct_keys_estimate": self.hll.estimate(),

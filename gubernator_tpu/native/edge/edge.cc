@@ -45,8 +45,11 @@ namespace {
 
 // ---------------------------------------------------------------- protocol
 
-constexpr uint32_t kMagicReq = 0x31424547;   // 'GEB1'
-constexpr uint32_t kMagicResp = 0x33424547;  // 'GEB3'
+constexpr uint32_t kMagicReq = 0x31424547;       // 'GEB1'
+constexpr uint32_t kMagicResp = 0x33424547;      // 'GEB3'
+constexpr uint32_t kMagicHello = 0x48424547;     // 'GEBH' (r4)
+constexpr uint32_t kMagicFastReq = 0x34424547;   // 'GEB4' pre-hashed
+constexpr uint32_t kMagicFastResp = 0x35424547;  // 'GEB5'
 
 struct Item {
   std::string name;
@@ -56,7 +59,85 @@ struct Item {
   int64_t duration = 0;
   uint8_t algorithm = 0;
   uint8_t behavior = 0;
+  uint64_t hash = 0;  // xxh64(name+"_"+key) for the GEB4 fast path
 };
+
+// ------------------------------------------------------------------ xxh64
+// XXH64 (Yann Collet's public-domain algorithm), implemented from the
+// spec — MUST produce bit-identical values to native/guberhash.cc's
+// implementation with the daemon's seed, or the edge's pre-hashed keys
+// would address different store slots than directly-served traffic
+// (pinned e2e by tests/test_edge_fast_path.py shared-state assertions).
+constexpr uint64_t kSlotHashSeed = 0x67756265726E6174ULL;  // "gubernat"
+
+uint64_t xx_rotl(uint64_t x, int r) { return (x << r) | (x >> (64 - r)); }
+
+uint64_t xxh64(const uint8_t* data, size_t len, uint64_t seed) {
+  constexpr uint64_t P1 = 0x9E3779B185EBCA87ULL;
+  constexpr uint64_t P2 = 0xC2B2AE3D27D4EB4FULL;
+  constexpr uint64_t P3 = 0x165667B19E3779F9ULL;
+  constexpr uint64_t P4 = 0x85EBCA77C2B2AE63ULL;
+  constexpr uint64_t P5 = 0x27D4EB2F165667C5ULL;
+  const uint8_t* p = data;
+  const uint8_t* end = data + len;
+  uint64_t h;
+  auto rd64 = [](const uint8_t* q) {
+    uint64_t v;
+    memcpy(&v, q, 8);
+    return v;  // little-endian host assumed (x86/arm)
+  };
+  auto rd32 = [](const uint8_t* q) {
+    uint32_t v;
+    memcpy(&v, q, 4);
+    return (uint64_t)v;
+  };
+  auto round = [](uint64_t acc, uint64_t input) {
+    return xx_rotl(acc + input * P2, 31) * P1;
+  };
+  if (len >= 32) {
+    uint64_t v1 = seed + P1 + P2, v2 = seed + P2, v3 = seed, v4 = seed - P1;
+    do {
+      v1 = round(v1, rd64(p)); p += 8;
+      v2 = round(v2, rd64(p)); p += 8;
+      v3 = round(v3, rd64(p)); p += 8;
+      v4 = round(v4, rd64(p)); p += 8;
+    } while (p + 32 <= end);
+    h = xx_rotl(v1, 1) + xx_rotl(v2, 7) + xx_rotl(v3, 12) + xx_rotl(v4, 18);
+    auto merge = [&](uint64_t acc, uint64_t val) {
+      return (acc ^ round(0, val)) * P1 + P4;
+    };
+    h = merge(h, v1); h = merge(h, v2); h = merge(h, v3); h = merge(h, v4);
+  } else {
+    h = seed + P5;
+  }
+  h += (uint64_t)len;
+  while (p + 8 <= end) {
+    h = xx_rotl(h ^ round(0, rd64(p)), 27) * P1 + P4;
+    p += 8;
+  }
+  if (p + 4 <= end) {
+    h = xx_rotl(h ^ (rd32(p) * P1), 23) * P2 + P3;
+    p += 4;
+  }
+  while (p < end) {
+    h = xx_rotl(h ^ (*p++ * P5), 11) * P1;
+  }
+  h ^= h >> 33;
+  h *= P2;
+  h ^= h >> 29;
+  h *= P3;
+  h ^= h >> 32;
+  return h;
+}
+
+uint64_t slot_hash(const std::string& name, const std::string& key) {
+  std::string joined;
+  joined.reserve(name.size() + 1 + key.size());
+  joined += name;
+  joined += '_';
+  joined += key;
+  return xxh64((const uint8_t*)joined.data(), joined.size(), kSlotHashSeed);
+}
 
 struct Decision {
   uint8_t status = 0;
@@ -340,6 +421,7 @@ std::string render_responses(const Decision* d, size_t n) {
 struct Pending {
   std::vector<Item> items;
   std::vector<Decision> decisions;
+  bool fast = false;  // all items GEB4-eligible (set by the handler)
   bool done = false;
   bool failed = false;
   std::mutex m;
@@ -367,11 +449,13 @@ class Batcher {
       std::this_thread::sleep_for(std::chrono::milliseconds(1));
   }
 
-  // enqueue and block until the batch round-trips
+  // enqueue and block until the batch round-trips. Fast (pre-hashed)
+  // and slow (string) pendings ride separate queues: a backend frame is
+  // all-GEB4 or all-GEB1, so one worker round-trip stays one frame.
   bool submit(Pending* p) {
     {
       std::lock_guard<std::mutex> lk(m_);
-      queue_.push_back(p);
+      (p->fast ? fast_queue_ : queue_).push_back(p);
       queued_items_ += p->items.size();
     }
     cv_.notify_one();
@@ -381,6 +465,8 @@ class Batcher {
   }
 
   bool backend_ok() const { return connected_.load() > 0; }
+  // GEB4 usable: the bridge's hello advertised it on every connection
+  bool fast_ok() const { return fast_ok_.load(); }
 
  private:
   int connect_backend() {
@@ -393,6 +479,27 @@ class Batcher {
       close(fd);
       return -1;
     }
+    // capability hello: 'GEBH' + u32 flags (bit 0 = GEB4 fast path).
+    // Bounded read so a wedged bridge can't hang the worker forever.
+    timeval tv{};
+    tv.tv_sec = 5;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    char hello[8];
+    if (!recv_all(fd, hello, 8)) {
+      close(fd);
+      return -1;
+    }
+    uint32_t magic, flags;
+    memcpy(&magic, hello, 4);
+    memcpy(&flags, hello + 4, 4);
+    if (magic != kMagicHello) {
+      close(fd);
+      return -1;
+    }
+    fast_ok_.store((flags & 1) != 0);
+    tv.tv_sec = 0;  // steady-state round-trips have no read deadline
+    tv.tv_usec = 0;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
     return fd;
   }
 
@@ -411,6 +518,52 @@ class Batcher {
       if (r <= 0) return false;
       p += r;
       n -= (size_t)r;
+    }
+    return true;
+  }
+
+  // GEB4/GEB5: fixed 33-byte pre-hashed items out, 25-byte decisions
+  // back — the daemon side is a single numpy structured-array view, so
+  // per-item cost exists ONLY in this process.
+  bool roundtrip_fast(int fd, std::vector<Pending*>& batch) {
+    std::string payload;
+    uint32_t n = 0;
+    for (Pending* p : batch) {
+      for (const Item& it : p->items) {
+        payload.append((const char*)&it.hash, 8);
+        put_i64(payload, it.hits);
+        put_i64(payload, it.limit);
+        put_i64(payload, it.duration);
+        payload.push_back((char)it.algorithm);
+        ++n;
+      }
+    }
+    std::string frame;
+    put_u32(frame, kMagicFastReq);
+    put_u32(frame, n);
+    put_u32(frame, (uint32_t)payload.size());
+    frame += payload;
+    if (!send_all(fd, frame.data(), frame.size())) return false;
+
+    char hdr[8];
+    if (!recv_all(fd, hdr, 8)) return false;
+    uint32_t magic, rn;
+    memcpy(&magic, hdr, 4);
+    memcpy(&rn, hdr + 4, 4);
+    if (magic != kMagicFastResp || rn != n) return false;
+    std::vector<char> raw(25u * rn);
+    if (rn && !recv_all(fd, raw.data(), raw.size())) return false;
+    size_t off = 0;
+    for (Pending* p : batch) {
+      p->decisions.resize(p->items.size());
+      for (Decision& d : p->decisions) {
+        const char* rec = raw.data() + off * 25;
+        d.status = (uint8_t)rec[0];
+        memcpy(&d.limit, rec + 1, 8);
+        memcpy(&d.remaining, rec + 9, 8);
+        memcpy(&d.reset_time, rec + 17, 8);
+        ++off;
+      }
     }
     return true;
   }
@@ -477,22 +630,29 @@ class Batcher {
     started_.fetch_add(1);
     while (true) {
       std::vector<Pending*> batch;
+      bool fast = false;
       {
         std::unique_lock<std::mutex> lk(m_);
-        cv_.wait(lk, [this] { return !queue_.empty(); });
+        cv_.wait(lk, [this] {
+          return !queue_.empty() || !fast_queue_.empty();
+        });
         // batch window: flush at limit_ items or after wait_us_
         if ((int)queued_items_ < limit_ && wait_us_ > 0) {
           cv_.wait_for(lk, std::chrono::microseconds(wait_us_), [this] {
             return (int)queued_items_ >= limit_;
           });
         }
+        // one frame kind per round-trip; drain the deeper queue first
+        // (both nonempty alternates naturally as they drain)
+        fast = fast_queue_.size() >= queue_.size() && !fast_queue_.empty();
+        auto& q = fast ? fast_queue_ : queue_;
         size_t take_items = 0;
-        while (!queue_.empty()) {
-          size_t next = queue_.front()->items.size();
+        while (!q.empty()) {
+          size_t next = q.front()->items.size();
           if (!batch.empty() && (int)(take_items + next) > limit_) break;
-          batch.push_back(queue_.front());
+          batch.push_back(q.front());
           take_items += next;
-          queue_.pop_front();
+          q.pop_front();
           if ((int)take_items >= limit_) break;
         }
         queued_items_ -= take_items;
@@ -504,7 +664,7 @@ class Batcher {
       }
       bool ok = fd >= 0;
       if (ok) {
-        ok = roundtrip(fd, batch);
+        ok = fast ? roundtrip_fast(fd, batch) : roundtrip(fd, batch);
         if (!ok) {
           close(fd);
           fd = -1;
@@ -528,12 +688,27 @@ class Batcher {
   int limit_;
   std::atomic<int> connected_{0};
   std::atomic<int> started_{0};
+  std::atomic<bool> fast_ok_{false};
   std::mutex m_;
   std::condition_variable cv_;
   std::deque<Pending*> queue_;
+  std::deque<Pending*> fast_queue_;
   size_t queued_items_ = 0;
   std::vector<std::thread> threads_;
 };
+
+// Mark a pending fast when the bridge advertises GEB4 and every item is
+// eligible: non-GLOBAL (GLOBAL needs the instance's replica/gossip
+// path) with non-empty name and key (empty fields need the instance's
+// per-item validation errors). Hashes are computed here, once.
+void classify_fast(Pending& p, Batcher* batcher) {
+  if (!batcher->fast_ok()) return;
+  for (const Item& it : p.items) {
+    if (it.behavior == 2 || it.name.empty() || it.key.empty()) return;
+  }
+  for (Item& it : p.items) it.hash = slot_hash(it.name, it.key);
+  p.fast = true;
+}
 
 // -------------------------------------------------------------- HTTP layer
 
@@ -663,13 +838,16 @@ void serve_connection(int fd, Batcher* batcher) {
                           "bytes\"}");
       } else if (p.items.empty()) {
         sent = http_reply(fd, 200, "OK", "{\"responses\": []}");
-      } else if (!batcher->submit(&p)) {
-        sent = http_reply(fd, 503, "Service Unavailable",
-                          "{\"error\": \"backend unavailable\"}");
       } else {
-        sent = http_reply(fd, 200, "OK",
-                          render_responses(p.decisions.data(),
-                                           p.decisions.size()));
+        classify_fast(p, batcher);
+        if (!batcher->submit(&p)) {
+          sent = http_reply(fd, 503, "Service Unavailable",
+                            "{\"error\": \"backend unavailable\"}");
+        } else {
+          sent = http_reply(fd, 200, "OK",
+                            render_responses(p.decisions.data(),
+                                             p.decisions.size()));
+        }
       }
     }
     if (!sent) {  // client stopped reading (SO_SNDTIMEO expired)

@@ -86,7 +86,66 @@ class ExactBackend:
         return dict(size=s.size, hit=s.hit, miss=s.miss)
 
 
-class TpuBackend:
+class _ArrayOps:
+    """Array-level decide surface shared by the device backends.
+
+    The serving hot path (edge GEB4 frames, serve/edge_bridge.py) carries
+    pre-hashed dense arrays end-to-end; these helpers are the object<->
+    array seam so the batcher can flatten MIXED batches (array groups
+    from the edge + request-object groups from gRPC/JSON callers) into
+    ONE device submit. Requires self.engine with decide_submit/decide_wait
+    taking (key_hash, hits, limit, duration, algo, gnp, now)."""
+
+    #: field order used everywhere a fields-dict is flattened
+    ARRAY_FIELDS = ("key_hash", "hits", "limit", "duration", "algo", "gnp")
+
+    def arrays_from_reqs(self, reqs, gnp) -> dict:
+        import numpy as np
+
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        n = len(reqs)
+        return dict(
+            key_hash=slot_hash_batch([r.hash_key() for r in reqs]),
+            hits=np.fromiter((r.hits for r in reqs), np.int64, n),
+            limit=np.fromiter((r.limit for r in reqs), np.int64, n),
+            duration=np.fromiter((r.duration for r in reqs), np.int64, n),
+            algo=np.fromiter((int(r.algorithm) for r in reqs), np.int32, n),
+            gnp=np.asarray(list(gnp), bool),
+        )
+
+    def decide_submit_arrays(self, fields: dict, now: Optional[int] = None):
+        from gubernator_tpu.api.types import millisecond_now
+
+        if fields["key_hash"].shape[0] == 0:
+            return None
+        if now is None:
+            now = millisecond_now()
+        return self.engine.decide_submit(now=now, **fields)
+
+    def decide_wait_arrays(self, handle):
+        """(status, limit, remaining, reset_time) int arrays."""
+        if handle is None:
+            import numpy as np
+
+            z = np.empty(0, np.int64)
+            return z, z, z, z
+        return self.engine.decide_wait(handle)
+
+    @staticmethod
+    def resps_from_arrays(status, limit, remaining, reset):
+        return [
+            RateLimitResp(
+                status=Status(int(status[i])),
+                limit=int(limit[i]),
+                remaining=int(remaining[i]),
+                reset_time=int(reset[i]),
+            )
+            for i in range(len(status))
+        ]
+
+
+class TpuBackend(_ArrayOps):
     """Single-chip slot-store backend."""
 
     def __init__(
@@ -121,7 +180,7 @@ class TpuBackend:
         return self.engine.stats.snapshot()
 
 
-class MeshBackend:
+class MeshBackend(_ArrayOps):
     """Mesh-sharded slot-store backend (all local devices by default)."""
 
     def __init__(
@@ -145,33 +204,11 @@ class MeshBackend:
         if not hasattr(engine, "decide_submit"):
             # lockstep wrappers (multihost) have no split — a None
             # attribute makes the batcher fall back to blocking decide
+            # (and the edge bridge's array fast path stays off)
             self.decide_submit = None
             self.decide_wait = None
-
-    def _arrays(self, reqs, gnp):
-        import numpy as np
-
-        n = len(reqs)
-        return dict(
-            key_hash=self._hash([r.hash_key() for r in reqs]),
-            hits=np.fromiter((r.hits for r in reqs), np.int64, n),
-            limit=np.fromiter((r.limit for r in reqs), np.int64, n),
-            duration=np.fromiter((r.duration for r in reqs), np.int64, n),
-            algo=np.fromiter((int(r.algorithm) for r in reqs), np.int32, n),
-            gnp=np.asarray(list(gnp), bool),
-        )
-
-    @staticmethod
-    def _to_resps(status, limit, remaining, reset):
-        return [
-            RateLimitResp(
-                status=Status(int(status[i])),
-                limit=int(limit[i]),
-                remaining=int(remaining[i]),
-                reset_time=int(reset[i]),
-            )
-            for i in range(status.shape[0])
-        ]
+            self.decide_submit_arrays = None
+            self.decide_wait_arrays = None
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now
@@ -180,8 +217,10 @@ class MeshBackend:
             return []
         if now is None:
             now = millisecond_now()
-        return self._to_resps(
-            *self.engine.decide_arrays(now=now, **self._arrays(reqs, gnp))
+        return self.resps_from_arrays(
+            *self.engine.decide_arrays(
+                now=now, **self.arrays_from_reqs(reqs, gnp)
+            )
         )
 
     def decide_submit(self, reqs, gnp, now=None):
@@ -197,13 +236,13 @@ class MeshBackend:
         if now is None:
             now = millisecond_now()
         return self.engine.decide_submit(
-            now=now, **self._arrays(reqs, gnp)
+            now=now, **self.arrays_from_reqs(reqs, gnp)
         )
 
     def decide_wait(self, handle):
         if handle is None:
             return []
-        return self._to_resps(*self.engine.decide_wait(handle))
+        return self.resps_from_arrays(*self.engine.decide_wait(handle))
 
     def update_globals(self, updates, now=None):
         np = self._np

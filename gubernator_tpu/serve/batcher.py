@@ -44,6 +44,8 @@ from gubernator_tpu.serve.aio import collect_batch
 def _item_weight(item) -> int:
     """Queue items are whole groups; the batch limit counts underlying
     requests/updates, not queue entries."""
+    if item[0] == "decide_arrays":
+        return max(1, item[1]["key_hash"].shape[0])
     return max(1, len(item[1]))
 
 
@@ -178,6 +180,26 @@ class DeviceBatcher:
         )
         return await fut
 
+    async def decide_arrays(self, fields: dict):
+        """Array-group decide — the edge bridge's pre-hashed fast path.
+        `fields`: key_hash/hits/limit/duration/algo numpy arrays (gnp
+        optional, default all-False; the edge routes GLOBAL items via the
+        request-object path). Resolves to (status, limit, remaining,
+        reset_time) arrays for exactly these rows, co-batched and
+        pipelined with every other caller. Only valid on backends
+        exposing decide_submit_arrays (the device backends)."""
+        if fields["key_hash"].shape[0] == 0:
+            import numpy as np
+
+            z = np.empty(0, np.int64)
+            return z, z, z, z
+        if self._closed:
+            raise RuntimeError("DeviceBatcher is stopped")
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._queue.put_nowait(("decide_arrays", fields, fut))
+        return await fut
+
     async def update_globals(self, updates) -> None:
         """Replica installs funnel through the same flusher queue so the
         backend stays single-threaded."""
@@ -231,7 +253,9 @@ class DeviceBatcher:
                 raise
 
     async def _flush(self, batch) -> None:
-        decide_items = [b for b in batch if b[0] == "decide"]
+        decide_items = [
+            b for b in batch if b[0] in ("decide", "decide_arrays")
+        ]
         global_items = [b for b in batch if b[0] == "globals"]
 
         inline = self._inline
@@ -252,6 +276,12 @@ class DeviceBatcher:
             # this and every remaining item in the batch
 
         if not decide_items:
+            return
+        if any(b[0] == "decide_arrays" for b in decide_items):
+            # mixed/array batch: flatten everything to dense arrays and
+            # take the array submit path (bridge gates array groups to
+            # array-capable backends, so decide_submit_arrays exists)
+            await self._flush_arrays(decide_items)
             return
         reqs = [r for _, rs, _, _ in decide_items for r in rs]
         gnp = [g for _, _, gs, _ in decide_items for g in gs]
@@ -321,6 +351,107 @@ class DeviceBatcher:
         # this batch now belongs to its fetch task (stop() awaits it): a
         # later cancel must not fail its futures from _run
         batch.clear()
+
+    async def _flush_arrays(self, decide_items) -> None:
+        """Array-path sibling of the pipelined branch in _flush: convert
+        request-object groups, concatenate all groups into one dense
+        field set, submit once, and let _finish_arrays slice responses
+        back per group. Same semaphore/cancellation discipline."""
+        import numpy as np
+
+        t0 = time.monotonic()
+        parts = []
+        for it in decide_items:
+            if it[0] == "decide":
+                parts.append(
+                    self.backend.arrays_from_reqs(
+                        it[1], [bool(g) for g in it[2]]
+                    )
+                )
+            else:
+                f = it[1]
+                if "gnp" not in f:
+                    f = dict(f)
+                    f["gnp"] = np.zeros(f["key_hash"].shape[0], bool)
+                parts.append(f)
+        keys = self.backend.ARRAY_FIELDS
+        fields = {
+            k: (
+                parts[0][k]
+                if len(parts) == 1
+                else np.concatenate([p[k] for p in parts])
+            )
+            for k in keys
+        }
+        lens = [p["key_hash"].shape[0] for p in parts]
+
+        await self._inflight.acquire()
+        loop = asyncio.get_running_loop()
+        submit_fut = asyncio.ensure_future(
+            loop.run_in_executor(
+                self._submit_pool, self.backend.decide_submit_arrays, fields
+            )
+        )
+        try:
+            handle = await asyncio.shield(submit_fut)
+        except asyncio.CancelledError:
+            self._inflight.release()
+            submit_fut.add_done_callback(
+                lambda t: t.cancelled() or t.exception()
+            )
+            raise
+        except Exception as e:
+            self._inflight.release()
+            self._fail(decide_items, e)
+            return
+        submit_s = time.monotonic() - t0
+        task = asyncio.ensure_future(
+            self._finish_arrays(handle, decide_items, lens, submit_s)
+        )
+        self._pending.add(task)
+        task.add_done_callback(self._pending.discard)
+        # the batch now belongs to its fetch task: clear the live batch
+        # (the same list object _run passed to _flush) so a later cancel
+        # doesn't fail futures the fetch will resolve — the same
+        # ownership transfer _flush's batch.clear() performs
+        self._live_batch.clear()
+
+    async def _finish_arrays(self, handle, decide_items, lens, submit_s):
+        t1 = time.monotonic()
+        loop = asyncio.get_running_loop()
+        try:
+            status, limit, remaining, reset = await loop.run_in_executor(
+                self._fetch_pool, self.backend.decide_wait_arrays, handle
+            )
+        except Exception as e:
+            self._fail(decide_items, e)
+            return
+        finally:
+            self._inflight.release()
+        k = 0
+        for it, n in zip(decide_items, lens):
+            span = (
+                status[k : k + n],
+                limit[k : k + n],
+                remaining[k : k + n],
+                reset[k : k + n],
+            )
+            k += n
+            fut = it[-1]
+            if fut.done():
+                continue
+            if it[0] == "decide":
+                fut.set_result(self.backend.resps_from_arrays(*span))
+            else:
+                fut.set_result(span)
+        try:
+            metrics.DEVICE_BATCH_SIZE.observe(k)
+            metrics.DEVICE_LAUNCH_MS.observe(
+                (submit_s + (time.monotonic() - t1)) * 1e3
+            )
+            self._observe_cache_stats()
+        except Exception:  # pragma: no cover - defensive
+            pass
 
     async def _finish(self, handle, decide_items, submit_s: float):
         t1 = time.monotonic()

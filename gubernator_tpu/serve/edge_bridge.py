@@ -49,10 +49,45 @@ log = logging.getLogger("gubernator_tpu.edge")
 
 MAGIC_REQ = 0x31424547  # 'GEB1' little-endian
 MAGIC_RESP = 0x33424547  # 'GEB3' (owner field added r3)
+MAGIC_HELLO = 0x48424547  # 'GEBH' — bridge capability hello (r4)
+MAGIC_FAST_REQ = 0x34424547  # 'GEB4' — pre-hashed array items (r4)
+MAGIC_FAST_RESP = 0x35424547  # 'GEB5'
 
 _HDR = struct.Struct("<II")
 _ITEM_FIX = struct.Struct("<qqqBB")
 _RESP_FIX = struct.Struct("<Bqqq")
+
+# GEB4 record: the edge pre-hashes name+"_"+key with the SAME XXH64 the
+# daemon's slot store uses (edge.cc xxh64 vs native/guberhash.cc — pinned
+# by tests), so the daemon's fast path never touches per-item Python:
+# np.frombuffer views the whole frame as a structured array.
+_FAST_REQ_DTYPE = None
+_FAST_RESP_DTYPE = None
+
+
+def _fast_dtypes():
+    global _FAST_REQ_DTYPE, _FAST_RESP_DTYPE
+    if _FAST_REQ_DTYPE is None:
+        import numpy as np
+
+        _FAST_REQ_DTYPE = np.dtype(
+            [
+                ("key_hash", "<u8"),
+                ("hits", "<i8"),
+                ("limit", "<i8"),
+                ("duration", "<i8"),
+                ("algo", "u1"),
+            ]
+        )
+        _FAST_RESP_DTYPE = np.dtype(
+            [
+                ("status", "u1"),
+                ("limit", "<i8"),
+                ("remaining", "<i8"),
+                ("reset_time", "<i8"),
+            ]
+        )
+    return _FAST_REQ_DTYPE, _FAST_RESP_DTYPE
 
 
 def decode_request_frame(
@@ -145,11 +180,94 @@ class EdgeBridge:
             await self._server.wait_closed()
             self._server = None
 
+    def _fast_ok(self) -> bool:
+        """The pre-hashed fast path bypasses the instance's ring routing
+        and GLOBAL handling, so it is only sound when this node owns the
+        whole key space (single-node deployment — the edge's documented
+        topology) and the backend takes arrays."""
+        backend = getattr(self.instance, "backend", None)
+        conf = getattr(self.instance, "conf", None)
+        return (
+            conf is not None
+            and len(getattr(conf, "peers", ())) <= 1
+            and getattr(backend, "decide_submit_arrays", None) is not None
+            and getattr(backend, "decide_submit", None) is not None
+        )
+
+    async def _serve_fast_frame(self, payload: bytes, n: int, writer):
+        import numpy as np
+
+        req_dt, resp_dt = _fast_dtypes()
+        if len(payload) != n * req_dt.itemsize:
+            raise ValueError("GEB4 payload length mismatch")
+        if not self._fast_ok():
+            # topology changed under a connected edge (or wrong backend):
+            # refuse loudly; the edge reconnects and re-handshakes onto
+            # the GEB1 path
+            raise ValueError(
+                "GEB4 frame but fast path unavailable (multi-node "
+                "topology or non-array backend)"
+            )
+        rec = np.frombuffer(payload, dtype=req_dt)
+        fields = dict(
+            key_hash=np.ascontiguousarray(rec["key_hash"]),
+            hits=np.ascontiguousarray(rec["hits"]),
+            limit=np.ascontiguousarray(rec["limit"]),
+            duration=np.ascontiguousarray(rec["duration"]),
+            algo=np.ascontiguousarray(rec["algo"]).astype(np.int32),
+        )
+        # distinct-key observability: feed the HLL with the hashes so
+        # /v1/debug/stats stays meaningful under fast-path traffic
+        # (hot-key NAMES are unavailable here by design)
+        self.instance.traffic.observe_hashes(fields["key_hash"])
+        if n <= MAX_BATCH_SIZE:
+            status, limit, remaining, reset = (
+                await self.instance.batcher.decide_arrays(fields)
+            )
+        else:
+            # same MAX_BATCH_SIZE discipline as the GEB1 path: an
+            # oversized co-batch splits into ladder-sized chunks instead
+            # of handing the engine a batch beyond its compiled rungs
+            # (which would either error or trigger a fresh multi-minute
+            # XLA compile on the serialized submit thread)
+            parts = []
+            for i in range(0, n, MAX_BATCH_SIZE):
+                chunk = {
+                    k: v[i : i + MAX_BATCH_SIZE] for k, v in fields.items()
+                }
+                parts.append(
+                    await self.instance.batcher.decide_arrays(chunk)
+                )
+            status, limit, remaining, reset = (
+                np.concatenate([p[j] for p in parts]) for j in range(4)
+            )
+        out = np.empty(n, dtype=resp_dt)
+        out["status"] = np.asarray(status, np.int64).astype(np.uint8)
+        out["limit"] = limit
+        out["remaining"] = remaining
+        out["reset_time"] = reset
+        writer.write(_HDR.pack(MAGIC_FAST_RESP, n) + out.tobytes())
+        await writer.drain()
+
     async def _serve_conn(self, reader, writer):
         try:
+            # capability hello: tells the edge whether GEB4 is usable on
+            # this connection (u8 flag; extend with more flags as needed)
+            writer.write(
+                _HDR.pack(MAGIC_HELLO, 1 if self._fast_ok() else 0)
+            )
+            await writer.drain()
             while True:
                 hdr = await reader.readexactly(_HDR.size)
                 magic, n = _HDR.unpack(hdr)
+                if magic == MAGIC_FAST_REQ:
+                    (plen,) = struct.unpack(
+                        "<I", await reader.readexactly(4)
+                    )
+                    await self._serve_fast_frame(
+                        await reader.readexactly(plen), n, writer
+                    )
+                    continue
                 if magic != MAGIC_REQ:
                     raise ValueError(f"bad magic {magic:#x}")
                 (plen,) = struct.unpack(

@@ -117,7 +117,14 @@ print(json.dumps({"us": min(ts) * 1e6, "threads": hn.prep_threads()}))
 def bench_threads():
     for t in (1, 2, 4, 8):
         env = dict(
-            os.environ, GUBER_PREP_THREADS=str(t), PYTHONPATH=os.getcwd()
+            os.environ,
+            GUBER_PREP_THREADS=str(t),
+            # APPEND to PYTHONPATH: replacing it drops this image's
+            # sitecustomize dir and the child dies importing jax with
+            # JAX_PLATFORMS pointing at an unregistered plugin
+            PYTHONPATH=os.getcwd()
+            + os.pathsep
+            + os.environ.get("PYTHONPATH", ""),
         )
         out = subprocess.run(
             [sys.executable, "-c", _CHILD % (N, NS, SLOTS, REPS)],

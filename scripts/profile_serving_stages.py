@@ -102,8 +102,12 @@ def main():
         "instance.get_rate_limits", inst.get_rate_limits
     )
     inst.batcher.decide = timed_async("batcher.decide", inst.batcher.decide)
-    be._arrays = timed("backend._arrays", be._arrays)
-    be._to_resps = timed("backend._to_resps", be._to_resps)
+    be.arrays_from_reqs = timed(
+        "backend.arrays_from_reqs", be.arrays_from_reqs
+    )
+    be.resps_from_arrays = timed(
+        "backend.resps_from_arrays", be.resps_from_arrays
+    )
 
     from gubernator_tpu.api.proto.gen import gubernator_pb2
     from gubernator_tpu.api.grpc_glue import V1Stub

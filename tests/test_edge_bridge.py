@@ -73,6 +73,13 @@ def test_bridge_answers_bad_item_without_failing_frame():
         await bridge.start()
         try:
             reader, writer = await asyncio.open_unix_connection(path)
+            # capability hello comes first on every connection (r4)
+            from gubernator_tpu.serve.edge_bridge import MAGIC_HELLO
+
+            hmagic, _flags = struct.unpack(
+                "<II", await reader.readexactly(8)
+            )
+            assert hmagic == MAGIC_HELLO
             writer.write(_frame([
                 _item(b"api", b"ok-1"),
                 _item(b"api", BAD),
@@ -119,3 +126,88 @@ def test_response_roundtrip():
     off += 2 + elen
     (olen,) = struct.unpack_from("<H", raw, off)
     assert raw[off + 2 : off + 2 + olen] == b"10.0.0.3:81"
+
+
+def test_fast_frame_chunks_oversized_batches():
+    """A GEB4 frame beyond MAX_BATCH_SIZE must reach the batcher as
+    ladder-sized chunks (the engine's compiled rungs top out there), and
+    the concatenated responses must preserve request order."""
+    import numpy as np
+
+    from gubernator_tpu.serve.config import MAX_BATCH_SIZE
+    from gubernator_tpu.serve.edge_bridge import (
+        MAGIC_FAST_REQ,
+        MAGIC_FAST_RESP,
+        MAGIC_HELLO,
+        _fast_dtypes,
+    )
+
+    seen_sizes = []
+
+    class FakeBatcher:
+        async def decide_arrays(self, fields):
+            n = fields["key_hash"].shape[0]
+            seen_sizes.append(n)
+            # echo limit back as remaining so order is checkable
+            return (
+                np.zeros(n, np.int64),
+                fields["limit"],
+                fields["limit"],
+                np.zeros(n, np.int64),
+            )
+
+    class FakeBackend:
+        decide_submit_arrays = object()
+        decide_submit = object()
+
+    class FakeConf:
+        peers = ["self"]
+
+    class FakeTraffic:
+        def observe_hashes(self, h):
+            pass
+
+    class FakeInstance:
+        backend = FakeBackend()
+        conf = FakeConf()
+        batcher = FakeBatcher()
+        traffic = FakeTraffic()
+
+    async def run():
+        path = "/tmp/guber-bridge-fast-chunk.sock"
+        bridge = EdgeBridge(FakeInstance(), path)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            hmagic, flags = struct.unpack(
+                "<II", await reader.readexactly(8)
+            )
+            assert hmagic == MAGIC_HELLO and flags == 1
+            n = MAX_BATCH_SIZE + 500
+            req_dt, resp_dt = _fast_dtypes()
+            rec = np.empty(n, req_dt)
+            rec["key_hash"] = np.arange(1, n + 1, dtype=np.uint64)
+            rec["hits"] = 1
+            rec["limit"] = np.arange(n, dtype=np.int64)
+            rec["duration"] = 1000
+            rec["algo"] = 0
+            payload = rec.tobytes()
+            writer.write(
+                struct.pack("<II", MAGIC_FAST_REQ, n)
+                + struct.pack("<I", len(payload))
+                + payload
+            )
+            await writer.drain()
+            magic, rn = struct.unpack("<II", await reader.readexactly(8))
+            assert magic == MAGIC_FAST_RESP and rn == n
+            out = np.frombuffer(
+                await reader.readexactly(n * resp_dt.itemsize), resp_dt
+            )
+            writer.close()
+            return out
+        finally:
+            await bridge.stop()
+
+    out = asyncio.run(run())
+    assert seen_sizes == [MAX_BATCH_SIZE, 500]
+    assert (out["remaining"] == np.arange(MAX_BATCH_SIZE + 500)).all()

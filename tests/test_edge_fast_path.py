@@ -1,0 +1,215 @@
+"""Edge pre-hashed fast path (GEB4) e2e against a real device backend.
+
+The edge hashes name+"_"+key with its own from-spec XXH64 and ships
+dense records; the daemon's bridge views them as numpy arrays and drives
+the batcher's array path — zero per-item Python. These tests pin:
+
+- bit-exact hash parity between edge.cc's xxh64 and the daemon's native
+  hasher (shared rate-limit state between edge-served and directly-served
+  traffic is only possible if both address the same store slot);
+- GLOBAL items still route through the string (GEB1) path with full
+  instance semantics;
+- per-item validation errors survive (empty-key items force GEB1);
+- fast-path traffic still feeds the distinct-key estimator.
+
+Requires the edge binary; the daemon runs the single-chip tpu backend on
+CPU (GUBER_JAX_PLATFORM=cpu) like the other daemon e2e tests.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from gubernator_tpu.api.grpc_glue import V1Stub
+from gubernator_tpu.api.proto.gen import gubernator_pb2
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+
+pytestmark = pytest.mark.skipif(
+    not EDGE_BIN.exists(),
+    reason="edge binary not built (make -C gubernator_tpu/native/edge)",
+)
+
+DAEMON_GRPC = 19494
+DAEMON_HTTP = 19495
+EDGE_HTTP = 19496
+EDGE_GRPC = 19497
+SOCK = "/tmp/guber-edge-fast-pytest.sock"
+
+
+@pytest.fixture(scope="module")
+def stack():
+    try:
+        os.unlink(SOCK)
+    except FileNotFoundError:
+        pass
+    env = dict(
+        os.environ,
+        GUBER_BACKEND="tpu",
+        GUBER_JAX_PLATFORM="cpu",
+        GUBER_STORE_SLOTS=str(1 << 10),
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{DAEMON_GRPC}",
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
+        GUBER_EDGE_SOCKET=SOCK,
+        PYTHONPATH=str(ROOT),
+        JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
+    )
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=ROOT, env=env,
+    )
+    deadline = time.monotonic() + 180  # tpu-backend warmup compiles
+    while time.monotonic() < deadline and not pathlib.Path(SOCK).exists():
+        time.sleep(0.2)
+        if daemon.poll() is not None:
+            pytest.fail(f"daemon died:\n{daemon.stdout.read()}")
+    edge = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(EDGE_HTTP), "--grpc-listen",
+         str(EDGE_GRPC), "--backend", SOCK],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    import socket as _s
+
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            _s.create_connection(("127.0.0.1", EDGE_GRPC), timeout=1).close()
+            break
+        except OSError:
+            time.sleep(0.05)
+    yield
+    edge.kill()
+    daemon.terminate()
+    daemon.wait(timeout=10)
+
+
+def _grpc_req(key, hits=1, limit=5, behavior=0):
+    return gubernator_pb2.RateLimitReq(
+        name="fp", unique_key=key, hits=hits, limit=limit,
+        duration=60_000, behavior=behavior,
+    )
+
+
+def _daemon_http(body: dict) -> dict:
+    return json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{DAEMON_HTTP}/v1/GetRateLimits",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=30,
+        ).read()
+    )
+
+
+def test_fast_path_shares_state_with_direct_traffic(stack):
+    """Two hits through the edge (GEB4, edge-side XXH64) then a peek
+    directly at the daemon (native hasher) must see the SAME bucket —
+    bit-exact hash parity, or remaining would read 5."""
+    v1 = V1Stub(grpc.insecure_channel(f"127.0.0.1:{EDGE_GRPC}"))
+    for expect in (4, 3):
+        r = v1.GetRateLimits(
+            gubernator_pb2.GetRateLimitsReq(requests=[_grpc_req("parity")])
+        )
+        assert r.responses[0].remaining == expect
+
+    out = _daemon_http(
+        {"requests": [{"name": "fp", "uniqueKey": "parity", "hits": 0,
+                       "limit": 5, "duration": 60000}]}
+    )
+    assert out["responses"][0]["remaining"] == "3"
+
+    # and back through the edge HTTP door (also fast-path eligible)
+    body = json.dumps(
+        {"requests": [{"name": "fp", "uniqueKey": "parity", "hits": 1,
+                       "limit": 5, "duration": 60000}]}
+    ).encode()
+    out2 = json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{EDGE_HTTP}/v1/GetRateLimits",
+                data=body, headers={"Content-Type": "application/json"},
+            ),
+            timeout=30,
+        ).read()
+    )
+    assert out2["responses"][0]["remaining"] == "2"
+
+
+def test_hash_parity_wide(stack):
+    """64 random-ish keys through the edge, then read each directly:
+    every bucket must show the consumed hit (any hash mismatch shows up
+    as an untouched bucket with remaining == limit)."""
+    keys = [f"wide-{i}-é{i % 7}" for i in range(64)]  # incl. utf-8
+    v1 = V1Stub(grpc.insecure_channel(f"127.0.0.1:{EDGE_GRPC}"))
+    r = v1.GetRateLimits(
+        gubernator_pb2.GetRateLimitsReq(
+            requests=[_grpc_req(k, limit=9) for k in keys]
+        )
+    )
+    assert all(x.remaining == 8 for x in r.responses)
+    out = _daemon_http(
+        {"requests": [{"name": "fp", "uniqueKey": k, "hits": 0,
+                       "limit": 9, "duration": 60000} for k in keys]}
+    )
+    assert all(x["remaining"] == "8" for x in out["responses"])
+
+
+def test_global_items_fall_back_to_string_path(stack):
+    """behavior=GLOBAL disqualifies a pending from GEB4; the instance's
+    GLOBAL handling (owner-side queue_update on a single node) must
+    still answer correctly through the edge."""
+    v1 = V1Stub(grpc.insecure_channel(f"127.0.0.1:{EDGE_GRPC}"))
+    r = v1.GetRateLimits(
+        gubernator_pb2.GetRateLimitsReq(
+            requests=[_grpc_req("glob", behavior=gubernator_pb2.GLOBAL)]
+        )
+    )
+    assert r.responses[0].status == gubernator_pb2.UNDER_LIMIT
+    assert r.responses[0].remaining == 4
+
+
+def test_validation_errors_force_string_path(stack):
+    v1 = V1Stub(grpc.insecure_channel(f"127.0.0.1:{EDGE_GRPC}"))
+    r = v1.GetRateLimits(
+        gubernator_pb2.GetRateLimitsReq(
+            requests=[
+                gubernator_pb2.RateLimitReq(  # empty unique_key
+                    name="fp", hits=1, limit=5, duration=60_000
+                ),
+                _grpc_req("valid-neighbor"),
+            ]
+        )
+    )
+    assert "unique_key" in r.responses[0].error
+    assert r.responses[1].remaining == 4
+
+
+def test_fast_path_feeds_distinct_key_estimator(stack):
+    before = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{DAEMON_HTTP}/v1/debug/stats", timeout=10
+        ).read()
+    )["distinct_keys_estimate"]
+    v1 = V1Stub(grpc.insecure_channel(f"127.0.0.1:{EDGE_GRPC}"))
+    v1.GetRateLimits(
+        gubernator_pb2.GetRateLimitsReq(
+            requests=[_grpc_req(f"hll-{i}") for i in range(200)]
+        )
+    )
+    after = json.loads(
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{DAEMON_HTTP}/v1/debug/stats", timeout=10
+        ).read()
+    )["distinct_keys_estimate"]
+    assert after >= before + 150
