@@ -309,18 +309,37 @@ class DeviceBatcher:
 
         # pipelined path: submit now (host presort + async dispatch);
         # fetch in a background task so the flusher can collect and
-        # submit the NEXT batch while the device computes this one. The
-        # semaphore bounds outstanding batches at fetch_depth; fetches
-        # run on the fetch pool and may complete out of order (each
-        # batch's futures are independent). A cancel while waiting for a
-        # slot reaches _run's handler with nothing submitted.
+        # submit the NEXT batch while the device computes this one.
+        await self._submit_pipelined(
+            lambda: submit(reqs, gnp),
+            decide_items,
+            lambda handle, submit_s: self._finish(
+                handle, decide_items, submit_s
+            ),
+        )
+
+    async def _submit_pipelined(
+        self, submit_call, decide_items, finish_factory
+    ) -> None:
+        """The pipelined paths' shared submit discipline: semaphore
+        admission (bounds outstanding batches at fetch_depth), shielded
+        executor submit, release/fail on every exit, and ownership
+        transfer of the live batch to the fetch task. A cancel while
+        waiting for a slot reaches _run's handler with nothing
+        submitted. `submit_call` runs on the single submit thread, so
+        per-batch host work (flatten/convert/presort) belongs inside it
+        — off the event loop AND inside the failure guard."""
         await self._inflight.acquire()
+        # t0 AFTER admission: under a saturated pipeline the acquire
+        # blocks for up to a batch period, which is queue wait, not
+        # launch cost — DEVICE_LAUNCH_MS must not double-count it
+        t0 = time.monotonic()
         # shield: a stop() mid-submit must not strand these futures —
         # the submit thread finishes either way (the store mutation has
         # already been dispatched), so fail the batch and propagate.
         loop = asyncio.get_running_loop()
         submit_fut = asyncio.ensure_future(
-            loop.run_in_executor(self._submit_pool, submit, reqs, gnp)
+            loop.run_in_executor(self._submit_pool, submit_call)
         )
         try:
             handle = await asyncio.shield(submit_fut)
@@ -340,81 +359,68 @@ class DeviceBatcher:
             self._fail(decide_items, e)
             return
         submit_s = time.monotonic() - t0
-        task = asyncio.ensure_future(
-            self._finish(handle, decide_items, submit_s)
-        )
+        task = asyncio.ensure_future(finish_factory(handle, submit_s))
         # hold the reference until done (stop() drains the set); discard
         # on completion so an idle batcher doesn't pin the last batches'
         # requests/responses until the next flush
         self._pending.add(task)
         task.add_done_callback(self._pending.discard)
         # this batch now belongs to its fetch task (stop() awaits it): a
-        # later cancel must not fail its futures from _run
-        batch.clear()
+        # later cancel must not fail its futures from _run. _live_batch
+        # is the same list object _run handed to _flush.
+        self._live_batch.clear()
 
     async def _flush_arrays(self, decide_items) -> None:
         """Array-path sibling of the pipelined branch in _flush: convert
         request-object groups, concatenate all groups into one dense
         field set, submit once, and let _finish_arrays slice responses
-        back per group. Same semaphore/cancellation discipline."""
-        import numpy as np
+        back per group. The flatten runs inside submit_call — on the
+        submit thread, where a conversion error (e.g. an out-of-int64
+        value from a JSON caller) fails THIS batch instead of killing
+        the flusher task."""
+        # group lengths are exception-free to read and needed for the
+        # response slicing regardless of submit outcome
+        lens = [
+            it[1]["key_hash"].shape[0]
+            if it[0] == "decide_arrays"
+            else len(it[1])
+            for it in decide_items
+        ]
 
-        t0 = time.monotonic()
-        parts = []
-        for it in decide_items:
-            if it[0] == "decide":
-                parts.append(
-                    self.backend.arrays_from_reqs(
-                        it[1], [bool(g) for g in it[2]]
+        def submit_call():
+            import numpy as np
+
+            parts = []
+            for it in decide_items:
+                if it[0] == "decide":
+                    parts.append(
+                        self.backend.arrays_from_reqs(
+                            it[1], [bool(g) for g in it[2]]
+                        )
                     )
+                else:
+                    f = it[1]
+                    if "gnp" not in f:
+                        f = dict(f)
+                        f["gnp"] = np.zeros(f["key_hash"].shape[0], bool)
+                    parts.append(f)
+            fields = {
+                k: (
+                    parts[0][k]
+                    if len(parts) == 1
+                    else np.concatenate([p[k] for p in parts])
                 )
-            else:
-                f = it[1]
-                if "gnp" not in f:
-                    f = dict(f)
-                    f["gnp"] = np.zeros(f["key_hash"].shape[0], bool)
-                parts.append(f)
-        keys = self.backend.ARRAY_FIELDS
-        fields = {
-            k: (
-                parts[0][k]
-                if len(parts) == 1
-                else np.concatenate([p[k] for p in parts])
-            )
-            for k in keys
-        }
-        lens = [p["key_hash"].shape[0] for p in parts]
+                for k in self.backend.ARRAY_FIELDS
+            }
+            return self.backend.decide_submit_arrays(fields)
 
-        await self._inflight.acquire()
-        loop = asyncio.get_running_loop()
-        submit_fut = asyncio.ensure_future(
-            loop.run_in_executor(
-                self._submit_pool, self.backend.decide_submit_arrays, fields
-            )
+        await self._submit_pipelined(
+            submit_call,
+            decide_items,
+            lambda handle, submit_s: self._finish_arrays(
+                handle, decide_items, lens, submit_s
+            ),
         )
-        try:
-            handle = await asyncio.shield(submit_fut)
-        except asyncio.CancelledError:
-            self._inflight.release()
-            submit_fut.add_done_callback(
-                lambda t: t.cancelled() or t.exception()
-            )
-            raise
-        except Exception as e:
-            self._inflight.release()
-            self._fail(decide_items, e)
-            return
-        submit_s = time.monotonic() - t0
-        task = asyncio.ensure_future(
-            self._finish_arrays(handle, decide_items, lens, submit_s)
-        )
-        self._pending.add(task)
-        task.add_done_callback(self._pending.discard)
-        # the batch now belongs to its fetch task: clear the live batch
-        # (the same list object _run passed to _flush) so a later cancel
-        # doesn't fail futures the fetch will resolve — the same
-        # ownership transfer _flush's batch.clear() performs
-        self._live_batch.clear()
 
     async def _finish_arrays(self, handle, decide_items, lens, submit_s):
         t1 = time.monotonic()

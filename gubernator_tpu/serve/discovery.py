@@ -74,16 +74,6 @@ class EtcdPool:
         verification; mutual TLS needs all three (python-etcd3 requires
         ca_cert with a client cert pair)."""
         if client is None:
-            try:
-                import etcd3  # noqa: F401
-            except ImportError as e:
-                raise RuntimeError(
-                    "etcd discovery requires the 'etcd3' package, which is "
-                    "not available in this image; use GUBER_PEERS (static) "
-                    "or kubernetes discovery"
-                ) from e
-            import etcd3
-
             host, _, port = endpoints[0].rpartition(":")
             kwargs: dict = {}
             if tls_ca:
@@ -95,13 +85,31 @@ class EtcdPool:
                     )
                 kwargs["cert_cert"] = tls_cert
                 kwargs["cert_key"] = tls_key
-            client = etcd3.client(host=host, port=int(port or 2379), **kwargs)
+            try:
+                # prefer the etcd3 library when installed (the contract
+                # tests pin the pool against it; pip install .[discovery])
+                import etcd3
+
+                client = etcd3.client(
+                    host=host, port=int(port or 2379), **kwargs
+                )
+            except ImportError:
+                # vendored minimal client over grpcio (serve/etcd_client):
+                # same etcd3-shaped surface, no extra dependency
+                from gubernator_tpu.serve.etcd_client import (
+                    VendoredEtcdClient,
+                )
+
+                client = VendoredEtcdClient(
+                    host=host, port=int(port or 2379), **kwargs
+                )
         self.client = client
         self.prefix = prefix
         self.advertise = advertise
         self.on_update = on_update
         self._lease = None
         self._tasks: list = []
+        self._closing = False
 
     async def start(self) -> None:
         await asyncio.to_thread(self._register)
@@ -132,7 +140,11 @@ class EtcdPool:
 
     async def _watch_loop(self) -> None:
         loop = asyncio.get_running_loop()
-        while True:
+        # stop the restart cycle once close() begins: restarting a watch
+        # after close cancelled the current one would strand a worker
+        # thread blocked in the new watch's iterator (no cancel handle
+        # left pointing at it), which wedges loop shutdown
+        while not self._closing:
             try:
                 # the watch iterator blocks between events, so it must be
                 # consumed on a worker thread — never on the serving loop
@@ -140,6 +152,8 @@ class EtcdPool:
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                if self._closing:
+                    break
                 log.error("etcd watch error: %s; retrying", e)
                 await asyncio.sleep(1)
 
@@ -162,6 +176,10 @@ class EtcdPool:
         await self.on_update(peers)
 
     async def close(self) -> None:
+        # mark closing BEFORE cancelling: _watch_loop must not restart
+        # the watch after its current consume unblocks (both run on this
+        # loop, so the flag is visible before any restart can interleave)
+        self._closing = True
         # cancel the blocking watch FIRST or its worker thread outlives
         # the pool (the iterator blocks between events)
         cancel = getattr(self, "_cancel_watch", None)

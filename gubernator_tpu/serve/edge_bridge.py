@@ -184,12 +184,22 @@ class EdgeBridge:
         """The pre-hashed fast path bypasses the instance's ring routing
         and GLOBAL handling, so it is only sound when this node owns the
         whole key space (single-node deployment — the edge's documented
-        topology) and the backend takes arrays."""
+        topology) and the backend takes arrays. Membership must be read
+        LIVE from the picker: discovery (etcd/k8s) grows the ring via
+        set_peers without ever touching conf.peers, and a fast path left
+        on in a grown cluster would admit every key locally (~Nx
+        over-admission). The picker starts empty, so "<= 1 peers" is
+        true both before set_peers and after a single-node set_peers."""
         backend = getattr(self.instance, "backend", None)
-        conf = getattr(self.instance, "conf", None)
+        picker = getattr(self.instance, "picker", None)
+        if picker is None:
+            return False
+        try:
+            n_peers = len(picker.peers())
+        except Exception:
+            return False
         return (
-            conf is not None
-            and len(getattr(conf, "peers", ())) <= 1
+            n_peers <= 1
             and getattr(backend, "decide_submit_arrays", None) is not None
             and getattr(backend, "decide_submit", None) is not None
         )
@@ -229,15 +239,20 @@ class EdgeBridge:
             # oversized co-batch splits into ladder-sized chunks instead
             # of handing the engine a batch beyond its compiled rungs
             # (which would either error or trigger a fresh multi-minute
-            # XLA compile on the serialized submit thread)
-            parts = []
-            for i in range(0, n, MAX_BATCH_SIZE):
-                chunk = {
-                    k: v[i : i + MAX_BATCH_SIZE] for k, v in fields.items()
-                }
-                parts.append(
-                    await self.instance.batcher.decide_arrays(chunk)
-                )
+            # XLA compile on the serialized submit thread). gather: all
+            # chunks enqueue at once so they co-batch / ride the fetch
+            # pipeline instead of paying one device round trip each.
+            parts = await asyncio.gather(
+                *[
+                    self.instance.batcher.decide_arrays(
+                        {
+                            k: v[i : i + MAX_BATCH_SIZE]
+                            for k, v in fields.items()
+                        }
+                    )
+                    for i in range(0, n, MAX_BATCH_SIZE)
+                ]
+            )
             status, limit, remaining, reset = (
                 np.concatenate([p[j] for p in parts]) for j in range(4)
             )

@@ -4,5 +4,6 @@
 # available in this image).
 set -euo pipefail
 cd "$(dirname "$0")/../gubernator_tpu/api/proto"
-protoc --python_out=gen gubernator.proto peers.proto
+protoc --python_out=gen gubernator.proto peers.proto \
+    etcd_mvcc.proto etcd_rpc.proto
 echo "generated: $(ls gen/*_pb2.py)"

@@ -160,8 +160,10 @@ def test_fast_frame_chunks_oversized_batches():
         decide_submit_arrays = object()
         decide_submit = object()
 
-    class FakeConf:
-        peers = ["self"]
+    class FakePicker:
+        # live membership, the surface _fast_ok actually consults
+        def peers(self):
+            return ["self"]
 
     class FakeTraffic:
         def observe_hashes(self, h):
@@ -169,7 +171,7 @@ def test_fast_frame_chunks_oversized_batches():
 
     class FakeInstance:
         backend = FakeBackend()
-        conf = FakeConf()
+        picker = FakePicker()
         batcher = FakeBatcher()
         traffic = FakeTraffic()
 
@@ -211,3 +213,59 @@ def test_fast_frame_chunks_oversized_batches():
     out = asyncio.run(run())
     assert seen_sizes == [MAX_BATCH_SIZE, 500]
     assert (out["remaining"] == np.arange(MAX_BATCH_SIZE + 500)).all()
+
+
+def test_fast_path_disabled_when_membership_grows():
+    """The GEB4 fast path bypasses ring routing, so LIVE membership
+    (picker.peers(), which discovery updates via set_peers) must gate
+    it — not static config. With >1 peers the hello advertises slow
+    path, and a GEB4 frame sent anyway is refused (connection closed),
+    never silently decided locally (r4 review: ~Nx over-admission)."""
+    import numpy as np
+
+    from gubernator_tpu.serve.edge_bridge import (
+        MAGIC_FAST_REQ,
+        MAGIC_HELLO,
+        _fast_dtypes,
+    )
+
+    class FakeBackend:
+        decide_submit_arrays = object()
+        decide_submit = object()
+
+    class FakePicker:
+        def peers(self):
+            return ["self", "other"]  # grown cluster
+
+    class FakeInstance:
+        backend = FakeBackend()
+        picker = FakePicker()
+
+    async def run():
+        path = "/tmp/guber-bridge-fast-multinode.sock"
+        bridge = EdgeBridge(FakeInstance(), path)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            hmagic, flags = struct.unpack(
+                "<II", await reader.readexactly(8)
+            )
+            assert hmagic == MAGIC_HELLO and flags == 0
+            # a (buggy or stale) edge sends GEB4 anyway: refused loudly
+            req_dt, _ = _fast_dtypes()
+            rec = np.zeros(2, req_dt)
+            rec["key_hash"] = [1, 2]
+            payload = rec.tobytes()
+            writer.write(
+                struct.pack("<II", MAGIC_FAST_REQ, 2)
+                + struct.pack("<I", len(payload))
+                + payload
+            )
+            await writer.drain()
+            got = await reader.read(8)
+            assert got == b"", got  # connection closed, no response
+            writer.close()
+        finally:
+            await bridge.stop()
+
+    asyncio.run(run())

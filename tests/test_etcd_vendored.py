@@ -1,0 +1,205 @@
+"""Vendored etcd client + EtcdPool live round trips over real gRPC.
+
+r3's gap: the etcd path existed but had never executed live (the etcd3
+library is absent from this image). Now the vendored client
+(serve/etcd_client.py) runs the full lease+put+watch+re-register cycle
+against tests/_fake_etcd.py — a real grpc server speaking the vendored
+etcd protos — and, when GUBER_TEST_ETCD names a live endpoint, against
+real etcd with the same assertions. The skip reason distinguishes "no
+etcd available" from "never tried": the fake-backed tests always run.
+"""
+
+import asyncio
+import os
+import threading
+import time
+
+import pytest
+
+from gubernator_tpu.serve.etcd_client import (
+    VendoredEtcdClient,
+    prefix_range_end,
+)
+from tests._fake_etcd import FakeEtcd
+
+REAL_ETCD = os.environ.get("GUBER_TEST_ETCD", "")
+
+
+@pytest.fixture()
+def fake():
+    srv = FakeEtcd().start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def client(fake):
+    c = VendoredEtcdClient(host="127.0.0.1", port=fake.port)
+    yield c
+    c.close()
+
+
+def test_prefix_range_end_convention():
+    assert prefix_range_end(b"/guber/") == b"/guber0"
+    assert prefix_range_end(b"a\xff") == b"b"
+    assert prefix_range_end(b"\xff\xff") == b"\0"
+
+
+def test_put_get_delete_roundtrip(client):
+    client.put("/t/a", "A")
+    client.put("/t/b", b"B")
+    client.put("/u/other", "X")
+    got = client.get_prefix("/t/")
+    assert sorted(v for v, _m in got) == [b"A", b"B"]
+    keys = sorted(m.key for _v, m in got)
+    assert keys == [b"/t/a", b"/t/b"]
+    assert client.delete("/t/a") is True
+    assert client.delete("/t/a") is False
+    assert [v for v, _m in client.get_prefix("/t/")] == [b"B"]
+
+
+def test_lease_lifecycle_and_keepalive(client, fake):
+    lease = client.lease(30)
+    assert lease.id in fake.lease_ids()
+    client.put("/l/me", "me", lease=lease)
+    lease.refresh()  # alive: no raise
+    fake.revoke_lease(lease.id)
+    # expiry drops the attached key, and refresh now fails loudly
+    assert client.get_prefix("/l/") == []
+    with pytest.raises(RuntimeError, match="expired"):
+        lease.refresh()
+
+
+def test_watch_prefix_sees_put_and_delete(client):
+    events, cancel = client.watch_prefix("/w/")
+    got = []
+    done = threading.Event()
+
+    def consume():
+        for ev in events:
+            got.append((ev.type, bytes(ev.kv.key)))
+            if len(got) >= 2:
+                break
+        done.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)  # let the watch register
+    client.put("/w/k1", "v1")
+    client.delete("/w/k1")
+    assert done.wait(timeout=10), got
+    assert got[0][1] == b"/w/k1" and got[1][1] == b"/w/k1"
+    assert got[0][0] == 0 and got[1][0] == 1  # PUT then DELETE
+    cancel()
+    t.join(timeout=5)
+
+
+def test_watch_cancel_unblocks(client):
+    events, cancel = client.watch_prefix("/wc/")
+    finished = threading.Event()
+
+    def consume():
+        for _ in events:
+            pass
+        finished.set()
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    cancel()
+    assert finished.wait(timeout=10)
+
+
+def _run_pool_cycle(client, fake_or_none):
+    """Full EtcdPool membership cycle on a real event loop."""
+    from gubernator_tpu.serve.discovery import EtcdPool
+
+    updates = []
+
+    async def scenario():
+        seen = asyncio.Event()
+
+        async def on_update(peers):
+            updates.append(sorted(p.address for p in peers))
+            seen.set()
+
+        pool = EtcdPool(
+            endpoints=["unused:0"],
+            prefix="/guber-test/peers/",
+            advertise="10.0.0.1:81",
+            on_update=on_update,
+            client=client,
+        )
+        await pool.start()
+        try:
+            assert updates[-1] == ["10.0.0.1:81"]
+
+            # events before the watch stream finishes registering are
+            # not delivered (same contract as clientv3/etcd3 watches) —
+            # wait for registration before acting
+            if fake_or_none is not None:
+                for _ in range(250):
+                    if fake_or_none._watches:
+                        break
+                    await asyncio.sleep(0.02)
+                assert fake_or_none._watches, "watch never registered"
+            else:
+                await asyncio.sleep(0.5)
+
+            # a second node registers out-of-band: the watch pushes it
+            seen.clear()
+            lease2 = client.lease(30)
+            client.put(
+                "/guber-test/peers/10.0.0.2:81", "10.0.0.2:81",
+                lease=lease2,
+            )
+            await asyncio.wait_for(seen.wait(), timeout=10)
+            assert updates[-1] == ["10.0.0.1:81", "10.0.0.2:81"]
+
+            # and its departure (lease revoke = expiry) pushes again
+            seen.clear()
+            lease2.revoke()
+            await asyncio.wait_for(seen.wait(), timeout=10)
+            assert updates[-1] == ["10.0.0.1:81"]
+
+            if fake_or_none is not None:
+                # lease-loss failure injection: revoke OUR lease behind
+                # the pool's back, drive the keepalive path directly
+                # (the loop fires at TTL/3 = 10s — too slow for a test),
+                # and assert the pool re-registered (etcd.go:247-301)
+                fake_or_none.revoke_lease(pool._lease.id)
+                assert client.get_prefix("/guber-test/peers/") == []
+                with pytest.raises(Exception):
+                    pool._lease.refresh()
+                await asyncio.to_thread(pool._register)
+                vals = [
+                    v.decode()
+                    for v, _m in client.get_prefix("/guber-test/peers/")
+                ]
+                assert vals == ["10.0.0.1:81"]
+        finally:
+            # ALWAYS close: a dangling watch worker would wedge
+            # asyncio.run's executor shutdown after a failure
+            await pool.close()
+        # close deletes the registration key
+        assert client.get_prefix("/guber-test/peers/") == []
+
+    asyncio.run(scenario())
+
+
+def test_pool_full_cycle_against_fake(client, fake):
+    _run_pool_cycle(client, fake)
+
+
+@pytest.mark.skipif(
+    not REAL_ETCD,
+    reason="no etcd available (set GUBER_TEST_ETCD=host:port to run "
+    "against a live cluster; the fake-backed cycle above always runs)",
+)
+def test_pool_full_cycle_against_real_etcd():
+    host, _, port = REAL_ETCD.rpartition(":")
+    c = VendoredEtcdClient(host=host or "127.0.0.1", port=int(port))
+    try:
+        _run_pool_cycle(c, None)
+    finally:
+        c.close()
