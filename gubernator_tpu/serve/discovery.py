@@ -6,11 +6,14 @@ Three pools, mirroring the reference's discovery layer:
   and test-cluster path, cluster/cluster.go:36-46).
 - EtcdPool — registers this node under `<prefix><advertise>` with a TTL
   lease + keepalive and watches the prefix for peer changes (reference
-  etcd.go:36-316). Requires an etcd3 client library; gated import, raises
-  a clear error when unavailable in this image.
+  etcd.go:36-316). Uses the etcd3 library when installed; otherwise
+  falls back to the vendored grpcio client (serve/etcd_client.py), so
+  etcd discovery works out of the box in this image.
 - K8sPool — watches the Endpoints API filtered by a label selector and
   marks self by pod IP (reference kubernetes.go:56-157). Uses the
-  kubernetes client when present; gated likewise.
+  kubernetes client when installed; otherwise falls back to the
+  vendored REST client (serve/k8s_client.py), likewise working out of
+  the box.
 
 All pools push full `[]PeerInfo` snapshots through `on_update`, and the
 instance rebuilds its ring (reference etcd.go:308-316 -> SetPeers).
@@ -224,18 +227,23 @@ class K8sPool:
             raise ValueError("inject both api and watch, or neither")
         if api is None:
             try:
-                import kubernetes  # noqa: F401
-            except ImportError as e:
-                raise RuntimeError(
-                    "kubernetes discovery requires the 'kubernetes' "
-                    "package, which is not available in this image; use "
-                    "GUBER_PEERS (static) or etcd discovery"
-                ) from e
-            import kubernetes
+                # prefer the kubernetes library when installed (contract
+                # tests pin the pool against it; pip install .[discovery])
+                import kubernetes
 
-            kubernetes.config.load_incluster_config()
-            api = kubernetes.client.CoreV1Api()
-            watch = kubernetes.watch.Watch()
+                kubernetes.config.load_incluster_config()
+                api = kubernetes.client.CoreV1Api()
+                watch = kubernetes.watch.Watch()
+            except ImportError:
+                # vendored minimal client over the plain REST API
+                # (serve/k8s_client): same surface, no dependency
+                from gubernator_tpu.serve.k8s_client import (
+                    VendoredK8sApi,
+                    VendoredK8sWatch,
+                )
+
+                api = VendoredK8sApi()  # in-cluster config
+                watch = VendoredK8sWatch()
         self.api = api
         self.watch = watch
         self.namespace = namespace
@@ -244,19 +252,25 @@ class K8sPool:
         self.pod_port = pod_port
         self.on_update = on_update
         self._task = None
+        self._closing = False
 
     async def start(self) -> None:
         self._task = asyncio.ensure_future(self._watch_loop())
 
     async def _watch_loop(self) -> None:
         loop = asyncio.get_running_loop()
-        while True:
+        # same no-restart-after-close guard as EtcdPool._watch_loop: a
+        # watch restarted mid-teardown opens a fresh stream nothing will
+        # ever stop
+        while not self._closing:
             try:
                 # blocking HTTP watch stream consumed on a worker thread
                 await asyncio.to_thread(self._consume_stream, loop)
             except asyncio.CancelledError:
                 raise
             except Exception as e:
+                if self._closing:
+                    break
                 log.error("k8s watch error: %s; retrying", e)
                 await asyncio.sleep(1)
 
@@ -284,6 +298,7 @@ class K8sPool:
         await self.on_update(peers)
 
     async def close(self) -> None:
+        self._closing = True
         # stop the blocking HTTP watch FIRST or its worker thread stays
         # in the long-poll and later calls into a dead event loop (same
         # invariant as EtcdPool.close)
