@@ -358,6 +358,21 @@ class MultiHostMeshEngine:
 
     def decide_arrays(self, key_hash, hits, limit, duration, algo, gnp, now):
         assert self.is_leader
+        return self.decide_wait(
+            self.decide_submit(
+                key_hash, hits, limit, duration, algo, gnp, now
+            )
+        )
+
+    def decide_submit(self, key_hash, hits, limit, duration, algo, gnp,
+                      now):
+        """Pipelined split for the multihost leader: followers only need
+        to ISSUE the identical jitted call (their psum legs run inside
+        the device program) — they never fetch results, so the leader
+        may submit batch N+1 while batch N's fetch is in flight, exactly
+        like the single-host engines. The ack still bounds skew at one
+        collective."""
+        assert self.is_leader
         self._lockstep(
             {
                 "kind": "decide",
@@ -371,11 +386,18 @@ class MultiHostMeshEngine:
             }
         )
         try:
-            return self.inner.decide_arrays(
+            return self.inner.decide_submit(
                 key_hash, hits, limit, duration, algo, gnp, now
             )
         finally:
             self._done()
+
+    def decide_wait(self, handle):
+        """Leader-local: fetching the packed outputs involves no
+        collective, so no lockstep message is needed (followers already
+        moved on at submit time)."""
+        assert self.is_leader
+        return self.inner.decide_wait(handle)
 
     def update_globals(self, key_hash, limit, remaining, reset_time, is_over,
                        now=None):
@@ -464,7 +486,12 @@ class MultiHostMeshEngine:
                     _send_msg(conn, {"kind": "nack", "error": err})
                     raise RuntimeError(err)
             elif kind == "decide":
-                self.inner.decide_arrays(**msg)
+                # submit only: the follower's psum legs execute inside
+                # the dispatched device program; fetching the packed
+                # outputs here would buy nothing and cost a device->host
+                # transfer per step (plus it would serialize the
+                # leader's fetch pipeline through follower acks)
+                self.inner.decide_submit(**msg)
             elif kind == "reset":
                 self.inner.reset()
             elif kind == "upsert":

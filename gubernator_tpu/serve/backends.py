@@ -202,9 +202,10 @@ class MeshBackend(_ArrayOps):
             engine = MeshEngine(store, devices=devices, buckets=buckets)
         self.engine = engine
         if not hasattr(engine, "decide_submit"):
-            # lockstep wrappers (multihost) have no split — a None
-            # attribute makes the batcher fall back to blocking decide
-            # (and the edge bridge's array fast path stays off)
+            # an engine without the submit/wait split (none in-tree since
+            # the multihost wrapper gained it in r4): None attributes make
+            # the batcher fall back to blocking decide and keep the edge
+            # bridge's array fast path off
             self.decide_submit = None
             self.decide_wait = None
             self.decide_submit_arrays = None
@@ -227,8 +228,9 @@ class MeshBackend(_ArrayOps):
         """Shard + dispatch without waiting (MeshEngine.decide_submit):
         gives the mesh backend the same host/device pipelining the
         single-chip backend has — the batcher preps batch N+1 while the
-        whole mesh computes batch N. Only offered when the engine has
-        the split (the multihost lockstep wrapper does not)."""
+        whole mesh computes batch N. The multihost lockstep wrapper has
+        the split too (followers dispatch-and-move-on, fetches stay
+        leader-local), so this pipelines across hosts as well."""
         from gubernator_tpu.api.types import millisecond_now
 
         if len(reqs) == 0:
@@ -331,7 +333,10 @@ class MultiHostBackend(MeshBackend):
     """Leader-side backend over a multi-process global mesh. Construct
     only on process 0; follower processes run
     MultiHostMeshEngine.follower_loop instead of serving (cli/daemon.py
-    wires both roles from GUBER_DIST_* env)."""
+    wires both roles from GUBER_DIST_* env). The lockstep wrapper
+    exposes the submit/wait split (followers dispatch and move on —
+    fetches are leader-local), so the fetch-depth pipeline and the
+    edge's array fast path work across hosts too."""
 
     def __init__(
         self,

@@ -82,10 +82,14 @@ class VendoredK8sApi:
                 )
             base_url = f"https://{host}:{port}"
             if token is None:
-                with open(f"{_SA_DIR}/token") as f:
-                    token = f.read().strip()
+                # remember the PATH, not the value: bound service-account
+                # tokens rotate (~1h on modern clusters) and a stale
+                # bearer would 401 every reconnect forever
+                self._token_path = f"{_SA_DIR}/token"
             if ca_cert is None:
                 ca_cert = f"{_SA_DIR}/ca.crt"
+        if not hasattr(self, "_token_path"):
+            self._token_path = None
         self.token = token
         self.timeout = timeout
         u = urllib.parse.urlparse(base_url.rstrip("/"))
@@ -111,8 +115,12 @@ class VendoredK8sApi:
                 self._host, self._port, timeout=_CONNECT_TIMEOUT_S
             )
         headers = {"Accept": "application/json"}
-        if self.token:
-            headers["Authorization"] = f"Bearer {self.token}"
+        token = self.token
+        if self._token_path is not None:
+            with open(self._token_path) as f:
+                token = f.read().strip()  # fresh per request (rotation)
+        if token:
+            headers["Authorization"] = f"Bearer {token}"
         try:
             conn.request("GET", path, headers=headers)
             resp = conn.getresponse()
@@ -249,6 +257,17 @@ class VendoredK8sWatch:
                     with self._lock:
                         if self._stopped:
                             return
+                    if ev.get("type") == "ERROR":
+                        # a Status object, not Endpoints (expired watch,
+                        # internal error). The kubernetes library raises
+                        # here too: yielding it would push an EMPTY peer
+                        # list and un-own every key until the next real
+                        # event. Raising routes into the pool's
+                        # retry/relist path instead.
+                        raise RuntimeError(
+                            "k8s watch ERROR event: "
+                            f"{ev.get('object', {})!r}"
+                        )
                     yield {
                         "type": ev.get("type", ""),
                         "object": _Endpoints(ev.get("object", {})),

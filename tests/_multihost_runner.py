@@ -140,6 +140,21 @@ def main():
     )
     assert (r5 == 7).all() and (l5 == 9).all(), (l5, r5)
 
+    # pipelined split across hosts (r4): TWO submits in flight before
+    # either wait — followers dispatch-and-move-on, the leader fetches
+    # later; both batches' collectives and store threading must line up
+    kh2 = kh * np.uint64(3) | np.uint64(1)
+    h1 = eng.decide_submit(
+        kh2, ones, ones * 2, dur, algo, gnp, T0 + 7
+    )
+    h2 = eng.decide_submit(
+        kh2, ones, ones * 2, dur, algo, gnp, T0 + 8
+    )
+    s6, _, r6, _ = eng.decide_wait(h1)
+    s7, _, r7, _ = eng.decide_wait(h2)
+    assert (s6 == 0).all() and (r6 == 1).all(), (s6, r6)
+    assert (s7 == 0).all() and (r7 == 0).all(), (s7, r7)
+
     eng.close()
     print("LEADER-OK", flush=True)
 

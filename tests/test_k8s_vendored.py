@@ -266,3 +266,43 @@ def test_pool_full_cycle_against_fake(api, fake):
             await pool.close()
 
     asyncio.run(scenario())
+
+
+def test_watch_error_event_raises_instead_of_wiping_peers(api, fake):
+    """An ERROR watch event carries a Status object, not Endpoints;
+    yielding it would push an EMPTY peer list through the pool. The
+    client must raise (the kubernetes library's behavior) so the pool's
+    retry path re-lists instead."""
+    fake._subsets = ["10.0.0.1"]
+    w = VendoredK8sWatch()
+    stream = w.stream(api.list_namespaced_endpoints, "default")
+    first = next(stream)  # synthesized ADDED
+    assert first["type"] == "ADDED"
+    with fake._lock:
+        err = json.dumps(
+            {"type": "ERROR",
+             "object": {"kind": "Status", "message": "too old"}}
+        ).encode() + b"\n"
+        for ws in fake._watchers:
+            ws.sendall(_chunk(err))
+    with pytest.raises(RuntimeError, match="ERROR event"):
+        next(stream)
+    w.stop()
+
+
+def test_token_reread_per_request(fake, tmp_path):
+    """In-cluster tokens rotate (~1h): the client must send the CURRENT
+    file contents, not the boot-time value."""
+    tok = tmp_path / "token"
+    tok.write_text("first-token")
+    fake.token = "first-token"
+    api = VendoredK8sApi(
+        base_url=f"http://127.0.0.1:{fake.port}", token="ignored"
+    )
+    api._token_path = str(tok)  # the in-cluster constructor sets this
+    fake._subsets = ["10.0.0.1"]
+    assert api.list_namespaced_endpoints("default").items
+    # rotate: both the file and the server's expectation change
+    tok.write_text("second-token")
+    fake.token = "second-token"
+    assert api.list_namespaced_endpoints("default").items
