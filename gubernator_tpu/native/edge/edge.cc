@@ -21,6 +21,7 @@
 #include <arpa/inet.h>
 #include <csignal>
 #include <netinet/in.h>
+#include <poll.h>
 #include <netinet/tcp.h>
 #include <sys/socket.h>
 #include <sys/un.h>
@@ -28,6 +29,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cerrno>
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
@@ -506,6 +508,7 @@ class Batcher {
   static bool send_all(int fd, const char* p, size_t n) {
     while (n) {
       ssize_t w = write(fd, p, n);
+      if (w < 0 && errno == EINTR) continue;  // signal mid-roundtrip
       if (w <= 0) return false;
       p += w;
       n -= (size_t)w;
@@ -515,6 +518,7 @@ class Batcher {
   static bool recv_all(int fd, char* p, size_t n) {
     while (n) {
       ssize_t r = read(fd, p, n);
+      if (r < 0 && errno == EINTR) continue;
       if (r <= 0) return false;
       p += r;
       n -= (size_t)r;
@@ -745,6 +749,25 @@ std::atomic<int> g_conns{0};
 int g_max_conns = 4096;
 int g_recv_timeout_s = 60;
 
+// SIGTERM/SIGINT: stop accepting, let in-flight requests drain (bounded),
+// exit 0 — the same graceful contract as the daemon (reference
+// cmd/gubernator/main.go:127-139 drains on SIGINT). The handler writes
+// one byte into a self-pipe the accept loops poll() on: process-directed
+// signals may be delivered to ANY thread, so waking a specific blocked
+// accept() via EINTR is not reliable (and stripping SA_RESTART would
+// instead abort in-flight reads everywhere else).
+std::atomic<bool> g_shutdown{false};
+int g_wake_pipe[2] = {-1, -1};
+
+void on_term(int) {
+  g_shutdown.store(true);
+  if (g_wake_pipe[1] >= 0) {
+    char b = 1;
+    // async-signal-safe; a full pipe just means a wakeup is already queued
+    (void)!write(g_wake_pipe[1], &b, 1);
+  }
+}
+
 struct ConnGuard {
   ~ConnGuard() { g_conns.fetch_sub(1, std::memory_order_relaxed); }
 };
@@ -892,6 +915,18 @@ int main(int argc, char** argv) {
   // (EPIPE), not SIGPIPE-kill the whole edge — e.g. the GOAWAY sent
   // while tearing down an h2 connection the peer already closed
   signal(SIGPIPE, SIG_IGN);
+  if (pipe(g_wake_pipe) != 0) {
+    perror("pipe");
+    return 1;
+  }
+  // SA_RESTART kept: in-flight reads/writes on connection and batcher
+  // threads must not be aborted by the shutdown signal; the self-pipe
+  // wakes the accept loops regardless of which thread took delivery
+  struct sigaction sa{};
+  sa.sa_handler = on_term;
+  sa.sa_flags = SA_RESTART;
+  sigaction(SIGTERM, &sa, nullptr);
+  sigaction(SIGINT, &sa, nullptr);
   int port = 8080;
   int grpc_port = 0;
   std::string backend = "/tmp/guber-edge.sock";
@@ -982,7 +1017,12 @@ int main(int argc, char** argv) {
   fflush(stderr);
 
   auto accept_loop = [&one](int lsrv, Batcher* b, bool grpc) {
-    while (true) {
+    pollfd pfds[2] = {{lsrv, POLLIN, 0}, {g_wake_pipe[0], POLLIN, 0}};
+    while (!g_shutdown.load()) {
+      pfds[0].revents = pfds[1].revents = 0;
+      if (poll(pfds, 2, -1) < 0) continue;  // EINTR etc: re-check flag
+      if (g_shutdown.load() || (pfds[1].revents & POLLIN)) break;
+      if (!(pfds[0].revents & POLLIN)) continue;
       int fd = accept(lsrv, nullptr, nullptr);
       if (fd < 0) continue;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
@@ -1013,4 +1053,26 @@ int main(int argc, char** argv) {
     std::thread(accept_loop, grpc_srv, &batcher, true).detach();
   }
   accept_loop(srv, &batcher, false);
+
+  // graceful drain: stop taking connections, give in-flight requests a
+  // bounded window to finish, then exit 0. Connection threads are
+  // detached; g_conns counts the live ones.
+  close(srv);
+  if (grpc_srv >= 0) close(grpc_srv);
+  fprintf(stderr, "guber-edge: shutdown signal; draining %d conns\n",
+          g_conns.load());
+  fflush(stderr);
+  const auto deadline =
+      std::chrono::steady_clock::now() + std::chrono::seconds(10);
+  while (g_conns.load() > 0 &&
+         std::chrono::steady_clock::now() < deadline) {
+    std::this_thread::sleep_for(std::chrono::milliseconds(20));
+  }
+  fprintf(stderr, "guber-edge: exiting (%d conns remained)\n",
+          g_conns.load());
+  fflush(nullptr);
+  // _exit: the Batcher's worker threads are parked in their queue wait
+  // and its destructor would std::terminate on the joinable handles;
+  // after the drain there is nothing left worth running destructors for
+  _exit(0);
 }

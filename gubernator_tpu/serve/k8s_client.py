@@ -72,6 +72,7 @@ class VendoredK8sApi:
         serviceaccount token/CA. Tests inject base_url (plain http)."""
         import os
 
+        self._token_path: Optional[str] = None
         if base_url is None:
             host = os.environ.get("KUBERNETES_SERVICE_HOST")
             port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
@@ -88,8 +89,6 @@ class VendoredK8sApi:
                 self._token_path = f"{_SA_DIR}/token"
             if ca_cert is None:
                 ca_cert = f"{_SA_DIR}/ca.crt"
-        if not hasattr(self, "_token_path"):
-            self._token_path = None
         self.token = token
         self.timeout = timeout
         u = urllib.parse.urlparse(base_url.rstrip("/"))

@@ -133,3 +133,41 @@ def test_edge_health_and_errors(edge_stack):
                        "limit": 1, "duration": 1000}]},
     )
     assert out["responses"][0]["error"] != ""
+
+
+def test_edge_sigterm_graceful(edge_stack):
+    """SIGTERM must drain and exit 0 — the daemon's graceful contract
+    extends to the edge (reference main.go:127-139 drains on SIGINT)."""
+    import signal as _signal
+    import subprocess as _sp
+
+    proc = _sp.Popen(
+        [str(EDGE_BIN), "--listen", "19187", "--backend", SOCK],
+        stdout=_sp.PIPE, stderr=_sp.STDOUT, text=True,
+    )
+    try:
+        deadline = time.monotonic() + 10
+        import socket as _socket
+
+        while time.monotonic() < deadline:
+            try:
+                _socket.create_connection(
+                    ("127.0.0.1", 19187), timeout=1
+                ).close()
+                break
+            except OSError:
+                time.sleep(0.05)
+        # it serves...
+        out = _post(19187, {"requests": [{"name": "g", "uniqueKey": "s",
+                                         "hits": 1, "limit": 3,
+                                         "duration": 60000}]})
+        assert out["responses"][0]["status"] == "UNDER_LIMIT"
+        # ...and drains cleanly on SIGTERM
+        proc.send_signal(_signal.SIGTERM)
+        assert proc.wait(timeout=15) == 0
+        assert "draining" in proc.stdout.read()
+    finally:
+        # a failure above must not leak an edge bound to the port
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
