@@ -3,7 +3,7 @@ mesh? (r2 verdict item 1.)
 
 SUPERSEDED for the headline number (r4): the served rate is now
 MEASURED end-to-end on the real chip — BENCH_SERVING_DEVICE_r4.json
-(83k dec/s through the gRPC wire on this tunnel-attached box; see
+(83-85k dec/s through the gRPC wire on this tunnel-attached box; see
 README "Device-backed serving"). This script remains as the co-located
 projection model and the prep-path comparison harness.
 

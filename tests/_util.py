@@ -13,3 +13,73 @@ def free_ports(n):
     finally:
         for s in socks:
             s.close()
+
+
+def spawn_daemon_edge(
+    env_overrides: dict,
+    sock_path: str,
+    edge_http: int,
+    edge_grpc: int = 0,
+    daemon_boot_timeout: float = 180.0,
+):
+    """Spawn a daemon (edge socket enabled) plus a guber-edge fronting
+    it, with HARD readiness checks: a dead or never-listening process
+    fails with its captured output instead of leaking into the tests as
+    opaque connection-refused noise. Returns (daemon, edge) Popens; the
+    caller owns teardown (edge.kill(); daemon.terminate()).
+
+    Shared across the daemon+edge e2e suites so spawn/teardown fixes
+    land once (r4 review: three divergent copies had already drifted).
+    """
+    import os
+    import pathlib
+    import subprocess
+    import sys
+    import time
+
+    import pytest
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    edge_bin = root / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+    try:
+        os.unlink(sock_path)
+    except FileNotFoundError:
+        pass
+    env = dict(os.environ, PYTHONPATH=str(root), **env_overrides)
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        cwd=root, env=env,
+    )
+    deadline = time.monotonic() + daemon_boot_timeout
+    while time.monotonic() < deadline and not os.path.exists(sock_path):
+        time.sleep(0.2)
+        if daemon.poll() is not None:
+            pytest.fail(f"daemon died:\n{daemon.stdout.read()}")
+    if not os.path.exists(sock_path):
+        daemon.kill()
+        pytest.fail("daemon never created the edge socket")
+
+    args = [str(edge_bin), "--listen", str(edge_http),
+            "--backend", sock_path]
+    if edge_grpc:
+        args += ["--grpc-listen", str(edge_grpc)]
+    edge = subprocess.Popen(
+        args, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    probe_port = edge_grpc or edge_http
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if edge.poll() is not None:
+            daemon.kill()
+            pytest.fail(f"edge died:\n{edge.stdout.read()}")
+        try:
+            socket.create_connection(
+                ("127.0.0.1", probe_port), timeout=1
+            ).close()
+            return daemon, edge
+        except OSError:
+            time.sleep(0.05)
+    edge.kill()
+    daemon.kill()
+    pytest.fail("edge never started listening")

@@ -1,0 +1,169 @@
+"""One bucket, four doors, two decide paths — every hit accounted for.
+
+The r4 serving stack answers the same store through four front doors:
+the daemon's own gRPC and HTTP listeners (request-object path through
+the instance) and the edge's gRPC and HTTP terminators (pre-hashed GEB4
+array path when eligible). A hash-parity or routing bug between any two
+of them silently splits one logical bucket into several. This test
+hammers ONE key through all four doors concurrently and asserts exact
+conservation: remaining == limit - total_successful_hits, with OVER
+refusals consuming nothing (the reference's token semantics,
+algorithms.go:57-62) — across paths, protocols, and the co-batching of
+all of it into shared device batches.
+
+Runs the tpu backend on CPU like the other daemon e2e suites.
+"""
+
+import json
+import pathlib
+import threading
+import urllib.request
+
+import grpc
+import pytest
+
+from gubernator_tpu.api.grpc_glue import V1Stub
+from gubernator_tpu.api.proto.gen import gubernator_pb2
+from tests._util import spawn_daemon_edge
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+
+pytestmark = pytest.mark.skipif(
+    not EDGE_BIN.exists(),
+    reason="edge binary not built (make -C gubernator_tpu/native/edge)",
+)
+
+DAEMON_GRPC = 19694
+DAEMON_HTTP = 19695
+EDGE_HTTP = 19696
+EDGE_GRPC = 19697
+SOCK = "/tmp/guber-coherence-pytest.sock"
+
+LIMIT = 100_000
+N_PER_DOOR = 120  # 4 doors x 120 hits, far under limit: all must admit
+
+# one persistent stub per gRPC door (channels reused across calls)
+_STUBS = {}
+
+
+@pytest.fixture(scope="module")
+def stack():
+    daemon, edge = spawn_daemon_edge(
+        dict(
+            GUBER_BACKEND="tpu",
+            GUBER_JAX_PLATFORM="cpu",
+            GUBER_STORE_SLOTS=str(1 << 10),
+            GUBER_GRPC_ADDRESS=f"127.0.0.1:{DAEMON_GRPC}",
+            GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
+            GUBER_EDGE_SOCKET=SOCK,
+            GUBER_FETCH_DEPTH="4",
+            JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
+        ),
+        SOCK,
+        edge_http=EDGE_HTTP,
+        edge_grpc=EDGE_GRPC,
+    )
+    yield
+    edge.kill()
+    daemon.terminate()
+    daemon.wait(timeout=10)
+    _STUBS.clear()
+
+
+def _call_door(kind, port, key, hits, limit=LIMIT):
+    """(status, remaining) for one request through the given door."""
+    if kind == "grpc":
+        stub = _STUBS.get(port)
+        if stub is None:
+            stub = _STUBS.setdefault(
+                port, V1Stub(grpc.insecure_channel(f"127.0.0.1:{port}"))
+            )
+        r = stub.GetRateLimits(
+            gubernator_pb2.GetRateLimitsReq(
+                requests=[
+                    gubernator_pb2.RateLimitReq(
+                        name="coh", unique_key=key, hits=hits,
+                        limit=limit, duration=600_000,
+                    )
+                ]
+            ),
+            timeout=30,
+        ).responses[0]
+        return int(r.status), int(r.remaining)
+    body = json.dumps(
+        {"requests": [{"name": "coh", "uniqueKey": key, "hits": hits,
+                       "limit": limit, "duration": 600000}]}
+    ).encode()
+    out = json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/GetRateLimits",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=30,
+        ).read()
+    )["responses"][0]
+    return (1 if out["status"] == "OVER_LIMIT" else 0,
+            int(out["remaining"]))
+
+
+ALL_DOORS = [
+    ("grpc", DAEMON_GRPC),
+    ("http", DAEMON_HTTP),
+    ("grpc", EDGE_GRPC),
+    ("http", EDGE_HTTP),
+]
+
+
+def test_one_bucket_four_doors_exact_conservation(stack):
+    key = "conserved"
+    errors = []
+    over_counts = [0] * len(ALL_DOORS)
+
+    def run(i, kind, port):
+        try:
+            for _ in range(N_PER_DOOR):
+                status, _rem = _call_door(kind, port, key, 1)
+                if status:
+                    over_counts[i] += 1
+        except Exception as e:  # pragma: no cover - diagnostic
+            errors.append((kind, port, repr(e)))
+
+    threads = [
+        threading.Thread(target=run, args=(i, k, p))
+        for i, (k, p) in enumerate(ALL_DOORS)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    # far under limit: nothing may have been refused
+    assert sum(over_counts) == 0, over_counts
+
+    # a zero-hit peek through each door must agree on the exact count
+    expected = LIMIT - len(ALL_DOORS) * N_PER_DOOR
+    for kind, port in ALL_DOORS:
+        status, remaining = _call_door(kind, port, key, 0)
+        assert remaining == expected, (
+            kind, port, remaining, expected,
+            "hits leaked or split across doors/paths",
+        )
+        assert status == 0
+
+
+def test_over_limit_consumes_nothing_across_doors(stack):
+    """Exhaust a tiny bucket through the edge, then hammer it OVER from
+    every door: remaining must stay exactly 0 (refusals don't consume,
+    reference algorithms.go:57-62 — a cross-path regression would show
+    as drift)."""
+    key = "exhausted"
+    status, remaining = _call_door("grpc", EDGE_GRPC, key, 5, limit=5)
+    assert status == 0 and remaining == 0
+
+    for kind, port in ALL_DOORS:
+        for _ in range(3):
+            status, remaining = _call_door(kind, port, key, 1, limit=5)
+            assert status == 1 and remaining == 0, (kind, port, remaining)
