@@ -17,11 +17,7 @@ CPU (GUBER_JAX_PLATFORM=cpu) like the other daemon e2e tests.
 """
 
 import json
-import os
 import pathlib
-import subprocess
-import sys
-import time
 import urllib.request
 
 import grpc
@@ -47,45 +43,22 @@ SOCK = "/tmp/guber-edge-fast-pytest.sock"
 
 @pytest.fixture(scope="module")
 def stack():
-    try:
-        os.unlink(SOCK)
-    except FileNotFoundError:
-        pass
-    env = dict(
-        os.environ,
-        GUBER_BACKEND="tpu",
-        GUBER_JAX_PLATFORM="cpu",
-        GUBER_STORE_SLOTS=str(1 << 10),
-        GUBER_GRPC_ADDRESS=f"127.0.0.1:{DAEMON_GRPC}",
-        GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
-        GUBER_EDGE_SOCKET=SOCK,
-        PYTHONPATH=str(ROOT),
-        JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
-    )
-    daemon = subprocess.Popen(
-        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=ROOT, env=env,
-    )
-    deadline = time.monotonic() + 180  # tpu-backend warmup compiles
-    while time.monotonic() < deadline and not pathlib.Path(SOCK).exists():
-        time.sleep(0.2)
-        if daemon.poll() is not None:
-            pytest.fail(f"daemon died:\n{daemon.stdout.read()}")
-    edge = subprocess.Popen(
-        [str(EDGE_BIN), "--listen", str(EDGE_HTTP), "--grpc-listen",
-         str(EDGE_GRPC), "--backend", SOCK],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    import socket as _s
+    from tests._util import spawn_daemon_edge
 
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        try:
-            _s.create_connection(("127.0.0.1", EDGE_GRPC), timeout=1).close()
-            break
-        except OSError:
-            time.sleep(0.05)
+    daemon, edge = spawn_daemon_edge(
+        dict(
+            GUBER_BACKEND="tpu",
+            GUBER_JAX_PLATFORM="cpu",
+            GUBER_STORE_SLOTS=str(1 << 10),
+            GUBER_GRPC_ADDRESS=f"127.0.0.1:{DAEMON_GRPC}",
+            GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
+            GUBER_EDGE_SOCKET=SOCK,
+            JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
+        ),
+        SOCK,
+        edge_http=EDGE_HTTP,
+        edge_grpc=EDGE_GRPC,
+    )
     yield
     edge.kill()
     daemon.terminate()

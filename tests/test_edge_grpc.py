@@ -13,9 +13,6 @@ Skipped when the edge binary is not built.
 
 import json
 import pathlib
-import subprocess
-import sys
-import time
 import urllib.request
 
 import grpc
@@ -41,44 +38,19 @@ SOCK = "/tmp/guber-edge-grpc-pytest.sock"
 
 @pytest.fixture(scope="module")
 def edge_stack():
-    import os
+    from tests._util import spawn_daemon_edge
 
-    try:
-        os.unlink(SOCK)
-    except FileNotFoundError:
-        pass
-    env = dict(
-        os.environ,
-        GUBER_BACKEND="exact",
-        GUBER_GRPC_ADDRESS=f"127.0.0.1:{GRPC}",
-        GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
-        GUBER_EDGE_SOCKET=SOCK,
-        PYTHONPATH=str(ROOT),
+    daemon, edge = spawn_daemon_edge(
+        dict(
+            GUBER_BACKEND="exact",
+            GUBER_GRPC_ADDRESS=f"127.0.0.1:{GRPC}",
+            GUBER_HTTP_ADDRESS=f"127.0.0.1:{DAEMON_HTTP}",
+            GUBER_EDGE_SOCKET=SOCK,
+        ),
+        SOCK,
+        edge_http=EDGE_HTTP,
+        edge_grpc=EDGE_GRPC,
     )
-    daemon = subprocess.Popen(
-        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        cwd=ROOT, env=env,
-    )
-    deadline = time.monotonic() + 60
-    while time.monotonic() < deadline and not pathlib.Path(SOCK).exists():
-        time.sleep(0.2)
-        if daemon.poll() is not None:
-            pytest.fail(f"daemon died:\n{daemon.stdout.read()}")
-    edge = subprocess.Popen(
-        [str(EDGE_BIN), "--listen", str(EDGE_HTTP), "--grpc-listen",
-         str(EDGE_GRPC), "--backend", SOCK],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-    )
-    import socket as _s
-
-    deadline = time.monotonic() + 10
-    while time.monotonic() < deadline:
-        try:
-            _s.create_connection(("127.0.0.1", EDGE_GRPC), timeout=1).close()
-            break
-        except OSError:
-            time.sleep(0.05)
     yield
     edge.kill()
     daemon.terminate()
