@@ -20,6 +20,8 @@
 
 #include <arpa/inet.h>
 #include <csignal>
+#include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <poll.h>
 #include <netinet/tcp.h>
@@ -37,6 +39,9 @@
 #include <cstdlib>
 #include <cstring>
 #include <deque>
+#include <functional>
+#include <map>
+#include <memory>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -49,9 +54,10 @@ namespace {
 
 constexpr uint32_t kMagicReq = 0x31424547;       // 'GEB1'
 constexpr uint32_t kMagicResp = 0x33424547;      // 'GEB3'
-constexpr uint32_t kMagicHello = 0x48424547;     // 'GEBH' (r4)
-constexpr uint32_t kMagicFastReq = 0x34424547;   // 'GEB4' pre-hashed
+constexpr uint32_t kMagicHello = 0x49424547;     // 'GEBI' ring hello (r5)
+constexpr uint32_t kMagicFastReq = 0x36424547;   // 'GEB6' pre-hashed (r5)
 constexpr uint32_t kMagicFastResp = 0x35424547;  // 'GEB5'
+constexpr uint32_t kMagicStale = 0x52424547;     // 'GEBR' stale ring
 
 struct Item {
   std::string name;
@@ -140,6 +146,84 @@ uint64_t slot_hash(const std::string& name, const std::string& key) {
   joined += key;
   return xxh64((const uint8_t*)joined.data(), joined.size(), kSlotHashSeed);
 }
+
+// ------------------------------------------------------------------ crc32
+// CRC-32 (IEEE 802.3, the zlib/Go crc32.ChecksumIEEE polynomial),
+// table-driven, written from the spec. MUST match zlib.crc32: the ring
+// places a key on the node whose point (crc32 of its gRPC address)
+// succeeds crc32(name+"_"+key) — bit parity with the daemon's
+// core/hashing.ring_hash (reference hash.go:40-42) is what makes the
+// edge's routing agree with every daemon's picker (pinned e2e by
+// tests/test_edge_cluster.py placement assertions).
+
+struct Crc32Table {
+  uint32_t t[256];
+  Crc32Table() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : (c >> 1);
+      t[i] = c;
+    }
+  }
+};
+
+uint32_t crc32_ieee(const uint8_t* p, size_t n) {
+  static const Crc32Table tbl;
+  uint32_t c = 0xFFFFFFFFu;
+  for (size_t i = 0; i < n; ++i) c = tbl.t[(c ^ p[i]) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+uint32_t crc32_str(const std::string& s) {
+  return crc32_ieee((const uint8_t*)s.data(), s.size());
+}
+
+// ------------------------------------------------------------------- ring
+// Consistent-hash view of the cluster, read from the bridge hello
+// (serve/edge_bridge.py `_hello`). Placement-compatible with the
+// daemon's picker (serve/peers.py ConsistentHashPicker / reference
+// hash.go:80-96): one crc32 point per node, sorted, successor with
+// wraparound.
+
+struct Node {
+  std::string grpc;    // the node's gRPC address (ring point + owner
+                       // metadata string)
+  std::string bridge;  // "host:port" of its edge bridge; empty = reach
+                       // it through the slow path (string frames to the
+                       // primary, which forwards over gRPC)
+  bool self = false;   // the node behind our --backend endpoint
+};
+
+struct Ring {
+  uint32_t hash = 0;  // membership fingerprint; echoed in fast frames
+  bool fast = false;  // bridge advertises the pre-hashed path
+  std::vector<Node> nodes;
+  std::vector<std::pair<uint32_t, int>> points;  // sorted (point, node)
+
+  void index() {
+    points.clear();
+    for (size_t i = 0; i < nodes.size(); ++i)
+      points.emplace_back(crc32_str(nodes[i].grpc), (int)i);
+    std::sort(points.begin(), points.end());
+  }
+
+  // node index owning `name_key`, or -1 on an empty ring
+  int owner(const std::string& name, const std::string& key) const {
+    if (points.empty()) return -1;
+    std::string joined;
+    joined.reserve(name.size() + 1 + key.size());
+    joined += name;
+    joined += '_';
+    joined += key;
+    uint32_t point = crc32_str(joined);
+    auto it = std::lower_bound(
+        points.begin(), points.end(),
+        std::make_pair(point, INT32_MIN));
+    if (it == points.end()) it = points.begin();
+    return it->second;
+  }
+};
 
 struct Decision {
   uint8_t status = 0;
@@ -418,122 +502,334 @@ std::string render_responses(const Decision* d, size_t n) {
   return out;
 }
 
-// ---------------------------------------------------------------- batcher
+// SIGTERM/SIGINT: stop accepting, let in-flight requests drain (bounded),
+// exit 0 — the same graceful contract as the daemon (reference
+// cmd/gubernator/main.go:127-139 drains on SIGINT). The handler writes
+// one byte into a self-pipe the accept loops poll() on: process-directed
+// signals may be delivered to ANY thread, so waking a specific blocked
+// accept() via EINTR is not reliable (and stripping SA_RESTART would
+// instead abort in-flight reads everywhere else).
+std::atomic<bool> g_shutdown{false};
+int g_wake_pipe[2] = {-1, -1};
+
+void on_term(int) {
+  g_shutdown.store(true);
+  if (g_wake_pipe[1] >= 0) {
+    char b = 1;
+    // async-signal-safe; a full pipe just means a wakeup is already queued
+    (void)!write(g_wake_pipe[1], &b, 1);
+  }
+}
+
+// ----------------------------------------------------------- lanes/router
+// r5 cluster shape: one request (Pending) splits into SHARDS — one
+// per-owner pre-hashed (GEB6) shard per cluster node, plus one string
+// (GEB1) shard for items that need the serving instance's full
+// semantics (GLOBAL, validation errors, nodes without a reachable
+// bridge). Each shard rides a Lane: a batching connection pool to one
+// bridge endpoint (the local unix socket, or a peer's TCP bridge).
+// This is the reference's every-compiled-node-routes shape
+// (gubernator.go:114, hash.go:80-96) applied to the edge tier.
 
 struct Pending {
   std::vector<Item> items;
-  std::vector<Decision> decisions;
-  bool fast = false;  // all items GEB4-eligible (set by the handler)
-  bool done = false;
-  bool failed = false;
+  std::vector<Decision> decisions;  // sized by Router::execute
+  int shards_left = 0;
   std::mutex m;
   std::condition_variable cv;
 };
 
-class Batcher {
- public:
-  // `workers` backend connections pull batches from one shared queue, so
-  // batch N+1 is in flight while N awaits its response (the daemon's
-  // asyncio loop serves each unix connection independently). Ordering
-  // across concurrent batches is no more defined than the reference's
-  // concurrent goroutines — per-connection HTTP pipelining stays FIFO.
-  Batcher(std::string backend_path, int batch_wait_us, int batch_limit,
-          int workers)
-      : path_(std::move(backend_path)),
-        wait_us_(batch_wait_us),
-        limit_(batch_limit) {
-    for (int i = 0; i < workers; ++i)
-      threads_.emplace_back([this] { run(); });
-    // block until every worker attempted its eager connect, so a
-    // readiness probe hitting HealthCheck right after the listen port
-    // opens sees the true backend state
-    while (started_.load() < workers)
-      std::this_thread::sleep_for(std::chrono::milliseconds(1));
-  }
+struct Shard {
+  Pending* parent = nullptr;
+  std::vector<uint32_t> idx;  // positions in parent->items
+  bool fast = false;          // GEB6 vs GEB1 framing
+  uint32_t ring_hash = 0;     // membership view this shard was routed
+                              // with (echoed in GEB6 frames)
+  std::string owner;          // non-self owner's gRPC addr: stamped as
+                              // metadata.owner on success (parity with
+                              // instance-side forwards, instance.py)
+  bool failed = false;
+  bool stale = false;         // failed because the bridge refused the
+                              // ring view (GEBR)
+};
 
-  // enqueue and block until the batch round-trips. Fast (pre-hashed)
-  // and slow (string) pendings ride separate queues: a backend frame is
-  // all-GEB4 or all-GEB1, so one worker round-trip stays one frame.
-  bool submit(Pending* p) {
-    {
-      std::lock_guard<std::mutex> lk(m_);
-      (p->fast ? fast_queue_ : queue_).push_back(p);
-      queued_items_ += p->items.size();
+enum class RtStatus { kOk, kFail, kStale };
+
+// Mark a shard finished. Decision/field writes above happen-before the
+// parent's wakeup via p->m. Notify while holding p->m: the waiter may
+// destroy the stack Pending the instant shards_left hits zero.
+void finish_shard(Shard* s, RtStatus st) {
+  if (st != RtStatus::kOk) {
+    s->failed = true;
+    s->stale = (st == RtStatus::kStale);
+  }
+  Pending* p = s->parent;
+  std::lock_guard<std::mutex> lk(p->m);
+  if (--p->shards_left == 0) p->cv.notify_one();
+}
+
+// Bridge endpoint: a unix path (the co-located daemon) or host:port (a
+// peer's TCP bridge listener).
+struct Endpoint {
+  bool is_unix = true;
+  std::string path;  // unix path, or host
+  uint16_t port = 0;
+  std::string spec;  // the original string (lane registry key)
+};
+
+Endpoint parse_endpoint(const std::string& s) {
+  Endpoint ep;
+  ep.spec = s;
+  size_t colon = s.rfind(':');
+  if (colon != std::string::npos && colon + 1 < s.size()) {
+    bool digits = true;
+    for (size_t i = colon + 1; i < s.size(); ++i)
+      if (s[i] < '0' || s[i] > '9') digits = false;
+    if (digits) {
+      ep.is_unix = false;
+      ep.path = s.substr(0, colon);
+      ep.port = (uint16_t)atoi(s.c_str() + colon + 1);
+      return ep;
     }
-    cv_.notify_one();
-    std::unique_lock<std::mutex> lk(p->m);
-    p->cv.wait(lk, [p] { return p->done; });
-    return !p->failed;
   }
+  ep.path = s;
+  return ep;
+}
 
-  bool backend_ok() const { return connected_.load() > 0; }
-  // GEB4 usable: the bridge's hello advertised it on every connection
-  bool fast_ok() const { return fast_ok_.load(); }
-
- private:
-  int connect_backend() {
-    int fd = socket(AF_UNIX, SOCK_STREAM, 0);
+// Connect with a bounded handshake: TCP connects are non-blocking with
+// a 5s poll (a peer that fell off the network must cost one failed
+// shard, not a 2-minute SYN timeout holding client requests hostage).
+int connect_endpoint(const Endpoint& ep) {
+  int fd;
+  if (ep.is_unix) {
+    fd = socket(AF_UNIX, SOCK_STREAM, 0);
     if (fd < 0) return -1;
     sockaddr_un addr{};
     addr.sun_family = AF_UNIX;
-    snprintf(addr.sun_path, sizeof addr.sun_path, "%s", path_.c_str());
+    snprintf(addr.sun_path, sizeof addr.sun_path, "%s", ep.path.c_str());
     if (connect(fd, (sockaddr*)&addr, sizeof addr) != 0) {
       close(fd);
       return -1;
     }
-    // capability hello: 'GEBH' + u32 flags (bit 0 = GEB4 fast path).
-    // Bounded read so a wedged bridge can't hang the worker forever.
+    return fd;
+  }
+  addrinfo hints{};
+  hints.ai_family = AF_UNSPEC;
+  hints.ai_socktype = SOCK_STREAM;
+  addrinfo* res = nullptr;
+  char portbuf[8];
+  snprintf(portbuf, sizeof portbuf, "%u", (unsigned)ep.port);
+  if (getaddrinfo(ep.path.c_str(), portbuf, &hints, &res) != 0 || !res)
+    return -1;
+  fd = socket(res->ai_family, SOCK_STREAM | SOCK_NONBLOCK, 0);
+  if (fd < 0) {
+    freeaddrinfo(res);
+    return -1;
+  }
+  int rc = connect(fd, res->ai_addr, (socklen_t)res->ai_addrlen);
+  freeaddrinfo(res);
+  if (rc != 0 && errno == EINPROGRESS) {
+    pollfd pfd{fd, POLLOUT, 0};
+    if (poll(&pfd, 1, 5000) <= 0) rc = -1;
+    else {
+      int err = 0;
+      socklen_t elen = sizeof err;
+      getsockopt(fd, SOL_SOCKET, SO_ERROR, &err, &elen);
+      rc = err == 0 ? 0 : -1;
+    }
+  } else if (rc != 0) {
+    rc = -1;
+  }
+  if (rc != 0) {
+    close(fd);
+    return -1;
+  }
+  int fl = fcntl(fd, F_GETFL);
+  fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  return fd;
+}
+
+bool send_all(int fd, const char* p, size_t n) {
+  while (n) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0 && errno == EINTR) continue;  // signal mid-roundtrip
+    if (w <= 0) return false;
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+bool recv_all(int fd, char* p, size_t n) {
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0 && errno == EINTR) continue;
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+// Ring-carrying hello ('GEBI', serve/edge_bridge.py `_hello`). The fd
+// must already have a receive deadline set; on success the deadline is
+// the caller's to clear.
+bool read_hello(int fd, Ring* out) {
+  char hdr[16];
+  if (!recv_all(fd, hdr, 16)) return false;
+  uint32_t magic, flags, rhash, n_nodes;
+  memcpy(&magic, hdr, 4);
+  memcpy(&flags, hdr + 4, 4);
+  memcpy(&rhash, hdr + 8, 4);
+  memcpy(&n_nodes, hdr + 12, 4);
+  if (magic != kMagicHello || n_nodes > 65536) return false;
+  out->fast = (flags & 1) != 0;
+  out->hash = rhash;
+  out->nodes.clear();
+  for (uint32_t i = 0; i < n_nodes; ++i) {
+    char fix[3];
+    if (!recv_all(fd, fix, 3)) return false;
+    Node nd;
+    nd.self = fix[0] != 0;
+    uint16_t glen;
+    memcpy(&glen, fix + 1, 2);
+    nd.grpc.resize(glen);
+    if (glen && !recv_all(fd, nd.grpc.data(), glen)) return false;
+    uint16_t blen;
+    if (!recv_all(fd, (char*)&blen, 2)) return false;
+    nd.bridge.resize(blen);
+    if (blen && !recv_all(fd, nd.bridge.data(), blen)) return false;
+    out->nodes.push_back(std::move(nd));
+  }
+  out->index();
+  return true;
+}
+
+class Lane {
+ public:
+  // `workers` connections to ONE bridge endpoint pull batches from a
+  // shared queue, so batch N+1 is in flight while N awaits its
+  // response. Ordering across concurrent batches is no more defined
+  // than the reference's concurrent goroutines — per-connection HTTP
+  // pipelining stays FIFO.
+  //
+  // Lifetime: created through create() only. Worker threads are
+  // detached and co-own the Lane via shared_ptr, so an evicted lane
+  // (membership churn dropped its endpoint) is freed when its last
+  // worker observes `stopping_` and exits — nobody ever joins a
+  // thread that may be blocked on a wedged peer.
+  using HelloFn = std::function<void(const Ring&)>;
+
+  static std::shared_ptr<Lane> create(Endpoint ep, int batch_wait_us,
+                                      int batch_limit, int workers,
+                                      HelloFn on_hello,
+                                      bool wait_connect) {
+    std::shared_ptr<Lane> lane(new Lane(std::move(ep), batch_wait_us,
+                                        batch_limit,
+                                        std::move(on_hello)));
+    for (int i = 0; i < workers; ++i)
+      std::thread([lane] { lane->run(); }).detach();
+    // primary lane: block until every worker attempted its eager
+    // connect, so a readiness probe hitting HealthCheck right after
+    // the listen port opens sees the true backend state. Peer lanes
+    // skip the wait — a request must not stall on a peer's SYN.
+    if (wait_connect)
+      while (lane->started_.load() < workers)
+        std::this_thread::sleep_for(std::chrono::milliseconds(1));
+    return lane;
+  }
+
+  // enqueue only; completion flows through finish_shard. Fast
+  // (pre-hashed) and slow (string) shards ride separate queues: a
+  // backend frame is all-GEB6 or all-GEB1. Returns false when the
+  // lane is shutting down (the caller fails the shard).
+  bool submit(Shard* s) {
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      if (stopping_) return false;
+      (s->fast ? fast_queue_ : queue_).push_back(s);
+      queued_items_ += s->idx.size();
+    }
+    cv_.notify_one();
+    return true;
+  }
+
+  // Fail everything queued and tell the workers to exit after their
+  // in-flight round-trips. Idempotent.
+  void shutdown() {
+    std::vector<Shard*> orphans;
+    {
+      std::lock_guard<std::mutex> lk(m_);
+      if (stopping_) return;
+      stopping_ = true;
+      orphans.insert(orphans.end(), queue_.begin(), queue_.end());
+      orphans.insert(orphans.end(), fast_queue_.begin(),
+                     fast_queue_.end());
+      queue_.clear();
+      fast_queue_.clear();
+      queued_items_ = 0;
+    }
+    cv_.notify_all();
+    for (Shard* s : orphans) finish_shard(s, RtStatus::kFail);
+  }
+
+  bool backend_ok() const { return connected_.load() > 0; }
+  // last hello's fast-path capability; false until the first connect
+  bool fast_advertised() const { return fast_ok_.load(); }
+
+ private:
+  Lane(Endpoint ep, int batch_wait_us, int batch_limit,
+       HelloFn on_hello)
+      : ep_(std::move(ep)),
+        wait_us_(batch_wait_us),
+        limit_(batch_limit),
+        on_hello_(std::move(on_hello)) {}
+  int connect_backend() {
+    int fd = connect_endpoint(ep_);
+    if (fd < 0) return -1;
+    // bounded hello read so a wedged bridge can't hang the worker
     timeval tv{};
     tv.tv_sec = 5;
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
-    char hello[8];
-    if (!recv_all(fd, hello, 8)) {
+    Ring ring;
+    if (!read_hello(fd, &ring)) {
       close(fd);
       return -1;
     }
-    uint32_t magic, flags;
-    memcpy(&magic, hello, 4);
-    memcpy(&flags, hello + 4, 4);
-    if (magic != kMagicHello) {
-      close(fd);
-      return -1;
+    fast_ok_.store(ring.fast);
+    if (on_hello_) on_hello_(ring);
+    if (ep_.is_unix) {
+      // co-located daemon: no steady-state deadline (pre-r5 contract;
+      // a wedged local daemon takes the whole node down regardless)
+      tv.tv_sec = 0;
+      tv.tv_usec = 0;
+    } else {
+      // PEER round-trips stay bounded: a peer that accepts a frame and
+      // never answers (half-open connection, wedged process) must cost
+      // one failed shard — not permanently absorb this worker while
+      // Router::execute waits forever and client connections pile up
+      // to the max-conns cap. Steady-state decides are milliseconds
+      // (rungs precompile at boot), so 30s is generous.
+      tv.tv_sec = 30;
+      tv.tv_usec = 0;
+      setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
     }
-    fast_ok_.store((flags & 1) != 0);
-    tv.tv_sec = 0;  // steady-state round-trips have no read deadline
-    tv.tv_usec = 0;
     setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
     return fd;
   }
 
-  static bool send_all(int fd, const char* p, size_t n) {
-    while (n) {
-      ssize_t w = write(fd, p, n);
-      if (w < 0 && errno == EINTR) continue;  // signal mid-roundtrip
-      if (w <= 0) return false;
-      p += w;
-      n -= (size_t)w;
-    }
-    return true;
-  }
-  static bool recv_all(int fd, char* p, size_t n) {
-    while (n) {
-      ssize_t r = read(fd, p, n);
-      if (r < 0 && errno == EINTR) continue;
-      if (r <= 0) return false;
-      p += r;
-      n -= (size_t)r;
-    }
-    return true;
-  }
-
-  // GEB4/GEB5: fixed 33-byte pre-hashed items out, 25-byte decisions
+  // GEB6/GEB5: fixed 33-byte pre-hashed items out, 25-byte decisions
   // back — the daemon side is a single numpy structured-array view, so
-  // per-item cost exists ONLY in this process.
-  bool roundtrip_fast(int fd, std::vector<Pending*>& batch) {
+  // per-item cost exists ONLY in this process. A GEBR reply means the
+  // bridge's membership view differs from the one these shards were
+  // routed with: fail them kStale (the router refreshes its ring).
+  RtStatus roundtrip_fast(int fd, std::vector<Shard*>& batch) {
     std::string payload;
     uint32_t n = 0;
-    for (Pending* p : batch) {
-      for (const Item& it : p->items) {
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx) {
+        const Item& it = s->parent->items[i];
         payload.append((const char*)&it.hash, 8);
         put_i64(payload, it.hits);
         put_i64(payload, it.limit);
@@ -545,39 +841,44 @@ class Batcher {
     std::string frame;
     put_u32(frame, kMagicFastReq);
     put_u32(frame, n);
+    put_u32(frame, batch[0]->ring_hash);  // batches share one view
     put_u32(frame, (uint32_t)payload.size());
     frame += payload;
-    if (!send_all(fd, frame.data(), frame.size())) return false;
+    if (!send_all(fd, frame.data(), frame.size())) return RtStatus::kFail;
 
     char hdr[8];
-    if (!recv_all(fd, hdr, 8)) return false;
+    if (!recv_all(fd, hdr, 8)) return RtStatus::kFail;
     uint32_t magic, rn;
     memcpy(&magic, hdr, 4);
     memcpy(&rn, hdr + 4, 4);
-    if (magic != kMagicFastResp || rn != n) return false;
+    if (magic == kMagicStale) return RtStatus::kStale;
+    if (magic != kMagicFastResp || rn != n) return RtStatus::kFail;
     std::vector<char> raw(25u * rn);
-    if (rn && !recv_all(fd, raw.data(), raw.size())) return false;
+    if (rn && !recv_all(fd, raw.data(), raw.size()))
+      return RtStatus::kFail;
     size_t off = 0;
-    for (Pending* p : batch) {
-      p->decisions.resize(p->items.size());
-      for (Decision& d : p->decisions) {
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx) {
+        Decision& d = s->parent->decisions[i];
         const char* rec = raw.data() + off * 25;
         d.status = (uint8_t)rec[0];
         memcpy(&d.limit, rec + 1, 8);
         memcpy(&d.remaining, rec + 9, 8);
         memcpy(&d.reset_time, rec + 17, 8);
+        if (!s->owner.empty()) d.owner = s->owner;
         ++off;
       }
     }
-    return true;
+    return RtStatus::kOk;
   }
 
-  bool roundtrip(int fd, std::vector<Pending*>& batch) {
+  RtStatus roundtrip(int fd, std::vector<Shard*>& batch) {
     std::string frame;
     uint32_t n = 0;
     std::string payload;
-    for (Pending* p : batch) {
-      for (const Item& it : p->items) {
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx) {
+        const Item& it = s->parent->items[i];
         put_u16(payload, (uint16_t)it.name.size());
         payload += it.name;
         put_u16(payload, (uint16_t)it.key.size());
@@ -594,38 +895,39 @@ class Batcher {
     put_u32(frame, n);
     put_u32(frame, (uint32_t)payload.size());
     frame += payload;
-    if (!send_all(fd, frame.data(), frame.size())) return false;
+    if (!send_all(fd, frame.data(), frame.size())) return RtStatus::kFail;
 
     char hdr[8];
-    if (!recv_all(fd, hdr, 8)) return false;
+    if (!recv_all(fd, hdr, 8)) return RtStatus::kFail;
     uint32_t magic, rn;
     memcpy(&magic, hdr, 4);
     memcpy(&rn, hdr + 4, 4);
-    if (magic != kMagicResp || rn != n) return false;
+    if (magic != kMagicResp || rn != n) return RtStatus::kFail;
     std::vector<Decision> all(rn);
     for (uint32_t i = 0; i < rn; ++i) {
       char fix[25];
-      if (!recv_all(fd, fix, 25)) return false;
+      if (!recv_all(fd, fix, 25)) return RtStatus::kFail;
       all[i].status = (uint8_t)fix[0];
       memcpy(&all[i].limit, fix + 1, 8);
       memcpy(&all[i].remaining, fix + 9, 8);
       memcpy(&all[i].reset_time, fix + 17, 8);
       uint16_t elen;
-      if (!recv_all(fd, (char*)&elen, 2)) return false;
+      if (!recv_all(fd, (char*)&elen, 2)) return RtStatus::kFail;
       all[i].error.resize(elen);
-      if (elen && !recv_all(fd, all[i].error.data(), elen)) return false;
+      if (elen && !recv_all(fd, all[i].error.data(), elen))
+        return RtStatus::kFail;
       uint16_t olen;
-      if (!recv_all(fd, (char*)&olen, 2)) return false;
+      if (!recv_all(fd, (char*)&olen, 2)) return RtStatus::kFail;
       all[i].owner.resize(olen);
-      if (olen && !recv_all(fd, all[i].owner.data(), olen)) return false;
+      if (olen && !recv_all(fd, all[i].owner.data(), olen))
+        return RtStatus::kFail;
     }
     size_t off = 0;
-    for (Pending* p : batch) {
-      p->decisions.assign(all.begin() + off,
-                          all.begin() + off + p->items.size());
-      off += p->items.size();
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx)
+        s->parent->decisions[i] = std::move(all[off++]);
     }
-    return true;
+    return RtStatus::kOk;
   }
 
   void run() {
@@ -633,17 +935,18 @@ class Batcher {
     if (fd >= 0) connected_.fetch_add(1);
     started_.fetch_add(1);
     while (true) {
-      std::vector<Pending*> batch;
+      std::vector<Shard*> batch;
       bool fast = false;
       {
         std::unique_lock<std::mutex> lk(m_);
         cv_.wait(lk, [this] {
-          return !queue_.empty() || !fast_queue_.empty();
+          return stopping_ || !queue_.empty() || !fast_queue_.empty();
         });
+        if (stopping_) break;
         // batch window: flush at limit_ items or after wait_us_
         if ((int)queued_items_ < limit_ && wait_us_ > 0) {
           cv_.wait_for(lk, std::chrono::microseconds(wait_us_), [this] {
-            return (int)queued_items_ >= limit_;
+            return stopping_ || (int)queued_items_ >= limit_;
           });
         }
         // one frame kind per round-trip; drain the deeper queue first
@@ -652,9 +955,15 @@ class Batcher {
         auto& q = fast ? fast_queue_ : queue_;
         size_t take_items = 0;
         while (!q.empty()) {
-          size_t next = q.front()->items.size();
+          Shard* head = q.front();
+          size_t next = head->idx.size();
           if (!batch.empty() && (int)(take_items + next) > limit_) break;
-          batch.push_back(q.front());
+          // a GEB6 frame carries ONE ring fingerprint: shards routed
+          // under different membership views never co-batch
+          if (fast && !batch.empty() &&
+              head->ring_hash != batch[0]->ring_hash)
+            break;
+          batch.push_back(head);
           take_items += next;
           q.pop_front();
           if ((int)take_items >= limit_) break;
@@ -666,53 +975,291 @@ class Batcher {
         fd = connect_backend();
         if (fd >= 0) connected_.fetch_add(1);
       }
-      bool ok = fd >= 0;
-      if (ok) {
-        ok = fast ? roundtrip_fast(fd, batch) : roundtrip(fd, batch);
-        if (!ok) {
+      if (fast && fd >= 0 && !fast_ok_.load()) {
+        // safety net (the router folds non-fast peers' items into the
+        // slow path at routing time): never put a pre-hashed frame on
+        // a bridge that didn't advertise it — and don't churn the
+        // healthy connection either; nothing was sent
+        for (Shard* s : batch) finish_shard(s, RtStatus::kFail);
+        continue;
+      }
+      RtStatus st = RtStatus::kFail;
+      if (fd >= 0) {
+        st = fast ? roundtrip_fast(fd, batch) : roundtrip(fd, batch);
+        if (st != RtStatus::kOk) {
+          // GEBR also closes bridge-side; reconnecting re-reads the
+          // hello, which (on the primary lane) republishes the ring
           close(fd);
           fd = -1;
           connected_.fetch_sub(1);
         }
       }
-      for (Pending* p : batch) {
-        // notify while holding p->m: submit() may destroy the stack
-        // Pending the instant it observes done, so notifying after
-        // unlock races with the cv's destruction
-        std::lock_guard<std::mutex> lk(p->m);
-        p->failed = !ok;
-        p->done = true;
-        p->cv.notify_one();
-      }
+      for (Shard* s : batch) finish_shard(s, st);
+    }
+    if (fd >= 0) {
+      close(fd);
+      connected_.fetch_sub(1);
     }
   }
 
-  std::string path_;
+  Endpoint ep_;
   int wait_us_;
   int limit_;
   std::atomic<int> connected_{0};
   std::atomic<int> started_{0};
   std::atomic<bool> fast_ok_{false};
+  HelloFn on_hello_;
   std::mutex m_;
   std::condition_variable cv_;
-  std::deque<Pending*> queue_;
-  std::deque<Pending*> fast_queue_;
+  bool stopping_ = false;  // guarded by m_
+  std::deque<Shard*> queue_;
+  std::deque<Shard*> fast_queue_;
   size_t queued_items_ = 0;
-  std::vector<std::thread> threads_;
 };
 
-// Mark a pending fast when the bridge advertises GEB4 and every item is
-// eligible: non-GLOBAL (GLOBAL needs the instance's replica/gossip
-// path) with non-empty name and key (empty fields need the instance's
-// per-item validation errors). Hashes are computed here, once.
-void classify_fast(Pending& p, Batcher* batcher) {
-  if (!batcher->fast_ok()) return;
-  for (const Item& it : p.items) {
-    if (it.behavior == 2 || it.name.empty() || it.key.empty()) return;
+class Router {
+ public:
+  Router(const std::string& primary, int batch_wait_us, int batch_limit,
+         int workers, int refresh_ms)
+      : primary_ep_(parse_endpoint(primary)),
+        wait_us_(batch_wait_us),
+        limit_(batch_limit),
+        workers_(workers),
+        refresh_ms_(refresh_ms) {
+    primary_ = Lane::create(
+        primary_ep_, wait_us_, limit_, workers_,
+        [this](const Ring& r) { publish_ring(r); },
+        /*wait_connect=*/true);
   }
-  for (Item& it : p.items) it.hash = slot_hash(it.name, it.key);
-  p.fast = true;
-}
+
+  void start_refresher() {
+    // ONE long-lived refresher: re-reads the ring every refresh_ms_,
+    // or immediately when request_refresh() wakes it (a stale frame
+    // was refused). Keeps thread churn off the request path entirely.
+    std::thread([this] {
+      while (!g_shutdown.load()) {
+        {
+          std::unique_lock<std::mutex> lk(refresh_cv_m_);
+          refresh_cv_.wait_for(
+              lk, std::chrono::milliseconds(refresh_ms_),
+              [this] { return refresh_asap_; });
+          refresh_asap_ = false;
+        }
+        refresh_ring();
+      }
+    }).detach();
+  }
+
+  bool backend_ok() const { return primary_->backend_ok(); }
+
+  // Split into shards, route, wait. Returns false only when EVERY
+  // shard failed (callers answer 503/UNAVAILABLE, matching the
+  // single-backend behavior); partial failures become per-item errors,
+  // like instance-side peer forwards (serve/instance.py forward()).
+  bool execute(Pending& p) {
+    if (p.items.empty()) return true;
+    p.decisions.assign(p.items.size(), Decision());
+    std::shared_ptr<const Ring> ring = current_ring();
+
+    Shard slow;
+    slow.parent = &p;
+    std::map<int, Shard> fast_by_node;
+    std::map<int, std::shared_ptr<Lane>> lane_by_node;
+    for (uint32_t i = 0; i < p.items.size(); ++i) {
+      Item& it = p.items[i];
+      // GLOBAL needs the instance's replica/gossip path; empty fields
+      // need its per-item validation errors
+      bool eligible = ring && ring->fast && it.behavior != 2 &&
+                      !it.name.empty() && !it.key.empty();
+      int node = -1;
+      if (eligible) {
+        node = ring->owner(it.name, it.key);
+        eligible = node >= 0;
+      }
+      if (eligible && !ring->nodes[node].self) {
+        const Node& nd = ring->nodes[node];
+        if (nd.bridge.empty()) {
+          eligible = false;
+        } else {
+          auto lit = lane_by_node.find(node);
+          if (lit == lane_by_node.end())
+            lit = lane_by_node.emplace(node, lane_for(nd.bridge)).first;
+          // a peer that hasn't advertised the fast path (mixed fleet,
+          // or its lane hasn't completed the first hello yet) gets its
+          // items over the slow path — the primary's instance forwards
+          // them over gRPC — instead of a doomed pre-hashed frame
+          if (!lit->second->fast_advertised()) eligible = false;
+        }
+      }
+      if (!eligible) {
+        slow.idx.push_back(i);
+        continue;
+      }
+      Shard& sh = fast_by_node[node];
+      if (sh.parent == nullptr) {
+        sh.parent = &p;
+        sh.fast = true;
+        sh.ring_hash = ring->hash;
+        if (!ring->nodes[node].self) sh.owner = ring->nodes[node].grpc;
+      }
+      sh.idx.push_back(i);
+      it.hash = slot_hash(it.name, it.key);
+    }
+
+    int n_shards =
+        (slow.idx.empty() ? 0 : 1) + (int)fast_by_node.size();
+    {
+      std::lock_guard<std::mutex> lk(p.m);
+      p.shards_left = n_shards;
+    }
+    if (!slow.idx.empty() && !primary_->submit(&slow))
+      finish_shard(&slow, RtStatus::kFail);
+    for (auto& [node, sh] : fast_by_node) {
+      std::shared_ptr<Lane> lane = ring->nodes[node].self
+                                       ? primary_
+                                       : lane_by_node.at(node);
+      if (!lane->submit(&sh)) finish_shard(&sh, RtStatus::kFail);
+    }
+    {
+      std::unique_lock<std::mutex> lk(p.m);
+      p.cv.wait(lk, [&p] { return p.shards_left == 0; });
+    }
+
+    bool any_ok = false, saw_stale = false;
+    auto fill_errors = [&](const Shard& s, const std::string& why) {
+      for (uint32_t i : s.idx) {
+        Decision& d = p.decisions[i];
+        d = Decision();
+        d.error = "while fetching rate limit '" + p.items[i].name + "_" +
+                  p.items[i].key + "' from peer - '" + why + "'";
+      }
+    };
+    if (!slow.idx.empty()) {
+      if (slow.failed) fill_errors(slow, "edge backend unavailable");
+      else any_ok = true;
+    }
+    for (auto& [node, sh] : fast_by_node) {
+      (void)node;
+      if (!sh.failed) {
+        any_ok = true;
+        continue;
+      }
+      saw_stale |= sh.stale;
+      fill_errors(sh, sh.stale
+                          ? "edge: cluster membership changed; retry"
+                          : "edge: bridge " +
+                                (sh.owner.empty() ? primary_ep_.spec
+                                                  : sh.owner) +
+                                " unreachable");
+    }
+    if (saw_stale) {
+      // refresh OFF the request path: connect_endpoint + hello can
+      // block up to ~10s against a wedged primary, and the per-item
+      // "membership changed; retry" errors are already composed — the
+      // reply must not wait on the re-read. Waking the long-lived
+      // refresher costs a notify, not a thread.
+      {
+        std::lock_guard<std::mutex> lk(refresh_cv_m_);
+        refresh_asap_ = true;
+      }
+      refresh_cv_.notify_one();
+    }
+    // a stale ring is a transient routing miss, not a dead backend:
+    // surface the per-item retry errors as a normal response instead
+    // of a blanket 503
+    return any_ok || saw_stale;
+  }
+
+ private:
+  std::shared_ptr<const Ring> current_ring() {
+    std::lock_guard<std::mutex> lk(ring_m_);
+    return ring_;
+  }
+
+  void publish_ring(const Ring& r) {
+    auto next = std::make_shared<Ring>(r);
+    {
+      std::lock_guard<std::mutex> lk(ring_m_);
+      ring_ = next;
+    }
+    // Evict lanes whose endpoint left the membership: under pod-IP
+    // discovery (k8s rollouts) endpoints are never reused, so an
+    // unevicted lane strands its worker threads forever. In-flight
+    // round-trips finish; queued shards fail; the Lane frees itself
+    // when its last detached worker exits.
+    std::vector<std::shared_ptr<Lane>> evicted;
+    {
+      std::lock_guard<std::mutex> lk(lanes_m_);
+      for (auto it = lanes_.begin(); it != lanes_.end();) {
+        bool live = false;
+        for (const Node& nd : next->nodes)
+          if (!nd.self && nd.bridge == it->first) live = true;
+        if (live) {
+          ++it;
+        } else {
+          evicted.push_back(it->second);
+          it = lanes_.erase(it);
+        }
+      }
+    }
+    for (auto& lane : evicted) lane->shutdown();
+    // pre-warm lanes for every peer bridge in the new membership so
+    // the first request after a ring change doesn't ride the slow
+    // path while the lane's first hello is still in flight
+    for (const Node& nd : next->nodes)
+      if (!nd.self && !nd.bridge.empty()) lane_for(nd.bridge);
+  }
+
+  // one short-lived hello round-trip to the primary bridge, debounced:
+  // concurrent stale shards must not stampede the bridge with connects
+  void refresh_ring() {
+    auto now = std::chrono::steady_clock::now();
+    {
+      std::lock_guard<std::mutex> lk(refresh_m_);
+      if (now - last_refresh_ < std::chrono::milliseconds(50)) return;
+      last_refresh_ = now;
+    }
+    int fd = connect_endpoint(primary_ep_);
+    if (fd < 0) return;
+    timeval tv{};
+    tv.tv_sec = 5;
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+    Ring r;
+    if (read_hello(fd, &r)) publish_ring(r);
+    close(fd);
+  }
+
+  // get-or-create the lane for a peer bridge endpoint; publish_ring
+  // evicts lanes for departed endpoints. The returned shared_ptr keeps
+  // a lane usable by an in-flight execute() even if eviction races it
+  // (submit on a stopped lane fails cleanly instead of dangling).
+  std::shared_ptr<Lane> lane_for(const std::string& spec) {
+    std::lock_guard<std::mutex> lk(lanes_m_);
+    auto it = lanes_.find(spec);
+    if (it != lanes_.end()) return it->second;
+    auto lane =
+        Lane::create(parse_endpoint(spec), wait_us_, limit_, workers_,
+                     nullptr, /*wait_connect=*/false);
+    lanes_.emplace(spec, lane);
+    return lane;
+  }
+
+  Endpoint primary_ep_;
+  std::shared_ptr<Lane> primary_;
+  int wait_us_;
+  int limit_;
+  int workers_;
+  int refresh_ms_;
+  std::mutex ring_m_;
+  std::shared_ptr<const Ring> ring_;
+  std::mutex lanes_m_;
+  std::unordered_map<std::string, std::shared_ptr<Lane>> lanes_;
+  std::mutex refresh_m_;
+  std::chrono::steady_clock::time_point last_refresh_{};
+  std::mutex refresh_cv_m_;
+  std::condition_variable refresh_cv_;
+  bool refresh_asap_ = false;  // guarded by refresh_cv_m_
+};
 
 // -------------------------------------------------------------- HTTP layer
 
@@ -749,30 +1296,11 @@ std::atomic<int> g_conns{0};
 int g_max_conns = 4096;
 int g_recv_timeout_s = 60;
 
-// SIGTERM/SIGINT: stop accepting, let in-flight requests drain (bounded),
-// exit 0 — the same graceful contract as the daemon (reference
-// cmd/gubernator/main.go:127-139 drains on SIGINT). The handler writes
-// one byte into a self-pipe the accept loops poll() on: process-directed
-// signals may be delivered to ANY thread, so waking a specific blocked
-// accept() via EINTR is not reliable (and stripping SA_RESTART would
-// instead abort in-flight reads everywhere else).
-std::atomic<bool> g_shutdown{false};
-int g_wake_pipe[2] = {-1, -1};
-
-void on_term(int) {
-  g_shutdown.store(true);
-  if (g_wake_pipe[1] >= 0) {
-    char b = 1;
-    // async-signal-safe; a full pipe just means a wakeup is already queued
-    (void)!write(g_wake_pipe[1], &b, 1);
-  }
-}
-
 struct ConnGuard {
   ~ConnGuard() { g_conns.fetch_sub(1, std::memory_order_relaxed); }
 };
 
-void serve_connection(int fd, Batcher* batcher) {
+void serve_connection(int fd, Router* router) {
   ConnGuard guard;
   std::string buf;
   char tmp[16384];
@@ -837,7 +1365,7 @@ void serve_connection(int fd, Batcher* batcher) {
     bool sent;
     if (is_health) {
       sent = http_reply(fd, 200, "OK",
-                        batcher->backend_ok()
+                        router->backend_ok()
                             ? "{\"status\": \"healthy\", \"message\": "
                               "\"edge\", \"peerCount\": 0}"
                             : "{\"status\": \"unhealthy\", \"message\": "
@@ -862,8 +1390,7 @@ void serve_connection(int fd, Batcher* batcher) {
       } else if (p.items.empty()) {
         sent = http_reply(fd, 200, "OK", "{\"responses\": []}");
       } else {
-        classify_fast(p, batcher);
-        if (!batcher->submit(&p)) {
+        if (!router->execute(p)) {
           sent = http_reply(fd, 503, "Service Unavailable",
                             "{\"error\": \"backend unavailable\"}");
         } else {
@@ -882,7 +1409,7 @@ void serve_connection(int fd, Batcher* batcher) {
 }
 
 // gRPC/HTTP2 terminator (serve_grpc_connection + HPACK + proto codec);
-// shares Item/Decision/Batcher above, hence the in-namespace include
+// shares Item/Decision/Router above, hence the in-namespace include
 #include "h2_grpc.inc"
 
 }  // namespace
@@ -895,6 +1422,7 @@ static const char kUsage[] =
     "  --backend PATH         daemon's edge unix socket "
     "(default /tmp/guber-edge.sock)\n"
     "  --batch-wait-us N      cross-connection batch window (default 500)\n"
+    "  --ring-refresh-ms N    cluster ring re-read period (default 1000)\n"
     "  --batch-limit N        max requests per backend frame (default 1000)\n"
     "  --workers N            pipelined backend connections (default 2)\n"
     "  --max-conns N          client connection cap (default 4096)\n"
@@ -933,6 +1461,7 @@ int main(int argc, char** argv) {
   int batch_wait_us = 500;
   int batch_limit = 1000;
   int workers = 2;
+  int ring_refresh_ms = 1000;
   for (int i = 1; i < argc; i += 2) {
     std::string a = argv[i];
     if (a == "--help" || a == "-h") {
@@ -949,6 +1478,10 @@ int main(int argc, char** argv) {
     else if (a == "--grpc-listen") ok = parse_int_flag(v, &grpc_port);
     else if (a == "--backend") backend = v;
     else if (a == "--batch-wait-us") ok = parse_int_flag(v, &batch_wait_us);
+    else if (a == "--ring-refresh-ms") {
+      ok = parse_int_flag(v, &ring_refresh_ms);
+      ring_refresh_ms = std::max(50, ring_refresh_ms);
+    }
     else if (a == "--batch-limit") ok = parse_int_flag(v, &batch_limit);
     else if (a == "--workers") {
       ok = parse_int_flag(v, &workers);
@@ -970,8 +1503,9 @@ int main(int argc, char** argv) {
     }
   }
 
-  // bind BEFORE spawning the batcher's worker threads: returning with
-  // joinable threads in Batcher's vector would std::terminate
+  // bind BEFORE constructing the router: its primary lane blocks on
+  // eager worker connects, and a bind failure should exit before
+  // spawning any detached lane threads
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   if (srv < 0) {
     perror("socket");
@@ -1008,7 +1542,9 @@ int main(int argc, char** argv) {
     }
   }
 
-  Batcher batcher(backend, batch_wait_us, batch_limit, workers);
+  Router router(backend, batch_wait_us, batch_limit, workers,
+                ring_refresh_ms);
+  router.start_refresher();
   fprintf(stderr, "guber-edge listening on :%d%s backend=%s\n", port,
           grpc_port > 0
               ? (" grpc=:" + std::to_string(grpc_port)).c_str()
@@ -1016,7 +1552,7 @@ int main(int argc, char** argv) {
           backend.c_str());
   fflush(stderr);
 
-  auto accept_loop = [&one](int lsrv, Batcher* b, bool grpc) {
+  auto accept_loop = [&one](int lsrv, Router* b, bool grpc) {
     pollfd pfds[2] = {{lsrv, POLLIN, 0}, {g_wake_pipe[0], POLLIN, 0}};
     while (!g_shutdown.load()) {
       pfds[0].revents = pfds[1].revents = 0;
@@ -1050,9 +1586,9 @@ int main(int argc, char** argv) {
   };
 
   if (grpc_srv >= 0) {
-    std::thread(accept_loop, grpc_srv, &batcher, true).detach();
+    std::thread(accept_loop, grpc_srv, &router, true).detach();
   }
-  accept_loop(srv, &batcher, false);
+  accept_loop(srv, &router, false);
 
   // graceful drain: stop taking connections, give in-flight requests a
   // bounded window to finish, then exit 0. Connection threads are
@@ -1071,8 +1607,9 @@ int main(int argc, char** argv) {
   fprintf(stderr, "guber-edge: exiting (%d conns remained)\n",
           g_conns.load());
   fflush(nullptr);
-  // _exit: the Batcher's worker threads are parked in their queue wait
-  // and its destructor would std::terminate on the joinable handles;
-  // after the drain there is nothing left worth running destructors for
+  // _exit: detached lane workers and the refresher still reference the
+  // stack Router; running destructors under them would be a
+  // use-after-free. After the drain there is nothing left worth
+  // running destructors for.
   _exit(0);
 }

@@ -65,6 +65,18 @@ class ServerConfig:
     # but unavailable.
     jax_platform: str = ""
     edge_socket: str = ""  # unix socket for the native edge bridge
+    # TCP listener for the edge bridge ("host:port"). Lets an edge
+    # fronting a multi-node cluster ship pre-hashed frames directly to
+    # each key's ring owner. Symmetric-fleet convention: every node
+    # listens on the SAME port, so peers' bridge endpoints are derived
+    # as (peer gRPC host, this port). Internal cluster port — do not
+    # expose to clients (serve/edge_bridge.py trust boundary).
+    edge_tcp: str = ""
+    # Explicit peer-bridge map overriding the symmetric convention:
+    # "grpc_addr=bridge_addr,..." — needed when nodes share a host
+    # (different ports per node, e.g. a localhost test cluster) or run
+    # heterogeneous port layouts.
+    edge_peer_bridges: str = ""
 
     # multi-host mesh (GUBER_DIST_*): one jax.distributed program over
     # several hosts; process 0 serves (backend=multihost), others run the
@@ -204,6 +216,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 15),
         jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
         edge_socket=_get(env, "GUBER_EDGE_SOCKET"),
+        edge_tcp=_get(env, "GUBER_EDGE_TCP"),
+        edge_peer_bridges=_get(env, "GUBER_EDGE_PEER_BRIDGES"),
         dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),
         dist_num_processes=_get_int(env, "GUBER_DIST_NUM_PROCESSES", 1),
         dist_process_id=_get_int(env, "GUBER_DIST_PROCESS_ID", 0),

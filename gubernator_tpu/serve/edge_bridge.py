@@ -9,8 +9,31 @@ C++. The decisions still flow through the full serving Instance
 (validation, ring ownership, forwarding, GLOBAL replica handling), so
 edge-fronted and directly-connected clients see identical semantics.
 
+Cluster topology (r5): the bridge also listens on TCP
+(GUBER_EDGE_TCP) so that an edge fronting a multi-node cluster can
+ship each pre-hashed frame DIRECTLY to the key's ring owner — the
+compiled-front-door-as-cluster-node shape of the reference, where
+every compiled server computes ring ownership itself (reference
+gubernator.go:114, hash.go:80-96). The hello carries the live ring
+(peer gRPC addresses + bridge endpoints + which one is this node);
+fast frames echo a fingerprint of the membership they were routed
+with, and a frame routed under a stale view is refused with a GEBR
+frame so the edge re-reads the ring — never silently mis-admitted.
+
 Frame protocol (little-endian, lengths in bytes):
 
+  hello (bridge->edge, on connect):
+                   u32 magic 'GEBI' | u32 flags | u32 ring_hash |
+                   u32 n_nodes | n_nodes x node
+      node: u8 is_self | u16 grpc_len | grpc_addr |
+            u16 bridge_len | bridge_addr
+      flags bit 0: pre-hashed fast path available (array backend).
+      ring_hash = crc32 of "\n".join(sorted(grpc addresses)) — the
+      membership fingerprint fast frames must echo. bridge_addr is
+      where an edge reaches THAT node's bridge ("host:port"); empty
+      for this node (the edge uses its configured --backend) and for
+      peers when GUBER_EDGE_TCP is unset (the edge then routes those
+      items through the string path, which forwards via gRPC).
   request frame:   u32 magic 'GEB1' | u32 n | u32 payload_len |
                    payload = n x item
       item: u16 name_len | name | u16 key_len | key |
@@ -22,12 +45,25 @@ Frame protocol (little-endian, lengths in bytes):
       (owner = metadata["owner"] for forwarded keys, empty otherwise;
       added in GEB3 — the magic bump makes a version mismatch fail the
       roundtrip loudly instead of desyncing the stream)
+  fast request:    u32 magic 'GEB6' | u32 n | u32 ring_hash |
+                   u32 payload_len | payload = n x 33-byte record
+      (GEB6 supersedes r4's GEB4: same records, plus the ring
+      fingerprint — the magic bump fails a version-skewed edge loudly)
+  fast response:   u32 magic 'GEB5' | u32 n | n x 25-byte record
+  stale ring:      u32 magic 'GEBR' | u32 0   (then the bridge closes;
+                   the edge reconnects, re-reads the hello, re-routes)
 
 One frame in flight per connection; the edge opens `--workers`
 backend connections (default 2) whose batches round-trip concurrently,
 so this handler runs concurrently with itself — safe because the
 serving instance already serves concurrent gRPC/HTTP callers from one
 event loop. Malformed input closes the connection.
+
+Trust boundary: like the PeersV1 gRPC service (which applies whatever
+batch a forwarding peer sends without re-checking ownership, reference
+gubernator.go:210-227), the bridge trusts a fast frame whose ring
+fingerprint matches — both are internal cluster ports and must not be
+exposed to clients.
 """
 
 from __future__ import annotations
@@ -35,6 +71,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import zlib
 from typing import List, Optional
 
 from gubernator_tpu.api.types import (
@@ -43,21 +80,32 @@ from gubernator_tpu.api.types import (
     RateLimitReq,
     RateLimitResp,
 )
+from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE
 
 log = logging.getLogger("gubernator_tpu.edge")
 
 MAGIC_REQ = 0x31424547  # 'GEB1' little-endian
 MAGIC_RESP = 0x33424547  # 'GEB3' (owner field added r3)
-MAGIC_HELLO = 0x48424547  # 'GEBH' — bridge capability hello (r4)
-MAGIC_FAST_REQ = 0x34424547  # 'GEB4' — pre-hashed array items (r4)
+MAGIC_HELLO = 0x49424547  # 'GEBI' — ring-carrying hello (r5; was GEBH)
+MAGIC_FAST_REQ = 0x36424547  # 'GEB6' — pre-hashed items + ring hash (r5)
 MAGIC_FAST_RESP = 0x35424547  # 'GEB5'
+MAGIC_STALE = 0x52424547  # 'GEBR' — fast frame refused: stale ring
+
+
+def ring_fingerprint(hosts) -> int:
+    """crc32 fingerprint of a membership set. Covers only the gRPC
+    addresses (the ring points, core/hashing.ring_hash): two nodes with
+    the same membership agree on this even when they derive different
+    bridge endpoints, and a bridge-endpoint misconfiguration can only
+    cause connection errors, never silent mis-ownership."""
+    return zlib.crc32("\n".join(sorted(hosts)).encode()) & 0xFFFFFFFF
 
 _HDR = struct.Struct("<II")
 _ITEM_FIX = struct.Struct("<qqqBB")
 _RESP_FIX = struct.Struct("<Bqqq")
 
-# GEB4 record: the edge pre-hashes name+"_"+key with the SAME XXH64 the
+# GEB6 record: the edge pre-hashes name+"_"+key with the SAME XXH64 the
 # daemon's slot store uses (edge.cc xxh64 vs native/guberhash.cc — pinned
 # by tests), so the daemon's fast path never touches per-item Python:
 # np.frombuffer views the whole frame as a structured array.
@@ -161,63 +209,138 @@ def encode_response_frame(resps) -> bytes:
 
 
 class EdgeBridge:
-    """Unix-socket server feeding edge batches into the serving instance."""
+    """Unix-socket (+ optional TCP) server feeding edge batches into the
+    serving instance. The unix socket serves a co-located edge; the TCP
+    listener serves edges fronting OTHER nodes of the cluster, which
+    ship pre-hashed frames for keys this node owns (cluster fast path,
+    r5)."""
 
-    def __init__(self, instance, path: str):
+    def __init__(
+        self,
+        instance,
+        path: str,
+        tcp_address: str = "",
+        peer_bridges: Optional[dict] = None,
+    ):
         self.instance = instance
         self.path = path
+        self.tcp_address = tcp_address
+        # explicit grpc_addr -> bridge_addr overrides (config
+        # GUBER_EDGE_PEER_BRIDGES); falls back to the symmetric-fleet
+        # port convention for unlisted peers
+        self.peer_bridges = peer_bridges or {}
         self._server: Optional[asyncio.AbstractServer] = None
+        self._tcp_server: Optional[asyncio.AbstractServer] = None
 
     async def start(self) -> None:
-        self._server = await asyncio.start_unix_server(
-            self._serve_conn, path=self.path
-        )
-        log.info("edge bridge listening on %s", self.path)
+        if self.path:
+            self._server = await asyncio.start_unix_server(
+                self._serve_conn, path=self.path
+            )
+            log.info("edge bridge listening on %s", self.path)
+        if self.tcp_address:
+            host, _, port = self.tcp_address.rpartition(":")
+            self._tcp_server = await asyncio.start_server(
+                self._serve_conn, host=host or "0.0.0.0", port=int(port)
+            )
+            log.info("edge bridge listening on tcp %s", self.tcp_address)
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        for srv in (self._server, self._tcp_server):
+            if srv is not None:
+                srv.close()
+                await srv.wait_closed()
+        self._server = None
+        self._tcp_server = None
 
     def _fast_ok(self) -> bool:
-        """The pre-hashed fast path bypasses the instance's ring routing
-        and GLOBAL handling, so it is only sound when this node owns the
-        whole key space (single-node deployment — the edge's documented
-        topology) and the backend takes arrays. Membership must be read
-        LIVE from the picker: discovery (etcd/k8s) grows the ring via
-        set_peers without ever touching conf.peers, and a fast path left
-        on in a grown cluster would admit every key locally (~Nx
-        over-admission). The picker starts empty, so "<= 1 peers" is
-        true both before set_peers and after a single-node set_peers."""
+        """Pre-hashed frames need a backend that takes arrays. Ring
+        soundness is no longer a single-node condition (r4): the edge
+        routes each item to its ring owner itself and every fast frame
+        carries the membership fingerprint it routed with, checked in
+        `_serve_fast_frame` — a frame routed under a different view is
+        refused, so a grown cluster can no longer be silently
+        over-admitted by a stale edge."""
         backend = getattr(self.instance, "backend", None)
-        picker = getattr(self.instance, "picker", None)
-        if picker is None:
-            return False
-        try:
-            n_peers = len(picker.peers())
-        except Exception:
-            return False
         return (
-            n_peers <= 1
-            and getattr(backend, "decide_submit_arrays", None) is not None
+            getattr(backend, "decide_submit_arrays", None) is not None
             and getattr(backend, "decide_submit", None) is not None
         )
+
+    def _ring_hash(self) -> int:
+        # computed fresh per use: at ~100 coalesced frames/s the crc32
+        # of a few peer addresses is noise, and any caching keyed on the
+        # picker object risks a stale fingerprint on allocator id reuse
+        # (set_peers builds a NEW picker per update) — a stale hash here
+        # is exactly the over-admission hole the fingerprint closes
+        picker = getattr(self.instance, "picker", None)
+        if picker is None:
+            return ring_fingerprint([])
+        try:
+            hosts = [p.host for p in picker.peers()]
+        except Exception:
+            hosts = []
+        return ring_fingerprint(hosts)
+
+    def _hello(self) -> bytes:
+        """Capability + ring hello. Peer bridge endpoints follow the
+        symmetric-fleet convention: every node's bridge listens on the
+        same TCP port (the port of this node's GUBER_EDGE_TCP), on the
+        same host as its gRPC address. When GUBER_EDGE_TCP is unset,
+        peers get empty bridge endpoints and the edge routes their keys
+        through the string path (instance-side gRPC forwarding) — the
+        pre-r5 behavior, now per-item instead of all-or-nothing."""
+        picker = getattr(self.instance, "picker", None)
+        peers = []
+        if picker is not None:
+            try:
+                peers = sorted(picker.peers(), key=lambda p: p.host)
+            except Exception:
+                peers = []
+        bridge_port = ""
+        if self.tcp_address:
+            bridge_port = self.tcp_address.rpartition(":")[2]
+        parts = [
+            struct.pack(
+                "<IIII",
+                MAGIC_HELLO,
+                1 if self._fast_ok() else 0,
+                self._ring_hash(),
+                len(peers),
+            )
+        ]
+        for p in peers:
+            grpc_addr = p.host.encode()
+            if p.is_owner:
+                bridge = b""
+            elif p.host in self.peer_bridges:
+                bridge = self.peer_bridges[p.host].encode()
+            elif bridge_port:
+                bridge = (
+                    p.host.rpartition(":")[0] + ":" + bridge_port
+                ).encode()
+            else:
+                bridge = b""
+            parts.append(struct.pack("<BH", 1 if p.is_owner else 0,
+                                     len(grpc_addr)))
+            parts.append(grpc_addr)
+            parts.append(struct.pack("<H", len(bridge)))
+            parts.append(bridge)
+        return b"".join(parts)
 
     async def _serve_fast_frame(self, payload: bytes, n: int, writer):
         import numpy as np
 
         req_dt, resp_dt = _fast_dtypes()
         if len(payload) != n * req_dt.itemsize:
-            raise ValueError("GEB4 payload length mismatch")
+            raise ValueError("GEB6 payload length mismatch")
         if not self._fast_ok():
-            # topology changed under a connected edge (or wrong backend):
-            # refuse loudly; the edge reconnects and re-handshakes onto
-            # the GEB1 path
+            # wrong backend for pre-hashed frames: refuse loudly; the
+            # edge reconnects and re-handshakes onto the GEB1 path
             raise ValueError(
-                "GEB4 frame but fast path unavailable (multi-node "
-                "topology or non-array backend)"
+                "GEB6 frame but fast path unavailable (non-array backend)"
             )
+        metrics.EDGE_FAST_ITEMS.inc(n)
         rec = np.frombuffer(payload, dtype=req_dt)
         fields = dict(
             key_hash=np.ascontiguousarray(rec["key_hash"]),
@@ -266,22 +389,32 @@ class EdgeBridge:
 
     async def _serve_conn(self, reader, writer):
         try:
-            # capability hello: tells the edge whether GEB4 is usable on
-            # this connection (u8 flag; extend with more flags as needed)
-            writer.write(
-                _HDR.pack(MAGIC_HELLO, 1 if self._fast_ok() else 0)
-            )
+            # ring-carrying hello: capability flags + live membership
+            # (rebuilt per connection; the edge refreshes by reconnecting)
+            writer.write(self._hello())
             await writer.drain()
             while True:
                 hdr = await reader.readexactly(_HDR.size)
                 magic, n = _HDR.unpack(hdr)
                 if magic == MAGIC_FAST_REQ:
-                    (plen,) = struct.unpack(
-                        "<I", await reader.readexactly(4)
+                    frame_ring, plen = struct.unpack(
+                        "<II", await reader.readexactly(8)
                     )
-                    await self._serve_fast_frame(
-                        await reader.readexactly(plen), n, writer
-                    )
+                    payload = await reader.readexactly(plen)
+                    if frame_ring != self._ring_hash():
+                        # the edge routed this frame with a different
+                        # membership view — deciding it here could admit
+                        # keys this node no longer owns. Refuse and close;
+                        # the edge re-reads the ring and re-routes.
+                        metrics.EDGE_STALE_RINGS.inc()
+                        log.warning(
+                            "refusing fast frame routed with stale ring "
+                            "(%#x != %#x)", frame_ring, self._ring_hash()
+                        )
+                        writer.write(_HDR.pack(MAGIC_STALE, 0))
+                        await writer.drain()
+                        return
+                    await self._serve_fast_frame(payload, n, writer)
                     continue
                 if magic != MAGIC_REQ:
                     raise ValueError(f"bad magic {magic:#x}")

@@ -79,6 +79,19 @@ STORE_EVICTIONS = Counter(
     "exposes the analogous cache_size-vs-max pressure)",
     registry=REGISTRY,
 )
+EDGE_FAST_ITEMS = Counter(
+    "edge_fast_items_total",
+    "Rate-limit items served through the pre-hashed (GEB6) edge fast "
+    "path on this node — in a cluster, nonzero on every node proves the "
+    "edge ships per-owner frames instead of funnelling through one node",
+    registry=REGISTRY,
+)
+EDGE_STALE_RINGS = Counter(
+    "edge_stale_ring_total",
+    "GEB6 frames rejected because the edge routed with a different "
+    "membership view than this node (the edge refreshes and retries)",
+    registry=REGISTRY,
+)
 DISTINCT_KEYS = Gauge(
     "distinct_keys_estimate",
     "HyperLogLog estimate of distinct rate-limit keys seen",

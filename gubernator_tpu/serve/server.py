@@ -223,10 +223,26 @@ class Server:
 
         if self.conf.http_address:
             await self._start_http()
-        if self.conf.edge_socket:
+        if self.conf.edge_socket or self.conf.edge_tcp:
             from gubernator_tpu.serve.edge_bridge import EdgeBridge
 
-            self._edge = EdgeBridge(self.instance, self.conf.edge_socket)
+            peer_bridges = {}
+            for pair in self.conf.edge_peer_bridges.split(","):
+                if not pair.strip():
+                    continue
+                grpc_addr, sep, bridge = pair.strip().partition("=")
+                if not sep or not grpc_addr or not bridge:
+                    raise ValueError(
+                        "GUBER_EDGE_PEER_BRIDGES entries must be "
+                        f"'grpc_addr=bridge_addr', got {pair!r}"
+                    )
+                peer_bridges[grpc_addr] = bridge
+            self._edge = EdgeBridge(
+                self.instance,
+                self.conf.edge_socket,
+                tcp_address=self.conf.edge_tcp,
+                peer_bridges=peer_bridges,
+            )
             await self._edge.start()
 
         await self._start_discovery()

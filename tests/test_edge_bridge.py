@@ -4,10 +4,16 @@ The C++ edge passes client bytes through its minimal JSON parser
 verbatim, so the Python bridge is the first place invalid UTF-8 can
 surface; one client's garbage must fail only its own item, never the
 co-batched requests of other connections (ADVICE r1 medium).
+
+r5: the hello carries the cluster ring ('GEBI') and pre-hashed frames
+('GEB6') echo the membership fingerprint they were routed with; a
+frame routed under a different view is refused with 'GEBR' — the
+over-admission guard that replaced r4's single-node gate.
 """
 
 import asyncio
 import struct
+from dataclasses import dataclass
 
 from gubernator_tpu.api.types import RateLimitResp, Status
 from gubernator_tpu.serve.edge_bridge import (
@@ -16,6 +22,7 @@ from gubernator_tpu.serve.edge_bridge import (
     EdgeBridge,
     decode_request_frame,
     encode_response_frame,
+    ring_fingerprint,
 )
 
 
@@ -33,6 +40,31 @@ def _frame(items) -> bytes:
     return struct.pack("<II", MAGIC_REQ, len(items)) + struct.pack(
         "<I", len(payload)
     ) + payload
+
+
+async def _read_hello(reader):
+    """Parse the GEBI hello; returns (flags, ring_hash, nodes) where
+    nodes is a list of (is_self, grpc, bridge)."""
+    from gubernator_tpu.serve.edge_bridge import MAGIC_HELLO
+
+    magic, flags, rhash, n = struct.unpack(
+        "<IIII", await reader.readexactly(16)
+    )
+    assert magic == MAGIC_HELLO
+    nodes = []
+    for _ in range(n):
+        is_self, glen = struct.unpack("<BH", await reader.readexactly(3))
+        grpc = (await reader.readexactly(glen)).decode()
+        (blen,) = struct.unpack("<H", await reader.readexactly(2))
+        bridge = (await reader.readexactly(blen)).decode()
+        nodes.append((bool(is_self), grpc, bridge))
+    return flags, rhash, nodes
+
+
+@dataclass
+class FakePeer:
+    host: str
+    is_owner: bool = False
 
 
 BAD = b"\xff\xfe\x80"  # not valid UTF-8
@@ -73,13 +105,7 @@ def test_bridge_answers_bad_item_without_failing_frame():
         await bridge.start()
         try:
             reader, writer = await asyncio.open_unix_connection(path)
-            # capability hello comes first on every connection (r4)
-            from gubernator_tpu.serve.edge_bridge import MAGIC_HELLO
-
-            hmagic, _flags = struct.unpack(
-                "<II", await reader.readexactly(8)
-            )
-            assert hmagic == MAGIC_HELLO
+            await _read_hello(reader)
             writer.write(_frame([
                 _item(b"api", b"ok-1"),
                 _item(b"api", BAD),
@@ -128,17 +154,36 @@ def test_response_roundtrip():
     assert raw[off + 2 : off + 2 + olen] == b"10.0.0.3:81"
 
 
+class _FakeBackendArrays:
+    decide_submit_arrays = object()
+    decide_submit = object()
+
+
+class _FakeTraffic:
+    def observe_hashes(self, h):
+        pass
+
+
+def _fast_frame(rec, ring_hash):
+    from gubernator_tpu.serve.edge_bridge import MAGIC_FAST_REQ
+
+    payload = rec.tobytes()
+    return (
+        struct.pack("<II", MAGIC_FAST_REQ, len(rec))
+        + struct.pack("<II", ring_hash, len(payload))
+        + payload
+    )
+
+
 def test_fast_frame_chunks_oversized_batches():
-    """A GEB4 frame beyond MAX_BATCH_SIZE must reach the batcher as
+    """A GEB6 frame beyond MAX_BATCH_SIZE must reach the batcher as
     ladder-sized chunks (the engine's compiled rungs top out there), and
     the concatenated responses must preserve request order."""
     import numpy as np
 
     from gubernator_tpu.serve.config import MAX_BATCH_SIZE
     from gubernator_tpu.serve.edge_bridge import (
-        MAGIC_FAST_REQ,
         MAGIC_FAST_RESP,
-        MAGIC_HELLO,
         _fast_dtypes,
     )
 
@@ -156,24 +201,16 @@ def test_fast_frame_chunks_oversized_batches():
                 np.zeros(n, np.int64),
             )
 
-    class FakeBackend:
-        decide_submit_arrays = object()
-        decide_submit = object()
-
     class FakePicker:
-        # live membership, the surface _fast_ok actually consults
+        # live membership, the surface the hello actually consults
         def peers(self):
-            return ["self"]
-
-    class FakeTraffic:
-        def observe_hashes(self, h):
-            pass
+            return [FakePeer("127.0.0.1:81", is_owner=True)]
 
     class FakeInstance:
-        backend = FakeBackend()
+        backend = _FakeBackendArrays()
         picker = FakePicker()
         batcher = FakeBatcher()
-        traffic = FakeTraffic()
+        traffic = _FakeTraffic()
 
     async def run():
         path = "/tmp/guber-bridge-fast-chunk.sock"
@@ -181,10 +218,10 @@ def test_fast_frame_chunks_oversized_batches():
         await bridge.start()
         try:
             reader, writer = await asyncio.open_unix_connection(path)
-            hmagic, flags = struct.unpack(
-                "<II", await reader.readexactly(8)
-            )
-            assert hmagic == MAGIC_HELLO and flags == 1
+            flags, rhash, nodes = await _read_hello(reader)
+            assert flags == 1
+            assert rhash == ring_fingerprint(["127.0.0.1:81"])
+            assert nodes == [(True, "127.0.0.1:81", "")]
             n = MAX_BATCH_SIZE + 500
             req_dt, resp_dt = _fast_dtypes()
             rec = np.empty(n, req_dt)
@@ -193,12 +230,7 @@ def test_fast_frame_chunks_oversized_batches():
             rec["limit"] = np.arange(n, dtype=np.int64)
             rec["duration"] = 1000
             rec["algo"] = 0
-            payload = rec.tobytes()
-            writer.write(
-                struct.pack("<II", MAGIC_FAST_REQ, n)
-                + struct.pack("<I", len(payload))
-                + payload
-            )
+            writer.write(_fast_frame(rec, rhash))
             await writer.drain()
             magic, rn = struct.unpack("<II", await reader.readexactly(8))
             assert magic == MAGIC_FAST_RESP and rn == n
@@ -215,55 +247,89 @@ def test_fast_frame_chunks_oversized_batches():
     assert (out["remaining"] == np.arange(MAX_BATCH_SIZE + 500)).all()
 
 
-def test_fast_path_disabled_when_membership_grows():
-    """The GEB4 fast path bypasses ring routing, so LIVE membership
-    (picker.peers(), which discovery updates via set_peers) must gate
-    it — not static config. With >1 peers the hello advertises slow
-    path, and a GEB4 frame sent anyway is refused (connection closed),
-    never silently decided locally (r4 review: ~Nx over-admission)."""
-    import numpy as np
-
-    from gubernator_tpu.serve.edge_bridge import (
-        MAGIC_FAST_REQ,
-        MAGIC_HELLO,
-        _fast_dtypes,
-    )
-
-    class FakeBackend:
-        decide_submit_arrays = object()
-        decide_submit = object()
+def test_multinode_hello_carries_ring_and_bridge_endpoints():
+    """With >1 peers and a TCP listener configured, the hello must
+    advertise the fast path plus every node's bridge endpoint (peer
+    gRPC host + this node's TCP port — the symmetric-fleet convention),
+    with an empty endpoint for self (the edge uses its --backend)."""
 
     class FakePicker:
         def peers(self):
-            return ["self", "other"]  # grown cluster
+            return [
+                FakePeer("10.0.0.2:81"),
+                FakePeer("10.0.0.1:81", is_owner=True),
+            ]
 
     class FakeInstance:
-        backend = FakeBackend()
+        backend = _FakeBackendArrays()
         picker = FakePicker()
 
     async def run():
-        path = "/tmp/guber-bridge-fast-multinode.sock"
+        path = "/tmp/guber-bridge-ring-hello.sock"
+        bridge = EdgeBridge(FakeInstance(), path)
+        await bridge.start()
+        # set after start: only the hello's endpoint derivation reads
+        # it here; the real TCP listener is covered by the cluster e2e
+        # (tests/test_edge_cluster.py)
+        bridge.tcp_address = "0.0.0.0:9470"
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            flags, rhash, nodes = await _read_hello(reader)
+            writer.close()
+            return flags, rhash, nodes
+        finally:
+            await bridge.stop()
+
+    flags, rhash, nodes = asyncio.run(run())
+    assert flags == 1  # fast path stays on in a cluster (r5)
+    assert rhash == ring_fingerprint(["10.0.0.1:81", "10.0.0.2:81"])
+    # sorted by gRPC address; self has no bridge endpoint, the peer's is
+    # derived from its host + our TCP port
+    assert nodes == [
+        (True, "10.0.0.1:81", ""),
+        (False, "10.0.0.2:81", "10.0.0.2:9470"),
+    ]
+
+
+def test_stale_ring_fast_frame_refused_with_gebr():
+    """A GEB6 frame whose ring fingerprint does not match the live
+    membership must be answered with GEBR and the connection closed —
+    deciding it locally could admit keys this node no longer owns
+    (the r5 replacement for r4's fast-path-off-in-clusters gate)."""
+    import numpy as np
+
+    from gubernator_tpu.serve.edge_bridge import MAGIC_STALE, _fast_dtypes
+
+    class FakePicker:
+        def peers(self):
+            return [
+                FakePeer("10.0.0.1:81", is_owner=True),
+                FakePeer("10.0.0.2:81"),
+            ]
+
+    class FakeInstance:
+        backend = _FakeBackendArrays()
+        picker = FakePicker()
+        traffic = _FakeTraffic()
+
+    async def run():
+        path = "/tmp/guber-bridge-stale-ring.sock"
         bridge = EdgeBridge(FakeInstance(), path)
         await bridge.start()
         try:
             reader, writer = await asyncio.open_unix_connection(path)
-            hmagic, flags = struct.unpack(
-                "<II", await reader.readexactly(8)
-            )
-            assert hmagic == MAGIC_HELLO and flags == 0
-            # a (buggy or stale) edge sends GEB4 anyway: refused loudly
+            flags, rhash, _nodes = await _read_hello(reader)
+            assert flags == 1
             req_dt, _ = _fast_dtypes()
             rec = np.zeros(2, req_dt)
             rec["key_hash"] = [1, 2]
-            payload = rec.tobytes()
-            writer.write(
-                struct.pack("<II", MAGIC_FAST_REQ, 2)
-                + struct.pack("<I", len(payload))
-                + payload
-            )
+            stale = (rhash + 1) & 0xFFFFFFFF
+            writer.write(_fast_frame(rec, stale))
             await writer.drain()
+            magic, n = struct.unpack("<II", await reader.readexactly(8))
+            assert magic == MAGIC_STALE and n == 0
             got = await reader.read(8)
-            assert got == b"", got  # connection closed, no response
+            assert got == b"", got  # bridge closed after GEBR
             writer.close()
         finally:
             await bridge.stop()
