@@ -1,6 +1,19 @@
 """Shared test helpers."""
 
+import os
+import pathlib
 import socket
+
+
+def edge_binary() -> "pathlib.Path":
+    """Path to the guber-edge binary the edge suites drive. Overridable
+    via GUBER_EDGE_BIN so the same suites can run against the
+    ASan/UBSan build (tests/test_edge_asan.py)."""
+    override = os.environ.get("GUBER_EDGE_BIN")
+    if override:
+        return pathlib.Path(override)
+    root = pathlib.Path(__file__).resolve().parent.parent
+    return root / "gubernator_tpu" / "native" / "edge" / "guber-edge"
 
 
 def free_ports(n):
@@ -40,7 +53,7 @@ def spawn_daemon_edge(
     import pytest
 
     root = pathlib.Path(__file__).resolve().parent.parent
-    edge_bin = root / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+    edge_bin = edge_binary()
     try:
         os.unlink(sock_path)
     except FileNotFoundError:

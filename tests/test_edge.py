@@ -17,7 +17,9 @@ import urllib.request
 import pytest
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+from tests._util import edge_binary
+
+EDGE_BIN = edge_binary()
 
 pytestmark = pytest.mark.skipif(
     not EDGE_BIN.exists(),

@@ -1,0 +1,103 @@
+"""Edge fuzz + e2e suites under AddressSanitizer/UBSan.
+
+The edge hand-rolls parsers for everything a client controls (HTTP/1.1
+headers, JSON bodies, HTTP/2 frames, HPACK dynamic tables + Huffman,
+protobuf) — the exact surfaces where a heap overflow that happens not
+to crash is invisible to functional tests. The reference gets memory
+safety for free from Go (its front end cannot heap-overflow); this
+tier earns it by running the SAME fuzz corpora and e2e drives against
+a `-fsanitize=address,undefined -fno-sanitize-recover=all` build: any
+OOB/UB aborts the edge, which the inner suites detect as a dead
+process.
+
+Build: `make -C gubernator_tpu/native/edge asan` (done here if the
+binary is missing or stale). The inner pytest run reuses the real
+suites via GUBER_EDGE_BIN (tests/_util.edge_binary), so sanitizer
+coverage tracks the corpora as they grow instead of forking them.
+"""
+
+import os
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_DIR = ROOT / "gubernator_tpu" / "native" / "edge"
+ASAN_BIN = EDGE_DIR / "guber-edge-asan"
+
+# the sanitized run re-executes whole suites; keep it in one module-
+# scoped build + two inner pytest invocations
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain"
+)
+
+
+@pytest.fixture(scope="module")
+def asan_bin():
+    build = subprocess.run(
+        ["make", "-C", str(EDGE_DIR), "asan"],
+        capture_output=True,
+        text=True,
+    )
+    if build.returncode != 0:
+        pytest.skip(f"asan build unavailable:\n{build.stderr[-2000:]}")
+    assert ASAN_BIN.exists()
+    return ASAN_BIN
+
+
+def _run_suites_under_asan(asan_bin, modules):
+    env = dict(
+        os.environ,
+        GUBER_EDGE_BIN=str(asan_bin),
+        # abort (not exit) on any report so the driving suite sees a
+        # dead edge; leak checking is off — the edge's shutdown path is
+        # _exit/SIGKILL by design, and LSan would flag the still-live
+        # detached-lane allocations as leaks on every teardown
+        ASAN_OPTIONS="abort_on_error=1:detect_leaks=0",
+        UBSAN_OPTIONS="abort_on_error=1:print_stacktrace=1",
+    )
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytest", "-x", "-q", *modules],
+        cwd=ROOT,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=900,
+    )
+    assert proc.returncode == 0, (
+        f"suites failed under ASan/UBSan:\n{proc.stdout[-4000:]}\n"
+        f"{proc.stderr[-2000:]}"
+    )
+    return proc.stdout
+
+
+def test_fuzz_corpora_clean_under_asan(asan_bin):
+    """Both fuzz suites (HTTP/JSON and gRPC/h2/HPACK) drive the
+    sanitized binary: garbage frames, truncated bodies, malformed
+    Huffman, oversized fields — all must parse or fail WITHOUT a
+    single OOB/UB report."""
+    out = _run_suites_under_asan(
+        asan_bin,
+        ["tests/test_edge_fuzz.py", "tests/test_edge_grpc_fuzz.py"],
+    )
+    assert " passed" in out
+
+
+def test_e2e_doors_clean_under_asan(asan_bin):
+    """The functional doors (HTTP + gRPC termination, fast path,
+    cluster routing) under the sanitized build: exercises the
+    steady-state codepaths the fuzzers skip (HPACK dynamic-table
+    reuse across requests, GEB6 framing, ring routing)."""
+    out = _run_suites_under_asan(
+        asan_bin,
+        [
+            "tests/test_edge.py",
+            "tests/test_edge_grpc.py",
+            "tests/test_edge_cluster.py",
+            "tests/test_edge_ring_change.py",
+        ],
+    )
+    assert " passed" in out

@@ -43,7 +43,9 @@ from gubernator_tpu.api.grpc_glue import V1Stub
 from gubernator_tpu.api.proto.gen import gubernator_pb2
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+from tests._util import edge_binary
+
+EDGE_BIN = edge_binary()
 
 pytestmark = pytest.mark.skipif(
     not EDGE_BIN.exists(),

@@ -30,7 +30,9 @@ import pytest
 from gubernator_tpu.serve.edge_bridge import EdgeBridge
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+from tests._util import edge_binary
+
+EDGE_BIN = edge_binary()
 
 pytestmark = pytest.mark.skipif(
     not EDGE_BIN.exists(),

@@ -26,7 +26,9 @@ from gubernator_tpu.cluster import LocalCluster
 from gubernator_tpu.serve.backends import ExactBackend
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
-EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+from tests._util import edge_binary
+
+EDGE_BIN = edge_binary()
 SOCK = "/tmp/guber-functional-edge.sock"
 EDGE_PORT = 19283
 ADDRS = [f"127.0.0.1:{p}" for p in range(9820, 9823)]
