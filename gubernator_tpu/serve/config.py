@@ -77,6 +77,11 @@ class ServerConfig:
     # (different ports per node, e.g. a localhost test cluster) or run
     # heterogeneous port layouts.
     edge_peer_bridges: str = ""
+    # Kill switch (GUBER_EDGE_FAST=0): stop advertising the pre-hashed
+    # fast path; every edge item rides the string path through the full
+    # instance. Operational fallback, and the slow-path denominator in
+    # scripts/bench_edge_cluster.py.
+    edge_fast: bool = True
 
     # multi-host mesh (GUBER_DIST_*): one jax.distributed program over
     # several hosts; process 0 serves (backend=multihost), others run the
@@ -218,6 +223,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         edge_socket=_get(env, "GUBER_EDGE_SOCKET"),
         edge_tcp=_get(env, "GUBER_EDGE_TCP"),
         edge_peer_bridges=_get(env, "GUBER_EDGE_PEER_BRIDGES"),
+        edge_fast=_get(env, "GUBER_EDGE_FAST", "1").lower()
+        not in ("0", "false", "no", "off"),
         dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),
         dist_num_processes=_get_int(env, "GUBER_DIST_NUM_PROCESSES", 1),
         dist_process_id=_get_int(env, "GUBER_DIST_PROCESS_ID", 0),

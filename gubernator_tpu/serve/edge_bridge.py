@@ -221,10 +221,12 @@ class EdgeBridge:
         path: str,
         tcp_address: str = "",
         peer_bridges: Optional[dict] = None,
+        fast_enabled: bool = True,
     ):
         self.instance = instance
         self.path = path
         self.tcp_address = tcp_address
+        self.fast_enabled = fast_enabled
         # explicit grpc_addr -> bridge_addr overrides (config
         # GUBER_EDGE_PEER_BRIDGES); falls back to the symmetric-fleet
         # port convention for unlisted peers
@@ -263,7 +265,8 @@ class EdgeBridge:
         over-admitted by a stale edge."""
         backend = getattr(self.instance, "backend", None)
         return (
-            getattr(backend, "decide_submit_arrays", None) is not None
+            self.fast_enabled
+            and getattr(backend, "decide_submit_arrays", None) is not None
             and getattr(backend, "decide_submit", None) is not None
         )
 

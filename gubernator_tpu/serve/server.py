@@ -242,6 +242,7 @@ class Server:
                 self.conf.edge_socket,
                 tcp_address=self.conf.edge_tcp,
                 peer_bridges=peer_bridges,
+                fast_enabled=self.conf.edge_fast,
             )
             await self._edge.start()
 

@@ -1,0 +1,243 @@
+"""Cluster-edge serving benchmark: the compiled door in front of 1 vs 3
+nodes, fast (per-owner GEB6 frames) vs slow (GEB1 + instance-side gRPC
+forwarding, the pre-r5 cluster behavior).
+
+What this measures: the SERVING STACK (edge parse + ring routing +
+frame protocol + bridge + batcher), not the device — every daemon runs
+the single-chip tpu backend on CPU (GUBER_JAX_PLATFORM=cpu), and all
+processes share this host's cores, so the 3-node rows carry the full
+cluster's CPU cost on one machine. The honest claims this artifact
+backs:
+
+- the edge fast path now SURVIVES cluster mode (r4's hard-disabled
+  itself at >1 nodes; the slow rows show what that cost);
+- per-owner routing in C++ beats funnelling every remote-owned item
+  through one node's Python instance + gRPC forwarding.
+
+Load shape: 16 client threads, 1000-item batches of distinct keys
+through the edge's gRPC door (the saturation shape of
+cli/bench_serving.py edge_grpc_batched_concurrent).
+
+Writes one JSON document (stdout or --out). Rows:
+  edge_{1,3}node_{fast,slow} -> decisions/s, p50/p99 batch latency.
+"""
+
+import argparse
+import json
+import os
+import pathlib
+import statistics
+import subprocess
+import sys
+import threading
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from gubernator_tpu.api.grpc_glue import V1Stub  # noqa: E402
+from gubernator_tpu.api.proto.gen import gubernator_pb2  # noqa: E402
+
+EDGE_BIN = ROOT / "gubernator_tpu" / "native" / "edge" / "guber-edge"
+BASE = 21100
+
+
+def spawn_cluster(n_nodes, fast):
+    grpc_addrs = [f"127.0.0.1:{BASE + i}" for i in range(n_nodes)]
+    socks = [f"/tmp/guber-bench-ec-{i}.sock" for i in range(n_nodes)]
+    bridges = ",".join(
+        f"{grpc_addrs[i]}=127.0.0.1:{BASE + 20 + i}"
+        for i in range(n_nodes)
+    )
+    daemons = []
+    for i in range(n_nodes):
+        try:
+            os.unlink(socks[i])
+        except FileNotFoundError:
+            pass
+        env = dict(
+            os.environ,
+            PYTHONPATH=str(ROOT),
+            GUBER_BACKEND="tpu",
+            GUBER_JAX_PLATFORM="cpu",
+            GUBER_GRPC_ADDRESS=grpc_addrs[i],
+            GUBER_HTTP_ADDRESS=f"127.0.0.1:{BASE + 10 + i}",
+            GUBER_ADVERTISE_ADDRESS=grpc_addrs[i],
+            GUBER_PEERS=",".join(grpc_addrs),
+            GUBER_EDGE_SOCKET=socks[i],
+            GUBER_EDGE_TCP=f"127.0.0.1:{BASE + 20 + i}",
+            GUBER_EDGE_PEER_BRIDGES=bridges,
+            GUBER_EDGE_FAST="1" if fast else "0",
+            JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
+        )
+        daemons.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+                cwd=ROOT,
+                env=env,
+            )
+        )
+    deadline = time.monotonic() + 300
+    for i, d in enumerate(daemons):
+        while not os.path.exists(socks[i]):
+            if d.poll() is not None or time.monotonic() > deadline:
+                for x in daemons:
+                    x.kill()
+                raise RuntimeError(f"daemon {i} failed to boot")
+            time.sleep(0.2)
+    return daemons, socks
+
+
+def spawn_edge(sock0, grpc_port):
+    edge = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(BASE + 40),
+         "--grpc-listen", str(grpc_port), "--backend", sock0],
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    import socket as sl
+
+    deadline = time.monotonic() + 10
+    while True:
+        try:
+            sl.create_connection(("127.0.0.1", grpc_port), timeout=1).close()
+            return edge
+        except OSError:
+            if edge.poll() is not None or time.monotonic() > deadline:
+                edge.kill()
+                raise RuntimeError("edge failed to start")
+            time.sleep(0.05)
+
+
+def measure(grpc_port, seconds, workers=16, batch_items=1000):
+    import grpc as grpclib
+
+    req = gubernator_pb2.GetRateLimitsReq(
+        requests=[
+            gubernator_pb2.RateLimitReq(
+                name="bec", unique_key=f"k{i}", hits=1,
+                limit=1_000_000_000, duration=60_000,
+            )
+            for i in range(batch_items)
+        ]
+    )
+    stubs = [
+        V1Stub(grpclib.insecure_channel(f"127.0.0.1:{grpc_port}"))
+        for _ in range(workers)
+    ]
+    # warmup (compile rungs are prebuilt at daemon boot; this warms
+    # channels + fetch pipeline)
+    for s in stubs:
+        s.GetRateLimits(req)
+
+    stop = time.monotonic() + seconds
+    counts = [0] * workers
+    lats = [[] for _ in range(workers)]
+    errs = [0] * workers
+
+    def worker(w):
+        stub = stubs[w]
+        while time.monotonic() < stop:
+            t0 = time.perf_counter()
+            resp = stub.GetRateLimits(req)
+            lats[w].append(time.perf_counter() - t0)
+            if any(r.error for r in resp.responses):
+                errs[w] += 1
+            counts[w] += 1
+
+    threads = [
+        threading.Thread(target=worker, args=(w,)) for w in range(workers)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    all_lats = sorted(x for per_w in lats for x in per_w)
+    n_batches = sum(counts)
+
+    def pct(p):
+        return round(
+            all_lats[min(len(all_lats) - 1, int(p * len(all_lats)))] * 1e3,
+            2,
+        )
+
+    return dict(
+        batches=n_batches,
+        decisions_per_sec=round(n_batches * batch_items / wall, 1),
+        p50_ms=pct(0.50),
+        p99_ms=pct(0.99),
+        error_batches=sum(errs),
+        wall_s=round(wall, 2),
+    )
+
+
+def run_config(n_nodes, fast, seconds):
+    daemons, socks = spawn_cluster(n_nodes, fast)
+    edge = spawn_edge(socks[0], BASE + 41)
+    try:
+        time.sleep(1.0)  # let lanes handshake
+        return measure(BASE + 41, seconds)
+    finally:
+        edge.kill()
+        for d in daemons:
+            d.terminate()
+        for d in daemons:
+            try:
+                d.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                d.kill()
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=8.0)
+    ap.add_argument("--out", default="")
+    args = ap.parse_args()
+
+    if not EDGE_BIN.exists():
+        print("edge binary not built", file=sys.stderr)
+        return 1
+
+    rows = {}
+    for n_nodes in (1, 3):
+        for fast in (True, False):
+            name = f"edge_{n_nodes}node_{'fast' if fast else 'slow'}"
+            print(f"running {name}...", file=sys.stderr)
+            rows[name] = run_config(n_nodes, fast, args.seconds)
+            print(f"  {rows[name]}", file=sys.stderr)
+
+    doc = dict(
+        schema="bench_edge_cluster_r5",
+        scope=(
+            "serving stack only: all daemons run the tpu backend on CPU "
+            "and share one host's cores with the edge and the load "
+            "generator; 3-node rows pay the whole cluster's CPU on one "
+            "machine. Load: 16 threads x 1000-item batches through the "
+            "edge gRPC door."
+        ),
+        host_cpus=os.cpu_count(),
+        rows=rows,
+        fast_over_slow_3node=round(
+            rows["edge_3node_fast"]["decisions_per_sec"]
+            / max(rows["edge_3node_slow"]["decisions_per_sec"], 1),
+            2,
+        ),
+        cluster_retention=round(
+            rows["edge_3node_fast"]["decisions_per_sec"]
+            / max(rows["edge_1node_fast"]["decisions_per_sec"], 1),
+            2,
+        ),
+    )
+    out = json.dumps(doc, indent=1)
+    if args.out:
+        pathlib.Path(args.out).write_text(out + "\n")
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,9 +26,9 @@ from gubernator_tpu.api.grpc_glue import V1Stub
 from gubernator_tpu.api.proto.gen import gubernator_pb2
 from tests._util import spawn_daemon_edge
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
 from tests._util import edge_binary
 
+ROOT = pathlib.Path(__file__).resolve().parent.parent
 EDGE_BIN = edge_binary()
 
 pytestmark = pytest.mark.skipif(

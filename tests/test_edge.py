@@ -16,9 +16,9 @@ import urllib.request
 
 import pytest
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
 from tests._util import edge_binary
 
+ROOT = pathlib.Path(__file__).resolve().parent.parent
 EDGE_BIN = edge_binary()
 
 pytestmark = pytest.mark.skipif(

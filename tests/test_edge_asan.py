@@ -43,7 +43,10 @@ def asan_bin():
         text=True,
     )
     if build.returncode != 0:
-        pytest.skip(f"asan build unavailable:\n{build.stderr[-2000:]}")
+        # toolchain presence is already guaranteed by the module skipif;
+        # with g++ available, a build break under ASANFLAGS must FAIL —
+        # a skip here would silently remove all sanitizer coverage
+        pytest.fail(f"asan build failed:\n{build.stderr[-2000:]}")
     assert ASAN_BIN.exists()
     return ASAN_BIN
 

@@ -23,7 +23,6 @@ import pytest
 from gubernator_tpu.api.types import RateLimitResp, Status
 from gubernator_tpu.serve.edge_bridge import EdgeBridge
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
 from tests._util import edge_binary
 
 EDGE_BIN = edge_binary()

@@ -12,7 +12,6 @@ Skipped when the edge binary is not built.
 """
 
 import json
-import pathlib
 import urllib.request
 
 import grpc
@@ -21,7 +20,6 @@ import pytest
 from gubernator_tpu.api.grpc_glue import PeersV1Stub, V1Stub
 from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
 from tests._util import edge_binary
 
 EDGE_BIN = edge_binary()

@@ -25,7 +25,6 @@ from gubernator_tpu.api.grpc_glue import V1Stub
 from gubernator_tpu.api.types import RateLimitResp, Status
 from gubernator_tpu.serve.edge_bridge import EdgeBridge
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
 from tests._util import edge_binary
 
 EDGE_BIN = edge_binary()

@@ -18,7 +18,6 @@ swapped mid-run:
 
 import asyncio
 import json
-import pathlib
 import struct
 import subprocess
 import time
@@ -29,7 +28,6 @@ import pytest
 
 from gubernator_tpu.serve.edge_bridge import EdgeBridge
 
-ROOT = pathlib.Path(__file__).resolve().parent.parent
 from tests._util import edge_binary
 
 EDGE_BIN = edge_binary()
