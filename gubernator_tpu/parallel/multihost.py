@@ -20,10 +20,14 @@ surfaces as a broken pipe and the cluster restarts fresh — the documented
 state-loss contract (reference architecture.md:5-11).
 
 Scaling model (BASELINE config 5, v5e-32 = 4 hosts x 8 chips): each chip
-owns 1/32 of the key space; decisions need one all-reduce over the 32
-shards. On real pods the mesh axis should be ordered so that the
-reduction's intra-host hops ride ICI and only the host-level combine
-crosses DCN — jax device order (process-major) does this by default.
+owns 1/32 of the key space. A multi-process mesh is built 2-D as
+("host", "chip") — process-major device order groups each host's chips —
+and the GLOBAL-sync reduction is HIERARCHICAL (sharded._hier_psum):
+chips combine within a host over ICI first, then one pre-reduced vector
+per host crosses DCN, instead of a flat 32-way all-reduce whose ring
+spans DCN on every leg. Collective structure is asserted from the
+compiled module in tests/test_sharded.py; the multi-process topologies
+in tests/test_multihost.py run it end to end.
 """
 
 from __future__ import annotations

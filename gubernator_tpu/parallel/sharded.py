@@ -102,6 +102,29 @@ def owner_of_np(key_hash: np.ndarray, n_shards: int) -> np.ndarray:
     )
 
 
+def _axis_me(axes: tuple) -> jax.Array:
+    """Flattened shard index under a 1-D ("shard",) or 2-D
+    ("host", "chip") mesh — the 2-D form is host-major, matching the
+    process-major device order the mesh is built with, so owner_of's
+    `mod n_shards` placement is identical under both layouts."""
+    me = jax.lax.axis_index(axes[0])
+    for ax in axes[1:]:
+        me = me * jax.lax.psum(1, ax) + jax.lax.axis_index(ax)
+    return me
+
+
+def _hier_psum(x: jax.Array, axes: tuple) -> jax.Array:
+    """Hierarchical all-reduce (BASELINE config 5): innermost axis
+    first. On a multi-slice mesh with axes ("host", "chip") this stages
+    the reduction — chips within a host combine over ICI, then ONE
+    pre-reduced vector per host crosses DCN — instead of a flat psum
+    whose ring spans DCN on every leg. Mathematically identical to
+    `psum(x, axes)`; the staging is the point."""
+    for ax in reversed(axes):
+        x = jax.lax.psum(x, ax)
+    return x
+
+
 def _local_decide(store: Store, req: BatchRequest, groups, now):
     """Per-device body under shard_map: store AND batch are this device's
     shards. The host routed every request row to its owner chip
@@ -120,13 +143,23 @@ def _local_decide(store: Store, req: BatchRequest, groups, now):
     return jax.tree.map(lambda x: x[None], new_store_shard), packed[None]
 
 
-def _local_decide_gathered(store: Store, req: BatchRequest, groups, now):
+def _local_decide_gathered(store: Store, req: BatchRequest, groups, now,
+                           axes=("shard",)):
     """_local_decide + one all_gather of the packed response rows: when
     the mesh spans processes the serving host cannot fetch follower
     shards directly, so the responses ride the compiled collective path
-    (ICI within a host, DCN between hosts) and come out replicated."""
+    (ICI within a host, DCN between hosts) and come out replicated. On
+    the 2-D mesh the gather names both axes host-major, so the gathered
+    row order equals the flattened shard order."""
     store, packed = _local_decide(store, req, groups, now)
-    return store, jax.lax.all_gather(packed[0], "shard")
+    out = packed[0]
+    if len(axes) == 1:
+        return store, jax.lax.all_gather(out, axes[0])
+    # gather chips within a host over ICI first, then hosts over DCN,
+    # then flatten [host, chip, ...] -> [shard, ...]
+    out = jax.lax.all_gather(out, axes[-1])
+    out = jax.lax.all_gather(out, axes[0])
+    return store, out.reshape((-1,) + out.shape[2:])
 
 
 def _np_presort_sharded(
@@ -408,9 +441,12 @@ def _shard_sync_globals(
     valid: jax.Array,
     now,
     n_shards: int,
+    axes: tuple = ("shard",),
 ):
-    """Owner peeks authoritative status; psum replicates; others upsert."""
-    me = jax.lax.axis_index("shard")
+    """Owner peeks authoritative status; psum replicates; others upsert.
+    On a 2-D ("host", "chip") mesh the replication is the hierarchical
+    ICI-then-DCN reduction of BASELINE config 5 (see _hier_psum)."""
+    me = _axis_me(axes)
     store = jax.tree.map(lambda x: x[0], store)
     mine = owner_of(key_hash, n_shards) == me
 
@@ -429,7 +465,7 @@ def _shard_sync_globals(
     mask = mine & valid
 
     def combine(x):
-        return jax.lax.psum(jnp.where(mask, x, 0), "shard")
+        return _hier_psum(jnp.where(mask, x, 0), axes)
 
     status = combine(resp.status)
     r_limit = combine(resp.limit)
@@ -460,9 +496,10 @@ def _shard_upsert(
     is_over: jax.Array,
     valid: jax.Array,
     n_shards: int,
+    axes: tuple = ("shard",),
 ):
     """Install GLOBAL replica statuses on each key's owning shard."""
-    me = jax.lax.axis_index("shard")
+    me = _axis_me(axes)
     store = jax.tree.map(lambda x: x[0], store)
     mine = owner_of(key_hash, n_shards) == me
     out = upsert_globals(
@@ -484,54 +521,86 @@ class MeshEngine:
         config: StoreConfig = StoreConfig(),
         devices: Optional[Sequence[jax.Device]] = None,
         buckets: Sequence[int] = (64, 256, 1024, 4096),
+        mesh_shape: Optional[Tuple[int, int]] = None,
     ):
         if devices is None:
             devices = jax.devices()
-        self.mesh = Mesh(np.asarray(devices), ("shard",))
         self.n = len(devices)
+        # a single-process mesh host can fetch every response shard
+        # directly; a multi-process mesh must all_gather them (the serving
+        # leader cannot address follower-process shards)
+        procs = {d.process_index for d in devices}
+        span = len(procs) > 1
+        if mesh_shape is None and span and self.n % len(procs) == 0:
+            mesh_shape = (len(procs), self.n // len(procs))
+        if mesh_shape is not None:
+            # 2-D ("host", "chip") mesh: the GLOBAL-sync reduction runs
+            # hierarchically — chips combine within a host over ICI,
+            # then hosts combine over DCN (BASELINE config 5's
+            # "hierarchical psum"). Device order is process-major
+            # (host-major), so the reshape groups each host's chips and
+            # the flattened (host, chip) index equals the 1-D shard
+            # index — placement is layout-independent.
+            n_hosts, per_host = mesh_shape
+            if n_hosts * per_host != self.n:
+                raise ValueError(
+                    f"mesh_shape {mesh_shape} != {self.n} devices"
+                )
+            dev_grid = np.asarray(devices).reshape(n_hosts, per_host)
+            self.mesh = Mesh(dev_grid, ("host", "chip"))
+            self.axes: tuple = ("host", "chip")
+        else:
+            self.mesh = Mesh(np.asarray(devices), ("shard",))
+            self.axes = ("shard",)
         self.config = config
         self.buckets = sorted(buckets)
         self.sub_buckets = sub_batch_ladder(self.buckets)
         self.clock = EpochClock()
         self.stats = EngineStats()
 
-        sharding = NamedSharding(self.mesh, P("shard"))
+        Ps = P(self.axes)  # leading dim over all mesh axes, host-major
+        sharding = NamedSharding(self.mesh, Ps)
         self.store_sharding = sharding
         self.store = self._fresh_store()
 
-        # a single-process mesh host can fetch every response shard
-        # directly; a multi-process mesh must all_gather them (the serving
-        # leader cannot address follower-process shards)
-        span = len({d.process_index for d in devices}) > 1
+        step_fn = (
+            functools.partial(_local_decide_gathered, axes=self.axes)
+            if span
+            else _local_decide
+        )
         self._step = jax.jit(
             jax.shard_map(
-                _local_decide_gathered if span else _local_decide,
+                step_fn,
                 mesh=self.mesh,
-                in_specs=(P("shard"), P("shard"), P("shard"), P()),
-                out_specs=(P("shard"), P() if span else P("shard")),
+                in_specs=(Ps, Ps, Ps, P()),
+                out_specs=(Ps, P() if span else Ps),
                 # the all_gather output IS replicated, but the static
                 # varying-axis check can't prove it — disable just there
                 check_vma=not span,
             ),
             donate_argnums=(0,),
         )
-        sync_fn = functools.partial(_shard_sync_globals, n_shards=self.n)
+        sync_fn = functools.partial(
+            _shard_sync_globals, n_shards=self.n, axes=self.axes
+        )
         self._sync = jax.jit(
             jax.shard_map(
                 sync_fn,
                 mesh=self.mesh,
-                in_specs=(P("shard"), P(), P(), P(), P(), P(), P()),
-                out_specs=(P("shard"), P()),
+                in_specs=(Ps, P(), P(), P(), P(), P(), P()),
+                out_specs=(Ps, P()),
             ),
             donate_argnums=(0,),
         )
-        upsert_fn = functools.partial(_shard_upsert, n_shards=self.n)
+        upsert_fn = functools.partial(
+            _shard_upsert, n_shards=self.n, axes=self.axes
+        )
         self._upsert = jax.jit(
             jax.shard_map(
                 upsert_fn,
                 mesh=self.mesh,
-                in_specs=(P("shard"),) + (P(),) * 6,
-                out_specs=P("shard"),
+                in_specs=(Ps,) + (P(),) * 6,
+                out_specs=Ps,
             ),
             donate_argnums=(0,),
         )

@@ -1,0 +1,88 @@
+"""Per-step cost of the GLOBAL sync collective: flat 1-D mesh vs the
+hierarchical 2-D ("host", "chip") mesh (BASELINE config 5).
+
+On the 8-virtual-device CPU mesh both forms reduce over the same 8
+shards, so this measures that the STAGED reduction costs about the
+same as the flat one where there is no real DCN to save — the win
+appears on true multi-slice hardware, where the staged form sends one
+pre-reduced vector per host across DCN instead of running every
+all-reduce leg over it. The collective structure itself is asserted in
+tests/test_sharded.py::test_hierarchical_sync_stages_collectives.
+
+Run:  XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      python scripts/bench_hier_sync.py
+(keep --steps/--batch modest on few-core hosts: 8 virtual devices in a
+tight loop can starve the CPU collective rendezvous, which aborts the
+process after 40s)
+"""
+
+import argparse
+import os
+import statistics
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from gubernator_tpu.core.store import StoreConfig  # noqa: E402
+from gubernator_tpu.parallel.sharded import MeshEngine  # noqa: E402
+
+
+def time_sync(eng, batch, steps):
+    kh = (np.arange(1, batch + 1) * 2654435761).astype(np.uint64)
+    lim = np.full(batch, 100, np.int64)
+    dur = np.full(batch, 60_000, np.int64)
+    t = 1_700_000_000_000
+    eng.sync_globals(kh, lim, dur, t)  # compile + warm
+    lats = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        eng.sync_globals(kh, lim, dur, t + i)
+        lats.append((time.perf_counter() - t0) * 1e6)
+    lats.sort()
+    return dict(
+        p50_us=round(lats[len(lats) // 2], 1),
+        p99_us=round(lats[int(len(lats) * 0.99)], 1),
+        mean_us=round(statistics.fmean(lats), 1),
+    )
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    # modest defaults: 8 virtual devices time-slice ONE core here, and
+    # a long tight loop can starve the CPU collective rendezvous (40s
+    # abort); 30 steps is enough for a p50 on a noise-floor comparison
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=256)
+    args = ap.parse_args()
+
+    n = len(jax.devices())
+    buckets = (args.batch,)
+    cfg = StoreConfig(rows=8, slots=1 << 12)
+    rows = {}
+    shapes = [("flat_1d", None)]
+    if n % 2 == 0:
+        shapes.append((f"hier_{n//2}x2", (n // 2, 2)))
+    if n % 4 == 0:
+        shapes.append((f"hier_{n//4}x4", (n // 4, 4)))
+    for name, shape in shapes:
+        eng = MeshEngine(cfg, buckets=buckets, mesh_shape=shape)
+        rows[name] = time_sync(eng, args.batch, args.steps)
+        print(name, rows[name], file=sys.stderr)
+    import json
+
+    print(json.dumps(dict(
+        devices=n, batch=args.batch, steps=args.steps, rows=rows
+    )))
+
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)

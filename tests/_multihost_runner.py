@@ -88,6 +88,15 @@ def main():
     n_shards = eng.n
     assert n_shards == len(devs), (n_shards, devs)
 
+    # r5: a multi-process mesh must be the 2-D ("host", "chip") form so
+    # the GLOBAL-sync reduction stages ICI-within-host before DCN
+    # (BASELINE config 5 "hierarchical psum"); structure is asserted
+    # from the compiled module in tests/test_sharded.py
+    assert eng.inner.axes == ("host", "chip"), eng.inner.axes
+    assert dict(eng.inner.mesh.shape) == {"host": nprocs, "chip": per}, (
+        eng.inner.mesh.shape
+    )
+
     from gubernator_tpu.core.hashing import slot_hash_batch
     from gubernator_tpu.parallel.sharded import owner_of_np, pad_request_sharded
 

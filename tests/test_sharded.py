@@ -318,3 +318,91 @@ def test_mesh_wait_uses_submit_time_epoch(mesh_engine):
     # the 12-day jump rebased batch 1's window to expired, so batch 2
     # recreates it at the new now (state-loss-on-jump contract)
     assert int(reset2[0]) == T0 + REBASE_AT + 1000 + 60_000
+
+
+# -- hierarchical (ICI -> DCN) mesh, BASELINE config 5 ---------------------
+
+
+def test_hierarchical_mesh_matches_flat():
+    """A forced 2-D ("host", "chip") mesh must produce decision-for-
+    decision the same results as the flat 8-shard mesh: placement is
+    the flattened host-major index, so only the reduction STRUCTURE
+    changes (staged psum), never the answers."""
+    flat = MeshEngine(StoreConfig(rows=4, slots=1 << 10), buckets=(64,))
+    hier = MeshEngine(
+        StoreConfig(rows=4, slots=1 << 10), buckets=(64,),
+        mesh_shape=(4, 2),
+    )
+    assert hier.axes == ("host", "chip")
+    assert dict(hier.mesh.shape) == {"host": 4, "chip": 2}
+
+    rng = random.Random(11)
+    keys = [f"hier:{i}" for i in range(48)]
+    now = T0
+    for step in range(12):
+        now += rng.choice([0, 5, 250])
+        batch = rng.sample(keys, rng.randint(1, 32))
+        a = dict(
+            key_hash=slot_hash_batch(batch),
+            hits=np.array(
+                [rng.randint(0, 3) for _ in batch], np.int64
+            ),
+            limit=np.full(len(batch), 5, np.int64),
+            duration=np.full(len(batch), 60_000, np.int64),
+            algo=np.array(
+                [rng.randint(0, 1) for _ in batch], np.int32
+            ),
+            gnp=np.zeros(len(batch), bool),
+        )
+        rf = flat.decide_arrays(now=now, **a)
+        rh = hier.decide_arrays(now=now, **a)
+        for f, h in zip(rf, rh):
+            np.testing.assert_array_equal(np.asarray(f), np.asarray(h))
+        if step == 6:
+            kh = a["key_hash"]
+            flat.sync_globals(
+                kh, a["limit"], a["duration"], now, a["algo"]
+            )
+            hier.sync_globals(
+                kh, a["limit"], a["duration"], now, a["algo"]
+            )
+
+
+def test_hierarchical_sync_stages_collectives():
+    """The compiled GLOBAL-sync step on the 2-D mesh must contain the
+    two-level reduction of BASELINE config 5: an intra-host all-reduce
+    (replica groups of chip-axis size, the ICI legs) AND an inter-host
+    all-reduce (groups spanning hosts, the DCN legs) — while the flat
+    mesh compiles a single all-reduce over all 8 shards."""
+    import re
+
+    def sync_hlo(eng):
+        B = 64
+        s = jax.ShapeDtypeStruct
+        return eng._sync.lower(
+            eng.store, s((B,), np.uint64), s((B,), np.int32),
+            s((B,), np.int32), s((B,), np.int32), s((B,), bool),
+            s((), np.int32),
+        ).as_text()
+
+    def groups(txt):
+        return {
+            m.replace(" ", "")
+            for m in re.findall(
+                r'all_reduce"?[^\n]*?dense<(\[\[[^>]*\]\])>', txt
+            )
+        }
+
+    flat = MeshEngine(StoreConfig(rows=4, slots=256), buckets=(64,))
+    g_flat = groups(sync_hlo(flat))
+    assert g_flat == {"[[0,1,2,3,4,5,6,7]]"}, g_flat
+
+    hier = MeshEngine(
+        StoreConfig(rows=4, slots=256), buckets=(64,), mesh_shape=(4, 2)
+    )
+    g_hier = groups(sync_hlo(hier))
+    # intra-host (chip) stage: 4 groups of 2; inter-host stage: 2
+    # groups of 4 — and no flat 8-wide all-reduce anywhere
+    assert "[[0,1],[2,3],[4,5],[6,7]]" in g_hier, g_hier
+    assert "[[0,2,4,6],[1,3,5,7]]" in g_hier, g_hier
+    assert "[[0,1,2,3,4,5,6,7]]" not in g_hier, g_hier
