@@ -1,0 +1,134 @@
+"""deploy/docker-compose.yaml's topology, executed natively.
+
+The compose file (r5) demos the reference's deployment shape: an etcd
+service plus two nodes that discover each other through it (reference
+docker-compose.yaml) via the vendored client. This image has no
+docker, so the compose file itself can't boot here — instead this test
+runs the SAME wiring with real processes: two daemons configured
+exactly like the compose services (GUBER_ETCD_ENDPOINTS, no
+GUBER_PEERS) against a protocol-real etcd (tests/_fake_etcd.py, real
+gRPC + the vendored field-number-exact protos), and proves
+
+- both nodes register and see each other (peerCount == 2 on both);
+- the ring actually works: a request sent to the NON-owner node comes
+  back with metadata.owner naming the other node (forwarded over
+  gRPC), i.e. discovery produced a functioning cluster, not just a
+  list.
+
+When docker IS available, `docker compose up` in deploy/ runs the same
+thing against real etcd; tests/test_etcd_vendored.py additionally runs
+the client cycle against a live etcd when GUBER_TEST_ETCD is set.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+GRPC = [29710, 29711]
+HTTP = [29720, 29721]
+
+
+def _daemon(i, etcd_port):
+    env = dict(
+        os.environ,
+        PYTHONPATH=str(ROOT),
+        GUBER_BACKEND="exact",
+        JAX_PLATFORMS="cpu",
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{GRPC[i]}",
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{HTTP[i]}",
+        GUBER_ADVERTISE_ADDRESS=f"127.0.0.1:{GRPC[i]}",
+        GUBER_ETCD_ENDPOINTS=f"127.0.0.1:{etcd_port}",
+    )
+    env.pop("GUBER_PEERS", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=ROOT,
+        env=env,
+    )
+
+
+def _get(url):
+    return json.loads(urllib.request.urlopen(url, timeout=5).read())
+
+
+def _post(port, body):
+    return json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/GetRateLimits",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=10,
+        ).read()
+    )
+
+
+def test_compose_topology_discovers_and_forwards():
+    from tests._fake_etcd import FakeEtcd
+
+    etcd = FakeEtcd().start()
+    daemons = [_daemon(i, etcd.port) for i in range(2)]
+    try:
+        # both nodes must discover each other through etcd
+        deadline = time.monotonic() + 60
+        counts = {}
+        while time.monotonic() < deadline:
+            for i in range(2):
+                if daemons[i].poll() is not None:
+                    pytest.fail(
+                        f"daemon {i} died:\n{daemons[i].stdout.read()}"
+                    )
+                try:
+                    counts[i] = _get(
+                        f"http://127.0.0.1:{HTTP[i]}/v1/HealthCheck"
+                    )["peerCount"]
+                except OSError:
+                    counts[i] = 0
+            if counts.get(0) == 2 and counts.get(1) == 2:
+                break
+            time.sleep(0.3)
+        assert counts == {0: 2, 1: 2}, counts
+
+        # the discovered ring must FUNCTION: find a key owned by node 1
+        # (response through node 0 carries metadata.owner), then verify
+        # coherence by reading it back through the owner
+        owner_key = None
+        for i in range(64):
+            out = _post(
+                HTTP[0],
+                {"requests": [{"name": "ct", "uniqueKey": f"k{i}",
+                               "hits": 1, "limit": 9,
+                               "duration": 60000}]},
+            )
+            resp = out["responses"][0]
+            assert resp["error"] == "", resp
+            if resp["metadata"].get("owner") == f"127.0.0.1:{GRPC[1]}":
+                owner_key = f"k{i}"
+                break
+        assert owner_key is not None, "no key owned by node 1 in 64 tries"
+        out = _post(
+            HTTP[1],
+            {"requests": [{"name": "ct", "uniqueKey": owner_key,
+                           "hits": 0, "limit": 9, "duration": 60000}]},
+        )
+        # node 1 owns it: local decide, consumed hit visible
+        resp = out["responses"][0]
+        assert resp["remaining"] == "8" and "owner" not in resp["metadata"]
+    finally:
+        for d in daemons:
+            d.terminate()
+        for d in daemons:
+            d.wait(timeout=10)
+        etcd.stop()
