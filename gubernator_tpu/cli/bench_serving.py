@@ -430,7 +430,7 @@ def main(argv=None) -> int:
             )
 
             # and the batched saturation shape through the edge's gRPC
-            # door — on device backends this rides the pre-hashed GEB4
+            # door — on device backends this rides the pre-hashed GEB6
             # array path end-to-end
             batch_1000 = gubernator_pb2.GetRateLimitsReq(
                 requests=[_req(f"k{i}") for i in range(1000)]

@@ -89,7 +89,7 @@ class ExactBackend:
 class _ArrayOps:
     """Array-level decide surface shared by the device backends.
 
-    The serving hot path (edge GEB4 frames, serve/edge_bridge.py) carries
+    The serving hot path (edge GEB6 frames, serve/edge_bridge.py) carries
     pre-hashed dense arrays end-to-end; these helpers are the object<->
     array seam so the batcher can flatten MIXED batches (array groups
     from the edge + request-object groups from gRPC/JSON callers) into

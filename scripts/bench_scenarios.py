@@ -348,12 +348,27 @@ def scenario_mixed_shard():
     return "mixed_100m_keys_v5e32_per_chip_slice", v
 
 
+def scenario_throughput_mode():
+    """The flagship workload (bench.py: mixed token+leaky, 100k zipf
+    keys) at B=131072 — trade batch latency (~3ms windows) for peak
+    sustained throughput. Committed so the README's throughput-mode row
+    traces to an artifact instead of a one-off run (r4 verdict weak #4)."""
+    from gubernator_tpu.core.store import StoreConfig
+
+    v = _measure_kernel(
+        StoreConfig(rows=16, slots=1 << 15), 100_000, "mixed",
+        B=131_072, S=max(1, _scenario_steps() // 8),
+    )
+    return "throughput_mode_100k_keys_b131072_single_chip", v
+
+
 SCENARIOS = {
     1: scenario_token_1k,
     2: scenario_leaky_100k,
     3: scenario_global_mesh,
     4: scenario_zipf_10m,
     5: scenario_mixed_shard,
+    6: scenario_throughput_mode,
 }
 
 
