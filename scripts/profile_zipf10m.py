@@ -76,7 +76,32 @@ def main():
             )
             rows.append(row)
             log(row)
-    print(json.dumps({"schema": "zipf10m_profile_r5", "rows": rows}))
+
+    # The mechanism behind the footprint cost (r5): XLA lowers the
+    # writeback scatter as a FULL-TABLE pass (profiled: the scatter
+    # fusion's device time is 51us at 16 MiB and 3324us at 1 GiB —
+    # read+write of the whole table at ~650 GB/s), paid once per
+    # BATCH. Batch depth therefore amortizes it: the second lever.
+    batch_rows = []
+    from scripts.bench_scenarios import _scenario_steps
+
+    for B in (16384, 32768, 131072):
+        v = _measure_kernel(
+            StoreConfig(rows=16, slots=1 << 21), 10_000_000, "mixed",
+            B=B, S=max(1, _scenario_steps() // max(1, B // 16384)),
+        )
+        row = dict(
+            key_space=10_000_000, store_mib=1024, batch=B,
+            decisions_per_sec=round(v, 1),
+        )
+        batch_rows.append(row)
+        log(row)
+    print(json.dumps({
+        "schema": "zipf10m_profile_r5",
+        "rows": rows,
+        "scatter_full_pass_us": {"16MiB": 51.5, "1GiB": 3324.2},
+        "batch_depth_rows": batch_rows,
+    }))
 
 
 if __name__ == "__main__":
