@@ -2,8 +2,12 @@
 
 The XLA scatter that applies the decide kernel's delta rows costs ~300us
 at B=16k on v5e — ~15x off the HBM bandwidth bound for the 16 MiB it
-actually moves — because XLA lowers scatter as serialized row updates.
-This kernel instead SWEEPS the whole store once per batch:
+actually moves. (r5 device-trace finding: current XLA lowers this
+scatter as a FULL-TABLE pass — 51us at 16 MiB, 3324us at 1 GiB, i.e.
+read+write of the whole table at ~650 GB/s regardless of update count;
+see scripts/profile_zipf10m.py and docs/round5.md, including the
+measured dead end of a sparse pallas alternative.) This kernel instead
+SWEEPS the whole store once per batch:
 
   for each tile of TILE_ROWS bucket rows (grid):  [Mosaic pipelines tiles]
     for each chunk of up to CHUNK update rows whose (sorted) bucket falls

@@ -511,6 +511,7 @@ std::string render_responses(const Decision* d, size_t n) {
 // instead abort in-flight reads everywhere else).
 std::atomic<bool> g_shutdown{false};
 int g_wake_pipe[2] = {-1, -1};
+int g_peer_timeout_s = 30;  // peer-bridge round-trip deadline (see Lane)
 
 void on_term(int) {
   g_shutdown.store(true);
@@ -810,8 +811,9 @@ class Lane {
       // one failed shard — not permanently absorb this worker while
       // Router::execute waits forever and client connections pile up
       // to the max-conns cap. Steady-state decides are milliseconds
-      // (rungs precompile at boot), so 30s is generous.
-      tv.tv_sec = 30;
+      // (rungs precompile at boot), so the default 30s is generous;
+      // --peer-timeout-s tunes it for slower device backends.
+      tv.tv_sec = g_peer_timeout_s;
       tv.tv_usec = 0;
       setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof tv);
     }
@@ -1423,10 +1425,12 @@ static const char kUsage[] =
     "(default /tmp/guber-edge.sock)\n"
     "  --batch-wait-us N      cross-connection batch window (default 500)\n"
     "  --ring-refresh-ms N    cluster ring re-read period (default 1000)\n"
+    "  --peer-timeout-s N     peer-bridge round-trip deadline "
+    "(default 30)\n"
     "  --batch-limit N        max requests per backend frame (default 1000)\n"
     "  --workers N            pipelined backend connections (default 2)\n"
     "  --max-conns N          client connection cap (default 4096)\n"
-    "  --recv-timeout-s N     per-read client timeout (default 30)\n";
+    "  --recv-timeout-s N     per-read client timeout (default 60)\n";
 
 // Strict non-negative integer parse: a typo'd VALUE ("80O0", "abc")
 // must fail loudly, not atoi-truncate into serving the wrong port.
@@ -1481,6 +1485,10 @@ int main(int argc, char** argv) {
     else if (a == "--ring-refresh-ms") {
       ok = parse_int_flag(v, &ring_refresh_ms);
       ring_refresh_ms = std::max(50, ring_refresh_ms);
+    }
+    else if (a == "--peer-timeout-s") {
+      ok = parse_int_flag(v, &g_peer_timeout_s);
+      g_peer_timeout_s = std::max(1, g_peer_timeout_s);
     }
     else if (a == "--batch-limit") ok = parse_int_flag(v, &batch_limit);
     else if (a == "--workers") {
