@@ -1,0 +1,210 @@
+"""Mixed fleet through the cluster edge: array-backend + exact-backend
+nodes in ONE ring.
+
+A rolling migration (or deliberate mixed deployment) puts nodes with
+and without the pre-hashed fast path in the same cluster. The router's
+contract (edge.cc Router::execute): items owned by a fast-capable peer
+ride GEB6 to that peer's bridge; items owned by a non-fast peer fold
+into the string path, where the primary's instance forwards them over
+gRPC — per ITEM, silently, with identical decisions either way.
+
+Topology here: node 0 (edge's primary) and node 1 run the tpu backend
+(fast-capable); node 2 runs the exact backend (no array path — its
+bridge hello advertises slow). Assertions:
+
+- every key decides exactly once with correct remaining, whoever owns
+  it (no errors, no double-admission);
+- node 1 serves fast items (its edge_fast_items_total grows by its
+  exact ownership share) while node 2 serves NONE over the fast path
+  (counter stays 0) yet still owns its share — proven by reading its
+  keys back through node 2 directly;
+- owner metadata appears for remote-owned items regardless of path.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from tests._util import edge_binary
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+EDGE_BIN = edge_binary()
+
+pytestmark = pytest.mark.skipif(
+    not EDGE_BIN.exists(),
+    reason="edge binary not built (make -C gubernator_tpu/native/edge)",
+)
+
+BASE = 19580
+GRPC_ADDRS = [f"127.0.0.1:{BASE + i}" for i in range(3)]
+HTTP_PORTS = [BASE + 10 + i for i in range(3)]
+BRIDGE_PORTS = [BASE + 20 + i for i in range(3)]
+EDGE_HTTP = BASE + 30
+SOCKS = [f"/tmp/guber-edge-mixed-{i}.sock" for i in range(3)]
+BACKENDS = ["tpu", "tpu", "exact"]
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    peers = ",".join(GRPC_ADDRS)
+    bridges = ",".join(
+        f"{GRPC_ADDRS[i]}=127.0.0.1:{BRIDGE_PORTS[i]}" for i in range(3)
+    )
+    daemons = []
+    for i in range(3):
+        try:
+            os.unlink(SOCKS[i])
+        except FileNotFoundError:
+            pass
+        env = dict(
+            os.environ,
+            PYTHONPATH=str(ROOT),
+            GUBER_BACKEND=BACKENDS[i],
+            GUBER_JAX_PLATFORM="cpu",
+            GUBER_STORE_SLOTS=str(1 << 10),
+            GUBER_GRPC_ADDRESS=GRPC_ADDRS[i],
+            GUBER_HTTP_ADDRESS=f"127.0.0.1:{HTTP_PORTS[i]}",
+            GUBER_ADVERTISE_ADDRESS=GRPC_ADDRS[i],
+            GUBER_PEERS=peers,
+            GUBER_EDGE_SOCKET=SOCKS[i],
+            GUBER_EDGE_TCP=f"127.0.0.1:{BRIDGE_PORTS[i]}",
+            GUBER_EDGE_PEER_BRIDGES=bridges,
+            JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
+        )
+        daemons.append(
+            subprocess.Popen(
+                [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+                stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+                text=True, cwd=ROOT, env=env,
+            )
+        )
+    deadline = time.monotonic() + 240
+    for i, d in enumerate(daemons):
+        while not os.path.exists(SOCKS[i]):
+            if d.poll() is not None:
+                for x in daemons:
+                    x.kill()
+                pytest.fail(f"daemon {i} died:\n{d.stdout.read()}")
+            if time.monotonic() > deadline:
+                for x in daemons:
+                    x.kill()
+                pytest.fail(f"daemon {i} boot timeout")
+            time.sleep(0.2)
+    edge = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(EDGE_HTTP), "--backend", SOCKS[0],
+         "--ring-refresh-ms", "200"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    import socket as sl
+
+    deadline = time.monotonic() + 10
+    while True:
+        if edge.poll() is not None:
+            for d in daemons:
+                d.kill()
+            pytest.fail(f"edge died:\n{edge.stdout.read()}")
+        try:
+            sl.create_connection(("127.0.0.1", EDGE_HTTP), timeout=1).close()
+            break
+        except OSError:
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+    # let the edge's peer lanes complete their hellos so fast routing
+    # to node 1 is active before the measured traffic
+    time.sleep(1.0)
+    yield
+    edge.kill()
+    for d in daemons:
+        d.terminate()
+    for d in daemons:
+        d.wait(timeout=10)
+
+
+def _post(port, body):
+    return json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/GetRateLimits",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=30,
+        ).read()
+    )
+
+
+def _metric(node, name):
+    text = urllib.request.urlopen(
+        f"http://127.0.0.1:{HTTP_PORTS[node]}/metrics", timeout=10
+    ).read().decode()
+    for line in text.splitlines():
+        if line.startswith(name + " "):
+            return float(line.split()[1])
+    return 0.0
+
+
+def _owner(name, key):
+    import bisect
+
+    from gubernator_tpu.core.hashing import ring_hash
+
+    points = sorted((ring_hash(a), a) for a in GRPC_ADDRS)
+    keys = [p for p, _ in points]
+    i = bisect.bisect_left(keys, ring_hash(f"{name}_{key}"))
+    return points[i % len(keys)][1] if i < len(keys) else points[0][1]
+
+
+def test_mixed_fleet_decides_once_and_degrades_per_item(fleet):
+    keys = [f"mx-{i}" for i in range(120)]
+    want = {a: [] for a in GRPC_ADDRS}
+    for k in keys:
+        want[_owner("mf", k)].append(k)
+    # the spread must exercise all three nodes for the test to mean
+    # anything (crc32 over 120 keys always does on this ring)
+    assert all(want[a] for a in GRPC_ADDRS), {
+        a: len(v) for a, v in want.items()
+    }
+
+    before_fast = [_metric(i, "edge_fast_items_total") for i in range(3)]
+    out = _post(
+        EDGE_HTTP,
+        {"requests": [
+            {"name": "mf", "uniqueKey": k, "hits": 1, "limit": 9,
+             "duration": 60000}
+            for k in keys
+        ]},
+    )
+    for k, r in zip(keys, out["responses"]):
+        assert r["error"] == "" and r["remaining"] == "8", (k, r)
+        owner = _owner("mf", k)
+        if owner == GRPC_ADDRS[0]:
+            assert "owner" not in r["metadata"], (k, r)
+        else:
+            # remote-owned: owner metadata present whether the item
+            # rode GEB6 (node 1) or the forwarded string path (node 2)
+            assert r["metadata"].get("owner") == owner, (k, r)
+
+    after_fast = [_metric(i, "edge_fast_items_total") for i in range(3)]
+    # node 1 (fast-capable) served its exact share over GEB6
+    assert after_fast[1] - before_fast[1] == len(want[GRPC_ADDRS[1]])
+    # node 2 (exact backend) NEVER sees a pre-hashed frame
+    assert after_fast[2] == before_fast[2] == 0.0
+    # and yet owns its share: read its keys back through it directly
+    out = _post(
+        HTTP_PORTS[2],
+        {"requests": [
+            {"name": "mf", "uniqueKey": k, "hits": 0, "limit": 9,
+             "duration": 60000}
+            for k in want[GRPC_ADDRS[2]][:20]
+        ]},
+    )
+    assert all(
+        r["remaining"] == "8" and "owner" not in r["metadata"]
+        for r in out["responses"]
+    ), out["responses"]
