@@ -97,3 +97,50 @@ def test_buckets_follow_device_batch_limit():
     b = buckets_for_limit(5000)
     assert b == (64, 256, 1024, 4096, 5120)
     assert choose_bucket(sorted(b), 4500) == 5120
+
+
+def test_edge_env_knobs_parse():
+    from gubernator_tpu.serve.config import config_from_env
+
+    conf = config_from_env(
+        {
+            "GUBER_EDGE_TCP": "0.0.0.0:9470",
+            "GUBER_EDGE_PEER_BRIDGES": "10.0.0.2:81=10.0.0.2:9470",
+            "GUBER_EDGE_FAST": "No",
+        }
+    )
+    assert conf.edge_tcp == "0.0.0.0:9470"
+    assert conf.edge_peer_bridges == "10.0.0.2:81=10.0.0.2:9470"
+    # the kill switch accepts the common falsy spellings (0/false/no/
+    # off, any case) — an operator's "No" mid-incident must not
+    # silently leave the fast path on
+    assert conf.edge_fast is False
+    assert config_from_env({}).edge_fast is True
+
+
+def test_malformed_peer_bridges_fails_server_start():
+    """A typo'd GUBER_EDGE_PEER_BRIDGES entry must abort startup with
+    the offending entry named, not silently serve with a broken map."""
+    import asyncio
+
+    import pytest
+
+    from gubernator_tpu.serve.config import config_from_env
+    from gubernator_tpu.serve.server import Server
+
+    conf = config_from_env(
+        {
+            "GUBER_BACKEND": "exact",
+            "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+            "GUBER_HTTP_ADDRESS": "",
+            "GUBER_EDGE_SOCKET": "/tmp/guber-badmap-test.sock",
+            "GUBER_EDGE_PEER_BRIDGES": "10.0.0.2:81-no-equals",
+        }
+    )
+
+    async def run():
+        server = Server(conf)
+        with pytest.raises(ValueError, match="no-equals"):
+            await server.start()
+
+    asyncio.run(run())

@@ -335,3 +335,31 @@ def test_stale_ring_fast_frame_refused_with_gebr():
             await bridge.stop()
 
     asyncio.run(run())
+
+
+def test_fast_kill_switch_unadvertises():
+    """GUBER_EDGE_FAST=0 (EdgeBridge fast_enabled=False) must stop
+    advertising the pre-hashed path in the hello — the operational
+    fallback that forces every edge item through the full instance."""
+
+    class FakePicker:
+        def peers(self):
+            return [FakePeer("127.0.0.1:81", is_owner=True)]
+
+    class FakeInstance:
+        backend = _FakeBackendArrays()
+        picker = FakePicker()
+
+    async def run():
+        path = "/tmp/guber-bridge-killswitch.sock"
+        bridge = EdgeBridge(FakeInstance(), path, fast_enabled=False)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            flags, _rhash, _nodes = await _read_hello(reader)
+            writer.close()
+            return flags
+        finally:
+            await bridge.stop()
+
+    assert asyncio.run(run()) == 0
