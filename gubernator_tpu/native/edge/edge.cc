@@ -1108,6 +1108,26 @@ class Router {
       it.hash = slot_hash(it.name, it.key);
     }
 
+    // Degraded-cluster heuristic: when the ONLY fast destination is
+    // this node and most items fold to the string path anyway (peers
+    // without reachable bridges — e.g. a cluster without
+    // GUBER_EDGE_TCP), splitting buys one small array frame at the
+    // cost of a second backend round-trip per request; measured on the
+    // 6-node no-bridge topology that trade LOSES (~15% door
+    // throughput), so fold the minority self-fast items into the slow
+    // frame and send ONE frame, the pre-r5 shape. Single-node (slow
+    // minority) and real clusters (remote fast shards exist) keep the
+    // split.
+    if (fast_by_node.size() == 1 && !slow.idx.empty()) {
+      auto it = fast_by_node.begin();
+      if (ring->nodes[it->first].self &&
+          it->second.idx.size() < slow.idx.size()) {
+        for (uint32_t i : it->second.idx) slow.idx.push_back(i);
+        std::sort(slow.idx.begin(), slow.idx.end());
+        fast_by_node.clear();
+      }
+    }
+
     int n_shards =
         (slow.idx.empty() ? 0 : 1) + (int)fast_by_node.size();
     {

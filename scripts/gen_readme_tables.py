@@ -4,8 +4,8 @@ Every number in the README's serving/GLOBAL tables must trace to an
 in-tree JSON artifact (r3 verdict weak #1: prose drifted from the
 committed numbers). This script rewrites the blocks between
 `<!-- BEGIN:<name> -->` / `<!-- END:<name> -->` sentinels in README.md
-from BENCH_SERVING_r4.json, BENCH_SERVING_DEVICE_r4.json and
-BENCH_GLOBAL_r4.json, so the tables CANNOT drift: regenerate with
+from the committed r5 artifacts (BENCH_SERVING_r5, _DEVICE_r5,
+_GLOBAL_r5, _SCENARIOS_r5, _EDGE_CLUSTER_r5, _ZIPF10M_PROFILE_r5), so the tables CANNOT drift: regenerate with
 
     python scripts/gen_readme_tables.py        # rewrite README.md
     python scripts/gen_readme_tables.py --check  # CI-style drift check
@@ -63,7 +63,7 @@ def _serving_rows(results, names) -> list:
 
 
 def table_serving_exact() -> str:
-    doc = json.loads((ROOT / "BENCH_SERVING_r4.json").read_text())
+    doc = json.loads((ROOT / "BENCH_SERVING_r5.json").read_text())
     rows = _serving_rows(
         doc["results"],
         [
@@ -82,7 +82,7 @@ def table_serving_exact() -> str:
 
 def table_serving_device() -> str:
     doc = json.loads(
-        (ROOT / "BENCH_SERVING_DEVICE_r4.json").read_text()
+        (ROOT / "BENCH_SERVING_DEVICE_r5.json").read_text()
     )
     lines = []
     for run in doc["runs"]:

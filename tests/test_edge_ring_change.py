@@ -212,3 +212,76 @@ def test_membership_change_refuses_then_reroutes():
             await bridge_b.stop()
 
     asyncio.run(main())
+
+
+def test_degraded_cluster_folds_self_fast_into_slow_frame():
+    """Cluster whose peers have NO reachable bridges (e.g. GUBER_EDGE_TCP
+    unset fleet-wide): most items fold to the string path anyway, and
+    splitting off a minority self-fast frame would cost a second backend
+    round-trip per request for nothing (measured ~15% door throughput on
+    the 6-node exact bench). The router must send ONE string frame:
+    the bridge's fast counter stays 0 while decisions stay correct.
+    Converse guard: the single-NODE ring (self-fast majority, no slow)
+    must still use the fast path."""
+    from tests._util import free_ports
+
+    edge_http, = free_ports(1)
+    sock_a = "/tmp/guber-fold-a.sock"
+    # 3-node ring, peers WITHOUT bridge endpoints: self owns ~1/3
+    nodes = [NODE_A, "10.99.0.3:81", "10.99.0.4:81"]
+
+    async def main():
+        import os
+
+        inst = CountingInstance(NODE_A, nodes)  # no peer_bridges map
+        bridge = EdgeBridge(inst, sock_a)
+        try:
+            os.unlink(sock_a)
+        except FileNotFoundError:
+            pass
+        await bridge.start()
+        edge = subprocess.Popen(
+            [str(EDGE_BIN), "--listen", str(edge_http),
+             "--backend", sock_a, "--ring-refresh-ms", "100",
+             "--batch-wait-us", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        try:
+            deadline = time.monotonic() + 10
+            import socket as sl
+
+            while True:
+                if edge.poll() is not None:
+                    pytest.fail(f"edge died:\n{edge.stdout.read()}")
+                try:
+                    sl.create_connection(
+                        ("127.0.0.1", edge_http), timeout=1
+                    ).close()
+                    break
+                except OSError:
+                    assert time.monotonic() < deadline
+                    await asyncio.sleep(0.05)
+
+            out = await asyncio.to_thread(_post, edge_http, 60, "fold")
+            assert all(
+                r["remaining"] == "6" and not r["error"]
+                for r in out["responses"]
+            ), out["responses"][:3]
+            # ONE string frame served everything: the pre-hashed path
+            # was never used even for self-owned items
+            assert inst.fast_items == 0, inst.fast_items
+
+            # converse: shrink to a 1-node ring -> fast path again
+            inst.picker = FakePicker([(NODE_A, True)])
+            deadline = time.monotonic() + 8
+            while time.monotonic() < deadline and inst.fast_items == 0:
+                out = await asyncio.to_thread(
+                    _post, edge_http, 10, f"f1-{time.monotonic_ns()}"
+                )
+                await asyncio.sleep(0.1)
+            assert inst.fast_items > 0, "fast path never re-engaged"
+        finally:
+            edge.kill()
+            await bridge.stop()
+
+    asyncio.run(main())
