@@ -233,8 +233,15 @@ class EdgeBridge:
         self.peer_bridges = peer_bridges or {}
         self._server: Optional[asyncio.AbstractServer] = None
         self._tcp_server: Optional[asyncio.AbstractServer] = None
+        # live connection writers: stop() must actively close them —
+        # py3.12's Server.wait_closed() waits for HANDLERS to finish,
+        # and a connected-but-idle edge parks its handler in
+        # readexactly forever, wedging daemon shutdown otherwise
+        self._conns: set = set()
+        self._stopping = False
 
     async def start(self) -> None:
+        self._stopping = False
         if self.path:
             self._server = await asyncio.start_unix_server(
                 self._serve_conn, path=self.path
@@ -248,9 +255,19 @@ class EdgeBridge:
             log.info("edge bridge listening on tcp %s", self.tcp_address)
 
     async def stop(self) -> None:
+        # flag first: a handler task accepted just before stop() may not
+        # have RUN yet (so its writer isn't in _conns when the sweep
+        # below looks) — it checks this flag on entry and exits instead
+        # of parking in readexactly under wait_closed
+        self._stopping = True
         for srv in (self._server, self._tcp_server):
             if srv is not None:
                 srv.close()
+        # unblock parked handlers BEFORE wait_closed (see _conns note)
+        for w in list(self._conns):
+            w.close()
+        for srv in (self._server, self._tcp_server):
+            if srv is not None:
                 await srv.wait_closed()
         self._server = None
         self._tcp_server = None
@@ -391,6 +408,10 @@ class EdgeBridge:
         await writer.drain()
 
     async def _serve_conn(self, reader, writer):
+        if self._stopping:
+            writer.close()
+            return
+        self._conns.add(writer)
         try:
             # ring-carrying hello: capability flags + live membership
             # (rebuilt per connection; the edge refreshes by reconnecting)
@@ -453,4 +474,5 @@ class EdgeBridge:
         except Exception:
             log.exception("edge bridge connection error")
         finally:
+            self._conns.discard(writer)
             writer.close()

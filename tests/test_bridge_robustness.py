@@ -1,0 +1,326 @@
+"""Bridge-side robustness: frame-parse fuzz + randomized router shapes.
+
+The r5 TCP listener (GUBER_EDGE_TCP) widens the bridge's exposure from
+"same-host unix socket" to "cluster-internal network port". It is a
+TRUSTED port (like PeersV1 — see serve/edge_bridge.py), but trusted
+must still mean crash-proof: a confused peer, a version-skewed edge,
+or a port scanner must cost one closed connection, never a daemon
+fault or a wedged event loop.
+
+Second half: randomized mixed-shape batches through the REAL edge
+binary against counting fakes — GLOBAL items, empty names/keys, and
+plain items interleaved at random, asserting every item answers
+exactly once with the right value and the right path (string path for
+GLOBAL/invalid, pre-hashed for the rest) across the split/fold router.
+"""
+
+import asyncio
+import json
+import random
+import struct
+import subprocess
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.serve.edge_bridge import EdgeBridge
+from tests._util import edge_binary, free_ports
+
+EDGE_BIN = edge_binary()
+
+
+class _ArrBackend:
+    decide_submit_arrays = object()
+    decide_submit = object()
+
+
+class _Traffic:
+    def observe_hashes(self, h):
+        pass
+
+
+class CountingInstance:
+    def __init__(self, self_host, hosts, peer_map=None):
+        self.backend = _ArrBackend()
+        self.picker = type(
+            "P",
+            (),
+            {
+                "peers": lambda s: [
+                    type("Q", (), {"host": h, "is_owner": h == self_host})()
+                    for h in hosts
+                ]
+            },
+        )()
+        self.fast_items = 0
+        self.slow_items = 0
+        inst = self
+
+        class B:
+            async def decide_arrays(self, fields):
+                n = fields["key_hash"].shape[0]
+                inst.fast_items += n
+                return (
+                    np.zeros(n, np.int64),
+                    fields["limit"],
+                    fields["limit"] - fields["hits"],
+                    np.zeros(n, np.int64),
+                )
+
+        self.batcher = B()
+        self.traffic = _Traffic()
+
+    async def get_rate_limits(self, reqs):
+        from gubernator_tpu.api.types import RateLimitResp, Status
+
+        self.slow_items += len(reqs)
+        out = []
+        for r in reqs:
+            if not r.unique_key:
+                out.append(
+                    RateLimitResp(error="field 'unique_key' cannot be empty")
+                )
+            elif not r.name:
+                out.append(
+                    RateLimitResp(error="field 'namespace' cannot be empty")
+                )
+            else:
+                out.append(
+                    RateLimitResp(
+                        status=Status.UNDER_LIMIT, limit=r.limit,
+                        remaining=r.limit - r.hits, reset_time=1,
+                    )
+                )
+        return out
+
+
+def test_bridge_survives_garbage_on_both_listeners():
+    """Random bytes, truncated frames, oversized counts, and a valid
+    hello-then-garbage sequence against the unix AND TCP listeners:
+    every connection must end closed with the bridge still serving."""
+    (tcp_port,) = free_ports(1)
+    sock = "/tmp/guber-bridge-fuzz.sock"
+
+    async def run():
+        import os
+
+        inst = CountingInstance("10.97.0.1:81", ["10.97.0.1:81"])
+        bridge = EdgeBridge(
+            inst, sock, tcp_address=f"127.0.0.1:{tcp_port}"
+        )
+        try:
+            os.unlink(sock)
+        except FileNotFoundError:
+            pass
+        await bridge.start()
+        rng = random.Random(1234)
+        try:
+            async def connect(kind):
+                if kind == "unix":
+                    return await asyncio.wait_for(
+                        asyncio.open_unix_connection(sock), 5
+                    )
+                return await asyncio.wait_for(
+                    asyncio.open_connection("127.0.0.1", tcp_port), 5
+                )
+
+            for trial in range(40):
+                kind = ("unix", "tcp")[trial % 2]
+                reader, writer = await connect(kind)
+                # consume the hello header so garbage lands mid-protocol
+                await asyncio.wait_for(reader.readexactly(16), 5)
+                shape = trial % 4
+                if shape == 0:  # pure garbage
+                    writer.write(rng.randbytes(rng.randint(1, 200)))
+                elif shape == 1:  # valid magic, absurd counts
+                    writer.write(
+                        struct.pack(
+                            "<II", 0x31424547, rng.randint(1 << 20, 1 << 30)
+                        )
+                        + struct.pack("<I", rng.randint(0, 1 << 16))
+                        + rng.randbytes(64)
+                    )
+                elif shape == 2:  # GEB6 header then truncation
+                    writer.write(
+                        struct.pack("<II", 0x36424547, 8)
+                        + struct.pack("<II", 0, 8 * 33)
+                        + rng.randbytes(rng.randint(0, 100))
+                    )
+                else:  # random magic
+                    writer.write(
+                        struct.pack(
+                            "<II",
+                            rng.getrandbits(32),
+                            rng.getrandbits(16),
+                        )
+                    )
+                try:
+                    writer.write_eof()
+                except (OSError, NotImplementedError):
+                    pass
+                # the bridge must close (or error) this connection
+                try:
+                    data = await asyncio.wait_for(reader.read(-1), 5)
+                except (
+                    asyncio.IncompleteReadError,
+                    ConnectionError,
+                    asyncio.TimeoutError,
+                ):
+                    # a connection the bridge chose to keep open (e.g.
+                    # a frame still waiting for its payload) is fine —
+                    # the bridge's own read path is eof/length bounded;
+                    # just abandon it
+                    data = b""
+                assert len(data) < (1 << 20)
+                writer.close()
+                try:
+                    await asyncio.wait_for(writer.wait_closed(), 5)
+                except (asyncio.TimeoutError, ConnectionError):
+                    pass
+
+            # bridge still serves a well-formed request afterwards
+            from tests.test_edge_bridge import _read_hello
+
+            reader, writer = await connect("tcp")
+            await asyncio.wait_for(_read_hello(reader), 5)
+            name, key = b"fz", b"alive"
+            item = (
+                struct.pack("<H", len(name)) + name
+                + struct.pack("<H", len(key)) + key
+                + struct.pack("<qqqBB", 1, 5, 60000, 0, 0)
+            )
+            writer.write(
+                struct.pack("<II", 0x31424547, 1)
+                + struct.pack("<I", len(item))
+                + item
+            )
+            await writer.drain()
+            magic, n = struct.unpack(
+                "<II", await asyncio.wait_for(reader.readexactly(8), 10)
+            )
+            assert magic == 0x33424547 and n == 1
+            writer.close()
+        finally:
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+pytestmark_edge = pytest.mark.skipif(
+    not EDGE_BIN.exists(), reason="edge binary not built"
+)
+
+
+@pytestmark_edge
+def test_randomized_mixed_shapes_through_router():
+    """300 randomized batches of interleaved plain/GLOBAL/invalid items
+    through the real edge against a 2-node ring (self + one reachable
+    peer bridge): every item answers exactly once with the expected
+    value and the expected path."""
+    edge_http, peer_tcp = free_ports(2)
+    sock_a = "/tmp/guber-router-shapes-a.sock"
+    NODE_A, NODE_B = "10.97.1.1:81", "10.97.1.2:81"
+
+    async def run():
+        import os
+
+        inst_a = CountingInstance(NODE_A, [NODE_A, NODE_B])
+        inst_b = CountingInstance(NODE_B, [NODE_A, NODE_B])
+        bridge_a = EdgeBridge(
+            inst_a, sock_a,
+            peer_bridges={NODE_B: f"127.0.0.1:{peer_tcp}"},
+        )
+        bridge_b = EdgeBridge(
+            inst_b, "", tcp_address=f"127.0.0.1:{peer_tcp}"
+        )
+        try:
+            os.unlink(sock_a)
+        except FileNotFoundError:
+            pass
+        await bridge_a.start()
+        await bridge_b.start()
+        edge = subprocess.Popen(
+            [str(EDGE_BIN), "--listen", str(edge_http),
+             "--backend", sock_a, "--batch-wait-us", "0"],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        rng = random.Random(77)
+        try:
+            import socket as sl
+
+            deadline = time.monotonic() + 10
+            while True:
+                if edge.poll() is not None:
+                    pytest.fail(f"edge died:\n{edge.stdout.read()}")
+                try:
+                    sl.create_connection(
+                        ("127.0.0.1", edge_http), timeout=1
+                    ).close()
+                    break
+                except OSError:
+                    assert time.monotonic() < deadline
+                    await asyncio.sleep(0.05)
+            # let the peer lane handshake so fast routing is active
+            await asyncio.sleep(0.8)
+
+            def call(batch):
+                body = json.dumps({"requests": batch}).encode()
+                return json.loads(
+                    urllib.request.urlopen(
+                        urllib.request.Request(
+                            f"http://127.0.0.1:{edge_http}"
+                            "/v1/GetRateLimits",
+                            data=body,
+                            headers={"Content-Type": "application/json"},
+                        ),
+                        timeout=20,
+                    ).read()
+                )
+
+            for trial in range(300):
+                n = rng.randint(1, 12)
+                batch, kinds = [], []
+                for i in range(n):
+                    k = rng.choice(
+                        ["plain", "plain", "plain", "global",
+                         "nokey", "noname"]
+                    )
+                    kinds.append(k)
+                    item = {
+                        "name": "" if k == "noname" else "rs",
+                        "uniqueKey": ""
+                        if k == "nokey"
+                        else f"t{trial}-{i}",
+                        "hits": 1,
+                        "limit": 9,
+                        "duration": 60000,
+                    }
+                    if k == "global":
+                        item["behavior"] = "GLOBAL"
+                    batch.append(item)
+                out = await asyncio.to_thread(call, batch)
+                assert len(out["responses"]) == n
+                for k, r in zip(kinds, out["responses"]):
+                    if k == "nokey":
+                        assert "unique_key" in r["error"], r
+                    elif k == "noname":
+                        assert "namespace" in r["error"], r
+                    else:
+                        assert r["error"] == "", (k, r)
+                        assert r["remaining"] == "8", (k, r)
+            # both paths actually exercised: fast items landed on both
+            # nodes, and the string path served the GLOBAL/invalid mix
+            assert inst_a.fast_items > 0 and inst_b.fast_items > 0, (
+                inst_a.fast_items, inst_b.fast_items
+            )
+            assert inst_a.slow_items > 0
+            assert inst_b.slow_items == 0  # forwards would need gRPC;
+            # the string path stays on the primary with these fakes
+        finally:
+            edge.kill()
+            await bridge_a.stop()
+            await bridge_b.stop()
+
+    asyncio.run(run())
