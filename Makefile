@@ -1,4 +1,4 @@
-.PHONY: all native proto test bench clean
+.PHONY: all native proto test bench readme readme-check clean
 
 all: native proto
 
@@ -14,6 +14,15 @@ test:
 
 bench:
 	python bench.py
+
+# README perf tables are GENERATED from the committed BENCH_* artifacts;
+# `readme` rewrites them, `readme-check` is the CI drift gate (also run
+# as a tier-1 test, tests/test_readme_tables.py)
+readme:
+	python scripts/gen_readme_tables.py
+
+readme-check:
+	python scripts/gen_readme_tables.py --check
 
 clean:
 	$(MAKE) -C gubernator_tpu/native clean

@@ -42,7 +42,7 @@ def main():
     import jax.numpy as jnp
     from jax import lax
 
-    import gubernator_tpu  # noqa: F401  (enables x64)
+    import gubernator_tpu.core  # noqa: F401  (enables x64)
     from gubernator_tpu.core.kernels import BatchRequest, decide_presorted
     from gubernator_tpu.core.store import (
         StoreConfig,
@@ -53,12 +53,17 @@ def main():
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")
 
-    B = 32768  # requests per batch (reference hard cap is 1000/RPC; the
+    import os
+
+    B = int(os.environ.get("GUBER_DEVICE_BATCH_LIMIT", "32768"))
+    # requests per batch (reference hard cap is 1000/RPC; the
     # device batch coalesces many RPCs, serve/batcher.py). Larger batches
     # amortize the gather/scatter fixed costs: measured 37.5M @ 32k with
     # the b/4 group rung (~0.87ms/batch — inside the serving latency
     # envelope). 32k keeps the flagship number consistent with the p99
-    # < 1ms serving story.
+    # < 1ms serving story; the override rides the SAME env knob the
+    # serving tier uses (GUBER_DEVICE_BATCH_LIMIT), so throughput-mode
+    # configs (e.g. 131072 on a big store) bench at their serving depth.
     R = 8  # distinct pre-staged batches cycled through. The per-step
     # i%R dynamic-slice of the staged [R, B] arrays costs ~145us/batch
     # (measured r3: R=1 runs 716us/batch vs R=8's 861) — kept

@@ -18,16 +18,16 @@ A brand-new implementation of the capabilities of Mailgun's Gubernator
   env config, micro-batched peer forwarding.
 
 Integer time/counter math is int64 end to end (matching the reference's
-wire types), so x64 mode is enabled at import.
+wire types); x64 mode is enabled by `gubernator_tpu.core` (the first
+import of every jax-touching module). This package root is deliberately
+JAX-free so the client seam (`gubernator_tpu.client`, the API types, the
+generated stubs) imports on hosts without JAX installed — the reference
+ships its Python client standalone (reference python/setup.py) and an
+external consumer here gets the same: `import gubernator_tpu.client`
+pulls in grpc + protobuf only (pinned by tests/test_client_nojax.py).
 """
 
-import jax
-
-# Rate-limit math is int64 on the wire (proto int64 hits/limit/duration and
-# unix-millisecond timestamps); enable x64 so device state matches exactly.
-jax.config.update("jax_enable_x64", True)
-
-from gubernator_tpu.api.types import (  # noqa: E402
+from gubernator_tpu.api.types import (
     Algorithm,
     Behavior,
     Status,

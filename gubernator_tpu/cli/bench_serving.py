@@ -153,12 +153,203 @@ async def _attach_edge_bridge(server, sock_path):
     return bridge
 
 
+def run_zipf10m(args) -> int:
+    """BASELINE config 4 through the SHIPPED serving configuration.
+
+    Each depth row boots the serving stack exactly as the daemon does —
+    GUBER_* env knobs -> config_from_env (validation included) ->
+    make_backend (store sized by GUBER_STORE_MIB/GUBER_STORE_TARGET_KEYS,
+    ladder from GUBER_DEVICE_BATCH_LIMIT) -> warmup (the deep rungs
+    compile here, before traffic) -> Instance + DeviceBatcher with
+    GUBER_DEVICE_DEEP_BATCH accumulation — then drives zipfian traffic
+    through the batcher's array door (`decide_arrays`, the same entry the
+    edge bridge's pre-hashed GEB6 frames use) from concurrent callers
+    whose groups the deep-batch collector coalesces to the rung. The
+    emitted rows demonstrate the measured big-store law on the shipped
+    path: at FIXED store footprint, throughput scales with batch depth
+    because the writeback's full-table pass is paid once per batch
+    (docs/round5.md; BENCH_ZIPF10M_PROFILE_r5.json).
+
+    Scoping: on a TPU this is config 4 itself (1 GiB store, 10M keys);
+    on a CPU-only host pass a scaled --store-mib/--keys and the artifact
+    records scope="cpu" — the depth-scaling shape, not the absolute
+    numbers, is the claim.
+    """
+    import asyncio
+    import os
+
+    import numpy as np
+
+    from gubernator_tpu.serve.config import config_from_env
+    from gubernator_tpu.serve.instance import Instance
+    from gubernator_tpu.serve.server import make_backend
+
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", str(_compile_cache_dir().resolve())
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    depths = [int(d) for d in args.depths.split(",") if d.strip()]
+    rng = np.random.default_rng(42)
+    # the r5 sweep's zipf key recipe (scripts/bench_scenarios.py) over
+    # args.keys; pre-hashed like edge GEB6 frames, staged outside the
+    # timed region
+    zipf = rng.zipf(1.2, size=1 << 22) % args.keys
+    pool = (
+        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
+        ^ np.uint64(0xDEADBEEFCAFEF00D)
+    )
+    rows = []
+
+    async def run_depth(conf, depth) -> dict:
+        # a caller group can never exceed the ladder top (the batcher
+        # ships an oversized group alone and choose_bucket would refuse)
+        group = min(args.group, depth)
+        backend = make_backend(conf)
+        print(f"depth {depth}: warmup (ladder compiles)...", file=sys.stderr)
+        t0 = time.monotonic()
+        await asyncio.to_thread(backend.warmup)
+        warm_s = time.monotonic() - t0
+        inst = Instance(conf, backend)
+        inst.start()
+        try:
+            stop_at = time.monotonic() + args.seconds
+            done_rows = 0
+            base_batches = backend.stats()["batches"]
+
+            async def worker(w: int):
+                nonlocal done_rows
+                i = w * 101
+                ones = np.ones(group, np.int64)
+                algo = np.zeros(group, np.int32)
+                while time.monotonic() < stop_at:
+                    off = (i * group) % (pool.shape[0] - group)
+                    i += 1
+                    fields = dict(
+                        key_hash=pool[off : off + group],
+                        hits=ones,
+                        limit=ones * 1000,
+                        duration=ones * 60_000,
+                        algo=algo,
+                    )
+                    await inst.batcher.decide_arrays(fields)
+                    done_rows += group
+            # enough concurrent groups outstanding to keep the submit
+            # gate saturated (deep accumulation engages only then):
+            # ~2 full deep batches of groups, floor 8
+            workers = max(8, 2 * depth // group)
+            t0 = time.monotonic()
+            await asyncio.gather(*[worker(w) for w in range(workers)])
+            elapsed = time.monotonic() - t0
+            batches = backend.stats()["batches"] - base_batches
+            return dict(
+                metric="zipf10m_serving_mode",
+                depth=depth,
+                decisions_per_sec=round(done_rows / elapsed, 1),
+                mean_device_batch=(
+                    round(done_rows / batches, 1) if batches else 0.0
+                ),
+                device_batches=batches,
+                seconds=round(elapsed, 3),
+                warmup_seconds=round(warm_s, 1),
+                workers=workers,
+                group_rows=group,
+            )
+        finally:
+            await inst.stop()
+
+    for depth in depths:
+        env = dict(os.environ)
+        env.update(
+            {
+                "GUBER_BACKEND": args.backend,
+                "GUBER_DEVICE_BATCH_LIMIT": str(depth),
+                "GUBER_DEVICE_DEEP_BATCH": "1",
+                "GUBER_STORE_MIB": str(args.store_mib),
+                "GUBER_STORE_TARGET_KEYS": str(args.keys),
+                "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+            }
+        )
+        env.pop("GUBER_STORE_SLOTS", None)
+        conf = config_from_env(env)  # the shipped knob surface, validated
+        r = asyncio.run(run_depth(conf, depth))
+        print(
+            f"depth {depth:>7}: {r['decisions_per_sec']:>14,.0f} dec/s  "
+            f"(mean device batch {r['mean_device_batch']:,.0f}, "
+            f"{r['device_batches']} batches)",
+            file=sys.stderr,
+        )
+        rows.append(r)
+
+    import jax as _jax
+
+    doc = dict(
+        scenario="zipf10m_throughput_serving_mode",
+        scope=_jax.devices()[0].platform,
+        device=_jax.devices()[0].device_kind,
+        backend=args.backend,
+        store_mib=args.store_mib,
+        key_space=args.keys,
+        served_via=(
+            "config_from_env -> make_backend -> Instance/DeviceBatcher"
+            " (GUBER_DEVICE_DEEP_BATCH=1), array door"
+        ),
+        env_knobs={
+            "GUBER_BACKEND": args.backend,
+            "GUBER_DEVICE_DEEP_BATCH": "1",
+            "GUBER_STORE_MIB": str(args.store_mib),
+            "GUBER_STORE_TARGET_KEYS": str(args.keys),
+            "GUBER_DEVICE_BATCH_LIMIT": "<row depth>",
+            "GUBER_PREP_THREADS": os.environ.get(
+                "GUBER_PREP_THREADS", "<default>"
+            ),
+        },
+        notes=(
+            "depth rows share one fixed store footprint; throughput "
+            "scaling with depth is the big-store writeback-amortization "
+            "law on the shipped serving path (docs/round5.md, "
+            "BENCH_ZIPF10M_PROFILE_r5.json)."
+        ),
+        rows=rows,
+    )
+    if args.json:
+        print(json.dumps(doc))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="serving benchmarks")
     parser.add_argument("--backend", default="exact")
     parser.add_argument("--seconds", type=float, default=3.0)
     parser.add_argument("--nodes", type=int, default=6)
     parser.add_argument("--json", action="store_true")
+    parser.add_argument(
+        "--scenario",
+        default="cluster",
+        choices=["cluster", "zipf10m"],
+        help="cluster = the reference benchmark suite over localhost "
+        "gRPC; zipf10m = BASELINE config 4 through the shipped serving "
+        "config (deep-batch ladder, GUBER_STORE_MIB-sized store)",
+    )
+    parser.add_argument(
+        "--depths",
+        default="4096,16384,32768,131072",
+        help="zipf10m: comma list of GUBER_DEVICE_BATCH_LIMIT rungs",
+    )
+    parser.add_argument(
+        "--keys", type=int, default=10_000_000,
+        help="zipf10m: live-key budget (GUBER_STORE_TARGET_KEYS)",
+    )
+    parser.add_argument(
+        "--store-mib", type=int, default=1024,
+        help="zipf10m: fixed store footprint (GUBER_STORE_MIB)",
+    )
+    parser.add_argument(
+        "--group", type=int, default=4096,
+        help="zipf10m: rows per caller group (edge-frame shape)",
+    )
     parser.add_argument(
         "--edge",
         action="store_true",
@@ -178,6 +369,18 @@ def main(argv=None) -> int:
         import os
 
         os.environ["GUBER_FETCH_DEPTH"] = str(args.fetch_depth)
+    if args.scenario == "zipf10m":
+        if args.backend == "exact":
+            # config 4 is a device scenario (the exact backend decides
+            # inline and cannot deep-batch; config.validate refuses the
+            # combination) — remap the cluster-suite default, loudly
+            print(
+                "zipf10m is a device scenario: using --backend tpu "
+                "(exact cannot deep-batch)",
+                file=sys.stderr,
+            )
+            args.backend = "tpu"
+        return run_zipf10m(args)
 
     backend_factory = None
     if args.backend == "exact":

@@ -76,13 +76,15 @@ def main(argv=None) -> int:
         )
         if conf.dist_process_id != 0:
             from gubernator_tpu.core.engine import buckets_for_limit
-            from gubernator_tpu.core.store import StoreConfig
 
-            # the bucket ladder must match the leader's exactly: warmup
-            # replays every bucket through the step pipe and a follower
-            # missing one would die in choose_bucket mid-lockstep
+            # the bucket ladder AND store geometry must match the
+            # leader's exactly: warmup replays every bucket through the
+            # step pipe and a follower missing one would die in
+            # choose_bucket mid-lockstep; store_config() (not raw
+            # rows/slots) so GUBER_STORE_MIB/TARGET_KEYS auto-sizing
+            # derives the same shape on every process
             eng = MultiHostMeshEngine(
-                StoreConfig(rows=conf.store_rows, slots=conf.store_slots),
+                conf.store_config(),
                 buckets=buckets_for_limit(conf.device_batch_limit),
             )
             eng.follower_loop(conf.dist_step_listen)

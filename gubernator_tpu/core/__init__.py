@@ -1,0 +1,14 @@
+"""Device-side core: slot store, kernels, engine, hashing, sketches.
+
+Rate-limit math is int64 on the wire (proto int64 hits/limit/duration
+and unix-millisecond timestamps); x64 mode is enabled here — the first
+import every jax-touching module goes through — so device state matches
+exactly. The package root deliberately does NOT import jax (the client
+seam: `gubernator_tpu.client` must be importable on hosts without JAX);
+anything that runs kernels imports from this package first and gets the
+flag set before any trace.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

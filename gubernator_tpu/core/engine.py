@@ -57,6 +57,15 @@ from gubernator_tpu.core.store import (
 
 DEFAULT_BUCKETS = (64, 256, 1024, 4096)
 
+# Throughput-mode extension of the ladder: deep rungs for big-store
+# deployments, where the writeback's full-table HBM pass is paid once
+# per batch and only batch depth amortizes it (a 1 GiB store measured
+# 4.28M dec/s at B=16384 vs 20.6M at B=131072 —
+# BENCH_ZIPF10M_PROFILE_r5.json, docs/round5.md). Only rungs below the
+# configured GUBER_DEVICE_BATCH_LIMIT materialize (buckets_for_limit),
+# so default deployments compile nothing extra.
+DEEP_BUCKETS = (16384, 32768, 131072)
+
 
 @functools.partial(jax.jit, donate_argnums=(0,))
 def _decide_packed_jit(store, req, now, groups=None):
@@ -72,8 +81,11 @@ def buckets_for_limit(limit: int) -> tuple:
     to the rungs below it plus one final rung at the limit itself
     (rounded up to a 128-lane multiple): a limit between rungs (e.g.
     5000) caps padding waste at the rounding instead of jumping to the
-    next power-of-four (which would pad 4097-5000-row batches 3.3x)."""
-    base = [b for b in DEFAULT_BUCKETS if b < limit]
+    next power-of-four (which would pad 4097-5000-row batches 3.3x).
+    Limits past the default envelope pick up the DEEP_BUCKETS rungs, so
+    a throughput-mode ladder (limit=131072) keeps intermediate rungs
+    (16384, 32768) instead of padding a 5k-row lull 26x to the top."""
+    base = [b for b in DEFAULT_BUCKETS + DEEP_BUCKETS if b < limit]
     base.append(-(-limit // 128) * 128)
     return tuple(base)
 

@@ -96,6 +96,20 @@ COUNTER_MAX = (1 << 31) - 1
 DENSE_LANES = 128
 SLOTS_PER_DENSE_ROW = DENSE_LANES // LANES  # 16
 
+BYTES_PER_ENTRY = LANES * 4  # one packed int32 entry = 32 bytes of HBM
+
+# Sizing guidance from the measured footprint≍throughput law (r5 sweep,
+# BENCH_ZIPF10M_PROFILE_r5.json): decide cost is a pure function of the
+# table's provisioned HBM footprint — the writeback pass ranges over
+# capacity whether entries are live or not — so capacity should track
+# the live-key budget, not "as much as fits". derive_store_config sizes
+# to the smallest power-of-two capacity keeping load under MAX_LOAD
+# (above ~68% load over-admission becomes measurable, README table);
+# below ~1/OVERSIZE_FACTOR load the extra footprint costs throughput
+# and buys nothing (check_store_budget's boot lint).
+MAX_LOAD = 0.68
+OVERSIZE_FACTOR = 4.0
+
 
 @dataclass(frozen=True)
 class StoreConfig:
@@ -167,6 +181,88 @@ class Store(NamedTuple):
     @property
     def flags(self) -> jax.Array:
         return self.entries[..., L_FLAGS]
+
+
+def store_capacity(config: StoreConfig) -> int:
+    """Total entry capacity (rows x slots)."""
+    return config.rows * config.slots
+
+
+def store_footprint_bytes(config: StoreConfig) -> int:
+    return store_capacity(config) * BYTES_PER_ENTRY
+
+
+def _pow2_at_least(n: int) -> int:
+    return 1 << max(0, (n - 1).bit_length())
+
+
+def derive_store_config(
+    target_keys: int = 0, mib: int = 0, rows: int = 16
+) -> StoreConfig:
+    """Derive store geometry from an operator-level budget.
+
+    Exactly one of `target_keys` / `mib` must be positive:
+
+    - `target_keys`: the SMALLEST power-of-two capacity whose load at
+      the expected live-key count stays under MAX_LOAD — throughput
+      first, because footprint IS the per-batch cost (10M keys derive
+      the 512 MiB shape the r5 sweep measured 1.75x faster than 1 GiB,
+      at load 0.60 — the deliberate eviction-pressure trade).
+    - `mib`: the largest power-of-two slot count whose footprint fits
+      in `mib` MiB — the knob for matching a known HBM budget.
+
+    The derived shape always satisfies StoreConfig's invariants
+    (power-of-two slots, rows*slots % 16 == 0): slots are floored at
+    SLOTS_PER_DENSE_ROW so even rows=1 keeps the dense 128-lane view.
+    """
+    if (target_keys > 0) == (mib > 0):
+        raise ValueError(
+            "derive_store_config needs exactly one of target_keys / mib"
+        )
+    if target_keys > 0:
+        entries = int(target_keys / MAX_LOAD) + 1
+        slots = _pow2_at_least(-(-entries // rows))
+    else:
+        entries = (mib << 20) // BYTES_PER_ENTRY
+        if entries < rows:
+            raise ValueError(
+                f"store budget {mib} MiB holds fewer than one bucket of "
+                f"{rows} ways ({rows * BYTES_PER_ENTRY} bytes)"
+            )
+        slots = 1 << ((entries // rows).bit_length() - 1)
+    slots = max(slots, SLOTS_PER_DENSE_ROW)
+    return StoreConfig(rows=rows, slots=slots)
+
+
+def check_store_budget(config: StoreConfig, target_keys: int) -> str:
+    """Footprint-vs-key-budget lint for boot time. Returns '' when the
+    provisioned shape suits `target_keys` live keys, else a one-line
+    diagnosis (caller decides warn vs fail): oversized tables pay the
+    footprint≍throughput law for nothing; undersized ones over-admit
+    under eviction pressure."""
+    if target_keys <= 0:
+        return ""
+    cap = store_capacity(config)
+    mib = store_footprint_bytes(config) / (1 << 20)
+    if cap > target_keys * OVERSIZE_FACTOR:
+        return (
+            f"store is oversized for the key budget: {cap} entries "
+            f"({mib:.0f} MiB) provisioned for {target_keys} live keys "
+            f"(load {target_keys / cap:.2f}). Decide throughput is a pure "
+            f"function of table footprint (BENCH_ZIPF10M_PROFILE_r5.json); "
+            f"right-size with GUBER_STORE_TARGET_KEYS={target_keys} "
+            f"(~{derive_store_config(target_keys=target_keys, rows=config.rows).slots} slots) "
+            f"or accept the throughput cost explicitly"
+        )
+    if target_keys > cap * MAX_LOAD:
+        return (
+            f"store is undersized for the key budget: {target_keys} live "
+            f"keys against {cap} entries (load {target_keys / cap:.2f} > "
+            f"{MAX_LOAD}) — expect measurable over-admission from "
+            f"eviction pressure; raise GUBER_STORE_TARGET_KEYS sizing or "
+            f"GUBER_STORE_MIB"
+        )
+    return ""
 
 
 def new_store(config: StoreConfig = StoreConfig()) -> Store:

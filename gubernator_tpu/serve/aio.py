@@ -47,6 +47,13 @@ async def pop_with_deadline(queue: "asyncio.Queue", timeout: float):
         raise
 
 
+#: poll period of collect_batch's hold_while phase — how long after the
+#: hold condition clears a deep batch may still sit unflushed. Device
+#: batch periods in deep mode are milliseconds, so 0.2ms of flush slack
+#: is noise there while keeping the idle-transition latency tight.
+HOLD_POLL_S = 0.0002
+
+
 async def collect_batch(
     queue: "asyncio.Queue",
     limit: int,
@@ -54,6 +61,7 @@ async def collect_batch(
     into: list,
     weight=None,
     carry: list = None,
+    hold_while=None,
 ) -> list:
     """Collect one coalesced batch INTO the caller's list (so a cancel
     mid-collect leaves the partial batch visible to the caller's drain
@@ -69,7 +77,20 @@ async def collect_batch(
     so batches never exceed the limit — except a single group bigger
     than the limit, which ships alone (progress over strictness; the
     engine's ladder covers MAX_BATCH_SIZE, the per-RPC cap). Callers
-    passing `weight` must pass `carry` and must drain it on teardown."""
+    passing `weight` must pass `carry` and must drain it on teardown.
+
+    `hold_while` (-> bool) is the deep-accumulation hook: after the
+    drain and straggler phases, keep collecting toward `limit` for as
+    long as the predicate holds. The device batcher passes "the submit
+    gate is saturated" — while every pipeline slot is occupied a flush
+    could not submit anyway, so accumulating costs zero latency and
+    builds the deep batches that amortize per-batch fixed costs (the
+    big-store writeback pass). The predicate is re-polled every
+    HOLD_POLL_S; when it clears (a slot freed — the device is about to
+    go idle) the batch flushes immediately, preserving the submit/wait
+    overlap of host marshalling with device execution. With the
+    predicate never true (default None), behavior is exactly the
+    historical drain + wait semantics."""
     if weight is None:
         weight = lambda _i: 1  # noqa: E731
     total = 0
@@ -91,13 +112,19 @@ async def collect_batch(
         total += w
         return True
 
-    while total < limit:
-        try:
-            item = queue.get_nowait()
-        except asyncio.QueueEmpty:
-            break
-        if not take(item):
-            return into
+    def drain_ready() -> bool:
+        """True while the batch can keep growing from queued items."""
+        while total < limit:
+            try:
+                item = queue.get_nowait()
+            except asyncio.QueueEmpty:
+                return True
+            if not take(item):
+                return False
+        return False
+
+    if not drain_ready():
+        return into
     if wait > 0:
         loop = asyncio.get_running_loop()
         deadline = loop.time() + wait
@@ -110,4 +137,10 @@ async def collect_batch(
                 break
             if not take(item):
                 return into
+    while (
+        total < limit and hold_while is not None and hold_while()
+    ):
+        item = await pop_with_deadline(queue, HOLD_POLL_S)
+        if item is not None and not take(item):
+            return into
     return into

@@ -27,6 +27,13 @@ rather than at 1/RTT. The native prep's reusable buffer ring is sized to
 depth+1 generations at construction (hashlib_native.set_prep_generations)
 so no in-flight batch's host arrays are ever overwritten by a later
 submit.
+
+Deep-batch mode (GUBER_DEVICE_DEEP_BATCH, serve/config.py) additionally
+accumulates toward batch_limit while every pipeline slot is occupied — a
+flush could not submit anyway — building the deep batches that amortize
+per-batch fixed device costs (the big-store full-table writeback pass).
+Idle flush semantics are unchanged: the hold predicate is False whenever
+a slot is free.
 """
 
 from __future__ import annotations
@@ -56,12 +63,22 @@ class DeviceBatcher:
         batch_wait: float = 0.0005,
         batch_limit: int = 1000,
         fetch_depth: Optional[int] = None,
+        deep_batch: bool = False,
     ):
         import os
 
         self.backend = backend
         self.batch_wait = batch_wait
         self.batch_limit = batch_limit
+        # throughput mode (GUBER_DEVICE_DEEP_BATCH): while the submit
+        # gate is saturated (every fetch_depth slot occupied — a flush
+        # could not submit anyway), keep accumulating toward
+        # batch_limit instead of parking a shallow batch at the
+        # semaphore. Deep batches amortize per-batch fixed device costs
+        # (the big-store full-table writeback); idle/light-load flush
+        # semantics are byte-identical to deep_batch=False because the
+        # hold predicate is False whenever a pipeline slot is free.
+        self.deep_batch = bool(deep_batch)
         if fetch_depth is None:
             fetch_depth = int(os.environ.get("GUBER_FETCH_DEPTH", "2"))
         self.fetch_depth = max(1, int(fetch_depth))
@@ -229,6 +246,9 @@ class DeviceBatcher:
                 await collect_batch(
                     self._queue, self.batch_limit, self.batch_wait, batch,
                     weight=_item_weight, carry=self._carry,
+                    hold_while=(
+                        self._inflight.locked if self.deep_batch else None
+                    ),
                 )
                 self._flushing = True
                 try:

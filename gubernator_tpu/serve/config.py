@@ -60,6 +60,24 @@ class ServerConfig:
     # only one of GUBER_STORE_ROWS / GUBER_STORE_SLOTS should re-check
     # the product, not just one knob.
     store_slots: int = 1 << 15
+    # store auto-sizing (core.store.derive_store_config): operator-level
+    # budgets that derive slots instead of pinning geometry by hand.
+    # GUBER_STORE_TARGET_KEYS sizes for ~2x the expected live keys (the
+    # measured footprint≍throughput law: provisioned capacity, not live
+    # keys, sets the per-batch HBM cost); GUBER_STORE_MIB pins the
+    # footprint directly. Either overrides store_slots; setting both
+    # sizes from MIB and lints the footprint against the key budget.
+    store_target_keys: int = 0
+    store_mib: int = 0
+    # escalate the boot-time footprint lint (oversized/undersized store
+    # for the declared key budget) from a log warning to a hard failure
+    store_size_strict: bool = False
+    # True when GUBER_STORE_SLOTS was set explicitly (config_from_env):
+    # an explicit pin + a key budget means "lint my footprint", not
+    # "derive over my pin". Library embedders constructing ServerConfig
+    # directly are covered either way: store_config() also treats a
+    # non-default store_slots value as a pin.
+    store_slots_pinned: bool = False
     # force a jax platform ("cpu", "tpu"); "" = jax default. Lets the
     # daemon run CPU-only on dev boxes where a TPU runtime is registered
     # but unavailable.
@@ -99,6 +117,15 @@ class ServerConfig:
     # many seconds after the first arrival (reference BatchWait).
     device_batch_wait: float = 0.0
     device_batch_limit: int = MAX_BATCH_SIZE
+    # Throughput mode (GUBER_DEVICE_DEEP_BATCH): while the device
+    # pipeline is saturated, keep accumulating toward device_batch_limit
+    # instead of flushing shallow batches the submit gate would park
+    # anyway. Deep batches amortize the store writeback's full-table
+    # pass (the big-store lever: 4.28M -> 20.6M dec/s on a 1 GiB store,
+    # BENCH_ZIPF10M_PROFILE_r5.json). Idle flush semantics (batch_wait)
+    # are untouched, so latency under light load does not change; under
+    # saturation per-request latency grows toward one deep-batch period.
+    device_deep_batch: bool = False
     # in-flight device batches the batcher keeps before stalling submits.
     # 2 suffices co-located (PCIe fetch ~0.1ms); raise toward ~16 when
     # the accelerator sits behind a high-latency link (fetches pipeline,
@@ -130,8 +157,96 @@ class ServerConfig:
     def resolved_advertise(self) -> str:
         return self.advertise_address or self.grpc_address
 
+    def store_config(self, logger=None):
+        """Resolve the final slot-store geometry (core.store.StoreConfig)
+        from the sizing knobs, and run the boot-time footprint lint when
+        a key budget is declared. Precedence: GUBER_STORE_MIB >
+        GUBER_STORE_TARGET_KEYS > explicit rows/slots — except that an
+        EXPLICIT GUBER_STORE_SLOTS pin (store_slots_pinned) is never
+        overridden by target_keys: the key budget then lints the pinned
+        footprint instead of deriving over it. The lint is skipped for
+        shapes derived from target_keys alone (right-sized by
+        construction); it fires when an explicit or MiB-pinned
+        footprint disagrees with the declared key budget — warning by
+        default, hard failure under GUBER_STORE_SIZE_STRICT."""
+        from gubernator_tpu.core.store import (
+            StoreConfig,
+            check_store_budget,
+            derive_store_config,
+        )
+
+        # a pin is an env-explicit GUBER_STORE_SLOTS OR a non-default
+        # slots value on a directly constructed ServerConfig (library
+        # embedders never go through config_from_env)
+        slots_pinned = self.store_slots_pinned or (
+            self.store_slots
+            != type(self).__dataclass_fields__["store_slots"].default
+        )
+        if self.store_mib > 0:
+            store = derive_store_config(
+                mib=self.store_mib, rows=self.store_rows
+            )
+            lint = check_store_budget(store, self.store_target_keys)
+        elif self.store_target_keys > 0 and not slots_pinned:
+            store = derive_store_config(
+                target_keys=self.store_target_keys, rows=self.store_rows
+            )
+            lint = ""
+        else:
+            store = StoreConfig(
+                rows=self.store_rows, slots=self.store_slots
+            )
+            lint = check_store_budget(store, self.store_target_keys)
+        if lint:
+            if self.store_size_strict:
+                raise ValueError(f"GUBER_STORE_SIZE_STRICT: {lint}")
+            import logging
+
+            (logger or logging.getLogger("gubernator_tpu.config")).warning(
+                "%s", lint
+            )
+        return store
+
     def validate(self) -> None:
         self.behaviors.validate()
+        # Cross-validate the batching knobs against the bucket ladder
+        # the engine will actually generate: the batcher never splits a
+        # caller group, so the ladder's top rung must cover the largest
+        # group any path can enqueue — a V1/PeersV1 RPC (MAX_BATCH_SIZE,
+        # the instance's hard cap), a peer micro-batch (batch_limit), or
+        # a GLOBAL broadcast install (global_batch_limit). Before this
+        # check, GUBER_DEVICE_BATCH_LIMIT below those caps was accepted
+        # silently and crashed choose_bucket on the first big group.
+        if self.backend != "exact":
+            from gubernator_tpu.core.engine import buckets_for_limit
+
+            ladder = buckets_for_limit(self.device_batch_limit)
+            need = max(
+                MAX_BATCH_SIZE,
+                self.behaviors.batch_limit,
+                self.behaviors.global_batch_limit,
+            )
+            if max(ladder) < need:
+                raise ValueError(
+                    f"GUBER_DEVICE_BATCH_LIMIT={self.device_batch_limit} "
+                    f"generates a bucket ladder topping out at "
+                    f"{max(ladder)}, below the largest request group the "
+                    f"serving tier can enqueue ({need}: max of the "
+                    f"per-RPC cap {MAX_BATCH_SIZE}, "
+                    f"GUBER_BATCH_LIMIT={self.behaviors.batch_limit}, "
+                    f"GUBER_GLOBAL_BATCH_LIMIT="
+                    f"{self.behaviors.global_batch_limit}); raise "
+                    f"GUBER_DEVICE_BATCH_LIMIT to at least {need}"
+                )
+        if self.device_deep_batch and self.backend == "exact":
+            raise ValueError(
+                "GUBER_DEVICE_DEEP_BATCH is a device-batching mode; the "
+                "exact backend decides inline and cannot use it"
+            )
+        if self.store_mib < 0 or self.store_target_keys < 0:
+            raise ValueError(
+                "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
+            )
         if self.etcd_endpoints and self.k8s_endpoints_selector:
             raise ValueError(
                 "choose either etcd or kubernetes discovery, not both"
@@ -219,6 +334,11 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         store_rows=_get_int(env, "GUBER_STORE_ROWS", 16),
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 15),
+        store_target_keys=_get_int(env, "GUBER_STORE_TARGET_KEYS", 0),
+        store_mib=_get_int(env, "GUBER_STORE_MIB", 0),
+        store_size_strict=_get(env, "GUBER_STORE_SIZE_STRICT")
+        in ("1", "true", "yes"),
+        store_slots_pinned=bool(_get(env, "GUBER_STORE_SLOTS")),
         jax_platform=_get(env, "GUBER_JAX_PLATFORM"),
         edge_socket=_get(env, "GUBER_EDGE_SOCKET"),
         edge_tcp=_get(env, "GUBER_EDGE_TCP"),
@@ -240,6 +360,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         device_batch_limit=_get_int(
             env, "GUBER_DEVICE_BATCH_LIMIT", MAX_BATCH_SIZE
         ),
+        device_deep_batch=_get(env, "GUBER_DEVICE_DEEP_BATCH")
+        in ("1", "true", "yes"),
         # device_fetch_depth deliberately NOT resolved here: the field's
         # None default defers to DeviceBatcher, the single owner of the
         # GUBER_FETCH_DEPTH env read (batcher.py __init__)
@@ -257,5 +379,14 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
         log_json=_get(env, "GUBER_LOG_JSON") in ("1", "true", "yes"),
     )
+    if conf.store_mib > 0 and conf.store_slots_pinned:
+        # two ACTIVE footprint pins: refuse rather than pick one
+        # silently (GUBER_STORE_MIB=0 means "off", not a pin;
+        # GUBER_STORE_TARGET_KEYS + SLOTS is allowed — the key budget
+        # then lints the explicit footprint at boot, store_config())
+        raise ValueError(
+            "GUBER_STORE_MIB and GUBER_STORE_SLOTS both set; pin the "
+            "store footprint one way"
+        )
     conf.validate()
     return conf

@@ -57,6 +57,7 @@ class Instance:
             batch_wait=conf.device_batch_wait,
             batch_limit=conf.device_batch_limit,
             fetch_depth=getattr(conf, "device_fetch_depth", None),
+            deep_batch=getattr(conf, "device_deep_batch", False),
         )
         self.global_mgr = GlobalManager(conf.behaviors, self)
         self.picker = ConsistentHashPicker()

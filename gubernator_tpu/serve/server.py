@@ -41,14 +41,32 @@ def make_backend(conf: ServerConfig):
 
         jax.config.update("jax_platforms", conf.jax_platform)
 
-    from gubernator_tpu.core.store import StoreConfig
-
-    store = StoreConfig(rows=conf.store_rows, slots=conf.store_slots)
     if conf.backend == "exact":
         return ExactBackend(conf.cache_size)
+    # sizing knobs (GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS) resolve
+    # here; an oversized/undersized footprint for the declared key
+    # budget warns (or fails under GUBER_STORE_SIZE_STRICT) at boot,
+    # per the measured footprint≍throughput law
+    store = conf.store_config(logger=log)
+    from gubernator_tpu.core.store import (
+        store_capacity,
+        store_footprint_bytes,
+    )
+
+    log.info(
+        "slot store: %d slots x %d ways = %d entries (%.0f MiB)",
+        store.slots, store.rows, store_capacity(store),
+        store_footprint_bytes(store) / (1 << 20),
+    )
     from gubernator_tpu.core.engine import buckets_for_limit
 
     buckets = buckets_for_limit(conf.device_batch_limit)
+    if conf.device_deep_batch:
+        log.info(
+            "throughput mode: deep-batch accumulation toward %d "
+            "(ladder %s)",
+            conf.device_batch_limit, buckets,
+        )
     if conf.backend == "tpu":
         return TpuBackend(store, buckets=buckets)
     if conf.backend == "mesh":
@@ -150,6 +168,36 @@ class PeersV1Servicer:
         return peers_pb2.UpdatePeerGlobalsResp()
 
 
+def register_servicers(grpc_server, instance: Instance):
+    """Embed gubernator in a caller-owned `grpc.aio` server.
+
+    The reference explicitly supports this shape: the application
+    provides the gRPC server and drives peer membership itself
+    (reference config.go:29-30, architecture.md:79-91). Here the same
+    contract: register the V1 + PeersV1 services on `grpc_server` and
+    return the instance (for chaining). The caller owns the server
+    lifecycle and discovery:
+
+        backend = make_backend(conf)          # or any backend object
+        instance = Instance(conf, backend)
+        instance.start()                      # batcher + gossip tasks
+        register_servicers(my_grpc_server, instance)
+        await my_grpc_server.start()
+        await instance.set_peers([PeerInfo(address=..., is_owner=...)])
+        ...
+        await instance.stop()                 # before the loop closes
+
+    Notes: call inside the event loop that will run the server —
+    Instance.start() binds its batcher to the running loop; set_peers
+    replaces the full membership each call (pass every live peer, with
+    is_owner=True on this node's own advertise address); warmup of a
+    device backend (backend.warmup()) is the caller's pre-serve step,
+    as in Server._start_inner."""
+    add_v1_servicer(grpc_server, V1Servicer(instance))
+    add_peers_servicer(grpc_server, PeersV1Servicer(instance))
+    return instance
+
+
 class Server:
     """One daemon: gRPC + HTTP, an Instance, and discovery."""
 
@@ -190,8 +238,7 @@ class Server:
             interceptors=[StatsInterceptor()],
             options=[("grpc.max_receive_message_length", 1 << 20)],
         )
-        add_v1_servicer(self.grpc_server, V1Servicer(self.instance))
-        add_peers_servicer(self.grpc_server, PeersV1Servicer(self.instance))
+        register_servicers(self.grpc_server, self.instance)
         bound = self.grpc_server.add_insecure_port(self.conf.grpc_address)
         if bound == 0:
             raise RuntimeError(

@@ -26,7 +26,7 @@ def main():
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
 
     buckets, B, S = 1 << 15, 16384, 256
     rng = np.random.default_rng(5)

@@ -36,7 +36,7 @@ def log(*a):
 def main():
     import jax
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
     from gubernator_tpu.core.engine import TpuEngine
     from gubernator_tpu.core.store import StoreConfig
 

@@ -230,7 +230,7 @@ def bench_device() -> list:
     import jax.numpy as jnp
     from jax import lax
 
-    import gubernator_tpu  # noqa: F401 (x64)
+    import gubernator_tpu.core  # noqa: F401 (x64)
     from gubernator_tpu.core.engine import (
         _presort_grouped,
         build_groups,

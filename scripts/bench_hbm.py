@@ -55,7 +55,7 @@ def main():
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
     from gubernator_tpu.core.pallas_sweep import _apply_inline
 
     buckets, B = 1 << 15, 16384

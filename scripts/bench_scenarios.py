@@ -330,7 +330,10 @@ def scenario_global_mesh():
 def scenario_zipf_10m():
     from gubernator_tpu.core.store import StoreConfig
 
-    # 2^21 buckets x 16 ways = 33.5M entries (1 GiB), ~30% load at 10M keys
+    # 2^21 buckets x 16 ways = 33.5M entries (1 GiB), ~30% load at 10M
+    # keys. Kernel-level row; the SERVING-path twin (env knobs, deep
+    # ladder, store auto-sizing) is `cli/bench_serving.py --scenario
+    # zipf10m` -> BENCH_SCENARIOS_r6.json
     v = _measure_kernel(
         StoreConfig(rows=16, slots=1 << 21), 10_000_000, "mixed"
     )
@@ -392,7 +395,7 @@ def main():
         jax.config.update("jax_platforms", "cpu")
         jax.config.update("jax_num_cpu_devices", args.cpu_mesh)
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
 
     todo = [args.scenario] if args.scenario else sorted(SCENARIOS)
     for n in todo:

@@ -71,7 +71,7 @@ def _traffic():
 def main():
     import jax
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
     import gubernator_tpu.parallel.sharded as sh
     from gubernator_tpu.core.store import StoreConfig
 

@@ -89,7 +89,7 @@ def run_regime(buckets: int, B: int, S: int = 512):
 def main():
     import jax
 
-    import gubernator_tpu  # noqa: F401 (x64 on)
+    import gubernator_tpu.core  # noqa: F401 (x64 on)
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")

@@ -19,7 +19,7 @@ from bench_sweep_regime import log, run_regime  # noqa: E402
 def main():
     import jax
 
-    import gubernator_tpu  # noqa: F401 (x64 on)
+    import gubernator_tpu.core  # noqa: F401 (x64 on)
 
     dev = jax.devices()[0]
     log(f"device: {dev.platform} ({dev.device_kind})")

@@ -211,6 +211,34 @@ def table_scenarios() -> str:
     return "\n".join(lines)
 
 
+def table_throughput_serving() -> str:
+    """BENCH_SCENARIOS_r6.json: BASELINE config 4's depth ladder through
+    the SHIPPED serving stack (env knobs -> config -> warmup ->
+    deep-batch accumulation), fixed store footprint per row."""
+    doc = json.loads((ROOT / "BENCH_SCENARIOS_r6.json").read_text())
+    base = doc["rows"][0]["decisions_per_sec"]
+    lines = [
+        "| `GUBER_DEVICE_BATCH_LIMIT` rung | decisions/s "
+        "| mean device batch | vs shallowest rung |",
+        "|---|---|---|---|",
+    ]
+    for r in doc["rows"]:
+        lines.append(
+            f"| {r['depth']:,} | {r['decisions_per_sec']:,.0f} "
+            f"| {r['mean_device_batch']:,.0f} "
+            f"| {r['decisions_per_sec'] / base:.2f}x |"
+        )
+    lines.append("")
+    lines.append(
+        f"({doc['scope']}-scoped run: {doc['store_mib']} MiB store "
+        f"(fixed), {doc['key_space']:,} zipf keys, "
+        f"{doc['backend']} backend, mean device batch = the rung in "
+        f"every row (deep accumulation engaged)."
+        f"{' ' + doc['notes'] if doc.get('notes') else ''})"
+    )
+    return "\n".join(lines)
+
+
 def table_edge_cluster() -> str:
     """BENCH_EDGE_CLUSTER_r5.json: the compiled door in front of 1 vs 3
     nodes, per-owner fast frames vs string-path forwarding."""
@@ -247,6 +275,7 @@ TABLES = {
     "serving-device-table": table_serving_device,
     "global-latency-table": table_global,
     "scenarios-table": table_scenarios,
+    "throughput-serving-table": table_throughput_serving,
     "edge-cluster-table": table_edge_cluster,
 }
 

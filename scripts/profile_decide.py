@@ -45,7 +45,7 @@ def main():
     import jax.numpy as jnp
     from jax import lax
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
     from gubernator_tpu.core.engine import _presort_grouped, build_groups
     from gubernator_tpu.core.kernels import BatchRequest, decide_presorted
     from gubernator_tpu.core.store import LANES, StoreConfig, new_store

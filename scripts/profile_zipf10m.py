@@ -45,7 +45,7 @@ def unique_stats(key_space, B=16384):
 def main():
     from gubernator_tpu.core.store import StoreConfig
 
-    import gubernator_tpu  # noqa: F401
+    import gubernator_tpu.core  # noqa: F401
 
     rows = []
     grid_keys = (100_000, 1_000_000, 10_000_000)

@@ -252,6 +252,106 @@ def test_stop_fails_requests_parked_in_collect_window(loop_run):
     loop_run(scenario())
 
 
+def test_deep_batch_accumulates_while_pipeline_full(loop_run):
+    """Throughput mode (deep_batch=True): while every fetch_depth slot
+    is occupied a flush could not submit anyway, so the collector keeps
+    accumulating; the moment a slot frees, everything accumulated ships
+    as ONE deep batch instead of a run of shallow ones."""
+
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(
+            be, batch_wait=0, batch_limit=100, fetch_depth=1,
+            deep_batch=True,
+        )
+        b.start()
+        t0 = asyncio.ensure_future(b.decide([_req(0)], [False]))
+        # batch 0 submitted; the single pipeline slot is now occupied
+        while not b._pending:
+            await asyncio.sleep(0.001)
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        t2 = asyncio.ensure_future(b.decide([_req(2)], [False]))
+        # the flusher must HOLD these (pipeline full), not submit them
+        await asyncio.sleep(0.05)
+        assert len(be.submits) == 1, be.submits
+        be.releases[0].set()
+        await t0
+        # slot freed -> the held groups flush together as one deep batch
+        while len(be.submits) < 2:
+            await asyncio.sleep(0.001)
+        assert be.submits[1] == ["k1", "k2"], be.submits
+        be.releases[1].set()
+        r1, r2 = await t1, await t2
+        assert [r.remaining for r in r1 + r2] == [7, 7]
+        await b.stop()
+
+    loop_run(scenario())
+
+
+def test_deep_batch_idle_flush_semantics_unchanged(loop_run):
+    """Deep mode must not change idle-path latency: with no batch in
+    flight the hold predicate is False, so a solo request flushes after
+    exactly the historical drain + batch_wait window — it is never held
+    hostage to traffic that may not come."""
+
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(
+            be, batch_wait=0, batch_limit=100_000, fetch_depth=2,
+            deep_batch=True,
+        )
+        b.start()
+        t1 = asyncio.ensure_future(b.decide([_req(1)], [False]))
+        # idle pipeline: the solo request must submit promptly
+        for _ in range(200):
+            if be.submits:
+                break
+            await asyncio.sleep(0.001)
+        assert be.submits == [["k1"]]
+        be.releases[0].set()
+        assert [r.remaining for r in await t1] == [7]
+        await b.stop()
+
+    loop_run(scenario())
+
+
+def test_deep_batch_respects_batch_limit(loop_run):
+    """Accumulation stops at batch_limit: a group that would overshoot
+    parks in carry and ships in the NEXT deep batch."""
+
+    async def scenario():
+        be = PipelinedFake()
+        b = DeviceBatcher(
+            be, batch_wait=0, batch_limit=3, fetch_depth=1,
+            deep_batch=True,
+        )
+        b.start()
+        t0 = asyncio.ensure_future(b.decide([_req(0)], [False]))
+        while not b._pending:
+            await asyncio.sleep(0.001)
+        tasks = [
+            asyncio.ensure_future(b.decide([_req(10 + i)], [False]))
+            for i in range(4)
+        ]
+        await asyncio.sleep(0.05)
+        assert len(be.submits) == 1
+        be.releases[0].set()
+        await t0
+        while len(be.submits) < 2:
+            await asyncio.sleep(0.001)
+        assert be.submits[1] == ["k10", "k11", "k12"]  # capped at 3
+        be.releases[1].set()
+        while len(be.submits) < 3:
+            await asyncio.sleep(0.001)
+            for k, ev in list(be.releases.items()):
+                ev.set()
+        for t in tasks:
+            await t
+        await b.stop()
+
+    loop_run(scenario())
+
+
 class BlockingFake:
     """A backend with only the blocking decide() — the fallback path."""
 

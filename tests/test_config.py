@@ -99,6 +99,75 @@ def test_buckets_follow_device_batch_limit():
     assert choose_bucket(sorted(b), 4500) == 5120
 
 
+def test_deep_buckets_extend_ladder():
+    """Throughput-mode limits pick up the DEEP_BUCKETS rungs so a lull
+    between 4096 and the top rung doesn't pad 26x."""
+    from gubernator_tpu.core.engine import buckets_for_limit
+
+    assert buckets_for_limit(131_072) == (
+        64, 256, 1024, 4096, 16384, 32768, 131072,
+    )
+    assert buckets_for_limit(32_768) == (64, 256, 1024, 4096, 16384, 32768)
+    # the default envelope is untouched: no deep rung below 16384
+    assert buckets_for_limit(4096) == (64, 256, 1024, 4096)
+
+
+def test_device_batch_limit_cross_validated_against_ladder():
+    """GUBER_DEVICE_BATCH_LIMIT below the largest group the serving tier
+    can enqueue (per-RPC cap / batch_limit / global_batch_limit) used to
+    be accepted silently and crash choose_bucket at runtime; it must
+    fail at boot with the knobs named."""
+    with pytest.raises(ValueError, match="GUBER_DEVICE_BATCH_LIMIT"):
+        config_from_env({"GUBER_DEVICE_BATCH_LIMIT": "500"})
+    # global broadcasts ride the same batcher queue: a global_batch_limit
+    # past the ladder top must fail too
+    with pytest.raises(ValueError, match="GUBER_GLOBAL_BATCH_LIMIT"):
+        config_from_env(
+            {
+                "GUBER_GLOBAL_BATCH_LIMIT": "5000",
+                "GUBER_DEVICE_BATCH_LIMIT": "2000",
+            }
+        )
+    # the exact backend has no bucket ladder: the same knobs pass
+    conf = config_from_env(
+        {"GUBER_DEVICE_BATCH_LIMIT": "500", "GUBER_BACKEND": "exact"}
+    )
+    assert conf.device_batch_limit == 500
+    # a deep ladder covering the caps is accepted
+    conf = config_from_env({"GUBER_DEVICE_BATCH_LIMIT": "131072"})
+    assert conf.device_batch_limit == 131072
+
+
+def test_deep_batch_knob():
+    conf = config_from_env({"GUBER_DEVICE_DEEP_BATCH": "1"})
+    assert conf.device_deep_batch is True
+    assert config_from_env({}).device_deep_batch is False
+    # deep batching is a device-batcher mode; exact decides inline
+    with pytest.raises(ValueError, match="DEEP_BATCH"):
+        config_from_env(
+            {"GUBER_DEVICE_DEEP_BATCH": "1", "GUBER_BACKEND": "exact"}
+        )
+
+
+def test_store_footprint_pins_are_exclusive():
+    with pytest.raises(ValueError, match="GUBER_STORE_MIB"):
+        config_from_env(
+            {"GUBER_STORE_MIB": "512", "GUBER_STORE_SLOTS": "32768"}
+        )
+    # MIB=0 means "off", not a pin: no conflict with explicit slots
+    conf = config_from_env(
+        {"GUBER_STORE_MIB": "0", "GUBER_STORE_SLOTS": "32768"}
+    )
+    assert conf.store_config().slots == 32768
+    # target_keys + explicit slots is allowed: the budget lints the
+    # explicit footprint at boot instead of overriding it
+    conf = config_from_env(
+        {"GUBER_STORE_TARGET_KEYS": "100000", "GUBER_STORE_SLOTS": "32768"}
+    )
+    assert conf.store_slots == 32768
+    assert conf.store_target_keys == 100_000
+
+
 def test_edge_env_knobs_parse():
     from gubernator_tpu.serve.config import config_from_env
 

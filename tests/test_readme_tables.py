@@ -1,0 +1,31 @@
+"""README perf tables must trace to committed artifacts — as a TEST.
+
+`scripts/gen_readme_tables.py --check` regenerates every sentinel block
+from the committed BENCH_* JSON artifacts and fails on any drift. It ran
+only by convention before (r5 landed it, nothing enforced it); running
+it as a tier-1 test means a PR that edits a perf number by hand, or
+commits a new artifact without regenerating, fails loudly here instead
+of publishing tables that say something the artifacts don't.
+"""
+
+import pathlib
+import subprocess
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def test_readme_tables_match_artifacts():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "gen_readme_tables.py"),
+         "--check"],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        cwd=ROOT,
+    )
+    assert r.returncode == 0, (
+        f"README tables drifted from the committed artifacts "
+        f"(rc={r.returncode}). Regenerate with `make readme`.\n"
+        f"{r.stderr}"
+    )

@@ -1,0 +1,178 @@
+"""Store auto-sizing: derivation from operator budgets + the boot lint.
+
+The r5 sweep established the footprint≍throughput law (decide cost is a
+pure function of provisioned capacity, BENCH_ZIPF10M_PROFILE_r5.json);
+these tests pin the sizing layer built on it: GUBER_STORE_TARGET_KEYS /
+GUBER_STORE_MIB derive shapes that always satisfy the StoreConfig
+invariants, and a footprint that disagrees with a declared key budget
+warns at boot (or fails under GUBER_STORE_SIZE_STRICT).
+"""
+
+import logging
+
+import pytest
+
+from gubernator_tpu.core.store import (
+    MAX_LOAD,
+    SLOTS_PER_DENSE_ROW,
+    StoreConfig,
+    check_store_budget,
+    derive_store_config,
+    store_capacity,
+    store_footprint_bytes,
+)
+from gubernator_tpu.serve.config import config_from_env
+
+
+def test_derive_from_key_budget():
+    # the r5-measured right-size for config 4: 10M keys -> the 512 MiB
+    # shape (2^20 slots x 16 ways, load 0.60), NOT the 1 GiB table that
+    # runs 1.75x slower for the same keys
+    s = derive_store_config(target_keys=10_000_000)
+    assert (s.rows, s.slots) == (16, 1 << 20)
+    assert store_footprint_bytes(s) == 512 << 20
+    # derived shapes always admit the budget under the eviction ceiling
+    for keys in (1, 100, 50_000, 999_999, 3_141_592, 10_000_000):
+        s = derive_store_config(target_keys=keys)
+        assert keys <= store_capacity(s) * MAX_LOAD * 1.001, (keys, s)
+
+
+def test_derive_from_mib():
+    # exact power-of-two budgets land exactly
+    s = derive_store_config(mib=512)
+    assert (s.rows, s.slots) == (16, 1 << 20)
+    assert store_footprint_bytes(s) == 512 << 20
+    s = derive_store_config(mib=1024)
+    assert store_footprint_bytes(s) == 1024 << 20
+    # non-power-of-two budgets floor to the largest fitting shape
+    s = derive_store_config(mib=100)
+    assert store_footprint_bytes(s) <= 100 << 20
+    assert store_footprint_bytes(s) == 64 << 20
+
+
+def test_derive_needs_exactly_one_budget():
+    with pytest.raises(ValueError):
+        derive_store_config()
+    with pytest.raises(ValueError):
+        derive_store_config(target_keys=10, mib=10)
+
+
+def test_derived_shapes_hold_store_invariants():
+    """StoreConfig's own invariants (power-of-two slots, rows*slots a
+    multiple of 16 for the dense 128-lane view) must hold across the
+    whole derivation surface — __post_init__ asserts them, so simply
+    constructing each shape is the check."""
+    for rows in (1, 2, 4, 8, 16):
+        for keys in (1, 7, 1000, 123_457, 10_000_000):
+            s = derive_store_config(target_keys=keys, rows=rows)
+            assert s.rows == rows
+            assert (s.rows * s.slots) % SLOTS_PER_DENSE_ROW == 0
+            assert s.slots >= SLOTS_PER_DENSE_ROW
+        for mib in (1, 2, 3, 64, 513):
+            s = derive_store_config(mib=mib, rows=rows)
+            assert (s.rows * s.slots) % SLOTS_PER_DENSE_ROW == 0
+
+
+def test_boot_derivation_from_env_knobs():
+    conf = config_from_env({"GUBER_STORE_TARGET_KEYS": "10000000"})
+    assert conf.store_config() == StoreConfig(rows=16, slots=1 << 20)
+    conf = config_from_env({"GUBER_STORE_MIB": "1024"})
+    assert conf.store_config() == StoreConfig(rows=16, slots=1 << 21)
+    # MIB wins over TARGET_KEYS for the footprint (the budget then only
+    # lints); explicit slots remain the fallback
+    conf = config_from_env(
+        {"GUBER_STORE_MIB": "512", "GUBER_STORE_TARGET_KEYS": "10000000"}
+    )
+    assert conf.store_config() == StoreConfig(rows=16, slots=1 << 20)
+    assert config_from_env({}).store_config() == StoreConfig(
+        rows=16, slots=1 << 15
+    )
+
+
+def test_oversized_footprint_warns_at_boot(caplog):
+    """A 1 GiB table declared to serve 100k keys pays the full-table
+    writeback for a ~0.3% load — the boot lint must say so."""
+    conf = config_from_env(
+        {"GUBER_STORE_MIB": "1024", "GUBER_STORE_TARGET_KEYS": "100000"}
+    )
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        store = conf.store_config()
+    assert store == StoreConfig(rows=16, slots=1 << 21)
+    assert any("oversized" in r.message for r in caplog.records)
+    # the message is actionable: it names the right-sizing knob
+    msg = next(r.message for r in caplog.records if "oversized" in r.message)
+    assert "GUBER_STORE_TARGET_KEYS" in msg
+
+
+def test_oversized_footprint_fails_under_strict():
+    conf = config_from_env(
+        {
+            "GUBER_STORE_MIB": "1024",
+            "GUBER_STORE_TARGET_KEYS": "100000",
+            "GUBER_STORE_SIZE_STRICT": "1",
+        }
+    )
+    with pytest.raises(ValueError, match="oversized"):
+        conf.store_config()
+
+
+def test_undersized_footprint_warns_over_admission(caplog):
+    """Key budget past the eviction ceiling of an explicit footprint ->
+    over-admission warning."""
+    conf = config_from_env(
+        {"GUBER_STORE_MIB": "16", "GUBER_STORE_TARGET_KEYS": "1000000"}
+    )
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        conf.store_config()
+    assert any("undersized" in r.message for r in caplog.records)
+
+
+def test_right_sized_footprint_is_silent(caplog):
+    conf = config_from_env(
+        {"GUBER_STORE_MIB": "512", "GUBER_STORE_TARGET_KEYS": "10000000"}
+    )
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        conf.store_config()
+    assert not caplog.records
+    # budget-derived shapes are right-sized by construction: never warn
+    conf = config_from_env({"GUBER_STORE_TARGET_KEYS": "42"})
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        conf.store_config()
+    assert not caplog.records
+
+
+def test_check_store_budget_no_budget_is_silent():
+    assert check_store_budget(StoreConfig(), 0) == ""
+
+
+def test_explicit_slots_pin_is_linted_not_overridden(caplog):
+    """An EXPLICIT GUBER_STORE_SLOTS pin plus a key budget keeps the
+    pinned geometry and lints it — deriving over a deliberate pin would
+    silently change the HBM footprint the operator chose."""
+    conf = config_from_env(
+        {"GUBER_STORE_SLOTS": "2048", "GUBER_STORE_TARGET_KEYS": "10000000"}
+    )
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        store = conf.store_config()
+    assert store == StoreConfig(rows=16, slots=2048)  # pin kept
+    assert any("undersized" in r.message for r in caplog.records)
+    # without the explicit pin the same budget derives the right size
+    conf = config_from_env({"GUBER_STORE_TARGET_KEYS": "10000000"})
+    assert conf.store_config() == StoreConfig(rows=16, slots=1 << 20)
+
+
+def test_directly_constructed_config_keeps_slot_pin(caplog):
+    """Library embedders construct ServerConfig without config_from_env;
+    a non-default store_slots there is a pin too — linted, never derived
+    over."""
+    from gubernator_tpu.serve.config import ServerConfig
+
+    conf = ServerConfig(store_slots=1 << 11, store_target_keys=10_000_000)
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        store = conf.store_config()
+    assert store == StoreConfig(rows=16, slots=1 << 11)
+    assert any("undersized" in r.message for r in caplog.records)
+    # default slots + a budget still derives (nothing was pinned)
+    assert ServerConfig(store_target_keys=10_000_000).store_config() == (
+        StoreConfig(rows=16, slots=1 << 20)
+    )
