@@ -1,4 +1,4 @@
-.PHONY: all native proto test bench readme readme-check clean
+.PHONY: all native proto test bench readme readme-check profile-stages clean
 
 all: native proto
 
@@ -23,6 +23,16 @@ readme:
 
 readme-check:
 	python scripts/gen_readme_tables.py --check
+
+# stage-attribution profile of the served pipeline (serve/stages.py via
+# /v1/debug/stages): boots the device serving stack + compiled edge,
+# drives the batched saturation shape, prints where the wall time goes.
+# SECONDS/OUT are overridable: make profile-stages SECONDS=30 OUT=x.json
+SECONDS ?= 10
+OUT ?= BENCH_STAGES.json
+profile-stages: native
+	python scripts/profile_serving_stages.py --seconds $(SECONDS) \
+	  --json $(OUT)
 
 clean:
 	$(MAKE) -C gubernator_tpu/native clean

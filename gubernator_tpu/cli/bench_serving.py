@@ -357,6 +357,37 @@ def main(argv=None) -> int:
         "make -C gubernator_tpu/native/edge)",
     )
     parser.add_argument(
+        "--edge-only",
+        action="store_true",
+        help="with --edge: run ONLY the edge-door scenarios (skip the "
+        "reference suite) — the A/B loop for protocol comparisons",
+    )
+    parser.add_argument(
+        "--edge-bin",
+        default="",
+        help="path to an alternative guber-edge binary (e.g. a pre-r7 "
+        "build for a windowed-vs-roundtrip protocol A/B); default: the "
+        "in-tree build",
+    )
+    parser.add_argument(
+        "--edge-workers",
+        type=int,
+        default=2,
+        help="edge backend connections (guber-edge --workers). The "
+        "windowed protocol (r7) keeps N frames in flight per "
+        "connection, so the binary's default 2 suffices; pre-r7 "
+        "builds needed 8 to hide the one-frame-per-roundtrip wait",
+    )
+    parser.add_argument(
+        "--edge-clients",
+        type=int,
+        default=16,
+        help="concurrent client threads for edge_grpc_batched_"
+        "concurrent (in-flight frame demand; past the backend "
+        "connection count only a windowed edge can keep them all "
+        "moving)",
+    )
+    parser.add_argument(
         "--fetch-depth",
         type=int,
         default=None,
@@ -383,25 +414,41 @@ def main(argv=None) -> int:
         return run_zipf10m(args)
 
     backend_factory = None
+    # device backends boot with the daemon's shipped co-batch depth
+    # (GUBER_DEVICE_BATCH_LIMIT, default 8192 here): the windowed edge
+    # keeps many frames in flight per connection (r7), and the device
+    # batcher folds those concurrent ~1000-item groups into one deep
+    # launch — the ladder rungs compile at warmup exactly as the daemon
+    # compiles them (make_backend), so this is the served path, not a
+    # bench-only trick.
+    import os as _os
+
+    device_limit = int(
+        _os.environ.get("GUBER_DEVICE_BATCH_LIMIT", "8192")
+    )
     if args.backend == "exact":
         from gubernator_tpu.serve.backends import ExactBackend
 
         backend_factory = lambda: ExactBackend(100_000)  # noqa: E731
     elif args.backend == "mesh":
+        from gubernator_tpu.core.engine import buckets_for_limit
         from gubernator_tpu.core.store import StoreConfig
         from gubernator_tpu.serve.backends import MeshBackend
 
         backend_factory = lambda: MeshBackend(  # noqa: E731
-            StoreConfig(rows=16, slots=1 << 12)
+            StoreConfig(rows=16, slots=1 << 12),
+            buckets=buckets_for_limit(device_limit),
         )
     elif args.backend == "tpu":
+        from gubernator_tpu.core.engine import buckets_for_limit
         from gubernator_tpu.core.store import StoreConfig
         from gubernator_tpu.serve.backends import TpuBackend
 
         # same store shape as the mesh run so the two device artifacts
         # are apples-to-apples
         backend_factory = lambda: TpuBackend(  # noqa: E731
-            StoreConfig(rows=16, slots=1 << 12)
+            StoreConfig(rows=16, slots=1 << 12),
+            buckets=buckets_for_limit(device_limit),
         )
     else:
         # an unknown name silently benching the wrong backend would
@@ -432,6 +479,7 @@ def main(argv=None) -> int:
         ADDRESSES[: args.nodes],
         backend_factory=backend_factory,
         http_addresses=http_addresses,
+        device_batch_limit=device_limit if device_backend else None,
     )
     print("starting cluster...", file=sys.stderr)
     # device backends pay per-node warmup at boot (~2 min/node with a warm
@@ -503,7 +551,9 @@ def main(argv=None) -> int:
             import urllib.request
 
             edge_bin = (
-                pathlib.Path(__file__).resolve().parents[1]
+                pathlib.Path(args.edge_bin)
+                if args.edge_bin
+                else pathlib.Path(__file__).resolve().parents[1]
                 / "native" / "edge" / "guber-edge"
             )
             if not edge_bin.exists():
@@ -528,7 +578,8 @@ def main(argv=None) -> int:
             edge_proc = subprocess.Popen(
                 [str(edge_bin), "--listen", str(edge_port),
                  "--grpc-listen", str(edge_grpc_port),
-                 "--backend", sock, "--workers", "8"],
+                 "--backend", sock, "--workers",
+                 str(args.edge_workers)],
                 stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
             )
             # poll for readiness instead of hoping a fixed sleep suffices
@@ -558,44 +609,49 @@ def main(argv=None) -> int:
 
             # same workload against node 0's Python HTTP gateway: the
             # apples-to-apples denominator for the edge multiplier
-            results.append(
-                _measure(
-                    "python_http_front_door",
-                    _front_door_call(
-                        f"http://{PYTHON_HTTP_ADDR}/v1/GetRateLimits",
-                        edge_body,
-                    ),
-                    args.seconds, workers=16,
+            # (skipped under --edge-only: the A/B loop compares edge
+            # binaries, not doors)
+            if not args.edge_only:
+                results.append(
+                    _measure(
+                        "python_http_front_door",
+                        _front_door_call(
+                            f"http://{PYTHON_HTTP_ADDR}/v1/GetRateLimits",
+                            edge_body,
+                        ),
+                        args.seconds, workers=16,
+                    )
                 )
-            )
-            results.append(
-                _measure("edge_front_door", through_edge, args.seconds,
-                         workers=16)
-            )
+                results.append(
+                    _measure("edge_front_door", through_edge,
+                             args.seconds, workers=16)
+                )
 
             # BASELINE config 3's honest low-concurrency restatement:
             # ONE client, GLOBAL behavior, through the compiled edge —
             # the reference's "most responses < 1ms" is a per-response
             # production latency, not a saturated-tail number
-            global_edge_body = _json.dumps(
-                {
-                    "requests": [
-                        {"name": "edge", "uniqueKey": "G", "hits": 1,
-                         "limit": 1000000, "duration": 10000,
-                         "behavior": "GLOBAL"}
-                    ]
-                }
-            ).encode()
-            results.append(
-                _measure(
-                    "global_1way_edge",
-                    _front_door_call(
-                        f"http://127.0.0.1:{edge_port}/v1/GetRateLimits",
-                        global_edge_body,
-                    ),
-                    args.seconds, workers=1,
+            if not args.edge_only:
+                global_edge_body = _json.dumps(
+                    {
+                        "requests": [
+                            {"name": "edge", "uniqueKey": "G", "hits": 1,
+                             "limit": 1000000, "duration": 10000,
+                             "behavior": "GLOBAL"}
+                        ]
+                    }
+                ).encode()
+                results.append(
+                    _measure(
+                        "global_1way_edge",
+                        _front_door_call(
+                            f"http://127.0.0.1:{edge_port}"
+                            "/v1/GetRateLimits",
+                            global_edge_body,
+                        ),
+                        args.seconds, workers=1,
+                    )
                 )
-            )
 
             # gRPC front doors under the SAME 16-way single-item load:
             # the compiled edge terminates h2/HPACK/proto itself
@@ -617,20 +673,21 @@ def main(argv=None) -> int:
 
                 return call
 
-            results.append(
-                _measure(
-                    "python_grpc_front_door",
-                    _grpc_door(cluster.peer_at(0)),
-                    args.seconds, workers=16,
+            if not args.edge_only:
+                results.append(
+                    _measure(
+                        "python_grpc_front_door",
+                        _grpc_door(cluster.peer_at(0)),
+                        args.seconds, workers=16,
+                    )
                 )
-            )
-            results.append(
-                _measure(
-                    "edge_grpc_front_door",
-                    _grpc_door(f"127.0.0.1:{edge_grpc_port}"),
-                    args.seconds, workers=16,
+                results.append(
+                    _measure(
+                        "edge_grpc_front_door",
+                        _grpc_door(f"127.0.0.1:{edge_grpc_port}"),
+                        args.seconds, workers=16,
+                    )
                 )
-            )
 
             # and the batched saturation shape through the edge's gRPC
             # door — on device backends this rides the pre-hashed GEB6
@@ -638,19 +695,22 @@ def main(argv=None) -> int:
             batch_1000 = gubernator_pb2.GetRateLimitsReq(
                 requests=[_req(f"k{i}") for i in range(1000)]
             )
+            n_ec = args.edge_clients
             eg_stubs = [
                 V1Stub(
                     grpc.insecure_channel(f"127.0.0.1:{edge_grpc_port}")
                 )
-                for _ in range(16)
+                for _ in range(n_ec)
             ]
 
             def edge_grpc_batched(i: int):
-                eg_stubs[(i // 1_000_000) % 16].GetRateLimits(batch_1000)
+                eg_stubs[(i // 1_000_000) % n_ec].GetRateLimits(
+                    batch_1000
+                )
 
             eb = _measure(
                 "edge_grpc_batched_concurrent", edge_grpc_batched,
-                args.seconds, workers=16,
+                args.seconds, workers=n_ec,
             )
             eb["decisions_per_sec"] = round(eb["ops_per_sec"] * 1000, 1)
             print(
@@ -659,50 +719,57 @@ def main(argv=None) -> int:
             )
             results.append(eb)
 
-        results.append(
-            _measure("no_batching", no_batching, args.seconds)
-        )
-        results.append(
-            _measure("get_rate_limit", get_rate_limit, args.seconds)
-        )
-        results.append(_measure("ping", ping, args.seconds))
-        results.append(_measure("global", global_call, args.seconds))
-        results.append(
-            _measure("thundering_herd", herd, args.seconds, workers=100)
-        )
-        b = _measure("batched", batched, args.seconds)
-        b["decisions_per_sec"] = round(b["ops_per_sec"] * 1000, 1)
-        print(
-            f"{'':18s} -> {b['decisions_per_sec']:12,.0f} decisions/s",
-            file=sys.stderr,
-        )
-        results.append(b)
+        if not args.edge_only:
+            results.append(
+                _measure("no_batching", no_batching, args.seconds)
+            )
+            results.append(
+                _measure("get_rate_limit", get_rate_limit, args.seconds)
+            )
+            results.append(_measure("ping", ping, args.seconds))
+            results.append(_measure("global", global_call, args.seconds))
+            results.append(
+                _measure(
+                    "thundering_herd", herd, args.seconds, workers=100
+                )
+            )
+            b = _measure("batched", batched, args.seconds)
+            b["decisions_per_sec"] = round(b["ops_per_sec"] * 1000, 1)
+            print(
+                f"{'':18s} -> {b['decisions_per_sec']:12,.0f} "
+                "decisions/s",
+                file=sys.stderr,
+            )
+            results.append(b)
 
-        # 16 concurrent clients each sending 1000-item batches: the
-        # saturation shape. One outstanding call per client means the
-        # single-client "batched" row measures round-trip latency, not
-        # capacity; with the batcher's fetch_depth pipeline the service
-        # overlaps many device batches, which only concurrency exposes.
-        conc_stubs: List[V1Stub] = [
-            V1Stub(grpc.insecure_channel(cluster.peer_at(0)))
-            for _ in range(16)
-        ]
+            # 16 concurrent clients each sending 1000-item batches: the
+            # saturation shape. One outstanding call per client means
+            # the single-client "batched" row measures round-trip
+            # latency, not capacity; with the batcher's fetch_depth
+            # pipeline the service overlaps many device batches, which
+            # only concurrency exposes.
+            conc_stubs: List[V1Stub] = [
+                V1Stub(grpc.insecure_channel(cluster.peer_at(0)))
+                for _ in range(16)
+            ]
 
-        def batched_concurrent(i: int):
-            # call index is w*1_000_000 + seq: key the stub by worker so
-            # each client thread owns one channel end-to-end
-            conc_stubs[(i // 1_000_000) % 16].GetRateLimits(batch)
+            def batched_concurrent(i: int):
+                # call index is w*1_000_000 + seq: key the stub by
+                # worker so each client thread owns one channel
+                # end-to-end
+                conc_stubs[(i // 1_000_000) % 16].GetRateLimits(batch)
 
-        bc = _measure(
-            "batched_concurrent", batched_concurrent, args.seconds,
-            workers=16,
-        )
-        bc["decisions_per_sec"] = round(bc["ops_per_sec"] * 1000, 1)
-        print(
-            f"{'':18s} -> {bc['decisions_per_sec']:12,.0f} decisions/s",
-            file=sys.stderr,
-        )
-        results.append(bc)
+            bc = _measure(
+                "batched_concurrent", batched_concurrent, args.seconds,
+                workers=16,
+            )
+            bc["decisions_per_sec"] = round(bc["ops_per_sec"] * 1000, 1)
+            print(
+                f"{'':18s} -> {bc['decisions_per_sec']:12,.0f} "
+                "decisions/s",
+                file=sys.stderr,
+            )
+            results.append(bc)
 
         if args.json:
             doc = {

@@ -29,10 +29,20 @@ class LocalCluster:
         global_sync_wait: float = 0.05,  # fast gossip for tests
         device_batch_wait: float = 0.0,
         http_addresses: Optional[Sequence[str]] = None,
+        device_batch_limit: Optional[int] = None,
     ):
         """`http_addresses` (parallel to `addresses`) additionally serves
         each node's HTTP JSON gateway — the harness default is gRPC-only
-        like the reference's (cluster.go)."""
+        like the reference's (cluster.go).
+
+        `device_batch_limit` mirrors the daemon's
+        GUBER_DEVICE_BATCH_LIMIT: the device batcher co-batches caller
+        groups up to this many items per launch (the deep rungs the
+        windowed edge protocol feeds, r7). None keeps the per-RPC
+        default — existing harness users see identical behavior. The
+        backend_factory must compile matching rungs
+        (core.engine.buckets_for_limit) or oversized batches recompile
+        at serve time."""
         self.addresses = list(addresses)
         self.http_addresses = (
             list(http_addresses) if http_addresses else [""] * len(addresses)
@@ -47,6 +57,7 @@ class LocalCluster:
         self._backend_factory = backend_factory
         self._global_sync_wait = global_sync_wait
         self._device_batch_wait = device_batch_wait
+        self._device_batch_limit = device_batch_limit
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -101,6 +112,8 @@ class LocalCluster:
                 device_batch_wait=self._device_batch_wait,
                 backend="exact",
             )
+            if self._device_batch_limit is not None:
+                conf.device_batch_limit = self._device_batch_limit
             backend = (
                 self._backend_factory()
                 if self._backend_factory is not None

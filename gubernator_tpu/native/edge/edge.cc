@@ -58,6 +58,34 @@ constexpr uint32_t kMagicHello = 0x49424547;     // 'GEBI' ring hello (r5)
 constexpr uint32_t kMagicFastReq = 0x36424547;   // 'GEB6' pre-hashed (r5)
 constexpr uint32_t kMagicFastResp = 0x35424547;  // 'GEB5'
 constexpr uint32_t kMagicStale = 0x52424547;     // 'GEBR' stale ring
+// windowed framing (r7): per-frame ids + a bridge-advertised credit
+// window, so N frames ride one connection and responses complete out
+// of order (serve/edge_bridge.py module docstring for the layouts)
+constexpr uint32_t kMagicWReq = 0x32424547;       // 'GEB2' string req
+constexpr uint32_t kMagicWResp = 0x34424547;      // 'GEB4' string resp
+constexpr uint32_t kMagicWFastReq = 0x37424547;   // 'GEB7' fast req
+constexpr uint32_t kMagicWFastResp = 0x38424547;  // 'GEB8' fast resp
+
+// CLOCK_MONOTONIC microseconds — the same clock domain as the
+// daemon's time.monotonic(), so a frame stamp crosses the socket
+// intact and the bridge can attribute edge->bridge transit
+// (serve/stages.py edge_to_bridge)
+uint64_t mono_us() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::microseconds>(
+             std::chrono::steady_clock::now().time_since_epoch())
+      .count();
+}
+
+// IPv6 bridge endpoint specs are refused loudly (ADVICE r5 #2): the
+// frame protocol splits host:port on the LAST colon, so '[::1]:9100'
+// or a bare '::1' would misparse silently (bracketed host handed to
+// getaddrinfo, or the address mistaken for a unix path).
+bool endpoint_is_ipv6ish(const std::string& s) {
+  if (s.find('[') != std::string::npos ||
+      s.find(']') != std::string::npos)
+    return true;
+  return std::count(s.begin(), s.end(), ':') > 1;
+}
 
 struct Item {
   std::string name;
@@ -198,6 +226,8 @@ struct Node {
 struct Ring {
   uint32_t hash = 0;  // membership fingerprint; echoed in fast frames
   bool fast = false;  // bridge advertises the pre-hashed path
+  bool windowed = false;  // bridge accepts GEB2/GEB7 windowed frames
+  uint32_t window = 0;    // credit window (frames in flight per conn)
   std::vector<Node> nodes;
   std::vector<std::pair<uint32_t, int>> points;  // sorted (point, node)
 
@@ -206,6 +236,18 @@ struct Ring {
     for (size_t i = 0; i < nodes.size(); ++i)
       points.emplace_back(crc32_str(nodes[i].grpc), (int)i);
     std::sort(points.begin(), points.end());
+    // two addresses on one crc32 point (~2^-32/pair) would split
+    // ownership between this sort-order tie-break and the picker's
+    // last-add-wins — and the membership fingerprint cannot catch it.
+    // The daemon's picker refuses the collision; surface it here too
+    // in case a version-skewed daemon let it through (ADVICE r5 #3).
+    for (size_t i = 1; i < points.size(); ++i)
+      if (points[i].first == points[i - 1].first)
+        fprintf(stderr,
+                "guber-edge: ring point collision %#x between '%s' and "
+                "'%s'; placement may diverge from the daemons\n",
+                points[i].first, nodes[points[i - 1].second].grpc.c_str(),
+                nodes[points[i].second].grpc.c_str());
   }
 
   // node index owning `name_key`, or -1 on an empty ring
@@ -686,6 +728,10 @@ bool read_hello(int fd, Ring* out) {
   memcpy(&n_nodes, hdr + 12, 4);
   if (magic != kMagicHello || n_nodes > 65536) return false;
   out->fast = (flags & 1) != 0;
+  out->windowed = (flags & 2) != 0;
+  out->window = flags >> 16;
+  if (out->windowed && out->window == 0) out->window = 1;
+  if (out->window > 1024) out->window = 1024;
   out->hash = rhash;
   out->nodes.clear();
   for (uint32_t i = 0; i < n_nodes; ++i) {
@@ -701,13 +747,22 @@ bool read_hello(int fd, Ring* out) {
     if (!recv_all(fd, (char*)&blen, 2)) return false;
     nd.bridge.resize(blen);
     if (blen && !recv_all(fd, nd.bridge.data(), blen)) return false;
+    if (!nd.bridge.empty() && endpoint_is_ipv6ish(nd.bridge)) {
+      // a misparsed endpoint would dial garbage; treat the node as
+      // bridge-less (its items ride the string path) and say so once
+      fprintf(stderr,
+              "guber-edge: ignoring IPv6 bridge endpoint '%s' for node "
+              "'%s' (bridge endpoints must be IPv4/hostname)\n",
+              nd.bridge.c_str(), nd.grpc.c_str());
+      nd.bridge.clear();
+    }
     out->nodes.push_back(std::move(nd));
   }
   out->index();
   return true;
 }
 
-class Lane {
+class Lane : public std::enable_shared_from_this<Lane> {
  public:
   // `workers` connections to ONE bridge endpoint pull batches from a
   // shared queue, so batch N+1 is in flight while N awaits its
@@ -715,11 +770,21 @@ class Lane {
   // than the reference's concurrent goroutines — per-connection HTTP
   // pipelining stays FIFO.
   //
+  // Windowed mode (r7): when the bridge's hello advertises a credit
+  // window, each worker connection splits into this writer thread and
+  // a detached reader thread. The writer streams frames (each stamped
+  // with a frame id + send time) without waiting for responses, up to
+  // `window` in flight; the reader matches responses by id — possibly
+  // out of order — and finishes their shards. Edge encode/decode of
+  // frame N+1 overlaps the bridge's device wait on frame N, which is
+  // where the one-frame-per-roundtrip protocol burned its wall time.
+  //
   // Lifetime: created through create() only. Worker threads are
   // detached and co-own the Lane via shared_ptr, so an evicted lane
   // (membership churn dropped its endpoint) is freed when its last
   // worker observes `stopping_` and exits — nobody ever joins a
-  // thread that may be blocked on a wedged peer.
+  // thread that may be blocked on a wedged peer. Readers co-own the
+  // Lane and their connection state the same way.
   using HelloFn = std::function<void(const Ring&)>;
 
   static std::shared_ptr<Lane> create(Endpoint ep, int batch_wait_us,
@@ -799,6 +864,8 @@ class Lane {
       return -1;
     }
     fast_ok_.store(ring.fast);
+    windowed_.store(ring.windowed);
+    window_.store(ring.windowed ? (int)ring.window : 0);
     if (on_hello_) on_hello_(ring);
     if (ep_.is_unix) {
       // co-located daemon: no steady-state deadline (pre-r5 contract;
@@ -821,13 +888,11 @@ class Lane {
     return fd;
   }
 
-  // GEB6/GEB5: fixed 33-byte pre-hashed items out, 25-byte decisions
-  // back — the daemon side is a single numpy structured-array view, so
-  // per-item cost exists ONLY in this process. A GEBR reply means the
-  // bridge's membership view differs from the one these shards were
-  // routed with: fail them kStale (the router refreshes its ring).
-  RtStatus roundtrip_fast(int fd, std::vector<Shard*>& batch) {
-    std::string payload;
+  // ---- frame builders / response fillers, shared by the one-frame
+  // round-trip path (version-skewed bridges) and the windowed path ----
+
+  static uint32_t build_fast_payload(const std::vector<Shard*>& batch,
+                                     std::string& payload) {
     uint32_t n = 0;
     for (Shard* s : batch) {
       for (uint32_t i : s->idx) {
@@ -840,6 +905,100 @@ class Lane {
         ++n;
       }
     }
+    return n;
+  }
+
+  static uint32_t build_string_payload(const std::vector<Shard*>& batch,
+                                       std::string& payload) {
+    uint32_t n = 0;
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx) {
+        const Item& it = s->parent->items[i];
+        put_u16(payload, (uint16_t)it.name.size());
+        payload += it.name;
+        put_u16(payload, (uint16_t)it.key.size());
+        payload += it.key;
+        put_i64(payload, it.hits);
+        put_i64(payload, it.limit);
+        put_i64(payload, it.duration);
+        payload.push_back((char)it.algorithm);
+        payload.push_back((char)it.behavior);
+        ++n;
+      }
+    }
+    return n;
+  }
+
+  static void fill_fast_decisions(std::vector<Shard*>& batch,
+                                  const char* raw) {
+    size_t off = 0;
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx) {
+        Decision& d = s->parent->decisions[i];
+        const char* rec = raw + off * 25;
+        d.status = (uint8_t)rec[0];
+        memcpy(&d.limit, rec + 1, 8);
+        memcpy(&d.remaining, rec + 9, 8);
+        memcpy(&d.reset_time, rec + 17, 8);
+        if (!s->owner.empty()) d.owner = s->owner;
+        ++off;
+      }
+    }
+  }
+
+  static bool read_string_decisions(int fd, uint32_t rn,
+                                    std::vector<Decision>& all) {
+    // wire count is attacker/desync-controlled on the windowed path
+    // (the roundtrip caller checks rn==n first, kMagicWResp cannot
+    // until the id lookup below the read): bound the allocation the
+    // same way the GEB8 branch bounds its 25-byte records, else a
+    // corrupt count bad_allocs a detached reader thread and
+    // std::terminate takes the whole edge down. 29 = min bytes/record.
+    if (rn > (64u << 20) / 29) return false;
+    all.assign(rn, Decision());
+    for (uint32_t i = 0; i < rn; ++i) {
+      char fix[25];
+      if (!recv_all(fd, fix, 25)) return false;
+      all[i].status = (uint8_t)fix[0];
+      memcpy(&all[i].limit, fix + 1, 8);
+      memcpy(&all[i].remaining, fix + 9, 8);
+      memcpy(&all[i].reset_time, fix + 17, 8);
+      uint16_t elen;
+      if (!recv_all(fd, (char*)&elen, 2)) return false;
+      all[i].error.resize(elen);
+      if (elen && !recv_all(fd, all[i].error.data(), elen)) return false;
+      uint16_t olen;
+      if (!recv_all(fd, (char*)&olen, 2)) return false;
+      all[i].owner.resize(olen);
+      if (olen && !recv_all(fd, all[i].owner.data(), olen)) return false;
+    }
+    return true;
+  }
+
+  static void fill_string_decisions(std::vector<Shard*>& batch,
+                                    std::vector<Decision>& all) {
+    size_t off = 0;
+    for (Shard* s : batch) {
+      for (uint32_t i : s->idx) {
+        Decision& d = s->parent->decisions[i];
+        d = std::move(all[off++]);
+        // per-owner slow shards (r7): stamp the routed owner when the
+        // serving node left it empty (it owned the key) — parity with
+        // instance-side forwards and the fast path. A node that
+        // re-forwarded a stale-routed item sets its own owner; keep it.
+        if (d.owner.empty() && !s->owner.empty()) d.owner = s->owner;
+      }
+    }
+  }
+
+  // GEB6/GEB5: fixed 33-byte pre-hashed items out, 25-byte decisions
+  // back — the daemon side is a single numpy structured-array view, so
+  // per-item cost exists ONLY in this process. A GEBR reply means the
+  // bridge's membership view differs from the one these shards were
+  // routed with: fail them kStale (the router refreshes its ring).
+  RtStatus roundtrip_fast(int fd, std::vector<Shard*>& batch) {
+    std::string payload;
+    uint32_t n = build_fast_payload(batch, payload);
     std::string frame;
     put_u32(frame, kMagicFastReq);
     put_u32(frame, n);
@@ -858,41 +1017,14 @@ class Lane {
     std::vector<char> raw(25u * rn);
     if (rn && !recv_all(fd, raw.data(), raw.size()))
       return RtStatus::kFail;
-    size_t off = 0;
-    for (Shard* s : batch) {
-      for (uint32_t i : s->idx) {
-        Decision& d = s->parent->decisions[i];
-        const char* rec = raw.data() + off * 25;
-        d.status = (uint8_t)rec[0];
-        memcpy(&d.limit, rec + 1, 8);
-        memcpy(&d.remaining, rec + 9, 8);
-        memcpy(&d.reset_time, rec + 17, 8);
-        if (!s->owner.empty()) d.owner = s->owner;
-        ++off;
-      }
-    }
+    fill_fast_decisions(batch, raw.data());
     return RtStatus::kOk;
   }
 
   RtStatus roundtrip(int fd, std::vector<Shard*>& batch) {
-    std::string frame;
-    uint32_t n = 0;
     std::string payload;
-    for (Shard* s : batch) {
-      for (uint32_t i : s->idx) {
-        const Item& it = s->parent->items[i];
-        put_u16(payload, (uint16_t)it.name.size());
-        payload += it.name;
-        put_u16(payload, (uint16_t)it.key.size());
-        payload += it.key;
-        put_i64(payload, it.hits);
-        put_i64(payload, it.limit);
-        put_i64(payload, it.duration);
-        payload.push_back((char)it.algorithm);
-        payload.push_back((char)it.behavior);
-        ++n;
-      }
-    }
+    uint32_t n = build_string_payload(batch, payload);
+    std::string frame;
     put_u32(frame, kMagicReq);
     put_u32(frame, n);
     put_u32(frame, (uint32_t)payload.size());
@@ -905,36 +1037,261 @@ class Lane {
     memcpy(&magic, hdr, 4);
     memcpy(&rn, hdr + 4, 4);
     if (magic != kMagicResp || rn != n) return RtStatus::kFail;
-    std::vector<Decision> all(rn);
-    for (uint32_t i = 0; i < rn; ++i) {
-      char fix[25];
-      if (!recv_all(fd, fix, 25)) return RtStatus::kFail;
-      all[i].status = (uint8_t)fix[0];
-      memcpy(&all[i].limit, fix + 1, 8);
-      memcpy(&all[i].remaining, fix + 9, 8);
-      memcpy(&all[i].reset_time, fix + 17, 8);
-      uint16_t elen;
-      if (!recv_all(fd, (char*)&elen, 2)) return RtStatus::kFail;
-      all[i].error.resize(elen);
-      if (elen && !recv_all(fd, all[i].error.data(), elen))
-        return RtStatus::kFail;
-      uint16_t olen;
-      if (!recv_all(fd, (char*)&olen, 2)) return RtStatus::kFail;
-      all[i].owner.resize(olen);
-      if (olen && !recv_all(fd, all[i].owner.data(), olen))
-        return RtStatus::kFail;
-    }
-    size_t off = 0;
-    for (Shard* s : batch) {
-      for (uint32_t i : s->idx)
-        s->parent->decisions[i] = std::move(all[off++]);
-    }
+    std::vector<Decision> all;
+    if (!read_string_decisions(fd, rn, all)) return RtStatus::kFail;
+    fill_string_decisions(batch, all);
     return RtStatus::kOk;
+  }
+
+  // ---- windowed connection state (r7) ----
+  // Co-owned by the writer worker and its detached reader thread; the
+  // last owner's destructor closes the fd. kill() (shutdown both
+  // directions) is safe to call while the other thread is blocked on
+  // the fd — it unblocks reads without invalidating the descriptor.
+  struct ConnState {
+    int fd = -1;
+    std::mutex m;
+    std::condition_variable cv;
+    struct Entry {
+      std::vector<Shard*> batch;
+      bool fast = false;
+      uint32_t n = 0;
+      // when the frame was registered: the reader's receive timeout
+      // must measure the oldest frame's OWN wait, not an idle-parked
+      // countdown a fresh frame happened to inherit
+      std::chrono::steady_clock::time_point sent{};
+    };
+    std::unordered_map<uint32_t, Entry> inflight;  // frame_id -> entry
+    bool dead = false;                             // guarded by m
+    ~ConnState() {
+      if (fd >= 0) close(fd);
+    }
+    void kill() { ::shutdown(fd, SHUT_RDWR); }
+  };
+
+  // Fail every frame still in flight (connection died, stream
+  // desynced, or GEBR refused the routed view). Entries the writer
+  // reclaimed on a failed send are already gone from the map, so no
+  // shard is ever finished twice.
+  static void drain_windowed(const std::shared_ptr<ConnState>& st,
+                             RtStatus rst) {
+    std::vector<ConnState::Entry> orphans;
+    {
+      std::lock_guard<std::mutex> lk(st->m);
+      st->dead = true;
+      for (auto& kv : st->inflight)
+        orphans.push_back(std::move(kv.second));
+      st->inflight.clear();
+    }
+    st->cv.notify_all();
+    for (auto& e : orphans)
+      for (Shard* s : e.batch) finish_shard(s, rst);
+  }
+
+  // Reader thread: match windowed responses to in-flight frames by id
+  // (out-of-order completion is the point), finish their shards, and
+  // release writer credit. Any protocol surprise or read failure kills
+  // the connection and fails whatever is still outstanding. On peer
+  // connections SO_RCVTIMEO bounds a wedged bridge; a timeout with
+  // NOTHING in flight is just an idle connection and keeps waiting.
+  void reader_loop(std::shared_ptr<ConnState> st) {
+    auto recv_exact = [&](char* p, size_t nbytes, bool idle_ok) -> bool {
+      size_t got = 0;
+      while (got < nbytes) {
+        ssize_t r = read(st->fd, p + got, nbytes - got);
+        if (r > 0) {
+          got += (size_t)r;
+          continue;
+        }
+        if (r < 0 && errno == EINTR) continue;
+        if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK) &&
+            idle_ok && got == 0) {
+          bool keep_waiting;
+          {
+            std::lock_guard<std::mutex> lk(st->m);
+            if (st->dead) {
+              keep_waiting = false;
+            } else if (st->inflight.empty()) {
+              keep_waiting = true;  // healthy idle conn: keep parking
+            } else {
+              // the SO_RCVTIMEO countdown that just expired mostly
+              // measured idle time if a frame was sent moments ago —
+              // only declare the bridge wedged once the OLDEST
+              // in-flight frame has itself waited out the timeout
+              auto oldest =
+                  std::chrono::steady_clock::time_point::max();
+              for (const auto& kv : st->inflight)
+                if (kv.second.sent < oldest) oldest = kv.second.sent;
+              keep_waiting = std::chrono::steady_clock::now() - oldest <
+                             std::chrono::seconds(g_peer_timeout_s);
+            }
+          }
+          if (keep_waiting) continue;
+        }
+        return false;
+      }
+      return true;
+    };
+    std::vector<char> raw;
+    RtStatus fail_as = RtStatus::kFail;
+    while (true) {
+      char hdr[8];
+      if (!recv_exact(hdr, 8, /*idle_ok=*/true)) break;
+      uint32_t magic, second;
+      memcpy(&magic, hdr, 4);
+      memcpy(&second, hdr + 4, 4);
+      if (magic == kMagicStale) {
+        // second = the refused frame id; every outstanding frame was
+        // routed with the same stale view, so they all fail kStale
+        // (the router wakes its refresher)
+        fail_as = RtStatus::kStale;
+        break;
+      }
+      char fidb[4];
+      uint32_t fid;
+      if (magic == kMagicWFastResp) {
+        if (!recv_exact(fidb, 4, false)) break;
+        memcpy(&fid, fidb, 4);
+        if (second > (uint32_t)(64 << 20) / 25) break;  // absurd count
+        raw.resize((size_t)25 * second);
+        if (second && !recv_exact(raw.data(), raw.size(), false)) break;
+        ConnState::Entry e;
+        bool ok = false;
+        {
+          std::lock_guard<std::mutex> lk(st->m);
+          auto it = st->inflight.find(fid);
+          if (it != st->inflight.end() && it->second.fast &&
+              it->second.n == second) {
+            e = std::move(it->second);
+            st->inflight.erase(it);
+            ok = true;
+          }
+        }
+        if (!ok) break;  // unknown id / kind mismatch: stream desynced
+        st->cv.notify_all();
+        fill_fast_decisions(e.batch, raw.data());
+        for (Shard* s : e.batch) finish_shard(s, RtStatus::kOk);
+        continue;
+      }
+      if (magic == kMagicWResp) {
+        if (!recv_exact(fidb, 4, false)) break;
+        memcpy(&fid, fidb, 4);
+        std::vector<Decision> all;
+        if (!read_string_decisions(st->fd, second, all)) break;
+        ConnState::Entry e;
+        bool ok = false;
+        {
+          std::lock_guard<std::mutex> lk(st->m);
+          auto it = st->inflight.find(fid);
+          if (it != st->inflight.end() && !it->second.fast &&
+              it->second.n == second) {
+            e = std::move(it->second);
+            st->inflight.erase(it);
+            ok = true;
+          }
+        }
+        if (!ok) break;
+        st->cv.notify_all();
+        fill_string_decisions(e.batch, all);
+        for (Shard* s : e.batch) finish_shard(s, RtStatus::kOk);
+        continue;
+      }
+      break;  // unknown magic: desynced
+    }
+    st->kill();  // unblock a writer mid-send; sends now fail fast
+    drain_windowed(st, fail_as);
+  }
+
+  // Stream one batch as a windowed frame: register it in the in-flight
+  // table (credit-gated), send, and return without waiting — the
+  // reader finishes the shards whenever the response lands. Returns
+  // false when the connection must be dropped; the batch's shards are
+  // finished on every failure path.
+  bool send_windowed(const std::shared_ptr<ConnState>& st,
+                     std::vector<Shard*>& batch, bool fast,
+                     uint32_t& next_frame_id) {
+    std::string payload;
+    uint32_t n = fast ? build_fast_payload(batch, payload)
+                      : build_string_payload(batch, payload);
+    uint32_t window = (uint32_t)std::max(1, window_.load());
+    uint32_t fid;
+    {
+      std::unique_lock<std::mutex> lk(st->m);
+      // credit gate: at most `window` frames in flight per connection
+      // (the bridge advertises the window it is willing to serve
+      // concurrently; beyond it frames would only queue in its socket)
+      st->cv.wait(lk, [&] {
+        return st->dead || st->inflight.size() < window;
+      });
+      if (st->dead) {
+        lk.unlock();
+        for (Shard* s : batch) finish_shard(s, RtStatus::kFail);
+        return false;
+      }
+      fid = next_frame_id++;
+      auto& e = st->inflight[fid];
+      e.batch = batch;
+      e.fast = fast;
+      e.n = n;
+      e.sent = std::chrono::steady_clock::now();
+    }
+    std::string frame;
+    uint64_t t_sent = mono_us();
+    if (fast) {
+      put_u32(frame, kMagicWFastReq);
+      put_u32(frame, n);
+      put_u32(frame, fid);
+      put_u32(frame, batch[0]->ring_hash);  // batches share one view
+      frame.append((const char*)&t_sent, 8);
+      put_u32(frame, (uint32_t)payload.size());
+    } else {
+      put_u32(frame, kMagicWReq);
+      put_u32(frame, n);
+      put_u32(frame, fid);
+      frame.append((const char*)&t_sent, 8);
+      put_u32(frame, (uint32_t)payload.size());
+    }
+    frame += payload;
+    if (!send_all(st->fd, frame.data(), frame.size())) {
+      // a partial write desyncs the stream: reclaim OUR frame if the
+      // reader hasn't already drained it, then drop the connection
+      bool mine;
+      {
+        std::lock_guard<std::mutex> lk(st->m);
+        mine = st->inflight.erase(fid) > 0;
+      }
+      if (mine)
+        for (Shard* s : batch) finish_shard(s, RtStatus::kFail);
+      return false;
+    }
+    return true;
   }
 
   void run() {
     int fd = connect_backend();
     if (fd >= 0) connected_.fetch_add(1);
+    std::shared_ptr<ConnState> st;  // non-null = windowed connection
+    uint32_t next_frame_id = 1;
+    auto adopt_windowed = [&] {
+      if (fd >= 0 && windowed_.load()) {
+        st = std::make_shared<ConnState>();
+        st->fd = fd;
+        auto self = shared_from_this();
+        auto stc = st;
+        std::thread([self, stc] { self->reader_loop(stc); }).detach();
+      }
+    };
+    auto drop_conn = [&] {
+      if (st) {
+        st->kill();  // reader fails anything left in flight and exits
+        st.reset();  // last ConnState owner closes the fd
+      } else if (fd >= 0) {
+        close(fd);
+      }
+      if (fd >= 0) connected_.fetch_sub(1);
+      fd = -1;
+    };
+    adopt_windowed();
     started_.fetch_add(1);
     while (true) {
       std::vector<Shard*> batch;
@@ -960,7 +1317,7 @@ class Lane {
           Shard* head = q.front();
           size_t next = head->idx.size();
           if (!batch.empty() && (int)(take_items + next) > limit_) break;
-          // a GEB6 frame carries ONE ring fingerprint: shards routed
+          // a fast frame carries ONE ring fingerprint: shards routed
           // under different membership views never co-batch
           if (fast && !batch.empty() &&
               head->ring_hash != batch[0]->ring_hash)
@@ -975,7 +1332,10 @@ class Lane {
       if (batch.empty()) continue;
       if (fd < 0) {
         fd = connect_backend();
-        if (fd >= 0) connected_.fetch_add(1);
+        if (fd >= 0) {
+          connected_.fetch_add(1);
+          adopt_windowed();
+        }
       }
       if (fast && fd >= 0 && !fast_ok_.load()) {
         // safety net (the router folds non-fast peers' items into the
@@ -985,10 +1345,16 @@ class Lane {
         for (Shard* s : batch) finish_shard(s, RtStatus::kFail);
         continue;
       }
-      RtStatus st = RtStatus::kFail;
+      if (st) {
+        // windowed: stream the frame and immediately collect the next
+        // batch — the reader completes it whenever the bridge answers
+        if (!send_windowed(st, batch, fast, next_frame_id)) drop_conn();
+        continue;
+      }
+      RtStatus rst = RtStatus::kFail;
       if (fd >= 0) {
-        st = fast ? roundtrip_fast(fd, batch) : roundtrip(fd, batch);
-        if (st != RtStatus::kOk) {
+        rst = fast ? roundtrip_fast(fd, batch) : roundtrip(fd, batch);
+        if (rst != RtStatus::kOk) {
           // GEBR also closes bridge-side; reconnecting re-reads the
           // hello, which (on the primary lane) republishes the ring
           close(fd);
@@ -996,12 +1362,17 @@ class Lane {
           connected_.fetch_sub(1);
         }
       }
-      for (Shard* s : batch) finish_shard(s, st);
+      for (Shard* s : batch) finish_shard(s, rst);
     }
-    if (fd >= 0) {
-      close(fd);
-      connected_.fetch_sub(1);
+    if (st) {
+      // bounded drain: let in-flight windowed frames finish before the
+      // kill, preserving shutdown()'s in-flight-completes contract
+      std::unique_lock<std::mutex> lk(st->m);
+      st->cv.wait_for(lk, std::chrono::seconds(5), [&] {
+        return st->inflight.empty() || st->dead;
+      });
     }
+    drop_conn();
   }
 
   Endpoint ep_;
@@ -1010,6 +1381,10 @@ class Lane {
   std::atomic<int> connected_{0};
   std::atomic<int> started_{0};
   std::atomic<bool> fast_ok_{false};
+  // windowed capability from the last hello (per-lane; connections made
+  // before a bridge upgrade keep their negotiated mode)
+  std::atomic<bool> windowed_{false};
+  std::atomic<int> window_{0};
   HelloFn on_hello_;
   std::mutex m_;
   std::condition_variable cv_;
@@ -1066,46 +1441,79 @@ class Router {
     Shard slow;
     slow.parent = &p;
     std::map<int, Shard> fast_by_node;
+    // per-owner STRING shards (r7 slow-path owner batching): items
+    // that fall off the pre-hashed path (fast kill switch, a peer
+    // that doesn't advertise it, mixed fleets) but whose OWNER has a
+    // reachable bridge ship as string frames straight to that owner —
+    // the owner serves them locally through its full instance —
+    // instead of funnelling through the primary's instance and a
+    // second gRPC forwarding hop. String frames carry no ring
+    // fingerprint: a stale-routed item is simply forwarded by its
+    // receiver, so this path needs no GEBR machinery.
+    std::map<int, Shard> slow_by_node;
     std::map<int, std::shared_ptr<Lane>> lane_by_node;
+    auto lane_at = [&](int node) -> std::shared_ptr<Lane>& {
+      auto lit = lane_by_node.find(node);
+      if (lit == lane_by_node.end())
+        lit = lane_by_node
+                  .emplace(node, lane_for(ring->nodes[node].bridge))
+                  .first;
+      return lit->second;
+    };
     for (uint32_t i = 0; i < p.items.size(); ++i) {
       Item& it = p.items[i];
       // GLOBAL needs the instance's replica/gossip path; empty fields
-      // need its per-item validation errors
-      bool eligible = ring && ring->fast && it.behavior != 2 &&
-                      !it.name.empty() && !it.key.empty();
+      // need its per-item validation errors — both stay on the
+      // primary. Ownership itself only needs the ring (carried by the
+      // hello regardless of the fast capability).
+      bool routable = ring && it.behavior != 2 && !it.name.empty() &&
+                      !it.key.empty();
       int node = -1;
-      if (eligible) {
+      if (routable) {
         node = ring->owner(it.name, it.key);
-        eligible = node >= 0;
+        routable = node >= 0;
       }
+      bool eligible = routable && ring->fast;
       if (eligible && !ring->nodes[node].self) {
         const Node& nd = ring->nodes[node];
         if (nd.bridge.empty()) {
           eligible = false;
         } else {
-          auto lit = lane_by_node.find(node);
-          if (lit == lane_by_node.end())
-            lit = lane_by_node.emplace(node, lane_for(nd.bridge)).first;
-          // a peer that hasn't advertised the fast path (mixed fleet,
-          // or its lane hasn't completed the first hello yet) gets its
-          // items over the slow path — the primary's instance forwards
-          // them over gRPC — instead of a doomed pre-hashed frame
-          if (!lit->second->fast_advertised()) eligible = false;
+          // a departed endpoint (nullptr: this ring snapshot predates
+          // an eviction) or a peer that hasn't advertised the fast
+          // path (mixed fleet, or its lane hasn't completed the first
+          // hello yet) gets its items over the slow path instead of a
+          // doomed pre-hashed frame
+          auto& lane = lane_at(node);
+          if (!lane || !lane->fast_advertised()) eligible = false;
         }
       }
-      if (!eligible) {
-        slow.idx.push_back(i);
+      if (eligible) {
+        Shard& sh = fast_by_node[node];
+        if (sh.parent == nullptr) {
+          sh.parent = &p;
+          sh.fast = true;
+          sh.ring_hash = ring->hash;
+          if (!ring->nodes[node].self)
+            sh.owner = ring->nodes[node].grpc;
+        }
+        sh.idx.push_back(i);
+        it.hash = slot_hash(it.name, it.key);
         continue;
       }
-      Shard& sh = fast_by_node[node];
-      if (sh.parent == nullptr) {
-        sh.parent = &p;
-        sh.fast = true;
-        sh.ring_hash = ring->hash;
-        if (!ring->nodes[node].self) sh.owner = ring->nodes[node].grpc;
+      // slow path: per-owner where the owner's bridge is reachable,
+      // the primary's string frame otherwise
+      if (routable && !ring->nodes[node].self &&
+          !ring->nodes[node].bridge.empty() && lane_at(node)) {
+        Shard& sh = slow_by_node[node];
+        if (sh.parent == nullptr) {
+          sh.parent = &p;
+          sh.owner = ring->nodes[node].grpc;
+        }
+        sh.idx.push_back(i);
+        continue;
       }
-      sh.idx.push_back(i);
-      it.hash = slot_hash(it.name, it.key);
+      slow.idx.push_back(i);
     }
 
     // Degraded-cluster heuristic: when the ONLY fast destination is
@@ -1128,14 +1536,18 @@ class Router {
       }
     }
 
-    int n_shards =
-        (slow.idx.empty() ? 0 : 1) + (int)fast_by_node.size();
+    int n_shards = (slow.idx.empty() ? 0 : 1) +
+                   (int)slow_by_node.size() + (int)fast_by_node.size();
     {
       std::lock_guard<std::mutex> lk(p.m);
       p.shards_left = n_shards;
     }
     if (!slow.idx.empty() && !primary_->submit(&slow))
       finish_shard(&slow, RtStatus::kFail);
+    for (auto& [node, sh] : slow_by_node) {
+      if (!lane_by_node.at(node)->submit(&sh))
+        finish_shard(&sh, RtStatus::kFail);
+    }
     for (auto& [node, sh] : fast_by_node) {
       std::shared_ptr<Lane> lane = ring->nodes[node].self
                                        ? primary_
@@ -1156,9 +1568,30 @@ class Router {
                   p.items[i].key + "' from peer - '" + why + "'";
       }
     };
+    // string shards can fail kStale too (r7): a GEBR refusing a fast
+    // frame drains EVERY frame in flight on that connection as stale,
+    // string frames included — those must surface as the per-item
+    // retry error and wake the refresher, not read as a dead backend
     if (!slow.idx.empty()) {
-      if (slow.failed) fill_errors(slow, "edge backend unavailable");
-      else any_ok = true;
+      if (slow.failed) {
+        saw_stale |= slow.stale;
+        fill_errors(slow, slow.stale
+                              ? "edge: cluster membership changed; retry"
+                              : "edge backend unavailable");
+      } else {
+        any_ok = true;
+      }
+    }
+    for (auto& [node, sh] : slow_by_node) {
+      (void)node;
+      if (!sh.failed) {
+        any_ok = true;
+        continue;
+      }
+      saw_stale |= sh.stale;
+      fill_errors(sh, sh.stale
+                          ? "edge: cluster membership changed; retry"
+                          : "edge: bridge " + sh.owner + " unreachable");
     }
     for (auto& [node, sh] : fast_by_node) {
       (void)node;
@@ -1255,10 +1688,23 @@ class Router {
   // evicts lanes for departed endpoints. The returned shared_ptr keeps
   // a lane usable by an in-flight execute() even if eviction races it
   // (submit on a stopped lane fails cleanly instead of dangling).
+  // Returns nullptr for an endpoint NOT in the CURRENT ring: an
+  // in-flight execute() routing with a pre-eviction ring must not
+  // resurrect a just-evicted lane — the recreated lane's detached
+  // workers would sit on a dead peer until the next ring publish
+  // (ADVICE r5 #1); the caller folds those items into the slow path.
   std::shared_ptr<Lane> lane_for(const std::string& spec) {
+    // ring before lanes_m_ — current_ring() takes ring_m_, and
+    // publish_ring never holds ring_m_ while taking lanes_m_
+    std::shared_ptr<const Ring> ring = current_ring();
     std::lock_guard<std::mutex> lk(lanes_m_);
     auto it = lanes_.find(spec);
     if (it != lanes_.end()) return it->second;
+    bool member = false;
+    if (ring)
+      for (const Node& nd : ring->nodes)
+        if (!nd.self && nd.bridge == spec) member = true;
+    if (!member) return nullptr;
     auto lane =
         Lane::create(parse_endpoint(spec), wait_us_, limit_, workers_,
                      nullptr, /*wait_connect=*/false);
@@ -1529,6 +1975,18 @@ int main(int argc, char** argv) {
       fprintf(stderr, "bad value for %s: %s\n%s", a.c_str(), v, kUsage);
       return 2;
     }
+  }
+
+  // the frame protocol splits host:port on the LAST colon, so an IPv6
+  // --backend ('[::1]:9100', bare '::1') would misparse silently
+  // (bracketed host handed to the resolver, or the address mistaken
+  // for a unix path). Refuse at config parse time (ADVICE r5 #2).
+  if (endpoint_is_ipv6ish(backend)) {
+    fprintf(stderr,
+            "--backend '%s' looks like an IPv6 literal; the backend must "
+            "be a unix socket path or an IPv4/hostname 'host:port'\n",
+            backend.c_str());
+    return 2;
   }
 
   // bind BEFORE constructing the router: its primary lane blocks on

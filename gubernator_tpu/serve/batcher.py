@@ -46,6 +46,7 @@ from typing import List, Optional, Sequence, Tuple
 from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
 from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.aio import collect_batch
+from gubernator_tpu.serve.stages import STAGES
 
 
 def _item_weight(item) -> int:
@@ -159,9 +160,14 @@ class DeviceBatcher:
         self._fetch_pool.shutdown(wait=False)
 
     async def decide(
-        self, reqs: Sequence[RateLimitReq], gnp: Sequence[bool]
+        self,
+        reqs: Sequence[RateLimitReq],
+        gnp: Sequence[bool],
+        frame: bool = False,
     ) -> List[RateLimitResp]:
-        """Submit requests; resolves when their device batch completes."""
+        """Submit requests; resolves when their device batch completes.
+        `frame=True` marks the group as one edge frame's work for the
+        per-frame stage clock (serve/stages.py)."""
         if not reqs:
             return []
         if self._closed:
@@ -192,19 +198,29 @@ class DeviceBatcher:
         # time. Groups are flattened at flush and responses sliced back.
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
+        # the second-to-last slot of EVERY queue tuple is the enqueue
+        # timestamp — the start of the batch_queue stage (serve/stages).
+        # None = unattributed: per-frame stages must count ONLY groups
+        # that belong to an edge frame, or the coverage ratio's
+        # numerator (stage seconds) outgrows its denominator (frame
+        # e2e) under direct gRPC/HTTP/peer traffic
         self._queue.put_nowait(
-            ("decide", list(reqs), [bool(g) for g in gnp], fut)
+            ("decide", list(reqs), [bool(g) for g in gnp],
+             time.monotonic() if frame else None, fut)
         )
         return await fut
 
-    async def decide_arrays(self, fields: dict):
+    async def decide_arrays(self, fields: dict, frame: bool = True):
         """Array-group decide — the edge bridge's pre-hashed fast path.
         `fields`: key_hash/hits/limit/duration/algo numpy arrays (gnp
         optional, default all-False; the edge routes GLOBAL items via the
         request-object path). Resolves to (status, limit, remaining,
         reset_time) arrays for exactly these rows, co-batched and
         pipelined with every other caller. Only valid on backends
-        exposing decide_submit_arrays (the device backends)."""
+        exposing decide_submit_arrays (the device backends).
+        `frame=False` keeps a group out of the per-frame stage clock —
+        a chunked frame flags only its first chunk, so one frame
+        contributes one batch_queue/device span, not one per chunk."""
         if fields["key_hash"].shape[0] == 0:
             import numpy as np
 
@@ -214,7 +230,10 @@ class DeviceBatcher:
             raise RuntimeError("DeviceBatcher is stopped")
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._queue.put_nowait(("decide_arrays", fields, fut))
+        self._queue.put_nowait(
+            ("decide_arrays", fields,
+             time.monotonic() if frame else None, fut)
+        )
         return await fut
 
     async def update_globals(self, updates) -> None:
@@ -224,7 +243,7 @@ class DeviceBatcher:
             raise RuntimeError("DeviceBatcher is stopped")
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._queue.put_nowait(("globals", updates, fut))
+        self._queue.put_nowait(("globals", updates, time.monotonic(), fut))
         await fut
 
     async def _run(self) -> None:
@@ -277,9 +296,15 @@ class DeviceBatcher:
             b for b in batch if b[0] in ("decide", "decide_arrays")
         ]
         global_items = [b for b in batch if b[0] == "globals"]
+        # batch_queue stage: enqueue -> collect, per frame-flagged
+        # caller group (enqueue stamp None = unattributed traffic)
+        t_collect = time.monotonic()
+        for it in decide_items:
+            if it[-2] is not None:
+                STAGES.add("batch_queue", t_collect - it[-2])
 
         inline = self._inline
-        for _, updates, fut in global_items:
+        for _, updates, _t_enq, fut in global_items:
             try:
                 if inline:
                     self.backend.update_globals(updates)
@@ -301,10 +326,10 @@ class DeviceBatcher:
             # mixed/array batch: flatten everything to dense arrays and
             # take the array submit path (bridge gates array groups to
             # array-capable backends, so decide_submit_arrays exists)
-            await self._flush_arrays(decide_items)
+            await self._flush_arrays(decide_items, t_collect)
             return
-        reqs = [r for _, rs, _, _ in decide_items for r in rs]
-        gnp = [g for _, _, gs, _ in decide_items for g in gs]
+        reqs = [r for _, rs, _, _, _ in decide_items for r in rs]
+        gnp = [g for _, _, gs, _, _ in decide_items for g in gs]
         t0 = time.monotonic()
         submit = getattr(self.backend, "decide_submit", None)
         if submit is None:
@@ -325,6 +350,10 @@ class DeviceBatcher:
                 self._fail(decide_items, e)
                 return
             self._resolve(decide_items, resps, time.monotonic() - t0)
+            span = time.monotonic() - t_collect
+            nf = sum(1 for it in decide_items if it[-2] is not None)
+            if nf:
+                STAGES.add("device", span * nf, nf)
             return
 
         # pipelined path: submit now (host presort + async dispatch);
@@ -334,7 +363,7 @@ class DeviceBatcher:
             lambda: submit(reqs, gnp),
             decide_items,
             lambda handle, submit_s: self._finish(
-                handle, decide_items, submit_s
+                handle, decide_items, submit_s, t_collect
             ),
         )
 
@@ -379,6 +408,7 @@ class DeviceBatcher:
             self._fail(decide_items, e)
             return
         submit_s = time.monotonic() - t0
+        STAGES.add("submit_host", submit_s)
         task = asyncio.ensure_future(finish_factory(handle, submit_s))
         # hold the reference until done (stop() drains the set); discard
         # on completion so an idle batcher doesn't pin the last batches'
@@ -390,7 +420,7 @@ class DeviceBatcher:
         # is the same list object _run handed to _flush.
         self._live_batch.clear()
 
-    async def _flush_arrays(self, decide_items) -> None:
+    async def _flush_arrays(self, decide_items, t_collect) -> None:
         """Array-path sibling of the pipelined branch in _flush: convert
         request-object groups, concatenate all groups into one dense
         field set, submit once, and let _finish_arrays slice responses
@@ -438,11 +468,13 @@ class DeviceBatcher:
             submit_call,
             decide_items,
             lambda handle, submit_s: self._finish_arrays(
-                handle, decide_items, lens, submit_s
+                handle, decide_items, lens, submit_s, t_collect
             ),
         )
 
-    async def _finish_arrays(self, handle, decide_items, lens, submit_s):
+    async def _finish_arrays(
+        self, handle, decide_items, lens, submit_s, t_collect
+    ):
         t1 = time.monotonic()
         loop = asyncio.get_running_loop()
         try:
@@ -454,6 +486,7 @@ class DeviceBatcher:
             return
         finally:
             self._inflight.release()
+            STAGES.add("fetch_wait", time.monotonic() - t1)
         k = 0
         for it, n in zip(decide_items, lens):
             span = (
@@ -470,6 +503,13 @@ class DeviceBatcher:
                 fut.set_result(self.backend.resps_from_arrays(*span))
             else:
                 fut.set_result(span)
+        # device stage: collect -> responses resolved, per
+        # frame-flagged caller group (covers submit + device execute +
+        # fetch + pipeline wait)
+        dev_span = time.monotonic() - t_collect
+        nf = sum(1 for it in decide_items if it[-2] is not None)
+        if nf:
+            STAGES.add("device", dev_span * nf, nf)
         try:
             metrics.DEVICE_BATCH_SIZE.observe(k)
             metrics.DEVICE_LAUNCH_MS.observe(
@@ -479,7 +519,9 @@ class DeviceBatcher:
         except Exception:  # pragma: no cover - defensive
             pass
 
-    async def _finish(self, handle, decide_items, submit_s: float):
+    async def _finish(
+        self, handle, decide_items, submit_s: float, t_collect: float
+    ):
         t1 = time.monotonic()
         loop = asyncio.get_running_loop()
         try:
@@ -491,12 +533,17 @@ class DeviceBatcher:
             return
         finally:
             self._inflight.release()
+            STAGES.add("fetch_wait", time.monotonic() - t1)
         # own cost only: host submit + own fetch span — NOT the time
         # spent queued behind earlier batches, which would double-count
         # device time under steady pipelining
         self._resolve(
             decide_items, resps, submit_s + (time.monotonic() - t1)
         )
+        dev_span = time.monotonic() - t_collect
+        nf = sum(1 for it in decide_items if it[-2] is not None)
+        if nf:
+            STAGES.add("device", dev_span * nf, nf)
 
     def _fail(self, items, exc: BaseException) -> None:
         # both queue item shapes carry their future last
@@ -511,7 +558,7 @@ class DeviceBatcher:
         # future request with no error surfaced). Responses come back
         # flat in flatten order; slice one span per caller group.
         k = 0
-        for _, rs, _, fut in decide_items:
+        for _, rs, _, _, fut in decide_items:
             span = resps[k : k + len(rs)]
             k += len(rs)
             if not fut.done():

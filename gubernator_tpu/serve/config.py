@@ -100,6 +100,23 @@ class ServerConfig:
     # instance. Operational fallback, and the slow-path denominator in
     # scripts/bench_edge_cluster.py.
     edge_fast: bool = True
+    # Credit window the bridge advertises in its hello (r7): max frames
+    # one edge connection may keep in flight. Each in-flight frame is a
+    # concurrently-served batch, so this bounds per-connection memory
+    # and co-batching depth; past ~the device fetch pipeline depth,
+    # more window buys only queueing. 0 = GUBER_EDGE_WINDOW (default
+    # 32). Exceeding the window is TCP-backpressured, never dropped.
+    edge_window: int = 0
+    # String->array fold (r7 slow-path owner batching, bridge side): a
+    # string frame whose items are ALL plain (BATCHING/NO_BATCHING,
+    # valid non-empty name/key) and ALL owned by this node skips
+    # request/response objects and instance routing, riding the same
+    # array path as pre-hashed frames. This is what keeps the
+    # GUBER_EDGE_FAST=0 kill switch (and bridge-carrying slow paths in
+    # general) near fast-path latency; GLOBAL items, validation
+    # errors, and misrouted items still take the full instance path.
+    # GUBER_EDGE_STRING_FOLD=0 restores the pre-r7 all-objects path.
+    edge_string_fold: bool = True
 
     # multi-host mesh (GUBER_DIST_*): one jax.distributed program over
     # several hosts; process 0 serves (backend=multihost), others run the
@@ -247,6 +264,23 @@ class ServerConfig:
             raise ValueError(
                 "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
             )
+        if self.edge_window < 0:
+            raise ValueError("GUBER_EDGE_WINDOW must be >= 0")
+        # bridge endpoints split host:port on the LAST colon — IPv6
+        # literals would misparse silently; refuse at config time
+        # (ADVICE r5 #2; serve/edge_bridge.reject_ipv6_endpoint)
+        from gubernator_tpu.serve.edge_bridge import reject_ipv6_endpoint
+
+        if self.edge_tcp:
+            reject_ipv6_endpoint(self.edge_tcp, "GUBER_EDGE_TCP")
+        for pair in self.edge_peer_bridges.split(","):
+            if not pair.strip():
+                continue
+            _, sep, bridge = pair.strip().partition("=")
+            if sep and bridge:
+                reject_ipv6_endpoint(
+                    bridge, "GUBER_EDGE_PEER_BRIDGES entry"
+                )
         if self.etcd_endpoints and self.k8s_endpoints_selector:
             raise ValueError(
                 "choose either etcd or kubernetes discovery, not both"
@@ -344,6 +378,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         edge_tcp=_get(env, "GUBER_EDGE_TCP"),
         edge_peer_bridges=_get(env, "GUBER_EDGE_PEER_BRIDGES"),
         edge_fast=_get(env, "GUBER_EDGE_FAST", "1").lower()
+        not in ("0", "false", "no", "off"),
+        edge_window=_get_int(env, "GUBER_EDGE_WINDOW", 0),
+        edge_string_fold=_get(env, "GUBER_EDGE_STRING_FOLD", "1").lower()
         not in ("0", "false", "no", "off"),
         dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),
         dist_num_processes=_get_int(env, "GUBER_DIST_NUM_PROCESSES", 1),

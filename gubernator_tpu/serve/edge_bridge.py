@@ -20,6 +20,21 @@ fast frames echo a fingerprint of the membership they were routed
 with, and a frame routed under a stale view is refused with a GEBR
 frame so the edge re-reads the ring — never silently mis-admitted.
 
+Windowed pipelining (r7): the r5 protocol allowed ONE frame in flight
+per connection, so every frame paid a full bridge round trip before
+the next could even be decoded — the edge's decode/encode serialized
+against the daemon's device wait, and the served rate was capped at
+(frames/connection-RTT) x connections. The hello now advertises a
+credit window W (flags bit 1 + high 16 bits); a windowed edge keeps up
+to W frames outstanding per connection, each carrying a frame id, and
+the bridge serves them CONCURRENTLY — responses complete out of order
+and are matched by id. Frames from all connections co-batch in the
+device batcher's queue (deep rungs under load), and edge-side
+decode/encode of frame N+1 overlaps the device wait of frame N.
+Exceeding the window is backpressured, not policed: the bridge stops
+reading the connection until a slot frees, so TCP flow control pushes
+back to the edge (which also respects the advertised credit itself).
+
 Frame protocol (little-endian, lengths in bytes):
 
   hello (bridge->edge, on connect):
@@ -28,12 +43,15 @@ Frame protocol (little-endian, lengths in bytes):
       node: u8 is_self | u16 grpc_len | grpc_addr |
             u16 bridge_len | bridge_addr
       flags bit 0: pre-hashed fast path available (array backend).
+      flags bit 1: windowed frames accepted (GEB2/GEB7); the credit
+      window (max frames in flight per connection) is flags >> 16.
       ring_hash = crc32 of "\n".join(sorted(grpc addresses)) — the
       membership fingerprint fast frames must echo. bridge_addr is
-      where an edge reaches THAT node's bridge ("host:port"); empty
-      for this node (the edge uses its configured --backend) and for
-      peers when GUBER_EDGE_TCP is unset (the edge then routes those
-      items through the string path, which forwards via gRPC).
+      where an edge reaches THAT node's bridge ("host:port",
+      IPv4/hostname only — IPv6 specs are refused at config time);
+      empty for this node (the edge uses its configured --backend) and
+      for peers when GUBER_EDGE_TCP is unset (the edge then routes
+      those items through the string path).
   request frame:   u32 magic 'GEB1' | u32 n | u32 payload_len |
                    payload = n x item
       item: u16 name_len | name | u16 key_len | key |
@@ -42,22 +60,36 @@ Frame protocol (little-endian, lengths in bytes):
   response frame:  u32 magic 'GEB3' | u32 n | n x item
       item: u8 status | i64 limit | i64 remaining | i64 reset_time |
             u16 error_len | error | u16 owner_len | owner
-      (owner = metadata["owner"] for forwarded keys, empty otherwise;
-      added in GEB3 — the magic bump makes a version mismatch fail the
-      roundtrip loudly instead of desyncing the stream)
+      (owner = metadata["owner"] for forwarded keys, empty otherwise)
   fast request:    u32 magic 'GEB6' | u32 n | u32 ring_hash |
                    u32 payload_len | payload = n x 33-byte record
-      (GEB6 supersedes r4's GEB4: same records, plus the ring
-      fingerprint — the magic bump fails a version-skewed edge loudly)
   fast response:   u32 magic 'GEB5' | u32 n | n x 25-byte record
-  stale ring:      u32 magic 'GEBR' | u32 0   (then the bridge closes;
-                   the edge reconnects, re-reads the hello, re-routes)
+  windowed string request (r7):
+                   u32 magic 'GEB2' | u32 n | u32 frame_id |
+                   u64 t_sent_us | u32 payload_len | payload
+      (items as GEB1; t_sent_us = sender's CLOCK_MONOTONIC stamp in
+      microseconds, 0 = unstamped — the bridge attributes the
+      edge->bridge transit stage from it, calibrated per connection
+      against the smallest delta seen so a remote edge's different
+      monotonic epoch self-cancels; serve/stages.py)
+  windowed string response (r7):
+                   u32 magic 'GEB4' | u32 n | u32 frame_id |
+                   items as GEB3
+  windowed fast request (r7):
+                   u32 magic 'GEB7' | u32 n | u32 frame_id |
+                   u32 ring_hash | u64 t_sent_us | u32 payload_len |
+                   payload = n x 33-byte record
+  windowed fast response (r7):
+                   u32 magic 'GEB8' | u32 n | u32 frame_id |
+                   n x 25-byte record
+  stale ring:      u32 magic 'GEBR' | u32 frame_id (0 pre-r7)
+                   (then the bridge closes; the edge fails every frame
+                   still in flight on the connection as stale,
+                   re-reads the hello, re-routes)
 
-One frame in flight per connection; the edge opens `--workers`
-backend connections (default 2) whose batches round-trip concurrently,
-so this handler runs concurrently with itself — safe because the
-serving instance already serves concurrent gRPC/HTTP callers from one
-event loop. Malformed input closes the connection.
+Non-windowed frames (GEB1/GEB6) keep their one-in-flight round-trip
+semantics for version-skewed edges; a bridge serves both framings on
+the same connection. Malformed input closes the connection.
 
 Trust boundary: like the PeersV1 gRPC service (which applies whatever
 batch a forwarding peer sends without re-checking ownership, reference
@@ -71,6 +103,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import struct
+import time
 import zlib
 from typing import List, Optional
 
@@ -82,6 +115,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE
+from gubernator_tpu.serve.stages import STAGES
 
 log = logging.getLogger("gubernator_tpu.edge")
 
@@ -91,6 +125,16 @@ MAGIC_HELLO = 0x49424547  # 'GEBI' — ring-carrying hello (r5; was GEBH)
 MAGIC_FAST_REQ = 0x36424547  # 'GEB6' — pre-hashed items + ring hash (r5)
 MAGIC_FAST_RESP = 0x35424547  # 'GEB5'
 MAGIC_STALE = 0x52424547  # 'GEBR' — fast frame refused: stale ring
+MAGIC_WREQ = 0x32424547  # 'GEB2' — windowed string request (r7)
+MAGIC_WRESP = 0x34424547  # 'GEB4' — windowed string response (r7)
+MAGIC_WFAST_REQ = 0x37424547  # 'GEB7' — windowed pre-hashed request (r7)
+MAGIC_WFAST_RESP = 0x38424547  # 'GEB8' — windowed pre-hashed response
+
+HELLO_FAST = 1  # hello flags bit 0
+HELLO_WINDOWED = 2  # hello flags bit 1; window size = flags >> 16
+
+DEFAULT_WINDOW = 32
+MAX_WINDOW = 1024
 
 
 def ring_fingerprint(hosts) -> int:
@@ -101,9 +145,27 @@ def ring_fingerprint(hosts) -> int:
     cause connection errors, never silent mis-ownership."""
     return zlib.crc32("\n".join(sorted(hosts)).encode()) & 0xFFFFFFFF
 
+
+def reject_ipv6_endpoint(spec: str, what: str) -> str:
+    """Bridge endpoints are 'host:port' split on the LAST colon — an
+    IPv6 literal ('[::1]:9100', bare '::1') would silently misparse
+    (bracketed host handed to the resolver, or the address mistaken
+    for a unix path). Refuse loudly at config time instead (ADVICE r5
+    #2); document hostnames/IPv4 only. Returns `spec` for chaining."""
+    if "[" in spec or "]" in spec or spec.count(":") > 1:
+        raise ValueError(
+            f"{what} {spec!r} looks like an IPv6 literal; bridge "
+            f"endpoints must be 'host:port' with an IPv4 address or "
+            f"hostname (the frame protocol splits on the last ':')"
+        )
+    return spec
+
+
 _HDR = struct.Struct("<II")
 _ITEM_FIX = struct.Struct("<qqqBB")
 _RESP_FIX = struct.Struct("<Bqqq")
+_WFAST_HDR = struct.Struct("<IIQ")  # frame_id | ring_hash | t_sent_us
+_WREQ_HDR = struct.Struct("<IQ")  # frame_id | t_sent_us
 
 # GEB6 record: the edge pre-hashes name+"_"+key with the SAME XXH64 the
 # daemon's slot store uses (edge.cc xxh64 vs native/guberhash.cc — pinned
@@ -111,6 +173,32 @@ _RESP_FIX = struct.Struct("<Bqqq")
 # np.frombuffer views the whole frame as a structured array.
 _FAST_REQ_DTYPE = None
 _FAST_RESP_DTYPE = None
+
+# GEB3/GEB4 response record for an all-folded string frame: the 25-byte
+# fixed decision plus the two zero-length varlen fields (error, owner)
+# as literal u16 zeros — one numpy tobytes() instead of n encode-loop
+# turns. The edge stamps the routed owner itself on per-owner slow
+# shards (edge.cc fill_string_decisions), so empty owner here keeps
+# parity with a locally-served item on the object path.
+_STRING_RESP_DTYPE = None
+
+
+def _string_resp_dtype():
+    global _STRING_RESP_DTYPE
+    if _STRING_RESP_DTYPE is None:
+        import numpy as np
+
+        _STRING_RESP_DTYPE = np.dtype(
+            [
+                ("status", "u1"),
+                ("limit", "<i8"),
+                ("remaining", "<i8"),
+                ("reset_time", "<i8"),
+                ("elen", "<u2"),
+                ("olen", "<u2"),
+            ]
+        )
+    return _STRING_RESP_DTYPE
 
 
 def _fast_dtypes():
@@ -188,8 +276,11 @@ def decode_request_frame(
     return items
 
 
-def encode_response_frame(resps) -> bytes:
-    parts = [_HDR.pack(MAGIC_RESP, len(resps))]
+def encode_response_frame(resps, magic=MAGIC_RESP, frame_id=None) -> bytes:
+    hdr = _HDR.pack(magic, len(resps))
+    if frame_id is not None:  # windowed (GEB4) framing
+        hdr += struct.pack("<I", frame_id)
+    parts = [hdr]
     for r in resps:
         err = r.error.encode()
         # metadata["owner"] rides the frame so forwarded responses keep
@@ -208,12 +299,45 @@ def encode_response_frame(resps) -> bytes:
     return b"".join(parts)
 
 
+class _ConnWindow:
+    """Per-connection windowed-frame state: the write lock serializing
+    out-of-order response writes, the credit semaphore (frames in
+    flight; acquiring in the READ loop means an exhausted window stops
+    reads and lets TCP backpressure the edge), and the live task set
+    (cancelled when the connection dies so no task writes into a
+    closed transport)."""
+
+    def __init__(self, window: int):
+        self.write_lock = asyncio.Lock()
+        self.sem = asyncio.Semaphore(window)
+        self.tasks: set = set()
+        # smallest (bridge mono - edge stamp) seen on this connection:
+        # the edge's monotonic epoch offset + its minimum transit.
+        # Transit is observed RELATIVE to this, so a remote edge whose
+        # CLOCK_MONOTONIC started at a different boot time calibrates
+        # itself instead of poisoning the edge_to_bridge stage.
+        # low_streak counts consecutive deltas implausibly far BELOW
+        # the floor — a streak rebase recovers from a corrupt-small
+        # first stamp that parked the floor sky-high.
+        self.mono_base: Optional[float] = None
+        self.low_streak: int = 0
+
+    def track(self, task: "asyncio.Task") -> None:
+        self.tasks.add(task)
+        task.add_done_callback(self.tasks.discard)
+
+    def cancel_all(self) -> None:
+        for t in list(self.tasks):
+            t.cancel()
+
+
 class EdgeBridge:
     """Unix-socket (+ optional TCP) server feeding edge batches into the
     serving instance. The unix socket serves a co-located edge; the TCP
     listener serves edges fronting OTHER nodes of the cluster, which
     ship pre-hashed frames for keys this node owns (cluster fast path,
-    r5)."""
+    r5). Windowed framing (r7) lets one connection carry `window`
+    concurrent frames."""
 
     def __init__(
         self,
@@ -222,15 +346,27 @@ class EdgeBridge:
         tcp_address: str = "",
         peer_bridges: Optional[dict] = None,
         fast_enabled: bool = True,
+        window: int = 0,
+        string_fold: bool = True,
     ):
         self.instance = instance
         self.path = path
+        if tcp_address:
+            reject_ipv6_endpoint(tcp_address, "GUBER_EDGE_TCP")
         self.tcp_address = tcp_address
         self.fast_enabled = fast_enabled
+        self.string_fold = string_fold
         # explicit grpc_addr -> bridge_addr overrides (config
         # GUBER_EDGE_PEER_BRIDGES); falls back to the symmetric-fleet
         # port convention for unlisted peers
         self.peer_bridges = peer_bridges or {}
+        for spec in self.peer_bridges.values():
+            reject_ipv6_endpoint(spec, "GUBER_EDGE_PEER_BRIDGES entry")
+        # 0 = default; GUBER_EDGE_WINDOW is parsed once, in
+        # config_from_env (server boots pass conf.edge_window here)
+        if window <= 0:
+            window = DEFAULT_WINDOW
+        self.window = max(1, min(int(window), MAX_WINDOW))
         self._server: Optional[asyncio.AbstractServer] = None
         self._tcp_server: Optional[asyncio.AbstractServer] = None
         # live connection writers: stop() must actively close them —
@@ -239,6 +375,8 @@ class EdgeBridge:
         # readexactly forever, wedging daemon shutdown otherwise
         self._conns: set = set()
         self._stopping = False
+        # (picker object, fingerprint) — see _ring_hash
+        self._ring_hash_cache: Optional[tuple] = None
 
     async def start(self) -> None:
         self._stopping = False
@@ -272,35 +410,50 @@ class EdgeBridge:
         self._server = None
         self._tcp_server = None
 
+    def _arrays_ok(self) -> bool:
+        """The array decide path needs a backend that takes arrays —
+        true for the device backends, false for e.g. the exact
+        backend. Deliberately independent of the GUBER_EDGE_FAST kill
+        switch: that switch governs the pre-hashed WIRE protocol, not
+        this node's ability to decide arrays."""
+        backend = getattr(self.instance, "backend", None)
+        return (
+            getattr(backend, "decide_submit_arrays", None) is not None
+            and getattr(backend, "decide_submit", None) is not None
+        )
+
     def _fast_ok(self) -> bool:
         """Pre-hashed frames need a backend that takes arrays. Ring
         soundness is no longer a single-node condition (r4): the edge
         routes each item to its ring owner itself and every fast frame
         carries the membership fingerprint it routed with, checked in
-        `_serve_fast_frame` — a frame routed under a different view is
+        the read loop — a frame routed under a different view is
         refused, so a grown cluster can no longer be silently
         over-admitted by a stale edge."""
-        backend = getattr(self.instance, "backend", None)
-        return (
-            self.fast_enabled
-            and getattr(backend, "decide_submit_arrays", None) is not None
-            and getattr(backend, "decide_submit", None) is not None
-        )
+        return self.fast_enabled and self._arrays_ok()
 
     def _ring_hash(self) -> int:
-        # computed fresh per use: at ~100 coalesced frames/s the crc32
-        # of a few peer addresses is noise, and any caching keyed on the
-        # picker object risks a stale fingerprint on allocator id reuse
-        # (set_peers builds a NEW picker per update) — a stale hash here
-        # is exactly the over-admission hole the fingerprint closes
+        # cached per picker OBJECT: windowed pipelining checks the
+        # fingerprint on every fast frame (tens of thousands/s), so
+        # rebuilding + sorting + crc32ing the address list per frame is
+        # real event-loop work. The cache holds a STRONG reference to
+        # the picker it hashed, so `is` identity is sound (no allocator
+        # id reuse while referenced — the stale-fingerprint hazard that
+        # kept this uncached pre-r7); set_peers installs a NEW picker
+        # per update, which misses the cache and recomputes.
         picker = getattr(self.instance, "picker", None)
         if picker is None:
             return ring_fingerprint([])
+        cached = self._ring_hash_cache
+        if cached is not None and cached[0] is picker:
+            return cached[1]
         try:
             hosts = [p.host for p in picker.peers()]
         except Exception:
             hosts = []
-        return ring_fingerprint(hosts)
+        h = ring_fingerprint(hosts)
+        self._ring_hash_cache = (picker, h)
+        return h
 
     def _hello(self) -> bytes:
         """Capability + ring hello. Peer bridge endpoints follow the
@@ -309,7 +462,9 @@ class EdgeBridge:
         same host as its gRPC address. When GUBER_EDGE_TCP is unset,
         peers get empty bridge endpoints and the edge routes their keys
         through the string path (instance-side gRPC forwarding) — the
-        pre-r5 behavior, now per-item instead of all-or-nothing."""
+        pre-r5 behavior, now per-item instead of all-or-nothing. An
+        IPv6 peer gRPC host cannot produce a valid bridge endpoint;
+        it is advertised bridge-less rather than misparsably."""
         picker = getattr(self.instance, "picker", None)
         peers = []
         if picker is not None:
@@ -320,11 +475,14 @@ class EdgeBridge:
         bridge_port = ""
         if self.tcp_address:
             bridge_port = self.tcp_address.rpartition(":")[2]
+        flags = HELLO_WINDOWED | (self.window << 16)
+        if self._fast_ok():
+            flags |= HELLO_FAST
         parts = [
             struct.pack(
                 "<IIII",
                 MAGIC_HELLO,
-                1 if self._fast_ok() else 0,
+                flags,
                 self._ring_hash(),
                 len(peers),
             )
@@ -335,7 +493,8 @@ class EdgeBridge:
                 bridge = b""
             elif p.host in self.peer_bridges:
                 bridge = self.peer_bridges[p.host].encode()
-            elif bridge_port:
+            elif bridge_port and p.host.count(":") == 1 and \
+                    "[" not in p.host:
                 bridge = (
                     p.host.rpartition(":")[0] + ":" + bridge_port
                 ).encode()
@@ -348,17 +507,51 @@ class EdgeBridge:
             parts.append(bridge)
         return b"".join(parts)
 
-    async def _serve_fast_frame(self, payload: bytes, n: int, writer):
+    async def _decide_arrays_chunked(self, fields: dict, n: int):
+        """Run one frame's array fields through the batcher, splitting
+        past MAX_BATCH_SIZE: never hand the engine a batch beyond its
+        compiled rungs (that would either error or trigger a fresh
+        multi-minute XLA compile on the serialized submit thread).
+        Chunks gather so they all enqueue at once and co-batch / ride
+        the fetch pipeline instead of paying one device round trip
+        each; only chunk 0 carries the frame's stage span (n chunks
+        must not record n device spans for one frame). Returns the
+        (status, limit, remaining, reset_time) arrays for all n rows.
+        Shared by the pre-hashed fast path and the string fold."""
+        if n <= MAX_BATCH_SIZE:
+            return await self.instance.batcher.decide_arrays(fields)
         import numpy as np
 
+        parts = await asyncio.gather(
+            *[
+                self.instance.batcher.decide_arrays(
+                    {
+                        k: v[i : i + MAX_BATCH_SIZE]
+                        for k, v in fields.items()
+                    },
+                    frame=(i == 0),
+                )
+                for i in range(0, n, MAX_BATCH_SIZE)
+            ]
+        )
+        return tuple(
+            np.concatenate([p[j] for p in parts]) for j in range(4)
+        )
+
+    async def _decide_fast(self, payload: bytes, n: int):
+        """Decode one pre-hashed payload and run it through the batcher.
+        Returns the packed n x 25-byte response records."""
+        import numpy as np
+
+        t_dec = time.monotonic()
         req_dt, resp_dt = _fast_dtypes()
         if len(payload) != n * req_dt.itemsize:
-            raise ValueError("GEB6 payload length mismatch")
+            raise ValueError("fast payload length mismatch")
         if not self._fast_ok():
             # wrong backend for pre-hashed frames: refuse loudly; the
-            # edge reconnects and re-handshakes onto the GEB1 path
+            # edge reconnects and re-handshakes onto the string path
             raise ValueError(
-                "GEB6 frame but fast path unavailable (non-array backend)"
+                "fast frame but fast path unavailable (non-array backend)"
             )
         metrics.EDGE_FAST_ITEMS.inc(n)
         rec = np.frombuffer(payload, dtype=req_dt)
@@ -373,45 +566,279 @@ class EdgeBridge:
         # /v1/debug/stats stays meaningful under fast-path traffic
         # (hot-key NAMES are unavailable here by design)
         self.instance.traffic.observe_hashes(fields["key_hash"])
-        if n <= MAX_BATCH_SIZE:
-            status, limit, remaining, reset = (
-                await self.instance.batcher.decide_arrays(fields)
-            )
-        else:
-            # same MAX_BATCH_SIZE discipline as the GEB1 path: an
-            # oversized co-batch splits into ladder-sized chunks instead
-            # of handing the engine a batch beyond its compiled rungs
-            # (which would either error or trigger a fresh multi-minute
-            # XLA compile on the serialized submit thread). gather: all
-            # chunks enqueue at once so they co-batch / ride the fetch
-            # pipeline instead of paying one device round trip each.
-            parts = await asyncio.gather(
-                *[
-                    self.instance.batcher.decide_arrays(
-                        {
-                            k: v[i : i + MAX_BATCH_SIZE]
-                            for k, v in fields.items()
-                        }
-                    )
-                    for i in range(0, n, MAX_BATCH_SIZE)
-                ]
-            )
-            status, limit, remaining, reset = (
-                np.concatenate([p[j] for p in parts]) for j in range(4)
-            )
+        STAGES.add("bridge_decode", time.monotonic() - t_dec)
+        status, limit, remaining, reset = (
+            await self._decide_arrays_chunked(fields, n)
+        )
+        t_enc = time.monotonic()
         out = np.empty(n, dtype=resp_dt)
         out["status"] = np.asarray(status, np.int64).astype(np.uint8)
         out["limit"] = limit
         out["remaining"] = remaining
         out["reset_time"] = reset
-        writer.write(_HDR.pack(MAGIC_FAST_RESP, n) + out.tobytes())
-        await writer.drain()
+        raw = out.tobytes()
+        STAGES.add("encode", time.monotonic() - t_enc)
+        return raw
+
+    async def _decide_string(self, payload: bytes, n: int):
+        """Decode one string-item payload and serve it through the full
+        instance (validation, routing, forwarding). Returns the
+        response list, one per item, in order."""
+        t_dec = time.monotonic()
+        decoded = decode_request_frame(payload, n)
+        STAGES.add("bridge_decode", time.monotonic() - t_dec)
+        good = [r for r in decoded if r is not None]
+        # the edge caps frames at its batch limit, but two large
+        # co-batched requests can still exceed the instance's
+        # MAX_BATCH_SIZE — split instead of erroring the frame
+        good_resps = []
+        for i in range(0, len(good), MAX_BATCH_SIZE):
+            good_resps.extend(
+                await self.instance.get_rate_limits(
+                    good[i : i + MAX_BATCH_SIZE],
+                    # one frame = one per-frame stage span: only the
+                    # first chunk represents it in the stage clock
+                    stage_frame=(i == 0),
+                )
+            )
+        it = iter(good_resps)
+        return [
+            next(it)
+            if r is not None
+            else RateLimitResp(
+                error="name or unique_key is not valid UTF-8"
+            )
+            for r in decoded
+        ]
+
+    def _fold_string_frame(self, payload: bytes, n: int):
+        """Lean parse + eligibility screen for the string->array fold
+        (r7 slow-path owner batching, bridge side). When EVERY item in
+        a string frame is plain (BATCHING/NO_BATCHING, non-empty UTF-8
+        name/key) and owned by this node under the current ring, the
+        frame needs no request/response objects and no instance
+        routing: it rides the same array path as pre-hashed frames.
+        Per-owner slow shards from the edge are all-plain all-owned by
+        construction, so the GUBER_EDGE_FAST=0 kill switch and mixed
+        fleets get fast-path treatment minus only the client-side
+        hashing. Returns (full_keys, fields) or None; None falls back
+        to the object path, which keeps full semantics for GLOBAL
+        items, per-item validation errors, and items a stale edge
+        routed to the wrong owner (forwarded by the instance there).
+        """
+        import numpy as np
+
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        picker = getattr(self.instance, "picker", None)
+        mask_fn = getattr(picker, "self_owned_mask", None)
+        if mask_fn is None or not getattr(picker, "size", lambda: 0)():
+            return None
+        # the wire count is untrusted: bound it by the payload's
+        # minimum bytes/item (2+2 length prefixes + 26 fixed) before
+        # sizing arrays from it, like _decide_fast's exact-length check
+        if n > len(payload) // 30:
+            return None
+        full: List[str] = []
+        hits = np.empty(n, np.int64)
+        limit = np.empty(n, np.int64)
+        duration = np.empty(n, np.int64)
+        algo = np.empty(n, np.int64)
+        off = 0
+        # ownership is screened in chunks DURING the parse: a mixed-
+        # ownership frame (pre-r7 edge funnelling a cluster's items
+        # through one node) is near-certain to fail within its first
+        # chunk, so it pays ~256 items of lean parse before falling
+        # back to the object path instead of a full parse + re-parse
+        checked = 0
+        try:
+            for i in range(n):
+                if i - checked >= 256:
+                    if not mask_fn(full[checked:]).all():
+                        return None
+                    checked = i
+                (nlen,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                raw_name = payload[off : off + nlen]
+                off += nlen
+                (klen,) = struct.unpack_from("<H", payload, off)
+                off += 2
+                raw_key = payload[off : off + klen]
+                off += klen
+                h, li, d, a, b = _ITEM_FIX.unpack_from(payload, off)
+                off += _ITEM_FIX.size
+                if (
+                    len(raw_name) != nlen
+                    or len(raw_key) != klen
+                    or not raw_name
+                    or not raw_key
+                    or b == 2
+                ):
+                    return None  # truncated/invalid/GLOBAL: object path
+                full.append(raw_name.decode() + "_" + raw_key.decode())
+                hits[i] = h
+                limit[i] = li
+                duration[i] = d
+                algo[i] = a
+        except (struct.error, UnicodeDecodeError):
+            return None  # malformed frame: the object path answers it
+        if off != len(payload):
+            return None
+        if full[checked:] and not mask_fn(full[checked:]).all():
+            return None
+        fields = dict(
+            key_hash=slot_hash_batch(full),
+            hits=hits,
+            limit=limit,
+            duration=duration,
+            # unknown algorithm bytes clamp to the default, matching
+            # decode_request_frame and the JSON gateway
+            algo=np.where(algo <= 1, algo, 0).astype(np.int32),
+        )
+        return full, fields
+
+    async def _decide_string_folded(self, full, fields, n: int) -> bytes:
+        """Array-decide one folded string frame and encode the GEB3/
+        GEB4 response body (25-byte decisions + empty error/owner) in
+        one numpy pass. Hot-key observability keeps full parity with
+        the object path: names AND hashes feed the sketches."""
+        import numpy as np
+
+        self.instance.traffic.observe(full, fields["key_hash"])
+        status, limit, remaining, reset = (
+            await self._decide_arrays_chunked(fields, n)
+        )
+        t_enc = time.monotonic()
+        out = np.zeros(n, dtype=_string_resp_dtype())
+        out["status"] = np.asarray(status, np.int64).astype(np.uint8)
+        out["limit"] = limit
+        out["remaining"] = remaining
+        out["reset_time"] = reset
+        raw = out.tobytes()
+        STAGES.add("encode", time.monotonic() - t_enc)
+        return raw
+
+    async def _decide_string_frame(
+        self, payload: bytes, n: int, magic=MAGIC_RESP, frame_id=None
+    ) -> bytes:
+        """Serve one string frame to a complete encoded response frame.
+        Tries the array fold first; anything it declines rides the
+        object path through the full instance."""
+        t_dec = time.monotonic()
+        fold = None
+        if self.string_fold and n and self._arrays_ok():
+            fold = self._fold_string_frame(payload, n)
+        if fold is not None:
+            metrics.EDGE_FOLDED_ITEMS.inc(n)
+            STAGES.add("bridge_decode", time.monotonic() - t_dec)
+            hdr = _HDR.pack(magic, n)
+            if frame_id is not None:
+                hdr += struct.pack("<I", frame_id)
+            return hdr + await self._decide_string_folded(*fold, n)
+        resps = await self._decide_string(payload, n)
+        t_enc = time.monotonic()
+        frame = encode_response_frame(resps, magic=magic, frame_id=frame_id)
+        STAGES.add("encode", time.monotonic() - t_enc)
+        return frame
+
+    @staticmethod
+    def _observe_transit(
+        wstate: "_ConnWindow", t_frame0: float, t_sent_us: int
+    ) -> float:
+        """edge->bridge transit from the frame's monotonic stamp,
+        calibrated per connection: CLOCK_MONOTONIC epochs differ
+        between hosts (boot-relative), so the raw delta is only
+        meaningful up to a constant offset. The smallest delta seen on
+        the connection (epoch offset + minimum transit) is taken as
+        zero and every frame's transit observed relative to it — on a
+        co-located edge that floor is the ~µs unix-socket hop, and on
+        a remote edge the boot-time skew self-cancels instead of
+        recording as 20s of phantom transit. What the stage then
+        measures is time spent ABOVE the connection's floor: credit-
+        window queueing and socket backlog, the actionable part.
+        Returns the observed transit (0.0 when unstamped or
+        implausible) so the frame's e2e clock can start at the SEND
+        stamp — keeping the per-frame stages and their coverage
+        denominator on the same span."""
+        if t_sent_us <= 0:
+            return 0.0
+        dt = t_frame0 - t_sent_us / 1e6
+        if wstate.mono_base is None:
+            wstate.mono_base = dt
+        elif dt < wstate.mono_base:
+            # recorded transits are bounded at 60s, so a genuine floor
+            # can only improve by less than that; a single
+            # future-dated/corrupt stamp must not poison the floor for
+            # the connection's lifetime — treat it as unstamped. But a
+            # STREAK of far-below deltas means the floor itself is
+            # bogus (the first stamp was corrupt-small, so mono_base is
+            # sky-high): rebase down rather than zeroing the stage for
+            # every frame that follows.
+            if wstate.mono_base - dt >= 60.0:
+                wstate.low_streak += 1
+                if wstate.low_streak < 3:
+                    return 0.0
+                wstate.mono_base = dt
+            else:
+                wstate.mono_base = dt
+            wstate.low_streak = 0
+        else:
+            wstate.low_streak = 0
+        transit = dt - wstate.mono_base
+        if transit < 60.0:  # desynced-stream garbage guard
+            STAGES.add("edge_to_bridge", transit)
+            return transit
+        # implausible gap above the floor: the FLOOR is what's bogus
+        # (e.g. the connection's first stamp was garbage) — re-base on
+        # this frame so one bad first sample can't zero the stage for
+        # every frame that follows
+        wstate.mono_base = dt
+        return 0.0
+
+    async def _serve_windowed(
+        self, magic, payload, n, frame_id, t_start, writer, wstate
+    ):
+        """One windowed frame, served concurrently with its siblings.
+        Runs as its own task; the response is written under the
+        connection's write lock whenever it completes (out of order is
+        fine — the edge matches on frame_id). `t_start` is the frame's
+        e2e clock start: the edge's send stamp when the frame carried
+        one, else the bridge's read time."""
+        try:
+            if magic == MAGIC_WFAST_REQ:
+                raw = await self._decide_fast(payload, n)
+                frame = (
+                    _HDR.pack(MAGIC_WFAST_RESP, n)
+                    + struct.pack("<I", frame_id)
+                    + raw
+                )
+            else:
+                frame = await self._decide_string_frame(
+                    payload, n, magic=MAGIC_WRESP, frame_id=frame_id
+                )
+            async with wstate.write_lock:
+                writer.write(frame)
+                await writer.drain()
+            STAGES.add_frame(time.monotonic() - t_start)
+        except asyncio.CancelledError:
+            raise
+        except (ConnectionResetError, BrokenPipeError):
+            pass  # edge went away mid-response; reader loop cleans up
+        except Exception:
+            # a malformed frame or dead batcher poisons the whole
+            # connection (the stream may be desynced): close it; the
+            # edge fails in-flight frames and reconnects
+            log.exception("windowed edge frame failed")
+            writer.close()
+        finally:
+            wstate.sem.release()
 
     async def _serve_conn(self, reader, writer):
         if self._stopping:
             writer.close()
             return
         self._conns.add(writer)
+        wstate = _ConnWindow(self.window)
         try:
             # ring-carrying hello: capability flags + live membership
             # (rebuilt per connection; the edge refreshes by reconnecting)
@@ -419,17 +846,64 @@ class EdgeBridge:
             await writer.drain()
             while True:
                 hdr = await reader.readexactly(_HDR.size)
+                t_frame0 = time.monotonic()
                 magic, n = _HDR.unpack(hdr)
+                if magic in (MAGIC_WFAST_REQ, MAGIC_WREQ):
+                    if magic == MAGIC_WFAST_REQ:
+                        frame_id, frame_ring, t_sent = _WFAST_HDR.unpack(
+                            await reader.readexactly(_WFAST_HDR.size)
+                        )
+                    else:
+                        frame_id, t_sent = _WREQ_HDR.unpack(
+                            await reader.readexactly(_WREQ_HDR.size)
+                        )
+                        frame_ring = None
+                    (plen,) = struct.unpack(
+                        "<I", await reader.readexactly(4)
+                    )
+                    payload = await reader.readexactly(plen)
+                    if (
+                        frame_ring is not None
+                        and frame_ring != self._ring_hash()
+                    ):
+                        # routed under a different membership view —
+                        # refuse the frame AND the connection; frames
+                        # still in flight were routed with the same
+                        # stale view and fail edge-side when the close
+                        # lands. The write lock keeps the GEBR from
+                        # interleaving a concurrent response write.
+                        metrics.EDGE_STALE_RINGS.inc()
+                        log.warning(
+                            "refusing fast frame routed with stale ring "
+                            "(%#x != %#x)", frame_ring, self._ring_hash()
+                        )
+                        async with wstate.write_lock:
+                            writer.write(_HDR.pack(MAGIC_STALE, frame_id))
+                            await writer.drain()
+                        return
+                    transit = self._observe_transit(
+                        wstate, t_frame0, t_sent
+                    )
+                    # credit gate: acquired BEFORE reading the next
+                    # frame, so an edge overrunning the advertised
+                    # window parks here and TCP backpressure does the
+                    # policing — no frame is ever dropped
+                    await wstate.sem.acquire()
+                    wstate.track(
+                        asyncio.ensure_future(
+                            self._serve_windowed(
+                                magic, payload, n, frame_id,
+                                t_frame0 - transit, writer, wstate,
+                            )
+                        )
+                    )
+                    continue
                 if magic == MAGIC_FAST_REQ:
                     frame_ring, plen = struct.unpack(
                         "<II", await reader.readexactly(8)
                     )
                     payload = await reader.readexactly(plen)
                     if frame_ring != self._ring_hash():
-                        # the edge routed this frame with a different
-                        # membership view — deciding it here could admit
-                        # keys this node no longer owns. Refuse and close;
-                        # the edge re-reads the ring and re-routes.
                         metrics.EDGE_STALE_RINGS.inc()
                         log.warning(
                             "refusing fast frame routed with stale ring "
@@ -438,7 +912,10 @@ class EdgeBridge:
                         writer.write(_HDR.pack(MAGIC_STALE, 0))
                         await writer.drain()
                         return
-                    await self._serve_fast_frame(payload, n, writer)
+                    raw = await self._decide_fast(payload, n)
+                    writer.write(_HDR.pack(MAGIC_FAST_RESP, n) + raw)
+                    await writer.drain()
+                    STAGES.add_frame(time.monotonic() - t_frame0)
                     continue
                 if magic != MAGIC_REQ:
                     raise ValueError(f"bad magic {magic:#x}")
@@ -446,33 +923,16 @@ class EdgeBridge:
                     "<I", await reader.readexactly(4)
                 )
                 payload = await reader.readexactly(plen)
-                decoded = decode_request_frame(payload, n)
-                good = [r for r in decoded if r is not None]
-                # the edge caps frames at its batch limit, but two large
-                # co-batched requests can still exceed the instance's
-                # MAX_BATCH_SIZE — split instead of erroring the frame
-                good_resps = []
-                for i in range(0, len(good), MAX_BATCH_SIZE):
-                    good_resps.extend(
-                        await self.instance.get_rate_limits(
-                            good[i : i + MAX_BATCH_SIZE]
-                        )
-                    )
-                it = iter(good_resps)
-                resps = [
-                    next(it)
-                    if r is not None
-                    else RateLimitResp(
-                        error="name or unique_key is not valid UTF-8"
-                    )
-                    for r in decoded
-                ]
-                writer.write(encode_response_frame(resps))
+                writer.write(await self._decide_string_frame(payload, n))
                 await writer.drain()
+                STAGES.add_frame(time.monotonic() - t_frame0)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass
         except Exception:
             log.exception("edge bridge connection error")
         finally:
+            # in-flight windowed tasks must not write into the closing
+            # transport or outlive the connection
+            wstate.cancel_all()
             self._conns.discard(writer)
             writer.close()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 from typing import List, Optional, Sequence, Tuple
 
 from gubernator_tpu.api.types import (
@@ -37,6 +38,7 @@ from gubernator_tpu.serve.batcher import DeviceBatcher
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE, ServerConfig
 from gubernator_tpu.serve.global_mgr import GlobalManager
 from gubernator_tpu.serve.peers import ConsistentHashPicker, PeerClient
+from gubernator_tpu.serve.stages import STAGES
 
 log = logging.getLogger("gubernator_tpu.instance")
 
@@ -77,8 +79,14 @@ class Instance:
     # -- public API (gubernator.go:75-169) ----------------------------------
 
     async def get_rate_limits(
-        self, reqs: Sequence[RateLimitReq]
+        self,
+        reqs: Sequence[RateLimitReq],
+        stage_frame: bool = False,
     ) -> List[RateLimitResp]:
+        """`stage_frame=True` (edge bridge string path only) marks the
+        local device group as one edge frame's work for the per-frame
+        stage clock; direct gRPC/HTTP/peer callers stay unattributed so
+        frame coverage keeps its denominator (serve/stages.py)."""
         if len(reqs) > MAX_BATCH_SIZE:
             raise BatchTooLargeError(
                 f"Requests.RateLimits list too large; max size is "
@@ -89,6 +97,7 @@ class Instance:
         local: List[Tuple[int, RateLimitReq, bool]] = []  # idx, req, gnp
         forwards: List[Tuple[int, RateLimitReq, PeerClient]] = []
         observed: List[str] = []
+        t_route0 = time.monotonic()
 
         for i, r in enumerate(reqs):
             if not r.unique_key:
@@ -124,6 +133,10 @@ class Instance:
 
         if observed:
             self.traffic.observe(observed, slot_hash_batch(observed))
+        # instance-side routing overhead (validation + ring lookups +
+        # sketches), attributed apart from the batcher's queue/device
+        # stages — the string path's own cost in the stage profile
+        STAGES.add("instance_route", time.monotonic() - t_route0)
 
         async def forward(i, r, peer):
             key = r.hash_key()
@@ -138,17 +151,56 @@ class Instance:
                 )
             out[i] = resp
 
+        async def forward_group(peer, items):
+            # owner batching (r7): the whole per-owner group rides ONE
+            # queue entry + ONE future through the peer's micro-batch
+            # flusher — a 1000-item RPC forwarding two thirds of its
+            # items no longer pays per-item future/enqueue overhead
+            # (the slow-path funnel the edge cluster bench exposed).
+            # Failures keep per-item error parity with forward().
+            try:
+                resps = await peer.get_peer_rate_limits_grouped(
+                    [r for _, r in items]
+                )
+                for (i, r), resp in zip(items, resps):
+                    resp.metadata["owner"] = peer.host
+                    out[i] = resp
+            except Exception as e:
+                for i, r in items:
+                    out[i] = RateLimitResp(
+                        error=(
+                            f"while fetching rate limit "
+                            f"'{r.hash_key()}' from peer - '{e}'"
+                        )
+                    )
+
+        # group BATCHING forwards per owner; NO_BATCHING keeps its
+        # direct-unary contract (reference peers.go:73-90)
+        grouped: dict = {}
+        singles = []
+        for i, r, peer in forwards:
+            if r.behavior == Behavior.NO_BATCHING:
+                singles.append((i, r, peer))
+            else:
+                grouped.setdefault(peer, []).append((i, r))
+
         # schedule forwards immediately so their RPCs overlap the local
         # device batch instead of queueing behind it
         tasks = [
-            asyncio.ensure_future(forward(i, r, p)) for i, r, p in forwards
+            asyncio.ensure_future(forward(i, r, p)) for i, r, p in singles
+        ]
+        tasks += [
+            asyncio.ensure_future(forward_group(p, items))
+            for p, items in grouped.items()
         ]
 
         if local:
             local_reqs = [r for _, r, _ in local]
             gnp = [g for _, _, g in local]
             try:
-                resps = await self.decide_local(local_reqs, gnp)
+                resps = await self.decide_local(
+                    local_reqs, gnp, frame=stage_frame
+                )
                 for (i, _, _), resp in zip(local, resps):
                     out[i] = resp
             except Exception as e:
@@ -164,14 +216,17 @@ class Instance:
         return [r if r is not None else RateLimitResp() for r in out]
 
     async def decide_local(
-        self, reqs: Sequence[RateLimitReq], gnp: Sequence[bool]
+        self,
+        reqs: Sequence[RateLimitReq],
+        gnp: Sequence[bool],
+        frame: bool = False,
     ) -> List[RateLimitResp]:
         """Run requests through the device batcher; owned GLOBAL keys are
         queued for status broadcast (gubernator.go:240-242)."""
         for r, is_gnp in zip(reqs, gnp):
             if r.behavior == Behavior.GLOBAL and not is_gnp:
                 self.global_mgr.queue_update(r)
-        return await self.batcher.decide(reqs, gnp)
+        return await self.batcher.decide(reqs, gnp, frame=frame)
 
     # -- peer-facing API ----------------------------------------------------
 
@@ -216,7 +271,20 @@ class Instance:
                     f"consistent hash is incomplete"
                 )
                 continue
-            picker.add(peer)
+            try:
+                picker.add(peer)
+            except ValueError as e:
+                # crc32 ring-point collision (picker.add): surface it
+                # through health instead of silently splitting
+                # ownership between tie-break rules (ADVICE r5 #3)
+                log.error("%s", e)
+                errs.append(str(e))
+                # a freshly built client was already connect()ed; close
+                # it or every set_peers round leaks a channel + flusher
+                # task while the collision persists
+                if existing is None:
+                    await peer.close()
+                continue
 
         old_hosts = {p.host for p in self.picker.peers()}
         new_hosts = {p.host for p in picker.peers()}

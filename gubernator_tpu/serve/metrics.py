@@ -86,6 +86,13 @@ EDGE_FAST_ITEMS = Counter(
     "edge ships per-owner frames instead of funnelling through one node",
     registry=REGISTRY,
 )
+EDGE_FOLDED_ITEMS = Counter(
+    "edge_folded_items_total",
+    "String-frame items served through the bridge's string->array fold "
+    "(all-plain all-owned frames skip request/response objects and "
+    "instance routing) — the slow path's share of fast-path treatment",
+    registry=REGISTRY,
+)
 EDGE_STALE_RINGS = Counter(
     "edge_stale_ring_total",
     "GEB6 frames rejected because the edge routed with a different "
@@ -95,6 +102,21 @@ EDGE_STALE_RINGS = Counter(
 DISTINCT_KEYS = Gauge(
     "distinct_keys_estimate",
     "HyperLogLog estimate of distinct rate-limit keys seen",
+    registry=REGISTRY,
+)
+STAGE_SECONDS = Gauge(
+    "serving_stage_seconds_total",
+    "Cumulative wall seconds attributed to one serving-pipeline stage "
+    "(serve/stages.py; exported lazily at scrape — the hot path "
+    "records into a plain accumulator). Pair with "
+    "serving_stage_samples_total for per-sample means.",
+    ["stage"],
+    registry=REGISTRY,
+)
+STAGE_SAMPLES = Gauge(
+    "serving_stage_samples_total",
+    "Samples accumulated per serving-pipeline stage",
+    ["stage"],
     registry=REGISTRY,
 )
 

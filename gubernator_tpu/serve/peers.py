@@ -45,9 +45,16 @@ class PeerClient:
         self.is_owner = is_owner  # true when this peer is this server
         self.channel: Optional[grpc.aio.Channel] = None
         self.stub: Optional[PeersV1Stub] = None
-        self._queue: "asyncio.Queue[Tuple[RateLimitReq, asyncio.Future]]" = (
+        # queue items are GROUPS: (reqs list, future resolving to the
+        # matching resps list). One future per group (r7 owner
+        # batching): a request batch forwarding hundreds of items to
+        # one owner costs one enqueue + one future, not one per item.
+        self._queue: "asyncio.Queue[Tuple[List[RateLimitReq], asyncio.Future]]" = (  # noqa: E501
             asyncio.Queue()
         )
+        # one-slot park for a group that would overflow the previous
+        # batch (aio.collect_batch carry contract)
+        self._carry: List = []
         self._flusher: Optional[asyncio.Task] = None
         self._closed = False
 
@@ -92,16 +99,33 @@ class PeerClient:
     async def get_peer_rate_limit(self, r: RateLimitReq) -> RateLimitResp:
         """Forward one request; batches unless NO_BATCHING
         (reference peers.go:73-90)."""
+        if r.behavior in (Behavior.BATCHING, Behavior.GLOBAL):
+            resps = await self.get_peer_rate_limits_grouped([r])
+            return resps[0]
         if self._closed:
             raise RuntimeError(
                 f"peer client for '{self.host}' is closed"
             )
-        if r.behavior in (Behavior.BATCHING, Behavior.GLOBAL):
-            fut: asyncio.Future = asyncio.get_running_loop().create_future()
-            self._queue.put_nowait((r, fut))
-            return await fut
         resp = await self.get_peer_rate_limits([r])
         return resp[0]
+
+    async def get_peer_rate_limits_grouped(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """Forward a whole group through the micro-batch flusher with
+        ONE queue entry and ONE future (r7 owner batching). The group
+        still coalesces with other callers' groups up to batch_limit
+        — same wire behavior as per-item enqueueing, a fraction of the
+        event-loop cost."""
+        if self._closed:
+            raise RuntimeError(
+                f"peer client for '{self.host}' is closed"
+            )
+        if not reqs:
+            return []
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self._queue.put_nowait((list(reqs), fut))
+        return await fut
 
     async def get_peer_rate_limits(
         self, reqs: Sequence[RateLimitReq]
@@ -141,13 +165,15 @@ class PeerClient:
         grow with in-flight RPC load while a lone request only waits the
         configured window (batch_wait=0 disables even that)."""
         while True:
-            batch: List[Tuple[RateLimitReq, asyncio.Future]] = []
+            batch: List[Tuple[List[RateLimitReq], asyncio.Future]] = []
             try:
                 await collect_batch(
                     self._queue,
                     self.conf.batch_limit,
                     self.conf.batch_wait,
                     batch,
+                    weight=lambda g: max(1, len(g[0])),
+                    carry=self._carry,
                 )
                 await self._send_batch(batch)
             except asyncio.CancelledError:
@@ -160,6 +186,10 @@ class PeerClient:
                 for _, fut in batch:
                     if not fut.done():
                         fut.set_exception(exc)
+                for _, fut in self._carry:
+                    if not fut.done():
+                        fut.set_exception(exc)
+                self._carry.clear()
                 while True:
                     try:
                         _, fut = self._queue.get_nowait()
@@ -170,7 +200,9 @@ class PeerClient:
                 raise
 
     async def _send_batch(self, batch) -> None:
-        reqs = [r for r, _ in batch]
+        # groups flatten into one peer RPC; responses slice back per
+        # group (reference peers.go:143-172, group-granular here)
+        reqs = [r for g, _ in batch for r in g]
         try:
             resps = await self.get_peer_rate_limits(reqs)
         except Exception as e:  # entire batch failed (peers.go:186-192)
@@ -180,9 +212,12 @@ class PeerClient:
                         RuntimeError(f"while fetching from peer - '{e}'")
                     )
             return
-        for (_, fut), resp in zip(batch, resps):
+        k = 0
+        for g, fut in batch:
+            span = resps[k : k + len(g)]
+            k += len(g)
             if not fut.done():
-                fut.set_result(resp)
+                fut.set_result(span)
 
 
 class ConsistentHashPicker:
@@ -199,7 +234,22 @@ class ConsistentHashPicker:
 
     def add(self, peer: PeerClient) -> None:
         point = self._hash(peer.host)
-        bisect.insort(self._keys, point)
+        existing = self._by_point.get(point)
+        if existing is not None and existing.host != peer.host:
+            # Two addresses colliding on one crc32 point (~2^-32 per
+            # pair) would silently split ownership: this picker's
+            # dict-overwrite (last add wins) disagrees with the edge's
+            # sort-order tie-break, and the membership fingerprint
+            # cannot catch it (same host set). Refuse loudly; set_peers
+            # surfaces it through health (ADVICE r5 #3).
+            raise ValueError(
+                f"ring point collision: '{peer.host}' and "
+                f"'{existing.host}' both hash to {point:#x}; rename one "
+                f"peer address (placement would silently diverge "
+                f"between pickers)"
+            )
+        if existing is None:
+            bisect.insort(self._keys, point)
         self._by_point[point] = peer
         self._by_host[peer.host] = peer
 
@@ -222,3 +272,27 @@ class ConsistentHashPicker:
         if i == len(self._keys):
             i = 0
         return self._by_point[self._keys[i]]
+
+    def self_owned_mask(self, keys: Sequence[str]):
+        """bool[len(keys)]: the key's ring successor is this server
+        itself (is_owner). Vectorized ownership screen for the edge
+        bridge's string->array fold (r7): one hash call per key plus a
+        single searchsorted against the ring, instead of a get() with
+        its dict lookups per key. Placement parity with get():
+        bisect_left == searchsorted side='left', wraparound to 0."""
+        import numpy as np
+
+        if not self._keys:
+            raise RuntimeError("unable to pick a peer; pool is empty")
+        pts = np.fromiter(
+            (self._hash(k) for k in keys), dtype=np.uint64, count=len(keys)
+        )
+        ring = np.asarray(self._keys, dtype=np.uint64)
+        idx = np.searchsorted(ring, pts, side="left")
+        idx[idx == len(ring)] = 0
+        own = np.fromiter(
+            (self._by_point[p].is_owner for p in self._keys),
+            dtype=bool,
+            count=len(self._keys),
+        )
+        return own[idx]

@@ -290,6 +290,8 @@ class Server:
                 tcp_address=self.conf.edge_tcp,
                 peer_bridges=peer_bridges,
                 fast_enabled=self.conf.edge_fast,
+                window=self.conf.edge_window,
+                string_fold=self.conf.edge_string_fold,
             )
             await self._edge.start()
 
@@ -321,6 +323,7 @@ class Server:
         app.router.add_get("/v1/HealthCheck", self._http_health)
         app.router.add_get("/metrics", self._http_metrics)
         app.router.add_get("/v1/debug/stats", self._http_debug_stats)
+        app.router.add_get("/v1/debug/stages", self._http_debug_stages)
         app.router.add_get("/v1/debug/profile", self._http_debug_profile)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -411,6 +414,14 @@ class Server:
         if "size" in stats:
             metrics.CACHE_SIZE.set(stats["size"])
         metrics.DISTINCT_KEYS.set(self.instance.traffic.hll.estimate())
+        # stage totals export lazily at scrape time: the hot path only
+        # touches the plain-float accumulator (serve/stages.py)
+        from gubernator_tpu.serve.stages import STAGES
+
+        snap = STAGES.snapshot()
+        for name, s in snap["stages"].items():
+            metrics.STAGE_SECONDS.labels(stage=name).set(s["total_s"])
+            metrics.STAGE_SAMPLES.labels(stage=name).set(s["count"])
 
     async def _http_debug_stats(self, request: web.Request):
         """Traffic observability: HLL cardinality + top hot keys + backend
@@ -424,6 +435,21 @@ class Server:
         body = self.instance.traffic.snapshot(max(top_n, 0))
         body["backend"] = self.backend.stats()
         return web.json_response(body)
+
+    async def _http_debug_stages(self, request: web.Request):
+        """Serving-pipeline stage attribution (serve/stages.py): where
+        one served decision's wall time goes — edge transit, frame
+        decode, batcher queue, device span (with the submit/fetch
+        split), response encode — plus the coverage of those stages
+        against frame end-to-end time. `?reset=1` zeroes the
+        accumulators (the profiler scopes a measurement window with
+        it). The reference has per-RPC Prometheus totals only; this is
+        the decomposition that says which stage to attack next."""
+        from gubernator_tpu.serve.stages import STAGES
+
+        if request.query.get("reset") in ("1", "true"):
+            STAGES.reset()
+        return web.json_response(STAGES.snapshot())
 
     async def _http_debug_profile(self, request: web.Request):
         """Capture a JAX/XLA device profile for ?ms= milliseconds (default

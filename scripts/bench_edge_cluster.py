@@ -211,19 +211,27 @@ def main():
             print(f"  {rows[name]}", file=sys.stderr)
 
     doc = dict(
-        schema="bench_edge_cluster_r5",
+        schema="bench_edge_cluster_r7",
         scope=(
             "serving stack only: all daemons run the tpu backend on CPU "
             "and share one host's cores with the edge and the load "
             "generator; 3-node rows pay the whole cluster's CPU on one "
             "machine. Load: 16 threads x 1000-item batches through the "
-            "edge gRPC door."
+            "edge gRPC door. Slow rows = GUBER_EDGE_FAST=0 (kill "
+            "switch): r7 slow-path owner batching keeps them off the "
+            "one-node funnel (edge per-owner string shards + bridge "
+            "string->array fold + instance grouped forwards)."
         ),
         host_cpus=os.cpu_count(),
         rows=rows,
         fast_over_slow_3node=round(
             rows["edge_3node_fast"]["decisions_per_sec"]
             / max(rows["edge_3node_slow"]["decisions_per_sec"], 1),
+            2,
+        ),
+        slow_over_fast_p99_3node=round(
+            rows["edge_3node_slow"]["p99_ms"]
+            / max(rows["edge_3node_fast"]["p99_ms"], 1e-9),
             2,
         ),
         cluster_retention=round(

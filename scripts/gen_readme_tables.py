@@ -239,6 +239,47 @@ def table_throughput_serving() -> str:
     return "\n".join(lines)
 
 
+def table_served_throughput() -> str:
+    """BENCH_SERVING_DEVICE_r7.json: the windowed front-door protocol
+    (r7) vs the one-frame-per-roundtrip protocol it replaced, same box,
+    same serving boot — medians over interleaved rounds."""
+    doc = json.loads(
+        (ROOT / "BENCH_SERVING_DEVICE_r7.json").read_text()
+    )
+    label = {
+        "windowed_r7": "windowed frames (GEB7, credit window, r7)",
+        "roundtrip_r5_protocol":
+            "one frame per round trip (GEB6, pre-r7 build)",
+    }
+    lines = [
+        "| edge protocol | decisions/s (median) | p50 | p99 |",
+        "|---|---|---|---|",
+    ]
+    for key, lab in label.items():
+        r = doc["rows"][key]
+        lines.append(
+            f"| {lab} | {r['median_decisions_per_sec']:,.0f} "
+            f"| {r['median_p50_ms']:.0f} ms "
+            f"| {r['median_p99_ms']:.0f} ms |"
+        )
+    lines.append("")
+    lines.append(
+        f"({doc['scenario']}, {doc['rounds']} interleaved rounds, "
+        f"2 backend connections each; the windowed protocol serves "
+        f"**{doc['speedup_windowed_over_roundtrip']:.2f}x** the "
+        f"round-trip protocol's decisions/s on the same box"
+        + (
+            f", {doc['saturation']['clients']} clients: "
+            f"**{doc['saturation']['speedup']:.2f}x** at "
+            f"{doc['saturation']['windowed_median_decisions_per_sec']:,.0f} dec/s"  # noqa: E501
+            if "saturation" in doc
+            else ""
+        )
+        + ". Scope and baseline provenance in the artifact.)"
+    )
+    return "\n".join(lines)
+
+
 def table_edge_cluster() -> str:
     """BENCH_EDGE_CLUSTER_r5.json: the compiled door in front of 1 vs 3
     nodes, per-owner fast frames vs string-path forwarding."""
@@ -276,6 +317,7 @@ TABLES = {
     "global-latency-table": table_global,
     "scenarios-table": table_scenarios,
     "throughput-serving-table": table_throughput_serving,
+    "served-throughput-table": table_served_throughput,
     "edge-cluster-table": table_edge_cluster,
 }
 

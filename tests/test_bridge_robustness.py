@@ -59,7 +59,7 @@ class CountingInstance:
         inst = self
 
         class B:
-            async def decide_arrays(self, fields):
+            async def decide_arrays(self, fields, frame=True):
                 n = fields["key_hash"].shape[0]
                 inst.fast_items += n
                 return (
@@ -72,7 +72,7 @@ class CountingInstance:
         self.batcher = B()
         self.traffic = _Traffic()
 
-    async def get_rate_limits(self, reqs):
+    async def get_rate_limits(self, reqs, stage_frame=False):
         from gubernator_tpu.api.types import RateLimitResp, Status
 
         self.slow_items += len(reqs)

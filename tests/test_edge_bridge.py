@@ -17,6 +17,8 @@ from dataclasses import dataclass
 
 from gubernator_tpu.api.types import RateLimitResp, Status
 from gubernator_tpu.serve.edge_bridge import (
+    HELLO_FAST,
+    HELLO_WINDOWED,
     MAGIC_REQ,
     MAGIC_RESP,
     EdgeBridge,
@@ -90,7 +92,7 @@ def test_bridge_answers_bad_item_without_failing_frame():
     items: per-item error for the bad one, real decisions for the rest."""
 
     class FakeInstance:
-        async def get_rate_limits(self, reqs):
+        async def get_rate_limits(self, reqs, stage_frame=False):
             return [
                 RateLimitResp(
                     status=Status.UNDER_LIMIT, limit=r.limit,
@@ -163,6 +165,9 @@ class _FakeTraffic:
     def observe_hashes(self, h):
         pass
 
+    def observe(self, keys, hashes):
+        pass
+
 
 def _fast_frame(rec, ring_hash):
     from gubernator_tpu.serve.edge_bridge import MAGIC_FAST_REQ
@@ -190,7 +195,7 @@ def test_fast_frame_chunks_oversized_batches():
     seen_sizes = []
 
     class FakeBatcher:
-        async def decide_arrays(self, fields):
+        async def decide_arrays(self, fields, frame=True):
             n = fields["key_hash"].shape[0]
             seen_sizes.append(n)
             # echo limit back as remaining so order is checkable
@@ -219,7 +224,9 @@ def test_fast_frame_chunks_oversized_batches():
         try:
             reader, writer = await asyncio.open_unix_connection(path)
             flags, rhash, nodes = await _read_hello(reader)
-            assert flags == 1
+            assert flags & HELLO_FAST
+            assert flags & HELLO_WINDOWED  # r7: windowed frames accepted
+            assert (flags >> 16) >= 1  # advertised credit window
             assert rhash == ring_fingerprint(["127.0.0.1:81"])
             assert nodes == [(True, "127.0.0.1:81", "")]
             n = MAX_BATCH_SIZE + 500
@@ -281,7 +288,7 @@ def test_multinode_hello_carries_ring_and_bridge_endpoints():
             await bridge.stop()
 
     flags, rhash, nodes = asyncio.run(run())
-    assert flags == 1  # fast path stays on in a cluster (r5)
+    assert flags & HELLO_FAST  # fast path stays on in a cluster (r5)
     assert rhash == ring_fingerprint(["10.0.0.1:81", "10.0.0.2:81"])
     # sorted by gRPC address; self has no bridge endpoint, the peer's is
     # derived from its host + our TCP port
@@ -319,7 +326,7 @@ def test_stale_ring_fast_frame_refused_with_gebr():
         try:
             reader, writer = await asyncio.open_unix_connection(path)
             flags, rhash, _nodes = await _read_hello(reader)
-            assert flags == 1
+            assert flags & HELLO_FAST
             req_dt, _ = _fast_dtypes()
             rec = np.zeros(2, req_dt)
             rec["key_hash"] = [1, 2]
@@ -330,6 +337,214 @@ def test_stale_ring_fast_frame_refused_with_gebr():
             assert magic == MAGIC_STALE and n == 0
             got = await reader.read(8)
             assert got == b"", got  # bridge closed after GEBR
+            writer.close()
+        finally:
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def _witem_frame(frame_id: int, items, t_sent_us: int = 0) -> bytes:
+    """Windowed string request (GEB2): frame_id + monotonic stamp."""
+    from gubernator_tpu.serve.edge_bridge import MAGIC_WREQ
+
+    payload = b"".join(items)
+    return (
+        struct.pack("<II", MAGIC_WREQ, len(items))
+        + struct.pack("<IQ", frame_id, t_sent_us)
+        + struct.pack("<I", len(payload))
+        + payload
+    )
+
+
+async def _read_wresp(reader):
+    """One GEB4 windowed response: (frame_id, [(status, limit, rem,
+    reset, error, owner)])."""
+    from gubernator_tpu.serve.edge_bridge import MAGIC_WRESP
+
+    magic, n = struct.unpack("<II", await reader.readexactly(8))
+    assert magic == MAGIC_WRESP, hex(magic)
+    (fid,) = struct.unpack("<I", await reader.readexactly(4))
+    out = []
+    for _ in range(n):
+        st, limit, rem, reset = struct.unpack(
+            "<Bqqq", await reader.readexactly(25)
+        )
+        (elen,) = struct.unpack("<H", await reader.readexactly(2))
+        err = (await reader.readexactly(elen)).decode()
+        (olen,) = struct.unpack("<H", await reader.readexactly(2))
+        owner = (await reader.readexactly(olen)).decode()
+        out.append((st, limit, rem, reset, err, owner))
+    return fid, out
+
+
+def test_windowed_frames_complete_out_of_order():
+    """Two GEB2 frames in flight on one connection: the first is served
+    slowly, the second fast — the responses must come back second-first,
+    matched by frame id. Out-of-order completion IS the pipelining win:
+    a slow frame no longer convoys the frames behind it."""
+    import time as _time
+
+    release_slow = asyncio.Event()
+
+    class FakeInstance:
+        async def get_rate_limits(self, reqs, stage_frame=False):
+            if reqs[0].unique_key == "slow":
+                await release_slow.wait()
+            return [
+                RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=r.limit,
+                    remaining=r.limit - r.hits, reset_time=7,
+                )
+                for r in reqs
+            ]
+
+    async def run():
+        path = "/tmp/guber-bridge-windowed-ooo.sock"
+        bridge = EdgeBridge(FakeInstance(), path)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            await _read_hello(reader)
+            t_us = int(_time.monotonic() * 1e6)
+            writer.write(_witem_frame(11, [_item(b"api", b"slow")], t_us))
+            writer.write(_witem_frame(12, [_item(b"api", b"fast")], t_us))
+            await writer.drain()
+            first = await asyncio.wait_for(_read_wresp(reader), 5)
+            release_slow.set()
+            second = await asyncio.wait_for(_read_wresp(reader), 5)
+            writer.close()
+            return first, second
+        finally:
+            await bridge.stop()
+
+    (fid1, resp1), (fid2, resp2) = asyncio.run(run())
+    assert fid1 == 12  # the fast frame finished first
+    assert fid2 == 11
+    assert resp1[0][:4] == (0, 5, 4, 7)
+    assert resp2[0][:4] == (0, 5, 4, 7)
+
+
+def test_windowed_credit_exhaustion_backpressures_reads():
+    """With window=2 and the instance gated shut, only the first two
+    frames may reach the instance — the bridge must stop READING the
+    connection (credit acquired before the next frame read) so TCP
+    backpressure, not a drop or an error, polices an edge overrunning
+    its credit. Opening the gate completes all four frames."""
+    gate = asyncio.Event()
+    calls = []
+
+    class FakeInstance:
+        async def get_rate_limits(self, reqs, stage_frame=False):
+            calls.append(reqs[0].unique_key)
+            await gate.wait()
+            return [
+                RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=r.limit,
+                    remaining=r.limit - r.hits, reset_time=1,
+                )
+                for r in reqs
+            ]
+
+    async def run():
+        path = "/tmp/guber-bridge-windowed-credit.sock"
+        bridge = EdgeBridge(FakeInstance(), path, window=2)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            flags, _rhash, _nodes = await _read_hello(reader)
+            assert flags >> 16 == 2  # the advertised window
+            for fid in range(1, 5):
+                writer.write(
+                    _witem_frame(fid, [_item(b"api", b"k%d" % fid)])
+                )
+            await writer.drain()
+            await asyncio.sleep(0.3)
+            # credit window exhausted after two in-flight frames: the
+            # bridge must not have started serving frames 3 and 4
+            blocked_calls = list(calls)
+            gate.set()
+            fids = set()
+            for _ in range(4):
+                fid, resps = await asyncio.wait_for(_read_wresp(reader), 5)
+                fids.add(fid)
+                assert resps[0][0] == 0
+            writer.close()
+            return blocked_calls, fids
+        finally:
+            await bridge.stop()
+
+    blocked_calls, fids = asyncio.run(run())
+    assert len(blocked_calls) == 2, blocked_calls
+    assert fids == {1, 2, 3, 4}
+
+
+def test_windowed_stale_ring_refused_mid_window():
+    """A GEB7 fast frame routed with a stale membership fingerprint must
+    be refused with GEBR carrying ITS frame id — even while other
+    frames are still in flight on the window — and the connection
+    closed (every outstanding frame was routed with the same stale
+    view; the edge fails them stale and re-reads the ring)."""
+    import numpy as np
+
+    from gubernator_tpu.serve.edge_bridge import (
+        MAGIC_STALE,
+        MAGIC_WFAST_REQ,
+        _fast_dtypes,
+    )
+
+    gate = asyncio.Event()
+
+    class FakeBatcher:
+        async def decide_arrays(self, fields, frame=True):
+            await gate.wait()  # frame 1 parks here, mid-window
+            n = fields["key_hash"].shape[0]
+            return (
+                np.zeros(n, np.int64),
+                fields["limit"],
+                fields["limit"],
+                np.zeros(n, np.int64),
+            )
+
+    class FakePicker:
+        def peers(self):
+            return [FakePeer("127.0.0.1:81", is_owner=True)]
+
+    class FakeInstance:
+        backend = _FakeBackendArrays()
+        picker = FakePicker()
+        batcher = FakeBatcher()
+        traffic = _FakeTraffic()
+
+    def wfast(fid, rec, ring_hash):
+        payload = rec.tobytes()
+        return (
+            struct.pack("<II", MAGIC_WFAST_REQ, len(rec))
+            + struct.pack("<IIQ", fid, ring_hash, 0)
+            + struct.pack("<I", len(payload))
+            + payload
+        )
+
+    async def run():
+        path = "/tmp/guber-bridge-windowed-stale.sock"
+        bridge = EdgeBridge(FakeInstance(), path)
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            _flags, rhash, _nodes = await _read_hello(reader)
+            req_dt, _ = _fast_dtypes()
+            rec = np.zeros(1, req_dt)
+            rec["key_hash"] = [1]
+            rec["limit"] = [5]
+            writer.write(wfast(21, rec, rhash))  # parks in the batcher
+            writer.write(wfast(22, rec, (rhash + 1) & 0xFFFFFFFF))
+            await writer.drain()
+            magic, fid = struct.unpack(
+                "<II", await asyncio.wait_for(reader.readexactly(8), 5)
+            )
+            assert magic == MAGIC_STALE and fid == 22
+            got = await asyncio.wait_for(reader.read(8), 5)
+            assert got == b"", got  # connection closed after GEBR
             writer.close()
         finally:
             await bridge.stop()
@@ -362,4 +577,154 @@ def test_fast_kill_switch_unadvertises():
         finally:
             await bridge.stop()
 
-    assert asyncio.run(run()) == 0
+    flags = asyncio.run(run())
+    assert not (flags & HELLO_FAST)
+    assert flags & HELLO_WINDOWED  # windowed framing is fast-agnostic
+
+
+def _fold_fixture(is_owner: bool, string_fold: bool = True,
+                  fast_enabled: bool = True):
+    """Bridge over a real ConsistentHashPicker (one peer) whose batcher
+    and instance record which path served each frame."""
+    import numpy as np
+
+    from gubernator_tpu.serve.peers import ConsistentHashPicker
+
+    folded_sizes = []
+    object_path_keys = []
+
+    class FakeBatcher:
+        async def decide_arrays(self, fields, frame=True):
+            n = fields["key_hash"].shape[0]
+            folded_sizes.append(n)
+            return (
+                np.zeros(n, np.int64),
+                fields["limit"],
+                fields["limit"] - fields["hits"],
+                np.full(n, 77, np.int64),
+            )
+
+    class FakeInstance:
+        backend = _FakeBackendArrays()
+        traffic = _FakeTraffic()
+        batcher = FakeBatcher()
+        picker = ConsistentHashPicker()
+
+        async def get_rate_limits(self, reqs, stage_frame=False):
+            object_path_keys.extend(r.unique_key for r in reqs)
+            return [
+                RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=r.limit,
+                    remaining=r.limit - r.hits, reset_time=77,
+                )
+                for r in reqs
+            ]
+
+    inst = FakeInstance()
+    inst.picker.add(FakePeer("127.0.0.1:81", is_owner=is_owner))
+    bridge = EdgeBridge(
+        inst, "", fast_enabled=fast_enabled, string_fold=string_fold
+    )
+    return bridge, folded_sizes, object_path_keys
+
+
+def _roundtrip_string_frame(bridge, items, sock_name):
+    """Send one GEB1 frame through a started bridge; return the decoded
+    per-item responses."""
+
+    async def run():
+        path = f"/tmp/guber-bridge-{sock_name}.sock"
+        bridge.path = path
+        await bridge.start()
+        try:
+            reader, writer = await asyncio.open_unix_connection(path)
+            await _read_hello(reader)
+            writer.write(_frame(items))
+            await writer.drain()
+            magic, n = struct.unpack("<II", await reader.readexactly(8))
+            assert magic == MAGIC_RESP and n == len(items)
+            out = []
+            for _ in range(n):
+                st, limit, rem, reset = struct.unpack(
+                    "<Bqqq", await reader.readexactly(25)
+                )
+                (elen,) = struct.unpack("<H", await reader.readexactly(2))
+                err = (await reader.readexactly(elen)).decode()
+                (olen,) = struct.unpack("<H", await reader.readexactly(2))
+                owner = (await reader.readexactly(olen)).decode()
+                out.append((st, limit, rem, reset, err, owner))
+            writer.close()
+            return out
+        finally:
+            await bridge.stop()
+
+    return asyncio.run(run())
+
+
+def test_string_fold_serves_plain_owned_frame_via_arrays():
+    """An all-plain all-owned GEB1 frame must skip the instance and
+    ride the array path (r7 string->array fold), producing wire bytes
+    identical in layout to the object path: 25-byte decisions with
+    empty error and owner fields. The fold must work with the fast
+    kill switch thrown — that is the case it exists for."""
+    bridge, folded_sizes, object_path_keys = _fold_fixture(
+        is_owner=True, fast_enabled=False
+    )
+    out = _roundtrip_string_frame(
+        bridge,
+        [_item(b"api", b"k1", hits=1, limit=5),
+         _item(b"api", b"k2", hits=2, limit=9)],
+        "fold-owned",
+    )
+    assert folded_sizes == [2]
+    assert object_path_keys == []
+    assert out[0] == (0, 5, 4, 77, "", "")
+    assert out[1] == (0, 9, 7, 77, "", "")
+
+
+def test_string_fold_declines_global_and_unowned_frames():
+    """A GLOBAL item anywhere in the frame, or any key this node does
+    not own, must push the WHOLE frame onto the object path — the fold
+    never bypasses global-manager or forwarding semantics."""
+    bridge, folded_sizes, object_path_keys = _fold_fixture(is_owner=True)
+    out = _roundtrip_string_frame(
+        bridge,
+        [_item(b"api", b"k1"), _item(b"api", b"g1", behavior=2)],
+        "fold-global",
+    )
+    assert folded_sizes == []
+    assert object_path_keys == ["k1", "g1"]
+    assert out[0][:4] == (0, 5, 4, 77)
+
+    bridge, folded_sizes, object_path_keys = _fold_fixture(is_owner=False)
+    _roundtrip_string_frame(bridge, [_item(b"api", b"k1")], "fold-unowned")
+    assert folded_sizes == []
+    assert object_path_keys == ["k1"]
+
+
+def test_string_fold_kill_switch():
+    """GUBER_EDGE_STRING_FOLD=0 (string_fold=False) must restore the
+    pre-r7 all-objects string path even for foldable frames."""
+    bridge, folded_sizes, object_path_keys = _fold_fixture(
+        is_owner=True, string_fold=False
+    )
+    _roundtrip_string_frame(bridge, [_item(b"api", b"k1")], "fold-off")
+    assert folded_sizes == []
+    assert object_path_keys == ["k1"]
+
+
+def test_picker_self_owned_mask_matches_get():
+    """self_owned_mask (the fold's vectorized ownership screen) must
+    agree with get() — the authoritative per-key placement — across a
+    multi-peer ring."""
+    from gubernator_tpu.serve.peers import ConsistentHashPicker
+
+    picker = ConsistentHashPicker()
+    picker.add(FakePeer("10.0.0.1:81", is_owner=True))
+    picker.add(FakePeer("10.0.0.2:81"))
+    picker.add(FakePeer("10.0.0.3:81"))
+    keys = [f"api_k{i}" for i in range(500)]
+    mask = picker.self_owned_mask(keys)
+    assert mask.any() and not mask.all()  # 500 keys spread over 3 peers
+    for k, owned in zip(keys, mask):
+        assert picker.get(k).is_owner == bool(owned)

@@ -64,7 +64,7 @@ class Inst:
         inst = self
 
         class B:
-            async def decide_arrays(self, fields):
+            async def decide_arrays(self, fields, frame=True):
                 n = fields["key_hash"].shape[0]
                 inst.fast_items += n
                 return (
@@ -82,7 +82,7 @@ class Inst:
         self.traffic = T()
         self.fast_items = 0
 
-    async def get_rate_limits(self, reqs):
+    async def get_rate_limits(self, reqs, stage_frame=False):
         from gubernator_tpu.api.types import RateLimitResp, Status
 
         return [

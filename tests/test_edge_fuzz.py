@@ -39,7 +39,7 @@ SOCK = "/tmp/guber-edge-fuzz.sock"
 class FakeInstance:
     """Answers every request UNDER_LIMIT with remaining = limit - hits."""
 
-    async def get_rate_limits(self, reqs):
+    async def get_rate_limits(self, reqs, stage_frame=False):
         return [
             RateLimitResp(
                 status=Status.UNDER_LIMIT,

@@ -42,7 +42,7 @@ PREFACE = b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n"
 
 
 class FakeInstance:
-    async def get_rate_limits(self, reqs):
+    async def get_rate_limits(self, reqs, stage_frame=False):
         return [
             RateLimitResp(
                 status=Status.UNDER_LIMIT, limit=r.limit,
@@ -229,3 +229,260 @@ def test_interleaved_garbage_then_real_traffic_same_port(edge):
     for blob in CORPUS[::3]:
         _send_raw(blob)
         _assert_alive(edge)
+
+
+# ---------------------------------------------------------------------------
+# Windowed (GEB2/GEB7) framing fuzz — r7. Two directions: hostile
+# windowed frames INTO the bridge's socket (a desynced or malicious
+# edge), and a hostile BRIDGE feeding garbage windowed responses to the
+# edge's reader thread (the only place the edge parses frames it did
+# not originate). test_edge_asan.py re-runs this module against the
+# sanitized binary, so both sides of the new framing get ASan coverage.
+# ---------------------------------------------------------------------------
+
+from gubernator_tpu.serve.edge_bridge import (  # noqa: E402
+    HELLO_FAST,
+    HELLO_WINDOWED,
+    MAGIC_HELLO,
+    MAGIC_STALE,
+    MAGIC_WFAST_REQ,
+    MAGIC_WFAST_RESP,
+    MAGIC_WREQ,
+    MAGIC_WRESP,
+    ring_fingerprint,
+)
+
+
+def _witems(n):
+    item = (
+        struct.pack("<H", 3) + b"api"
+        + struct.pack("<H", 1) + b"k"
+        + struct.pack("<qqqBB", 1, 5, 1000, 0, 0)
+    )
+    return item * n
+
+
+WINDOWED_BRIDGE_CORPUS = [
+    # GEB2 whose payload length disagrees with the item encoding
+    struct.pack("<II", MAGIC_WREQ, 3)
+    + struct.pack("<IQ", 1, 0) + struct.pack("<I", 4) + b"\xff" * 4,
+    # GEB2 header then EOF (cut mid-frame)
+    struct.pack("<II", MAGIC_WREQ, 5) + struct.pack("<IQ", 2, 0),
+    # GEB7 with a payload that is not n x 33 bytes
+    struct.pack("<II", MAGIC_WFAST_REQ, 4)
+    + struct.pack("<IIQ", 3, 0, 0) + struct.pack("<I", 7) + b"\x00" * 7,
+    # absurd item count with a tiny payload
+    struct.pack("<II", MAGIC_WREQ, 1 << 30)
+    + struct.pack("<IQ", 4, 0) + struct.pack("<I", 2) + b"ab",
+    # GEBR sent TO the bridge (only the bridge may send it)
+    struct.pack("<II", MAGIC_STALE, 9),
+    # stamp from the far future (transit attribution must drop it)
+    struct.pack("<II", MAGIC_WREQ, 1)
+    + struct.pack("<IQ", 5, 1 << 62)
+    + struct.pack("<I", len(_witems(1))) + _witems(1),
+]
+
+
+def test_hostile_windowed_frames_at_bridge_socket(edge):
+    """Garbage GEB2/GEB7 frames straight into the bridge's unix socket:
+    each hostile connection may die, but the bridge (and the edge's
+    gRPC door riding it) must keep serving."""
+    for blob in WINDOWED_BRIDGE_CORPUS:
+        try:
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(2)
+            s.connect(SOCK)
+            s.recv(65536)  # hello
+            s.sendall(blob)
+            try:
+                while s.recv(65536):
+                    pass
+            except (socket.timeout, OSError):
+                pass
+            s.close()
+        except OSError:
+            pass
+        _assert_alive(edge)
+
+
+HOSTILE_PORT = 19591
+HOSTILE_SOCK = "/tmp/guber-edge-hostile-bridge.sock"
+
+
+def test_windowed_hostile_bridge_responses_fail_cleanly():
+    """A hostile bridge answers the edge's windowed frames with garbage
+    — unknown magic, unknown frame id, absurd record count, GEBR, a
+    truncated header — one per connection. The edge must fail each
+    in-flight batch cleanly (503 / per-item retry errors), reconnect,
+    and once the bridge behaves, serve a real decision. This is the
+    reader-thread parse surface, the only frames the edge did not
+    originate."""
+    import asyncio
+    import json as _json
+    import queue as _queue
+    import urllib.request
+    import urllib.error
+
+    hostile = _queue.Queue()
+    for mode in ("bad_magic", "unknown_fid", "absurd_count", "gebr",
+                 "truncate"):
+        hostile.put(mode)
+
+    grpc_addr = "127.0.0.1:9991"
+    rhash = ring_fingerprint([grpc_addr])
+
+    def hello():
+        flags = HELLO_FAST | HELLO_WINDOWED | (4 << 16)
+        g = grpc_addr.encode()
+        return (
+            struct.pack("<IIII", MAGIC_HELLO, flags, rhash, 1)
+            + struct.pack("<BH", 1, len(g)) + g + struct.pack("<H", 0)
+        )
+
+    async def handle(reader, writer):
+        try:
+            writer.write(hello())
+            await writer.drain()
+            while True:
+                hdr = await reader.readexactly(8)
+                magic, n = struct.unpack("<II", hdr)
+                if magic == MAGIC_WFAST_REQ:
+                    fid, _rh, _ts = struct.unpack(
+                        "<IIQ", await reader.readexactly(16)
+                    )
+                elif magic == MAGIC_WREQ:
+                    fid, _ts = struct.unpack(
+                        "<IQ", await reader.readexactly(12)
+                    )
+                else:
+                    return  # non-windowed frame: not this bridge's deal
+                (plen,) = struct.unpack(
+                    "<I", await reader.readexactly(4)
+                )
+                await reader.readexactly(plen)
+                try:
+                    mode = hostile.get_nowait()
+                except _queue.Empty:
+                    mode = "behave"
+                if mode == "bad_magic":
+                    writer.write(struct.pack("<II", 0xDEADBEEF, 0))
+                    await writer.drain()
+                    return
+                if mode == "unknown_fid":
+                    writer.write(
+                        struct.pack("<II", MAGIC_WFAST_RESP, n)
+                        + struct.pack("<I", fid ^ 0x5A5A)
+                        + b"\x00" * (25 * n)
+                    )
+                    await writer.drain()
+                    return
+                if mode == "absurd_count":
+                    writer.write(
+                        struct.pack("<II", MAGIC_WFAST_RESP, 1 << 28)
+                        + struct.pack("<I", fid)
+                    )
+                    await writer.drain()
+                    return
+                if mode == "gebr":
+                    writer.write(struct.pack("<II", MAGIC_STALE, fid))
+                    await writer.drain()
+                    return
+                if mode == "truncate":
+                    writer.write(struct.pack("<II", MAGIC_WFAST_RESP, n))
+                    await writer.drain()
+                    return
+                # behave: well-formed windowed response, every item OK
+                rec = struct.pack("<Bqqq", 0, 9, 8, 1)
+                if magic == MAGIC_WFAST_REQ:
+                    writer.write(
+                        struct.pack("<II", MAGIC_WFAST_RESP, n)
+                        + struct.pack("<I", fid) + rec * n
+                    )
+                else:
+                    item = rec + struct.pack("<H", 0) + struct.pack("<H", 0)
+                    writer.write(
+                        struct.pack("<II", MAGIC_WRESP, n)
+                        + struct.pack("<I", fid) + item * n
+                    )
+                await writer.drain()
+        except (asyncio.IncompleteReadError, ConnectionResetError,
+                BrokenPipeError, OSError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    pathlib.Path(HOSTILE_SOCK).unlink(missing_ok=True)
+    loop = asyncio.new_event_loop()
+    server_box = {}
+
+    def run_loop():
+        asyncio.set_event_loop(loop)
+        server_box["srv"] = loop.run_until_complete(
+            asyncio.start_unix_server(handle, HOSTILE_SOCK)
+        )
+        loop.run_forever()
+
+    t = threading.Thread(target=run_loop, daemon=True)
+    t.start()
+    for _ in range(100):
+        if pathlib.Path(HOSTILE_SOCK).exists():
+            break
+        time.sleep(0.05)
+
+    proc = subprocess.Popen(
+        [str(EDGE_BIN), "--listen", str(HOSTILE_PORT), "--backend",
+         HOSTILE_SOCK, "--workers", "1", "--batch-wait-us", "100"],
+        stdout=sys.stderr, stderr=subprocess.STDOUT,
+    )
+    try:
+        for _ in range(100):
+            try:
+                with socket.create_connection(
+                    ("127.0.0.1", HOSTILE_PORT), 0.2
+                ):
+                    break
+            except OSError:
+                time.sleep(0.05)
+        else:
+            raise RuntimeError("edge did not listen")
+
+        body = _json.dumps(
+            {"requests": [{"name": "fz", "uniqueKey": "ok", "hits": 1,
+                           "limit": 9, "duration": 60000}]}
+        ).encode()
+        url = f"http://127.0.0.1:{HOSTILE_PORT}/v1/GetRateLimits"
+        deadline = time.monotonic() + 30
+        ok = False
+        while time.monotonic() < deadline:
+            assert proc.poll() is None, "edge died on hostile response"
+            try:
+                req = urllib.request.Request(
+                    url, data=body,
+                    headers={"Content-Type": "application/json"},
+                )
+                resp = _json.loads(
+                    urllib.request.urlopen(req, timeout=5).read()
+                )
+                r0 = resp["responses"][0]
+                if not r0.get("error") and int(r0.get("limit", 0)) == 9:
+                    ok = True
+                    break
+            except (urllib.error.HTTPError, urllib.error.URLError,
+                    OSError):
+                pass  # hostile phase: 503s / resets are the contract
+            time.sleep(0.1)
+        assert ok, "edge never recovered after the bridge became sane"
+        assert hostile.empty(), "not every hostile mode was exercised"
+        assert proc.poll() is None
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        loop.call_soon_threadsafe(loop.stop)
+        t.join(timeout=5)
+        pathlib.Path(HOSTILE_SOCK).unlink(missing_ok=True)
