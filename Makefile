@@ -1,4 +1,5 @@
-.PHONY: all native proto test bench readme readme-check profile-stages clean
+.PHONY: all native proto test bench readme readme-check profile-stages \
+	chaos clean
 
 all: native proto
 
@@ -33,6 +34,16 @@ OUT ?= BENCH_STAGES.json
 profile-stages: native
 	python scripts/profile_serving_stages.py --seconds $(SECONDS) \
 	  --json $(OUT)
+
+# chaos soak (r8): 3-node cluster under load with a peer killed +
+# restarted mid-run and GUBER_FAULT_SPEC injection active; asserts
+# bounded error rate, breaker recovery, graceful drain. SECONDS/OUT
+# overridable: make chaos SECONDS=60 OUT=chaos.json
+CHAOS_SECONDS ?= 30
+CHAOS_OUT ?= BENCH_CHAOS_r8.json
+chaos:
+	python scripts/chaos_soak.py --seconds $(CHAOS_SECONDS) \
+	  --json $(CHAOS_OUT)
 
 clean:
 	$(MAKE) -C gubernator_tpu/native clean

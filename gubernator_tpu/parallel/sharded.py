@@ -532,7 +532,21 @@ class MeshEngine:
         procs = {d.process_index for d in devices}
         span = len(procs) > 1
         if mesh_shape is None and span and self.n % len(procs) == 0:
-            mesh_shape = (len(procs), self.n // len(procs))
+            # The auto 2-D shape assumes the device list is process-major
+            # with EQUAL per-process counts. Validate that before
+            # committing: with unequal contributions (n still divisible
+            # by len(procs)) the reshape would group chips of different
+            # hosts under one 'host' row — numerically correct, but the
+            # "ICI within a row, DCN across rows" staging would silently
+            # cross DCN inside a row. Fall back to the flat ('shard',)
+            # mesh when any row mixes processes (ADVICE r5 #1).
+            grid = np.asarray(devices).reshape(
+                len(procs), self.n // len(procs)
+            )
+            if all(
+                len({d.process_index for d in row}) == 1 for row in grid
+            ):
+                mesh_shape = (len(procs), self.n // len(procs))
         if mesh_shape is not None:
             # 2-D ("host", "chip") mesh: the GLOBAL-sync reduction runs
             # hierarchically — chips combine within a host over ICI,

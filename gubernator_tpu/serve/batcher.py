@@ -46,6 +46,7 @@ from typing import List, Optional, Sequence, Tuple
 from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
 from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.aio import collect_batch
+from gubernator_tpu.serve.faults import FAULTS, FaultError
 from gubernator_tpu.serve.stages import STAGES
 
 
@@ -158,6 +159,21 @@ class DeviceBatcher:
         self._pending.clear()
         self._submit_pool.shutdown(wait=False)
         self._fetch_pool.shutdown(wait=False)
+
+    async def drain(self) -> None:
+        """Graceful-drain wait: resolves when no queued, collected,
+        parked, or in-flight work remains. Callers must have stopped
+        feeding the batcher first (drain doesn't gate decide()); the
+        server's drain path bounds this with the GUBER_DRAIN_TIMEOUT_MS
+        budget."""
+        while (
+            not self._queue.empty()
+            or self._live_batch
+            or self._carry
+            or self._flushing
+            or self._pending
+        ):
+            await asyncio.sleep(0.005)
 
     async def decide(
         self,
@@ -292,6 +308,17 @@ class DeviceBatcher:
                 raise
 
     async def _flush(self, batch) -> None:
+        if FAULTS.enabled:
+            # device_submit injection point (GUBER_FAULT_SPEC): an
+            # error fails THIS batch's callers (per-item errors, the
+            # same envelope as a real submit failure) and must never
+            # kill the flusher task; delay/hang stall the submit path
+            # like a wedged device would
+            try:
+                await FAULTS.inject("device_submit")
+            except FaultError as e:
+                self._fail(batch, e)
+                return
         decide_items = [
             b for b in batch if b[0] in ("decide", "decide_arrays")
         ]

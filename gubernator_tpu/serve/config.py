@@ -35,11 +35,60 @@ class BehaviorConfig:
     global_sync_wait: float = 0.0005  # GLOBAL gossip window
     global_batch_limit: int = MAX_BATCH_SIZE
 
+    # -- peer resilience (r8) ----------------------------------------------
+    # Per-RPC deadline for peer calls (GUBER_PEER_TIMEOUT_MS). 0 = fall
+    # back to batch_timeout, the pre-r8 behavior, so existing deployments
+    # pinning only GUBER_BATCH_TIMEOUT_MS keep their deadline.
+    peer_timeout: float = 0.0
+    # Bounded retries with exponential backoff + FULL jitter
+    # (delay ~ U(0, min(max, base * 2^attempt))). Retried only for
+    # failures that are safe to re-send: transport-level errors where
+    # the request never reached the peer (UNAVAILABLE / connection
+    # refused / injected retryable faults), or ANY failure when every
+    # request in the batch is a zero-hit peek (truly idempotent).
+    # DEADLINE_EXCEEDED on a hit-carrying batch is NOT retried — the
+    # peer may have applied the hits (at-most-once over double-count).
+    peer_retries: int = 2  # GUBER_PEER_RETRIES; 0 disables
+    peer_backoff: float = 0.025  # GUBER_PEER_BACKOFF_MS: base delay
+    peer_backoff_max: float = 0.25  # GUBER_PEER_BACKOFF_MAX_MS: cap
+    # Per-peer circuit breaker (serve/breaker.py): trip after
+    # `breaker_failures` consecutive failures OR a failure ratio >=
+    # `breaker_ratio` over the last `breaker_window` calls; fail fast
+    # while open; after `breaker_cooldown` let `breaker_probes`
+    # half-open probes decide. breaker_failures=0 disables the breaker.
+    breaker_failures: int = 5  # GUBER_BREAKER_FAILURES
+    breaker_ratio: float = 0.5  # GUBER_BREAKER_RATIO
+    breaker_window: int = 20  # GUBER_BREAKER_WINDOW
+    breaker_cooldown: float = 1.0  # GUBER_BREAKER_COOLDOWN_MS
+    breaker_probes: int = 1  # GUBER_BREAKER_PROBES
+
+    def effective_peer_timeout(self) -> float:
+        return self.peer_timeout if self.peer_timeout > 0 else self.batch_timeout
+
     def validate(self) -> None:
         if self.batch_limit > MAX_BATCH_SIZE:
             raise ValueError(
                 f"behaviors.batch_limit cannot exceed '{MAX_BATCH_SIZE}'"
             )
+        if self.peer_timeout < 0 or self.peer_retries < 0:
+            raise ValueError(
+                "GUBER_PEER_TIMEOUT_MS / GUBER_PEER_RETRIES must be >= 0"
+            )
+        if self.peer_backoff < 0 or self.peer_backoff_max < self.peer_backoff:
+            raise ValueError(
+                "GUBER_PEER_BACKOFF_MS must be >= 0 and <= "
+                "GUBER_PEER_BACKOFF_MAX_MS"
+            )
+        if self.breaker_failures < 0:
+            raise ValueError("GUBER_BREAKER_FAILURES must be >= 0")
+        if not (0.0 < self.breaker_ratio <= 1.0):
+            raise ValueError("GUBER_BREAKER_RATIO must be in (0, 1]")
+        if self.breaker_window < 1 or self.breaker_probes < 1:
+            raise ValueError(
+                "GUBER_BREAKER_WINDOW / GUBER_BREAKER_PROBES must be >= 1"
+            )
+        if self.breaker_cooldown < 0:
+            raise ValueError("GUBER_BREAKER_COOLDOWN_MS must be >= 0")
 
 
 @dataclass
@@ -167,6 +216,20 @@ class ServerConfig:
     k8s_pod_port: str = ""
     k8s_endpoints_selector: str = ""
 
+    # Degraded mode (GUBER_DEGRADED_LOCAL=1, r8): when the OWNING peer
+    # of a forwarded item is unreachable (circuit open, retries
+    # exhausted, deadline), answer from the LOCAL store with
+    # metadata["degraded"]="true" instead of a per-item error. Trades
+    # global accuracy for availability — the reference's documented
+    # eventual-consistency stance, opt-in because a rate limiter that
+    # silently under-counts is not always the right failure mode.
+    degraded_local: bool = False
+    # Graceful drain bound (GUBER_DRAIN_TIMEOUT_MS): SIGTERM
+    # deregisters from discovery, refuses new edge frames, lets
+    # in-flight gRPC/edge work finish, and flushes the batcher +
+    # GLOBAL queues — all within this budget, then hard-stops.
+    drain_timeout: float = 5.0
+
     debug: bool = False
     log_level: str = "info"  # panic|fatal|error|warn|info|debug|trace
     log_json: bool = False
@@ -266,6 +329,8 @@ class ServerConfig:
             )
         if self.edge_window < 0:
             raise ValueError("GUBER_EDGE_WINDOW must be >= 0")
+        if self.drain_timeout < 0:
+            raise ValueError("GUBER_DRAIN_TIMEOUT_MS must be >= 0")
         # bridge endpoints split host:port on the LAST colon — IPv6
         # literals would misparse silently; refuse at config time
         # (ADVICE r5 #2; serve/edge_bridge.reject_ipv6_endpoint)
@@ -348,6 +413,19 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         global_batch_limit=_get_int(
             env, "GUBER_GLOBAL_BATCH_LIMIT", MAX_BATCH_SIZE
         ),
+        peer_timeout=_get_float_ms(env, "GUBER_PEER_TIMEOUT_MS", 0.0),
+        peer_retries=_get_int(env, "GUBER_PEER_RETRIES", 2),
+        peer_backoff=_get_float_ms(env, "GUBER_PEER_BACKOFF_MS", 25.0 / 1000),
+        peer_backoff_max=_get_float_ms(
+            env, "GUBER_PEER_BACKOFF_MAX_MS", 250.0 / 1000
+        ),
+        breaker_failures=_get_int(env, "GUBER_BREAKER_FAILURES", 5),
+        breaker_ratio=float(env.get("GUBER_BREAKER_RATIO") or 0.5),
+        breaker_window=_get_int(env, "GUBER_BREAKER_WINDOW", 20),
+        breaker_cooldown=_get_float_ms(
+            env, "GUBER_BREAKER_COOLDOWN_MS", 1.0
+        ),
+        breaker_probes=_get_int(env, "GUBER_BREAKER_PROBES", 1),
     )
     peers = [
         p.strip()
@@ -412,6 +490,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         k8s_pod_ip=_get(env, "GUBER_K8S_POD_IP"),
         k8s_pod_port=_get(env, "GUBER_K8S_POD_PORT"),
         k8s_endpoints_selector=_get(env, "GUBER_K8S_ENDPOINTS_SELECTOR"),
+        degraded_local=_get(env, "GUBER_DEGRADED_LOCAL")
+        in ("1", "true", "yes"),
+        drain_timeout=_get_float_ms(env, "GUBER_DRAIN_TIMEOUT_MS", 5.0),
         debug=_get(env, "GUBER_DEBUG") in ("1", "true", "yes"),
         log_level=_get(env, "GUBER_LOG_LEVEL", "info"),
         log_json=_get(env, "GUBER_LOG_JSON") in ("1", "true", "yes"),

@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Awaitable, Callable, List, Sequence
+from typing import Awaitable, Callable, List, Optional, Sequence
 
 from gubernator_tpu.api.types import PeerInfo
 
@@ -113,6 +113,12 @@ class EtcdPool:
         self._lease = None
         self._tasks: list = []
         self._closing = False
+        # last membership actually delivered (sorted addresses):
+        # consecutive identical snapshots are not re-pushed — the
+        # watch-start re-sync (see _consume_watch) usually confirms
+        # what the initial push already delivered, and every push
+        # rebuilds the instance's ring
+        self._last_pushed: Optional[list] = None
 
     async def start(self) -> None:
         await asyncio.to_thread(self._register)
@@ -163,6 +169,14 @@ class EtcdPool:
     def _consume_watch(self, loop) -> None:
         events, cancel = self.client.watch_prefix(self.prefix)
         self._cancel_watch = cancel
+        # re-sync AFTER the watch is live: a peer that registered
+        # between start()'s initial _push_peers and this point emitted
+        # its event before anyone was watching, and nothing else ever
+        # re-pushes — the node would sit at a stale peer count until
+        # the NEXT membership change (observed as test_compose_topology
+        # flaking at peerCount 1 under full-suite load, where the
+        # register->watch gap stretches to seconds)
+        asyncio.run_coroutine_threadsafe(self._push_peers(), loop).result()
         for _ in events:
             asyncio.run_coroutine_threadsafe(self._push_peers(), loop).result()
 
@@ -176,6 +190,10 @@ class EtcdPool:
             )
             for v, _ in kvs
         ]
+        key = sorted(p.address for p in peers)
+        if key == self._last_pushed:
+            return
+        self._last_pushed = key
         await self.on_update(peers)
 
     async def close(self) -> None:

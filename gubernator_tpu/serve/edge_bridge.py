@@ -86,6 +86,18 @@ Frame protocol (little-endian, lengths in bytes):
                    (then the bridge closes; the edge fails every frame
                    still in flight on the connection as stale,
                    re-reads the hello, re-routes)
+      frame_id 0xFFFFFFFF is the DRAIN code (r8): the node is shutting
+      down gracefully — frames already accepted on the connection have
+      been answered (the bridge waits for them BEFORE sending the
+      refusal), this one was not served, and reconnecting is pointless
+      (the listener is closed). An edge that predates the code treats
+      it as a stale-ring refusal: it fails the refused frame for
+      re-route and finds the node gone on reconnect — degraded, not
+      broken. Sent on the windowed (GEB2/GEB7) and legacy fast (GEB6)
+      framings, whose readers understand GEBR; a legacy STRING frame
+      (GEB1) predates GEBR entirely and is drain-refused with a
+      well-formed GEB3 response carrying per-item "node draining"
+      errors instead.
 
 Non-windowed frames (GEB1/GEB6) keep their one-in-flight round-trip
 semantics for version-skewed edges; a bridge serves both framings on
@@ -115,6 +127,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE
+from gubernator_tpu.serve.faults import FAULTS
 from gubernator_tpu.serve.stages import STAGES
 
 log = logging.getLogger("gubernator_tpu.edge")
@@ -135,6 +148,11 @@ HELLO_WINDOWED = 2  # hello flags bit 1; window size = flags >> 16
 
 DEFAULT_WINDOW = 32
 MAX_WINDOW = 1024
+
+#: GEBR frame_id meaning "draining, not stale ring" (r8): real frame
+#: ids are sequence numbers far below this; legacy edges treat it as a
+#: stale-ring refusal (safe: re-route, reconnect fails)
+DRAIN_FRAME_ID = 0xFFFFFFFF
 
 
 def ring_fingerprint(hosts) -> int:
@@ -375,11 +393,18 @@ class EdgeBridge:
         # readexactly forever, wedging daemon shutdown otherwise
         self._conns: set = set()
         self._stopping = False
+        # graceful drain (r8): set by drain() — read loops refuse NEW
+        # frames with a GEBR drain code after answering the frames
+        # already in flight; _active_frames counts frames accepted but
+        # not yet answered so drain() can wait for exactly those
+        self._draining = False
+        self._active_frames = 0
         # (picker object, fingerprint) — see _ring_hash
         self._ring_hash_cache: Optional[tuple] = None
 
     async def start(self) -> None:
         self._stopping = False
+        self._draining = False
         if self.path:
             self._server = await asyncio.start_unix_server(
                 self._serve_conn, path=self.path
@@ -391,6 +416,26 @@ class EdgeBridge:
                 self._serve_conn, host=host or "0.0.0.0", port=int(port)
             )
             log.info("edge bridge listening on tcp %s", self.tcp_address)
+
+    async def drain(self, timeout: float) -> None:
+        """Graceful drain: stop accepting connections, refuse NEW
+        frames (GEBR drain code — see the protocol header), and wait
+        up to `timeout` for every frame already accepted to be
+        answered. Connections stay open so those answers can be
+        written; stop() closes them afterwards. No accepted frame is
+        dropped unless the timeout expires."""
+        self._draining = True
+        for srv in (self._server, self._tcp_server):
+            if srv is not None:
+                srv.close()
+        deadline = time.monotonic() + max(0.0, timeout)
+        while self._active_frames > 0 and time.monotonic() < deadline:
+            await asyncio.sleep(0.01)
+        if self._active_frames:
+            log.warning(
+                "edge drain timed out with %d frame(s) still in flight",
+                self._active_frames,
+            )
 
     async def stop(self) -> None:
         # flag first: a handler task accepted just before stop() may not
@@ -805,6 +850,11 @@ class EdgeBridge:
         e2e clock start: the edge's send stamp when the frame carried
         one, else the bridge's read time."""
         try:
+            if FAULTS.enabled:
+                # edge_frame injection point: delay stretches this
+                # frame's service; error poisons the connection (the
+                # generic handler below), like a real decode/serve crash
+                await FAULTS.inject("edge_frame")
             if magic == MAGIC_WFAST_REQ:
                 raw = await self._decide_fast(payload, n)
                 frame = (
@@ -833,8 +883,28 @@ class EdgeBridge:
         finally:
             wstate.sem.release()
 
+    async def _refuse_draining(self, writer, wstate, frame_id: int):
+        """Drain-refuse one just-read frame: first let every frame
+        already in flight on this connection finish (their responses
+        ride the still-open writer — no accepted frame is lost), then
+        send the GEBR drain code for the frame that will NOT be served
+        and close the connection by returning."""
+        if wstate.tasks:
+            await asyncio.gather(
+                *list(wstate.tasks), return_exceptions=True
+            )
+        async with wstate.write_lock:
+            writer.write(_HDR.pack(MAGIC_STALE, frame_id))
+            await writer.drain()
+
+    def _frame_begun(self) -> None:
+        self._active_frames += 1
+
+    def _frame_done(self, *_args) -> None:
+        self._active_frames -= 1
+
     async def _serve_conn(self, reader, writer):
-        if self._stopping:
+        if self._stopping or self._draining:
             writer.close()
             return
         self._conns.add(writer)
@@ -881,6 +951,13 @@ class EdgeBridge:
                             writer.write(_HDR.pack(MAGIC_STALE, frame_id))
                             await writer.drain()
                         return
+                    if self._draining:
+                        # answered in-flight frames first, then refuse
+                        # this one with the drain code
+                        await self._refuse_draining(
+                            writer, wstate, DRAIN_FRAME_ID
+                        )
+                        return
                     transit = self._observe_transit(
                         wstate, t_frame0, t_sent
                     )
@@ -889,14 +966,15 @@ class EdgeBridge:
                     # window parks here and TCP backpressure does the
                     # policing — no frame is ever dropped
                     await wstate.sem.acquire()
-                    wstate.track(
-                        asyncio.ensure_future(
-                            self._serve_windowed(
-                                magic, payload, n, frame_id,
-                                t_frame0 - transit, writer, wstate,
-                            )
+                    self._frame_begun()
+                    task = asyncio.ensure_future(
+                        self._serve_windowed(
+                            magic, payload, n, frame_id,
+                            t_frame0 - transit, writer, wstate,
                         )
                     )
+                    task.add_done_callback(self._frame_done)
+                    wstate.track(task)
                     continue
                 if magic == MAGIC_FAST_REQ:
                     frame_ring, plen = struct.unpack(
@@ -912,9 +990,21 @@ class EdgeBridge:
                         writer.write(_HDR.pack(MAGIC_STALE, 0))
                         await writer.drain()
                         return
-                    raw = await self._decide_fast(payload, n)
-                    writer.write(_HDR.pack(MAGIC_FAST_RESP, n) + raw)
-                    await writer.drain()
+                    if self._draining:
+                        # GEB6's reader understands GEBR; carry the
+                        # drain code like the windowed framings (the
+                        # pre-r8 edge ignores the id field here)
+                        await self._refuse_draining(
+                            writer, wstate, DRAIN_FRAME_ID
+                        )
+                        return
+                    self._frame_begun()
+                    try:
+                        raw = await self._decide_fast(payload, n)
+                        writer.write(_HDR.pack(MAGIC_FAST_RESP, n) + raw)
+                        await writer.drain()
+                    finally:
+                        self._frame_done()
                     STAGES.add_frame(time.monotonic() - t_frame0)
                     continue
                 if magic != MAGIC_REQ:
@@ -923,8 +1013,29 @@ class EdgeBridge:
                     "<I", await reader.readexactly(4)
                 )
                 payload = await reader.readexactly(plen)
-                writer.write(await self._decide_string_frame(payload, n))
-                await writer.drain()
+                if self._draining:
+                    # the GEB1 string reader predates GEBR entirely (a
+                    # stale magic is a hard protocol failure there), so
+                    # drain-refuse with a well-formed GEB3 response
+                    # carrying per-item errors — degraded, in-protocol
+                    writer.write(
+                        encode_response_frame(
+                            [
+                                RateLimitResp(error="node draining")
+                                for _ in range(n)
+                            ]
+                        )
+                    )
+                    await writer.drain()
+                    return
+                self._frame_begun()
+                try:
+                    writer.write(
+                        await self._decide_string_frame(payload, n)
+                    )
+                    await writer.drain()
+                finally:
+                    self._frame_done()
                 STAGES.add_frame(time.monotonic() - t_frame0)
         except (asyncio.IncompleteReadError, ConnectionResetError):
             pass

@@ -29,17 +29,18 @@ from gubernator_tpu.serve.config import BehaviorConfig
 from gubernator_tpu.serve.metrics import (
     GLOBAL_ASYNC_DURATIONS,
     GLOBAL_BROADCAST_DURATIONS,
+    GLOBAL_TASK_RESTARTS,
 )
 
 log = logging.getLogger("gubernator_tpu.global")
 
-
-def _log_task_death(task: asyncio.Task) -> None:
-    if task.cancelled():
-        return
-    exc = task.exception()
-    if exc is not None:
-        log.error("global manager loop died: %r", exc, exc_info=exc)
+#: supervision backoff bounds for a crashing gossip loop: restart fast
+#: after a one-off (a dead loop silently stops ALL GLOBAL gossip), back
+#: off exponentially while the crash repeats, reset once a run survives
+#: SUPERVISE_RESET_S
+SUPERVISE_BACKOFF_S = 0.05
+SUPERVISE_BACKOFF_MAX_S = 5.0
+SUPERVISE_RESET_S = 60.0
 
 
 class GlobalManager:
@@ -55,11 +56,41 @@ class GlobalManager:
     def start(self) -> None:
         if not self._tasks:
             self._tasks = [
-                asyncio.ensure_future(self._run_async_hits()),
-                asyncio.ensure_future(self._run_broadcasts()),
+                asyncio.ensure_future(
+                    self._supervise("async_hits", self._run_async_hits)
+                ),
+                asyncio.ensure_future(
+                    self._supervise("broadcasts", self._run_broadcasts)
+                ),
             ]
-            for t in self._tasks:
-                t.add_done_callback(_log_task_death)
+
+    async def _supervise(self, name: str, loop_factory) -> None:
+        """Keep a gossip loop alive: an unexpected death restarts it
+        with bounded exponential backoff instead of only logging (the
+        pre-r8 behavior left GLOBAL gossip silently dead for the rest
+        of the process). Restarts are counted in
+        global_task_restarts_total{task}."""
+        backoff = SUPERVISE_BACKOFF_S
+        while True:
+            started = time.monotonic()
+            try:
+                await loop_factory()
+                return  # loops are infinite; a clean return means done
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                if time.monotonic() - started > SUPERVISE_RESET_S:
+                    backoff = SUPERVISE_BACKOFF_S
+                log.error(
+                    "global manager %s loop died: %r; restarting in "
+                    "%.2fs", name, e, backoff, exc_info=e,
+                )
+                try:
+                    GLOBAL_TASK_RESTARTS.labels(task=name).inc()
+                except Exception:  # pragma: no cover - defensive
+                    pass
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, SUPERVISE_BACKOFF_MAX_S)
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -70,6 +101,20 @@ class GlobalManager:
             except asyncio.CancelledError:
                 pass
         self._tasks = []
+
+    async def drain(self) -> None:
+        """Graceful-drain flush: push whatever is aggregated NOW instead
+        of waiting out the sync window — pending non-owner hits reach
+        their owners and owned-key statuses broadcast before shutdown.
+        Send errors are already logged per peer by the senders."""
+        hits, self._hits = self._hits, {}
+        self._hits_event.clear()
+        if hits:
+            await self._send_hits(hits)
+        updates, self._updates = self._updates, {}
+        self._updates_event.clear()
+        if updates:
+            await self._update_peers(updates)
 
     # -- queue entry points (non-blocking, called on the serving loop) ------
 

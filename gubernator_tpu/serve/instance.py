@@ -34,8 +34,11 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.sketches import TrafficStats
+from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.batcher import DeviceBatcher
+from gubernator_tpu.serve.breaker import OPEN as BREAKER_OPEN
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE, ServerConfig
+from gubernator_tpu.serve.faults import FAULTS
 from gubernator_tpu.serve.global_mgr import GlobalManager
 from gubernator_tpu.serve.peers import ConsistentHashPicker, PeerClient
 from gubernator_tpu.serve.stages import STAGES
@@ -144,6 +147,10 @@ class Instance:
                 resp = await peer.get_peer_rate_limit(r)
                 resp.metadata["owner"] = peer.host
             except Exception as e:
+                degraded = await self._degraded_fallback([(i, r)], peer, e)
+                if degraded is not None:
+                    out[i] = degraded[0]
+                    return
                 resp = RateLimitResp(
                     error=(
                         f"while fetching rate limit '{key}' from peer - '{e}'"
@@ -166,6 +173,11 @@ class Instance:
                     resp.metadata["owner"] = peer.host
                     out[i] = resp
             except Exception as e:
+                degraded = await self._degraded_fallback(items, peer, e)
+                if degraded is not None:
+                    for (i, _), resp in zip(items, degraded):
+                        out[i] = resp
+                    return
                 for i, r in items:
                     out[i] = RateLimitResp(
                         error=(
@@ -215,6 +227,35 @@ class Instance:
             await asyncio.gather(*tasks)
         return [r if r is not None else RateLimitResp() for r in out]
 
+    async def _degraded_fallback(self, items, peer, exc):
+        """Degraded mode (GUBER_DEGRADED_LOCAL=1): a forward that failed
+        with its owner unreachable is answered from the LOCAL store,
+        stamped metadata["degraded"]="true" — availability over global
+        accuracy, the reference's documented eventual-consistency
+        stance, opt-in. `items`: [(out_index, req)]. Returns the
+        responses or None (mode off / local decide itself failed →
+        caller surfaces the original per-item error)."""
+        if not getattr(self.conf, "degraded_local", False):
+            return None
+        try:
+            resps = await self.decide_local(
+                [r for _, r in items], [False] * len(items)
+            )
+        except Exception:
+            return None
+        for resp in resps:
+            resp.metadata["degraded"] = "true"
+            resp.metadata["owner"] = peer.host
+        log.warning(
+            "degraded mode: answered %d item(s) locally, owner '%s' "
+            "unreachable (%s)", len(items), peer.host, exc,
+        )
+        try:
+            metrics.DEGRADED_RESPONSES.inc(len(items))
+        except Exception:  # pragma: no cover - defensive
+            pass
+        return resps
+
     async def decide_local(
         self,
         reqs: Sequence[RateLimitReq],
@@ -239,6 +280,10 @@ class Instance:
                 f"'{MAX_BATCH_SIZE}'"
             )
         try:
+            if FAULTS.enabled:
+                # owner-side injection point: a chaos spec can make THIS
+                # node a slow/failing owner for its peers' forwards
+                await FAULTS.inject("peer_serve")
             return await self.decide_local(reqs, [False] * len(reqs))
         except Exception as e:
             return [RateLimitResp(error=str(e)) for _ in reqs]
@@ -249,7 +294,30 @@ class Instance:
         await self.batcher.update_globals(list(updates))
 
     def health_check(self) -> HealthCheckResp:
-        return self.health
+        """Membership health (set_peers) merged with live breaker state:
+        a peer whose circuit is open is a dialable-but-dead peer, the
+        exact condition the reference's health contract (peer
+        dialability) cannot see. Reported unhealthy so orchestration
+        rotates traffic away while the breaker does the same per-RPC."""
+        h = self.health
+        # effective_state, not raw state: an idle breaker past its
+        # cooldown is "half-open pending first probe", and reporting it
+        # open would leave this node unhealthy forever once traffic is
+        # routed away (no forwards -> no acquire -> no transition)
+        open_peers = sorted(
+            p.host
+            for p in self.picker.peers()
+            if p.breaker is not None
+            and p.breaker.effective_state() == BREAKER_OPEN
+        )
+        if not open_peers:
+            return h
+        msg = "circuit open: " + ",".join(open_peers)
+        if h.message:
+            msg = h.message + "|" + msg
+        return HealthCheckResp(
+            status=UNHEALTHY, message=msg, peer_count=h.peer_count
+        )
 
     # -- membership (gubernator.go:254-310) ---------------------------------
 

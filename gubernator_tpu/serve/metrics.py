@@ -119,6 +119,56 @@ STAGE_SAMPLES = Gauge(
     ["stage"],
     registry=REGISTRY,
 )
+FAULTS_INJECTED = Counter(
+    "faults_injected_total",
+    "Injected faults fired (serve/faults.py, GUBER_FAULT_SPEC) — a "
+    "chaos run asserts this is nonzero so it can't pass with its "
+    "faults silently misconfigured",
+    ["point", "action"],
+    registry=REGISTRY,
+)
+PEER_RPC_RETRIES = Counter(
+    "peer_rpc_retries_total",
+    "Peer RPC attempts retried after a retryable failure (bounded by "
+    "GUBER_PEER_RETRIES, exponential backoff + full jitter)",
+    ["peer"],
+    registry=REGISTRY,
+)
+PEER_BREAKER_STATE = Gauge(
+    "peer_breaker_state",
+    "Per-peer circuit breaker state: 0=closed, 1=half-open, 2=open "
+    "(serve/breaker.py; also surfaced through HealthCheck)",
+    ["peer"],
+    registry=REGISTRY,
+)
+PEER_BREAKER_TRANSITIONS = Counter(
+    "peer_breaker_transitions_total",
+    "Circuit breaker state transitions, labelled by destination state",
+    ["peer", "to"],
+    registry=REGISTRY,
+)
+DEGRADED_RESPONSES = Counter(
+    "degraded_responses_total",
+    "Requests answered from the LOCAL store because the owning peer was "
+    "unreachable (GUBER_DEGRADED_LOCAL=1; responses carry "
+    'metadata["degraded"]="true")',
+    registry=REGISTRY,
+)
+GLOBAL_TASK_RESTARTS = Counter(
+    "global_task_restarts_total",
+    "GlobalManager background loops restarted after an unexpected death "
+    "(supervised with backoff; pre-r8 a dead loop only logged and GLOBAL "
+    "gossip silently stopped)",
+    ["task"],
+    registry=REGISTRY,
+)
+DRAIN_DURATION = Gauge(
+    "drain_duration_seconds",
+    "Wall time of the last graceful drain (SIGTERM: deregister, refuse "
+    "new edge frames, flush batcher + GLOBAL queues; bounded by "
+    "GUBER_DRAIN_TIMEOUT_MS)",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:

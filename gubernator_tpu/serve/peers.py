@@ -18,6 +18,8 @@ from __future__ import annotations
 
 import asyncio
 import bisect
+import logging
+import random
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import grpc
@@ -27,8 +29,44 @@ from gubernator_tpu.api.grpc_glue import PeersV1Stub
 from gubernator_tpu.api.proto.gen import peers_pb2
 from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.core.hashing import ring_hash
+from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.aio import collect_batch
+from gubernator_tpu.serve.breaker import (
+    OPEN as BREAKER_OPEN,
+    BreakerOpenError,
+    CircuitBreaker,
+)
 from gubernator_tpu.serve.config import BehaviorConfig
+from gubernator_tpu.serve.faults import FAULTS, FaultError
+
+log = logging.getLogger("gubernator_tpu.peers")
+
+
+def is_retryable(exc: BaseException, all_peek: bool = False) -> bool:
+    """Safe-to-resend classification for the peer retry policy.
+
+    `all_peek=True` (every request in the batch carries hits=0) makes
+    ANY failure retryable — re-running a peek is free. Otherwise only
+    failures where the request never reached the peer's application
+    layer qualify: gRPC UNAVAILABLE (connection refused / reset before
+    dispatch), plain connection errors, and injected faults flagged
+    retryable. DEADLINE_EXCEEDED and application errors are NOT safe —
+    the peer may have already applied the hits, and a rate limiter that
+    double-counts under partial failure is worse than one that errors.
+    """
+    if all_peek:
+        return True
+    if isinstance(exc, FaultError):
+        return exc.retryable
+    if isinstance(exc, ConnectionError):
+        return True
+    if isinstance(exc, grpc.RpcError):
+        code = getattr(exc, "code", None)
+        try:
+            return callable(code) and code() == grpc.StatusCode.UNAVAILABLE
+        except Exception:
+            return False
+    return False
 
 
 class PeerClient:
@@ -57,6 +95,41 @@ class PeerClient:
         self._carry: List = []
         self._flusher: Optional[asyncio.Task] = None
         self._closed = False
+        # per-peer circuit breaker (r8): failures on THIS peer's RPCs
+        # trip it; while open every call fails fast (BreakerOpenError)
+        # instead of paying a deadline. State survives set_peers churn
+        # because existing clients are reused there.
+        self.breaker = self._make_breaker()
+
+    def _make_breaker(self) -> Optional[CircuitBreaker]:
+        c = self.conf
+        if getattr(c, "breaker_failures", 0) <= 0:
+            return None  # GUBER_BREAKER_FAILURES=0 disables
+
+        def on_transition(frm: str, to: str) -> None:
+            from gubernator_tpu.serve.breaker import STATE_CODES
+
+            log.warning(
+                "peer '%s' circuit breaker: %s -> %s", self.host, frm, to
+            )
+            try:
+                metrics.PEER_BREAKER_TRANSITIONS.labels(
+                    peer=self.host, to=to
+                ).inc()
+                metrics.PEER_BREAKER_STATE.labels(peer=self.host).set(
+                    STATE_CODES[to]
+                )
+            except Exception:  # pragma: no cover - defensive
+                pass
+
+        return CircuitBreaker(
+            failures=c.breaker_failures,
+            ratio=c.breaker_ratio,
+            window=c.breaker_window,
+            cooldown=c.breaker_cooldown,
+            probes=c.breaker_probes,
+            on_transition=on_transition,
+        )
 
     def connect(self) -> None:
         self._closed = False  # (re)opening
@@ -72,7 +145,32 @@ class PeerClient:
                 0 < int(port) < 65536
             ):
                 raise ValueError(f"invalid peer address {self.host!r}")
-            self.channel = grpc.aio.insecure_channel(self.host)
+            self.channel = grpc.aio.insecure_channel(
+                self.host,
+                options=[
+                    # bound gRPC's reconnect backoff to the breaker
+                    # cooldown: during an outage the channel's redial
+                    # backoff grows (default cap 120s!), so without
+                    # this the half-open probe after a peer RETURNS
+                    # fails against a still-backed-off channel and
+                    # recovery stretches far past the breaker's
+                    # contract (measured 4s vs the 2-cooldown bound in
+                    # the chaos soak)
+                    ("grpc.initial_reconnect_backoff_ms", 100),
+                    (
+                        "grpc.max_reconnect_backoff_ms",
+                        max(
+                            200,
+                            int(
+                                getattr(
+                                    self.conf, "breaker_cooldown", 1.0
+                                )
+                                * 1000
+                            ),
+                        ),
+                    ),
+                ],
+            )
             self.stub = PeersV1Stub(self.channel)
         if self._flusher is None:
             self._flusher = asyncio.ensure_future(self._run())
@@ -133,17 +231,30 @@ class PeerClient:
         pb_req = peers_pb2.GetPeerRateLimitsReq(
             requests=[convert.req_to_pb(r) for r in reqs]
         )
-        pb_resp = await self.stub.GetPeerRateLimits(
-            pb_req, timeout=self.conf.batch_timeout
-        )
-        if len(pb_resp.rate_limits) != len(reqs):
-            raise RuntimeError(
-                "peer responded with mismatched rate limit list size"
+        timeout = self.conf.effective_peer_timeout()
+
+        async def call() -> List[RateLimitResp]:
+            pb_resp = await self.stub.GetPeerRateLimits(
+                pb_req, timeout=timeout or None
             )
-        return [convert.resp_from_pb(p) for p in pb_resp.rate_limits]
+            if len(pb_resp.rate_limits) != len(reqs):
+                raise RuntimeError(
+                    "peer responded with mismatched rate limit list size"
+                )
+            return [convert.resp_from_pb(p) for p in pb_resp.rate_limits]
+
+        # a batch of pure peeks (hits all 0) is idempotent end to end;
+        # anything carrying hits only retries transport-level failures
+        # (is_retryable) so a slow peer is never double-counted
+        return await self._call_resilient(
+            call, idempotent=all(r.hits == 0 for r in reqs),
+            timeout=timeout,
+        )
 
     async def update_peer_globals(self, updates) -> None:
-        """updates: sequence of (key, RateLimitResp)."""
+        """updates: sequence of (key, RateLimitResp). Installing a
+        status replica is last-write-wins idempotent, so retries are
+        always safe here."""
         pb_req = peers_pb2.UpdatePeerGlobalsReq(
             globals=[
                 peers_pb2.UpdatePeerGlobal(
@@ -152,9 +263,83 @@ class PeerClient:
                 for k, s in updates
             ]
         )
-        await self.stub.UpdatePeerGlobals(
-            pb_req, timeout=self.conf.global_timeout
-        )
+        timeout = self.conf.global_timeout
+
+        async def call() -> None:
+            await self.stub.UpdatePeerGlobals(
+                pb_req, timeout=timeout or None
+            )
+
+        await self._call_resilient(call, idempotent=True, timeout=timeout)
+
+    # -- resilience envelope (r8) -------------------------------------------
+
+    async def _call_resilient(
+        self, do_call, idempotent: bool, timeout: float
+    ):
+        """Deadline + circuit breaker + bounded retry around one peer
+        RPC. The deadline wraps fault injection AND the RPC, so an
+        injected hang (GUBER_FAULT_SPEC peer_rpc:hang) is bounded
+        exactly like a wedged peer. Retries use exponential backoff
+        with FULL jitter; only is_retryable failures re-send."""
+        c = self.conf
+        attempt = 0
+        while True:
+            b = self.breaker
+            token = b.acquire() if b is not None else None
+            if b is not None and not token:
+                raise BreakerOpenError(
+                    f"peer '{self.host}' circuit open (failing fast)"
+                )
+            try:
+                result = await asyncio.wait_for(
+                    self._guarded(do_call), timeout or None
+                )
+            except asyncio.CancelledError:
+                # teardown, not peer health: release a half-open probe
+                # slot without counting an outcome
+                if b is not None:
+                    b.record_cancel(token)
+                raise
+            except Exception as e:
+                if b is not None:
+                    b.record_failure(token)
+                retries = getattr(c, "peer_retries", 0)
+                if (
+                    attempt < retries
+                    and is_retryable(e, idempotent)
+                    # when THIS failure tripped the breaker, don't
+                    # sleep a backoff only to raise BreakerOpenError
+                    # on re-acquire: fail fast with the root-cause
+                    # error instead
+                    and (b is None or b.state != BREAKER_OPEN)
+                ):
+                    attempt += 1
+                    try:
+                        metrics.PEER_RPC_RETRIES.labels(
+                            peer=self.host
+                        ).inc()
+                    except Exception:  # pragma: no cover - defensive
+                        pass
+                    await asyncio.sleep(
+                        random.uniform(
+                            0.0,
+                            min(
+                                c.peer_backoff_max,
+                                c.peer_backoff * (2 ** (attempt - 1)),
+                            ),
+                        )
+                    )
+                    continue
+                raise
+            if b is not None:
+                b.record_success(token)
+            return result
+
+    async def _guarded(self, do_call):
+        if FAULTS.enabled:
+            await FAULTS.inject("peer_rpc", peer=self.host)
+        return await do_call()
 
     # -- micro-batch flusher ------------------------------------------------
 

@@ -297,6 +297,85 @@ class Server:
 
         await self._start_discovery()
 
+    async def drain(self) -> dict:
+        """Graceful drain (SIGTERM path), bounded end to end by
+        GUBER_DRAIN_TIMEOUT_MS: (1) deregister from discovery so peers
+        and edges stop routing new work here; (2) the edge bridge
+        refuses NEW frames (GEBR drain code) after answering the ones
+        in flight; (3) the gRPC server and (4) the HTTP gateway stop
+        accepting and let in-flight requests finish — every request
+        door is closed BEFORE the queue flushes, or the batcher's
+        run-dry wait could chase a moving target; (5) aggregated
+        GLOBAL hits/updates flush to their owners; (6) the device
+        batcher runs dry. Each step gets the budget remaining; a step
+        that times out keeps its handle so the caller's stop() still
+        hard-closes it. Returns step timings (the chaos soak records
+        them)."""
+        t0 = time.monotonic()
+        budget = getattr(self.conf, "drain_timeout", 5.0)
+        deadline = t0 + budget
+
+        def remaining() -> float:
+            return max(0.05, deadline - time.monotonic())
+
+        timings = {}
+
+        async def step(name, coro) -> bool:
+            t = time.monotonic()
+            ok = True
+            try:
+                await asyncio.wait_for(coro, remaining())
+            except asyncio.TimeoutError:
+                log.warning("drain step '%s' exceeded the budget", name)
+                ok = False
+            except Exception as e:
+                log.warning("drain step '%s' failed: %s", name, e)
+            timings[name] = time.monotonic() - t
+            return ok
+
+        if self._pool is not None:
+            if await step("deregister", self._pool.close()):
+                self._pool = None
+        if self._edge is not None:
+            # self-bounding (its poll loop carries the deadline): no
+            # wait_for, so it is never cancelled mid-refusal
+            t = time.monotonic()
+            await self._edge.drain(remaining())
+            timings["edge"] = time.monotonic() - t
+        if self.grpc_server is not None:
+            # grace makes stop() self-bounding (handlers are
+            # force-cancelled when it expires) — and it must NOT run
+            # under wait_for: cancelling grpc.aio's stop() mid-flight
+            # leaves the server in a state where a LATER stop() can
+            # await forever (observed as SIGTERMed daemons outliving
+            # their supervisor's kill timeout by minutes)
+            t = time.monotonic()
+            await self.grpc_server.stop(grace=remaining())
+            timings["grpc"] = time.monotonic() - t
+            self.grpc_server = None
+        if self._http_runner is not None:
+            # stops the sites (no new connections) and shuts the app
+            # down, finishing in-flight handlers — without this, HTTP
+            # requests accepted mid-drain would be reset by stop().
+            # Bounded by the site's shutdown_timeout (2s, _start_http)
+            # on top of the wait_for; a timed-out cleanup keeps the
+            # handle so stop() finishes it
+            if await step("http", self._http_runner.cleanup()):
+                self._http_runner = None
+        await step("global_flush", self.instance.global_mgr.drain())
+        await step("batcher", self.instance.batcher.drain())
+        timings["total"] = time.monotonic() - t0
+        try:
+            metrics.DRAIN_DURATION.set(timings["total"])
+        except Exception:  # pragma: no cover - defensive
+            pass
+        log.info(
+            "drained in %.0f ms (budget %.0f ms): %s",
+            timings["total"] * 1e3, budget * 1e3,
+            {k: round(v * 1e3, 1) for k, v in timings.items()},
+        )
+        return timings
+
     async def stop(self) -> None:
         if self._edge is not None:
             await self._edge.stop()
@@ -328,7 +407,15 @@ class Server:
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
         host, _, port = self.conf.http_address.rpartition(":")
-        site = web.TCPSite(self._http_runner, host or "0.0.0.0", int(port))
+        # shutdown_timeout bounds how long cleanup() waits for open
+        # connections (aiohttp default: 60s!). Rate-limit requests are
+        # milliseconds of work, so 2s covers any in-flight handler
+        # while keeping SIGTERM (drain, then stop) promptly bounded —
+        # a lingering idle keep-alive must not stall shutdown.
+        site = web.TCPSite(
+            self._http_runner, host or "0.0.0.0", int(port),
+            shutdown_timeout=2.0,
+        )
         await site.start()
         log.info("HTTP listening on %s", self.conf.http_address)
 
@@ -414,6 +501,14 @@ class Server:
         if "size" in stats:
             metrics.CACHE_SIZE.set(stats["size"])
         metrics.DISTINCT_KEYS.set(self.instance.traffic.hll.estimate())
+        # per-peer breaker state gauges refresh at scrape time (state
+        # also changes lazily at acquire, so transitions alone would
+        # leave the gauge stale between calls)
+        for peer in self.instance.peer_list():
+            if peer.breaker is not None:
+                metrics.PEER_BREAKER_STATE.labels(peer=peer.host).set(
+                    peer.breaker.state_code
+                )
         # stage totals export lazily at scrape time: the hot path only
         # touches the plain-float accumulator (serve/stages.py)
         from gubernator_tpu.serve.stages import STAGES
@@ -558,16 +653,62 @@ def _enum_val(enum_pb, v):
 
 
 async def run_daemon(conf: ServerConfig) -> None:
-    """Start a server and run until SIGINT/SIGTERM
-    (reference cmd/gubernator/main.go:127-139)."""
+    """Start a server and run until SIGINT/SIGTERM (reference
+    cmd/gubernator/main.go:127-139). SIGTERM (the orchestrated-shutdown
+    signal) drains gracefully — deregister, refuse new edge frames,
+    finish in-flight work, flush GLOBAL + batcher queues — bounded by
+    GUBER_DRAIN_TIMEOUT_MS; SIGINT stops immediately."""
     import signal
 
     server = Server(conf)
     await server.start()
     stop = asyncio.Event()
+    graceful: list = []
+    drain_task: list = []
     loop = asyncio.get_running_loop()
-    for sig in (signal.SIGINT, signal.SIGTERM):
-        loop.add_signal_handler(sig, stop.set)
+
+    def on_term():
+        # second SIGTERM = the supervisor is impatient: abandon the
+        # drain and hard-stop now
+        if graceful:
+            graceful.clear()
+            for t in drain_task:
+                t.cancel()
+        graceful.append(True)
+        stop.set()
+
+    loop.add_signal_handler(signal.SIGINT, stop.set)
+    loop.add_signal_handler(signal.SIGTERM, on_term)
     await stop.wait()
+    # shutdown watchdog on a plain THREAD (immune to a wedged event
+    # loop): a signalled daemon must exit within a bound, full stop.
+    # The drain itself is budgeted, but a teardown await that never
+    # returns (e.g. a client-library close wedging under load) would
+    # otherwise leave a zombie the supervisor has to SIGKILL minutes
+    # later — observed in the full-suite soak as daemons outliving
+    # their test's kill timeout.
+    import os
+    import threading
+
+    def _force_exit():
+        log.error(
+            "shutdown watchdog fired (teardown wedged); forcing exit"
+        )
+        logging.shutdown()
+        os._exit(1)
+
+    watchdog = threading.Timer(
+        2 * getattr(conf, "drain_timeout", 5.0) + 10.0, _force_exit
+    )
+    watchdog.daemon = True
+    watchdog.start()
+    if graceful:
+        log.info("SIGTERM: draining")
+        drain_task.append(asyncio.ensure_future(server.drain()))
+        try:
+            await drain_task[0]
+        except asyncio.CancelledError:
+            log.warning("drain aborted (second SIGTERM)")
     log.info("shutting down")
     await server.stop()
+    watchdog.cancel()

@@ -1,0 +1,513 @@
+"""Chaos soak: a 3-node cluster under load while a peer is killed,
+restarted, and faults are injected — the end-to-end proof of the r8
+resilience layer (deadlines, retries, circuit breaker, degraded mode,
+graceful drain).
+
+Timeline (one soak):
+
+  phase 0  boot 3 daemons (exact backend, static full-mesh peers,
+           GUBER_DEGRADED_LOCAL=1, breaker/retry knobs pinned,
+           GUBER_FAULT_SPEC latency+error injection on the observer
+           node) and drive HTTP load at all of them
+  phase 1  healthy baseline
+  phase 2  SIGKILL the victim node mid-load; the observer's breaker
+           must trip (health goes unhealthy, "circuit open"), and
+           victim-owned keys are answered DEGRADED from local stores
+           (metadata.degraded=true), not errored
+  phase 3  restart the victim; measure recovery = time from the victim
+           serving again to the observer forwarding to it successfully
+           (breaker half-open probe -> closed); must be within 2
+           breaker cooldowns ("health intervals")
+  phase 4  SIGTERM the drain node under load: the daemon must
+           deregister, finish in-flight work, and exit 0 within
+           GUBER_DRAIN_TIMEOUT_MS + stop margin, with every accepted
+           request answered (no in-flight loss)
+
+Acceptance (exit code != 0 on violation, ISSUE 3):
+  - served error rate (item errors + accepted-but-unanswered requests
+    on ALIVE nodes) < 5% over the soak
+  - breaker trips after the kill and recovers within 2 cooldowns of
+    the victim returning
+  - drain exits 0 within the budget; no in-flight request lost
+  - injected faults actually fired (faults_injected_total > 0)
+
+Writes the measured soak to --json (BENCH_CHAOS_r8.json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tests._util import free_ports  # noqa: E402
+
+BREAKER_COOLDOWN_MS = 1000
+DRAIN_TIMEOUT_MS = 3000
+FAULT_SPEC = "peer_rpc:delay=20ms:p=0.1,peer_rpc:error:p=0.02"
+
+OBSERVER, DRAIN_NODE, VICTIM = 0, 1, 2
+
+
+class Cluster:
+    def __init__(self, n=3):
+        self.n = n
+        self.grpc = free_ports(n)
+        self.http = free_ports(n)
+        self.peers = ",".join(f"127.0.0.1:{p}" for p in self.grpc)
+        self.log_dir = tempfile.mkdtemp(prefix="guber-chaos-")
+        self.procs = [None] * n
+
+    def env(self, i):
+        env = dict(
+            os.environ,
+            PYTHONPATH=str(ROOT),
+            JAX_PLATFORMS="cpu",
+            GUBER_BACKEND="exact",
+            GUBER_GRPC_ADDRESS=f"127.0.0.1:{self.grpc[i]}",
+            GUBER_HTTP_ADDRESS=f"127.0.0.1:{self.http[i]}",
+            GUBER_ADVERTISE_ADDRESS=f"127.0.0.1:{self.grpc[i]}",
+            GUBER_PEERS=self.peers,
+            GUBER_DEGRADED_LOCAL="1",
+            GUBER_PEER_TIMEOUT_MS="250",
+            GUBER_PEER_RETRIES="2",
+            GUBER_PEER_BACKOFF_MS="10",
+            GUBER_PEER_BACKOFF_MAX_MS="50",
+            GUBER_BREAKER_FAILURES="3",
+            GUBER_BREAKER_COOLDOWN_MS=str(BREAKER_COOLDOWN_MS),
+            GUBER_DRAIN_TIMEOUT_MS=str(DRAIN_TIMEOUT_MS),
+        )
+        env.pop("GUBER_FAULT_SPEC", None)
+        env.pop("GUBER_ETCD_ENDPOINTS", None)
+        env.pop("GUBER_K8S_ENDPOINTS_SELECTOR", None)
+        if i == OBSERVER:
+            # latency + error injection on the observer's peer RPCs:
+            # retries + deadlines must keep the served error rate flat
+            env["GUBER_FAULT_SPEC"] = FAULT_SPEC
+            env["GUBER_FAULT_SEED"] = "8"
+        return env
+
+    def spawn(self, i):
+        out = open(os.path.join(self.log_dir, f"node{i}.log"), "a")
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+            stdout=out, stderr=subprocess.STDOUT, text=True,
+            cwd=ROOT, env=self.env(i),
+        )
+
+    def wait_healthy(self, i, timeout=60.0, peers=None):
+        deadline = time.monotonic() + timeout
+        want = peers if peers is not None else self.n
+        while time.monotonic() < deadline:
+            if self.procs[i].poll() is not None:
+                raise RuntimeError(
+                    f"node {i} died at boot; log: "
+                    f"{self.log_dir}/node{i}.log"
+                )
+            try:
+                h = get_json(
+                    f"http://127.0.0.1:{self.http[i]}/v1/HealthCheck"
+                )
+                if h["peerCount"] == want:
+                    return
+            except OSError:
+                pass
+            time.sleep(0.1)
+        raise RuntimeError(f"node {i} never became healthy")
+
+    def log_tail(self, i, lines=30):
+        try:
+            text = pathlib.Path(
+                self.log_dir, f"node{i}.log"
+            ).read_text().splitlines()
+            return "\n".join(text[-lines:])
+        except OSError:
+            return "<no log>"
+
+
+def get_json(url, timeout=5):
+    return json.loads(urllib.request.urlopen(url, timeout=timeout).read())
+
+
+def get_text(url, timeout=5):
+    return urllib.request.urlopen(url, timeout=timeout).read().decode()
+
+
+def post_limits(port, reqs, timeout=10):
+    body = json.dumps({"requests": reqs}).encode()
+    return json.loads(
+        urllib.request.urlopen(
+            urllib.request.Request(
+                f"http://127.0.0.1:{port}/v1/GetRateLimits",
+                data=body,
+                headers={"Content-Type": "application/json"},
+            ),
+            timeout=timeout,
+        ).read()
+    )
+
+
+class LoadGen:
+    """Threaded HTTP load against the alive nodes. Each request batch
+    is accounted per item: ok / degraded / item_error; a request that
+    was ACCEPTED (connection + write succeeded) but never answered
+    counts as in-flight loss; connection refusals count as routed-away
+    (a dead listener — the client's LB would route around it), not as
+    served errors."""
+
+    def __init__(self, cluster, keys, batch=16, workers=3):
+        self.cluster = cluster
+        self.keys = keys
+        self.batch = batch
+        self.workers = workers
+        self.alive = set(range(cluster.n))
+        self.counts = {
+            "ok": 0, "degraded": 0, "item_error": 0,
+            "inflight_loss": 0, "refused": 0,
+        }
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads = []
+        self._seq = 0
+
+    def start(self):
+        for w in range(self.workers):
+            t = threading.Thread(target=self._run, args=(w,), daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=15)
+
+    def mark_dead(self, i):
+        with self._lock:
+            self.alive.discard(i)
+
+    def mark_alive(self, i):
+        with self._lock:
+            self.alive.add(i)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.counts)
+
+    def _run(self, w):
+        k = w * 131
+        while not self._stop.is_set():
+            with self._lock:
+                targets = sorted(self.alive)
+            if not targets:
+                time.sleep(0.05)
+                continue
+            node = targets[(k // self.batch) % len(targets)]
+            reqs = []
+            for _ in range(self.batch):
+                reqs.append({
+                    "name": "chaos",
+                    "uniqueKey": self.keys[k % len(self.keys)],
+                    "hits": 1, "limit": 10_000_000,
+                    "duration": 3_600_000,
+                })
+                k += 1
+            try:
+                out = post_limits(
+                    self.cluster.http[node], reqs, timeout=10
+                )
+                with self._lock:
+                    for r in out["responses"]:
+                        if r.get("error"):
+                            self.counts["item_error"] += 1
+                        elif r["metadata"].get("degraded") == "true":
+                            self.counts["degraded"] += 1
+                        else:
+                            self.counts["ok"] += 1
+            except urllib.error.URLError as e:
+                refused = isinstance(
+                    getattr(e, "reason", None), ConnectionRefusedError
+                )
+                with self._lock:
+                    if refused:
+                        self.counts["refused"] += self.batch
+                    else:
+                        # accepted but unanswered (reset / timeout /
+                        # EOF): the in-flight loss a graceful drain
+                        # must prevent
+                        self.counts["inflight_loss"] += self.batch
+            except (ConnectionError, TimeoutError, OSError):
+                with self._lock:
+                    self.counts["inflight_loss"] += self.batch
+            time.sleep(0.01)
+
+
+def find_victim_keys(cluster, victim_addr, want=8):
+    """Keys the victim owns, discovered through the observer's
+    metadata.owner (same technique as test_compose_topology)."""
+    keys = []
+    for i in range(512):
+        key = f"vk{i}"
+        out = post_limits(cluster.http[OBSERVER], [{
+            "name": "chaos", "uniqueKey": key, "hits": 0,
+            "limit": 10_000_000, "duration": 3_600_000,
+        }])
+        r = out["responses"][0]
+        if r["error"]:
+            continue
+        if r["metadata"].get("owner") == victim_addr:
+            keys.append(key)
+            if len(keys) >= want:
+                break
+    if not keys:
+        raise RuntimeError("no victim-owned key found in 512 tries")
+    return keys
+
+
+def poll_until(pred, timeout, interval=0.1, what=""):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    print(f"POLL TIMEOUT: {what}", file=sys.stderr)
+    return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=20.0,
+                    help="approximate total soak length")
+    ap.add_argument("--json", default="BENCH_CHAOS_r8.json")
+    args = ap.parse_args()
+    phase = max(2.0, args.seconds / 5.0)
+
+    cluster = Cluster(3)
+    gen = None
+    failures = []
+    result = {
+        "soak": "chaos_3node_kill_restart_drain",
+        "backend": "exact",
+        "nodes": 3,
+        "fault_spec": FAULT_SPEC,
+        "breaker_cooldown_ms": BREAKER_COOLDOWN_MS,
+        "drain_timeout_ms": DRAIN_TIMEOUT_MS,
+        "phase_seconds": phase,
+    }
+    victim_addr = f"127.0.0.1:{cluster.grpc[VICTIM]}"
+    try:
+        t_boot = time.monotonic()
+        for i in range(3):
+            cluster.spawn(i)
+        for i in range(3):
+            cluster.wait_healthy(i)
+        result["boot_s"] = round(time.monotonic() - t_boot, 2)
+        print(f"cluster up in {result['boot_s']}s; logs in "
+              f"{cluster.log_dir}", file=sys.stderr)
+
+        victim_keys = find_victim_keys(cluster, victim_addr)
+        keys = [f"vk{i}" for i in range(64)] + [
+            f"ck{i}" for i in range(128)
+        ]
+        gen = LoadGen(cluster, keys)
+        gen.start()
+
+        # phase 1: healthy baseline
+        time.sleep(phase)
+
+        # phase 2: kill the victim mid-run. The load generator stops
+        # targeting it first (a real LB routes around a dead listener);
+        # the half-second grace lets requests already written to the
+        # victim's socket finish, so the in-flight-loss counter stays
+        # scoped to what a GRACEFUL exit (phase 4) must prevent — a
+        # SIGKILL legitimately loses its own in-flight work.
+        print("killing victim", file=sys.stderr)
+        gen.mark_dead(VICTIM)
+        time.sleep(0.5)
+        cluster.procs[VICTIM].send_signal(signal.SIGKILL)
+        cluster.procs[VICTIM].wait(timeout=10)
+        t_kill = time.monotonic()
+
+        def breaker_open():
+            try:
+                h = get_json(
+                    f"http://127.0.0.1:{cluster.http[OBSERVER]}"
+                    "/v1/HealthCheck"
+                )
+                return h["status"] == "unhealthy" and \
+                    "circuit open" in h["message"]
+            except OSError:
+                return False
+
+        if poll_until(breaker_open, phase + 10,
+                      what="observer breaker never tripped"):
+            result["breaker_trip_s"] = round(
+                time.monotonic() - t_kill, 2
+            )
+        else:
+            failures.append("breaker never tripped after the kill")
+        time.sleep(phase)
+
+        # degraded answers must actually be happening for victim keys
+        out = post_limits(cluster.http[OBSERVER], [{
+            "name": "chaos", "uniqueKey": victim_keys[0], "hits": 0,
+            "limit": 10_000_000, "duration": 3_600_000,
+        }])
+        r = out["responses"][0]
+        if r["error"] or r["metadata"].get("degraded") != "true":
+            failures.append(
+                f"victim-owned key not served degraded during the "
+                f"outage: {r}"
+            )
+
+        # phase 3: restart the victim; recovery clock starts when IT
+        # is serving again (the breaker can only probe a live peer)
+        print("restarting victim", file=sys.stderr)
+        cluster.spawn(VICTIM)
+        cluster.wait_healthy(VICTIM)
+        t_back = time.monotonic()
+        gen.mark_alive(VICTIM)
+
+        def forwards_again():
+            try:
+                out = post_limits(cluster.http[OBSERVER], [{
+                    "name": "chaos", "uniqueKey": victim_keys[0],
+                    "hits": 0, "limit": 10_000_000,
+                    "duration": 3_600_000,
+                }], timeout=3)
+                r = out["responses"][0]
+                return (
+                    not r["error"]
+                    and r["metadata"].get("owner") == victim_addr
+                    and r["metadata"].get("degraded") != "true"
+                )
+            except OSError:
+                return False
+
+        recovered = poll_until(
+            forwards_again, 2 * BREAKER_COOLDOWN_MS / 1e3 + 2.0,
+            interval=0.05, what="observer never forwarded again",
+        )
+        result["recovery_s"] = round(time.monotonic() - t_back, 2)
+        bound_s = 2 * BREAKER_COOLDOWN_MS / 1e3
+        result["recovery_bound_s"] = bound_s
+        if not recovered or result["recovery_s"] > bound_s + 1.0:
+            failures.append(
+                f"breaker recovery took {result['recovery_s']}s "
+                f"(bound: 2 cooldowns = {bound_s}s + 1s poll margin)"
+            )
+        time.sleep(phase)
+
+        # phase 4: graceful drain of a node under load
+        print("draining a node (SIGTERM)", file=sys.stderr)
+        gen.mark_dead(DRAIN_NODE)  # LB stops routing; in-flight stays
+        t_term = time.monotonic()
+        cluster.procs[DRAIN_NODE].send_signal(signal.SIGTERM)
+        try:
+            rc = cluster.procs[DRAIN_NODE].wait(
+                timeout=DRAIN_TIMEOUT_MS / 1e3 + 10
+            )
+        except subprocess.TimeoutExpired:
+            rc = None
+        result["drain_s"] = round(time.monotonic() - t_term, 2)
+        if rc != 0:
+            failures.append(
+                f"drain node exit code {rc} "
+                f"(log tail:\n{cluster.log_tail(DRAIN_NODE)})"
+            )
+        if result["drain_s"] > DRAIN_TIMEOUT_MS / 1e3 + 8:
+            failures.append(
+                f"drain took {result['drain_s']}s (budget "
+                f"{DRAIN_TIMEOUT_MS / 1e3}s + stop margin)"
+            )
+        drained_log = cluster.log_tail(DRAIN_NODE, 100)
+        result["drain_logged"] = "drained in" in drained_log
+        time.sleep(max(1.0, phase / 2))
+
+        gen.stop()
+        counts = gen.snapshot()
+        result["counts"] = counts
+        served = (
+            counts["ok"] + counts["degraded"] + counts["item_error"]
+            + counts["inflight_loss"]
+        )
+        errors = counts["item_error"] + counts["inflight_loss"]
+        result["error_rate"] = round(errors / served, 4) if served else 1.0
+        result["inflight_loss"] = counts["inflight_loss"]
+        if served < 500:
+            failures.append(f"soak too small to judge ({served} items)")
+        if result["error_rate"] >= 0.05:
+            failures.append(
+                f"served error rate {result['error_rate']:.2%} >= 5% "
+                f"({counts})"
+            )
+        if counts["inflight_loss"] > 0:
+            failures.append(
+                f"{counts['inflight_loss']} accepted item(s) never "
+                f"answered (in-flight loss)"
+            )
+        if counts["degraded"] == 0:
+            failures.append("no degraded answers — outage never bit?")
+
+        # the injected faults must actually have fired, and the breaker
+        # must have cycled open -> closed, or this soak proved nothing
+        metrics_text = get_text(
+            f"http://127.0.0.1:{cluster.http[OBSERVER]}/metrics"
+        )
+        injected = sum(
+            float(line.rsplit(" ", 1)[1])
+            for line in metrics_text.splitlines()
+            if line.startswith("faults_injected_total{")
+        )
+        result["faults_injected"] = int(injected)
+        if injected <= 0:
+            failures.append("faults_injected_total == 0 on the observer")
+        for want in ('to="open"', 'to="closed"'):
+            if not any(
+                line.startswith("peer_breaker_transitions_total")
+                and want in line and not line.rstrip().endswith(" 0.0")
+                for line in metrics_text.splitlines()
+            ):
+                failures.append(
+                    f"no breaker transition {want} in observer metrics"
+                )
+    finally:
+        if gen is not None:
+            gen._stop.set()
+        for p in cluster.procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+        for p in cluster.procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    result["pass"] = not failures
+    result["failures"] = failures
+    out_path = ROOT / args.json
+    out_path.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps(result, indent=2))
+    if failures:
+        print("CHAOS SOAK FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("chaos soak passed", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

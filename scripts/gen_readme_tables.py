@@ -311,6 +311,67 @@ def table_edge_cluster() -> str:
     return "\n".join(lines)
 
 
+def table_resilience_knobs() -> str:
+    """Resilience knob table (r8), generated FROM the config dataclass
+    defaults so the README cannot drift from the code — the same
+    no-drift contract as the benchmark tables."""
+    if str(ROOT) not in sys.path:  # script runs from anywhere
+        sys.path.insert(0, str(ROOT))
+    from gubernator_tpu.serve.config import BehaviorConfig, ServerConfig
+
+    b = BehaviorConfig()
+    s = ServerConfig.__dataclass_fields__
+
+    def ms(v: float) -> str:
+        return f"{v * 1000:g} ms"
+
+    rows = [
+        ("`GUBER_PEER_TIMEOUT_MS`",
+         ms(b.peer_timeout) if b.peer_timeout else
+         f"`GUBER_BATCH_TIMEOUT_MS` ({ms(b.batch_timeout)})",
+         "Per-RPC deadline on every peer call (forwards + gossip); a "
+         "hung peer costs at most this, never a stuck request"),
+        ("`GUBER_PEER_RETRIES`", str(b.peer_retries),
+         "Bounded retries, exponential backoff + full jitter. Only "
+         "safe-to-resend failures retry: transport-level errors that "
+         "never reached the peer, or any failure on all-peek batches. "
+         "0 disables"),
+        ("`GUBER_PEER_BACKOFF_MS` / `_MAX_MS`",
+         f"{ms(b.peer_backoff)} / {ms(b.peer_backoff_max)}",
+         "Retry backoff base and cap (delay ~ U(0, min(cap, "
+         "base·2^attempt)))"),
+        ("`GUBER_BREAKER_FAILURES`", str(b.breaker_failures),
+         "Consecutive failures tripping a peer's circuit breaker "
+         "(0 disables the breaker)"),
+        ("`GUBER_BREAKER_RATIO` / `_WINDOW`",
+         f"{b.breaker_ratio:g} / {b.breaker_window}",
+         "Alternative trip: failure ratio over the last WINDOW calls "
+         "(catches brown-outs that never fail consecutively)"),
+        ("`GUBER_BREAKER_COOLDOWN_MS`", ms(b.breaker_cooldown),
+         "Open -> half-open delay; while open, calls to that peer "
+         "fail fast (no RPC, no deadline wait)"),
+        ("`GUBER_BREAKER_PROBES`", str(b.breaker_probes),
+         "Half-open probe count: all succeeding closes the breaker, "
+         "any failing re-opens it"),
+        ("`GUBER_DEGRADED_LOCAL`",
+         "1" if s["degraded_local"].default else "0 (off)",
+         'Answer owner-unreachable items from the LOCAL store with '
+         '`metadata["degraded"]="true"` instead of erroring '
+         "(availability over global accuracy)"),
+        ("`GUBER_DRAIN_TIMEOUT_MS`", ms(s["drain_timeout"].default),
+         "SIGTERM drain budget: deregister, refuse new edge frames "
+         "(GEBR drain code), finish in-flight work, flush batcher + "
+         "GLOBAL queues"),
+        ("`GUBER_FAULT_SPEC` / `GUBER_FAULT_SEED`", "unset",
+         "Fault injection (tests/chaos only): e.g. "
+         "`peer_rpc:delay=200ms:p=0.1,peer_rpc:error:p=0.05`; points "
+         "peer_rpc, peer_serve, device_submit, edge_frame"),
+    ]
+    lines = ["| Knob | Default | What it does |", "|---|---|---|"]
+    lines += [f"| {k} | {d} | {w} |" for k, d, w in rows]
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -319,6 +380,7 @@ TABLES = {
     "throughput-serving-table": table_throughput_serving,
     "served-throughput-table": table_served_throughput,
     "edge-cluster-table": table_edge_cluster,
+    "resilience-knobs-table": table_resilience_knobs,
 }
 
 

@@ -1,0 +1,38 @@
+"""The chaos soak (scripts/chaos_soak.py) as a test: 3 real daemons,
+one SIGKILLed + restarted mid-load, fault injection active, drain under
+load — asserting bounded error rate, breaker recovery within 2
+cooldowns, and zero in-flight loss. Marked `slow` (tier-1 runs
+`-m 'not slow'`); the fast deterministic slice of the same machinery is
+tests/test_faults.py + tests/test_resilience.py. Run it directly with
+`make chaos` or `pytest -m slow tests/test_chaos_soak.py`.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.slow
+
+
+def test_chaos_soak_passes(tmp_path):
+    out = tmp_path / "chaos.json"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "chaos_soak.py"),
+         "--seconds", "15", "--json", str(out)],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"chaos soak failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    doc = json.loads(out.read_text())
+    assert doc["pass"] and not doc["failures"]
+    assert doc["error_rate"] < 0.05
+    assert doc["inflight_loss"] == 0
+    assert doc["recovery_s"] <= doc["recovery_bound_s"] + 1.0
+    assert doc["faults_injected"] > 0
+    assert doc["counts"]["degraded"] > 0

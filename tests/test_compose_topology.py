@@ -18,6 +18,13 @@ gRPC + the vendored field-number-exact protos), and proves
 When docker IS available, `docker compose up` in deploy/ runs the same
 thing against real etcd; tests/test_etcd_vendored.py additionally runs
 the client cycle against a live etcd when GUBER_TEST_ETCD is set.
+
+Isolation (r8 deflake): ports are allocated per-run (the r5-r7 version
+pinned 2971x/2972x, which collided with leftovers/TIME_WAIT under
+full-suite runs), and each daemon's output goes to its own temp FILE —
+the old stdout=PIPE was never read until failure, so a chatty daemon
+could fill the 64 KiB pipe buffer, block on a log write, and miss the
+discovery deadline only when the rest of the suite made it slow.
 """
 
 import json
@@ -30,32 +37,40 @@ import urllib.request
 
 import pytest
 
+from _util import free_ports
+
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 
-GRPC = [29710, 29711]
-HTTP = [29720, 29721]
 
-
-def _daemon(i, etcd_port):
+def _daemon(grpc_port, http_port, etcd_port, log_dir, i):
     env = dict(
         os.environ,
         PYTHONPATH=str(ROOT),
         GUBER_BACKEND="exact",
         JAX_PLATFORMS="cpu",
-        GUBER_GRPC_ADDRESS=f"127.0.0.1:{GRPC[i]}",
-        GUBER_HTTP_ADDRESS=f"127.0.0.1:{HTTP[i]}",
-        GUBER_ADVERTISE_ADDRESS=f"127.0.0.1:{GRPC[i]}",
+        GUBER_GRPC_ADDRESS=f"127.0.0.1:{grpc_port}",
+        GUBER_HTTP_ADDRESS=f"127.0.0.1:{http_port}",
+        GUBER_ADVERTISE_ADDRESS=f"127.0.0.1:{grpc_port}",
         GUBER_ETCD_ENDPOINTS=f"127.0.0.1:{etcd_port}",
     )
     env.pop("GUBER_PEERS", None)
-    return subprocess.Popen(
+    out = open(os.path.join(log_dir, f"daemon{i}.log"), "w+")
+    proc = subprocess.Popen(
         [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
-        stdout=subprocess.PIPE,
+        stdout=out,
         stderr=subprocess.STDOUT,
         text=True,
         cwd=ROOT,
         env=env,
     )
+    proc._log = out  # noqa: SLF001 - test-local teardown handle
+    return proc
+
+
+def _read_log(proc) -> str:
+    proc._log.flush()
+    proc._log.seek(0)
+    return proc._log.read()
 
 
 def _get(url):
@@ -75,11 +90,17 @@ def _post(port, body):
     )
 
 
-def test_compose_topology_discovers_and_forwards():
+def test_compose_topology_discovers_and_forwards(tmp_path):
     from tests._fake_etcd import FakeEtcd
 
+    grpc_ports = free_ports(2)
+    http_ports = free_ports(2)
+    log_dir = str(tmp_path)  # pytest-managed: cleaned up, kept on failure
     etcd = FakeEtcd().start()
-    daemons = [_daemon(i, etcd.port) for i in range(2)]
+    daemons = [
+        _daemon(grpc_ports[i], http_ports[i], etcd.port, log_dir, i)
+        for i in range(2)
+    ]
     try:
         # both nodes must discover each other through etcd
         deadline = time.monotonic() + 60
@@ -88,11 +109,11 @@ def test_compose_topology_discovers_and_forwards():
             for i in range(2):
                 if daemons[i].poll() is not None:
                     pytest.fail(
-                        f"daemon {i} died:\n{daemons[i].stdout.read()}"
+                        f"daemon {i} died:\n{_read_log(daemons[i])}"
                     )
                 try:
                     counts[i] = _get(
-                        f"http://127.0.0.1:{HTTP[i]}/v1/HealthCheck"
+                        f"http://127.0.0.1:{http_ports[i]}/v1/HealthCheck"
                     )["peerCount"]
                 except OSError:
                     counts[i] = 0
@@ -107,19 +128,20 @@ def test_compose_topology_discovers_and_forwards():
         owner_key = None
         for i in range(64):
             out = _post(
-                HTTP[0],
+                http_ports[0],
                 {"requests": [{"name": "ct", "uniqueKey": f"k{i}",
                                "hits": 1, "limit": 9,
                                "duration": 60000}]},
             )
             resp = out["responses"][0]
             assert resp["error"] == "", resp
-            if resp["metadata"].get("owner") == f"127.0.0.1:{GRPC[1]}":
+            owner = f"127.0.0.1:{grpc_ports[1]}"
+            if resp["metadata"].get("owner") == owner:
                 owner_key = f"k{i}"
                 break
         assert owner_key is not None, "no key owned by node 1 in 64 tries"
         out = _post(
-            HTTP[1],
+            http_ports[1],
             {"requests": [{"name": "ct", "uniqueKey": owner_key,
                            "hits": 0, "limit": 9, "duration": 60000}]},
         )
@@ -131,4 +153,5 @@ def test_compose_topology_discovers_and_forwards():
             d.terminate()
         for d in daemons:
             d.wait(timeout=10)
+            d._log.close()
         etcd.stop()

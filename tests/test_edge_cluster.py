@@ -33,6 +33,7 @@ import os
 import pathlib
 import subprocess
 import sys
+import tempfile
 import time
 import urllib.request
 
@@ -53,13 +54,51 @@ pytestmark = pytest.mark.skipif(
 )
 
 N_NODES = 3
-BASE = 19540
-GRPC_PORTS = [BASE + i for i in range(N_NODES)]
-HTTP_PORTS = [BASE + 10 + i for i in range(N_NODES)]
-BRIDGE_PORTS = [BASE + 20 + i for i in range(N_NODES)]
-EDGE_HTTP = BASE + 30
-EDGE_GRPC = BASE + 31
-SOCKS = [f"/tmp/guber-edge-cluster-{i}.sock" for i in range(N_NODES)]
+# Dynamic per-process ports (r8 deflake): this module also runs INSIDE
+# tests/test_edge_asan.py as a subprocess suite — with the old fixed
+# 1954x block, the inner and outer incarnations shared ports, and a
+# lingering listener or the C++ edge's SO_REUSEADDR-less rebind over
+# TIME_WAIT produced "address already in use" boot failures only under
+# full-suite runs. Each process now allocates its own block.
+from _util import free_ports as _free_ports  # noqa: E402
+
+
+def _pick_ports():
+    """Allocate the module's port block, re-rolling until the crc32
+    ring induced by the gRPC addresses spreads the suite's key set
+    over every node — the placement assertions below (exact per-node
+    shares, 'every node serves some of 200 keys') assume a non-
+    degenerate 3-point ring, which fixed addresses guaranteed by
+    construction and random ports must re-establish."""
+    from gubernator_tpu.core.hashing import ring_hash
+
+    sample = [f"ec_ck-{i}" for i in range(200)]
+    for _ in range(64):
+        ports = _free_ports(3 * N_NODES + 2)
+        addrs = [f"127.0.0.1:{p}" for p in ports[:N_NODES]]
+        points = sorted((ring_hash(a), a) for a in addrs)
+        ring = [p for p, _ in points]
+        import bisect
+
+        share = {a: 0 for a in addrs}
+        for k in sample:
+            i = bisect.bisect_left(ring, ring_hash(k))
+            share[points[0 if i == len(ring) else i][1]] += 1
+        if min(share.values()) >= 10:
+            return ports
+    raise RuntimeError("no balanced ring in 64 port rolls")
+
+
+_PORTS = _pick_ports()
+GRPC_PORTS = _PORTS[0:N_NODES]
+HTTP_PORTS = _PORTS[N_NODES:2 * N_NODES]
+BRIDGE_PORTS = _PORTS[2 * N_NODES:3 * N_NODES]
+EDGE_HTTP = _PORTS[3 * N_NODES]
+EDGE_GRPC = _PORTS[3 * N_NODES + 1]
+SOCKS = [
+    f"/tmp/guber-edge-cluster-{os.getpid()}-{i}.sock"
+    for i in range(N_NODES)
+]
 GRPC_ADDRS = [f"127.0.0.1:{p}" for p in GRPC_PORTS]
 
 
@@ -88,29 +127,71 @@ def _spawn_cluster():
             GUBER_EDGE_SOCKET=SOCKS[i],
             GUBER_EDGE_TCP=f"127.0.0.1:{BRIDGE_PORTS[i]}",
             GUBER_EDGE_PEER_BRIDGES=bridges,
+            # teardown SIGTERMs the daemons, which drains (r8); the
+            # cluster is idle by then so the drain is milliseconds —
+            # a small budget just keeps the worst case snappy
+            GUBER_DRAIN_TIMEOUT_MS="1000",
             JAX_COMPILATION_CACHE_DIR=str(ROOT / ".jax_cache_cpu"),
         )
-        daemons.append(
-            subprocess.Popen(
-                [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-                cwd=ROOT,
-                env=env,
-            )
+        # log FILES, not an undrained stdout=PIPE: a daemon filling the
+        # 64 KiB pipe buffer blocks mid-serve under full-suite load
+        # (same deflake as test_compose_topology r8), and on failure
+        # the log is readable without racing the pipe
+        log_f = open(
+            tempfile.mkstemp(prefix=f"guber-edge-cluster-{i}-",
+                             suffix=".log")[0],
+            "w+",
         )
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "gubernator_tpu.cli.daemon"],
+            stdout=log_f,
+            stderr=subprocess.STDOUT,
+            text=True,
+            cwd=ROOT,
+            env=env,
+        )
+        proc._log = log_f  # noqa: SLF001 - test-local handle
+        daemons.append(proc)
+
+    def _dead(i, msg):
+        for x in daemons:
+            x.kill()
+        d = daemons[i]
+        d._log.flush()
+        d._log.seek(0)
+        pytest.fail(f"daemon {i} {msg}:\n{d._log.read()}")
+
     deadline = time.monotonic() + 240
     for i, d in enumerate(daemons):
         while not os.path.exists(SOCKS[i]):
             if d.poll() is not None:
-                for x in daemons:
-                    x.kill()
-                pytest.fail(f"daemon {i} died:\n{d.stdout.read()}")
+                _dead(i, "died at boot")
             if time.monotonic() > deadline:
                 for x in daemons:
                     x.kill()
                 pytest.fail(f"daemon {i} never created its edge socket")
+            time.sleep(0.2)
+    # the edge socket appears before discovery settles; wait until every
+    # node actually SERVES (health up, full peer count) so a test's
+    # first HTTP call can never race a still-booting or just-crashed
+    # node into an unexplained ConnectionRefused
+    for i, d in enumerate(daemons):
+        while True:
+            if d.poll() is not None:
+                _dead(i, "died before serving")
+            try:
+                h = json.loads(
+                    urllib.request.urlopen(
+                        f"http://127.0.0.1:{HTTP_PORTS[i]}/v1/HealthCheck",
+                        timeout=2,
+                    ).read()
+                )
+                if h.get("peerCount") == N_NODES:
+                    break
+            except OSError:
+                pass
+            if time.monotonic() > deadline:
+                _dead(i, "never became healthy")
             time.sleep(0.2)
     return daemons
 
@@ -155,7 +236,14 @@ def cluster():
     for d in daemons:
         d.terminate()
     for d in daemons:
-        d.wait(timeout=10)
+        # never leak a daemon: a teardown that outlives the graceful
+        # window is escalated to SIGKILL (a leaked process would hold
+        # this module's fixed ports and poison later suites)
+        try:
+            d.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            d.kill()
+            d.wait(timeout=10)
 
 
 def _expected_owner(name: str, key: str) -> str:

@@ -17,6 +17,7 @@ CPU (GUBER_JAX_PLATFORM=cpu) like the other daemon e2e tests.
 """
 
 import json
+import os
 import pathlib
 import urllib.request
 
@@ -36,11 +37,14 @@ pytestmark = pytest.mark.skipif(
     reason="edge binary not built (make -C gubernator_tpu/native/edge)",
 )
 
-DAEMON_GRPC = 19494
-DAEMON_HTTP = 19495
-EDGE_HTTP = 19496
-EDGE_GRPC = 19497
-SOCK = "/tmp/guber-edge-fast-pytest.sock"
+# dynamic per-process ports + pid-scoped socket: this module's old
+# fixed 1949x block collided with its own incarnation inside the ASan
+# suite's subprocess runs under full-suite load (r8 deflake; see the
+# matching note in test_edge_cluster.py)
+from tests._util import free_ports as _free_ports  # noqa: E402
+
+DAEMON_GRPC, DAEMON_HTTP, EDGE_HTTP, EDGE_GRPC = _free_ports(4)
+SOCK = f"/tmp/guber-edge-fast-pytest-{os.getpid()}.sock"
 
 
 @pytest.fixture(scope="module")

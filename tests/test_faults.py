@@ -1,0 +1,237 @@
+"""Fault-injection harness tests (serve/faults.py) — the fast,
+deterministic tier-1 slice of the chaos story: the GUBER_FAULT_SPEC
+grammar, rule matching (probability / host / budget), and the real
+injection points in PeerClient and DeviceBatcher. The full
+kill-a-node soak lives in test_chaos_soak.py (marked slow).
+"""
+
+import asyncio
+
+import pytest
+
+from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
+from gubernator_tpu.serve.batcher import DeviceBatcher
+from gubernator_tpu.serve.config import BehaviorConfig
+from gubernator_tpu.serve.faults import (
+    FAULTS,
+    FaultError,
+    FaultInjector,
+    parse_duration_s,
+    parse_fault_spec,
+)
+from gubernator_tpu.serve.peers import PeerClient
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    FAULTS.clear()
+    yield
+    FAULTS.clear()
+
+
+# -- grammar ---------------------------------------------------------------
+
+
+def test_parse_issue_example_spec():
+    rules = parse_fault_spec(
+        "peer_rpc:delay=200ms:p=0.1,peer_rpc:error:p=0.05,"
+        "device_submit:hang"
+    )
+    assert [(r.point, r.action) for r in rules] == [
+        ("peer_rpc", "delay"),
+        ("peer_rpc", "error"),
+        ("device_submit", "hang"),
+    ]
+    assert rules[0].delay_s == pytest.approx(0.2)
+    assert rules[0].p == pytest.approx(0.1)
+    assert rules[1].p == pytest.approx(0.05)
+
+
+def test_parse_durations():
+    assert parse_duration_s("200ms") == pytest.approx(0.2)
+    assert parse_duration_s("1.5s") == pytest.approx(1.5)
+    assert parse_duration_s("50") == pytest.approx(0.05)  # bare = ms
+    with pytest.raises(ValueError):
+        parse_duration_s("fast")
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "nonsense",  # no action
+        "warp_core:error",  # unknown point
+        "peer_rpc:explode",  # unknown action
+        "peer_rpc:delay",  # delay without duration
+        "peer_rpc:error:p=1.5",  # probability out of range
+        "peer_rpc:error:zeal=9",  # unknown param
+        "peer_rpc:hang=5s",  # hang takes no value
+    ],
+)
+def test_parse_rejects_typos_loudly(bad):
+    # a silently-dropped rule would let a chaos run pass for the wrong
+    # reason — every typo must be a hard error
+    with pytest.raises(ValueError):
+        parse_fault_spec(bad)
+
+
+def test_empty_spec_disables():
+    inj = FaultInjector()
+    inj.configure("")
+    assert not inj.enabled
+
+
+# -- rule matching ---------------------------------------------------------
+
+
+def test_host_filter_and_budget():
+    inj = FaultInjector()
+    inj.configure("peer_rpc:error:host=10.0.0.3:n=2")
+
+    async def run():
+        # other peers unaffected
+        await inj.inject("peer_rpc", peer="10.0.0.4:81")
+        for _ in range(2):  # budget: exactly two injections
+            with pytest.raises(FaultError):
+                await inj.inject("peer_rpc", peer="10.0.0.3:81")
+        await inj.inject("peer_rpc", peer="10.0.0.3:81")  # budget spent
+
+    asyncio.run(run())
+
+
+def test_probability_deterministic_with_seed():
+    def count(seed):
+        inj = FaultInjector()
+        inj.configure("peer_rpc:error:p=0.3", seed=seed)
+        hits = 0
+
+        async def run():
+            nonlocal hits
+            for _ in range(200):
+                try:
+                    await inj.inject("peer_rpc")
+                except FaultError:
+                    hits += 1
+
+        asyncio.run(run())
+        return hits
+
+    a, b = count(42), count(42)
+    assert a == b  # reproducible
+    assert 30 <= a <= 90  # ~0.3 of 200
+
+
+def test_delay_rule_sleeps():
+    inj = FaultInjector()
+    inj.configure("edge_frame:delay=30ms")
+
+    async def run():
+        import time
+
+        t0 = time.monotonic()
+        await inj.inject("edge_frame")
+        assert time.monotonic() - t0 >= 0.025
+
+    asyncio.run(run())
+
+
+# -- real injection points -------------------------------------------------
+
+
+class _OkStub:
+    def __init__(self):
+        self.calls = 0
+
+    async def GetPeerRateLimits(self, pb_req, timeout=None):
+        from gubernator_tpu.api import convert
+        from gubernator_tpu.api.proto.gen import peers_pb2
+
+        self.calls += 1
+        return peers_pb2.GetPeerRateLimitsResp(
+            rate_limits=[
+                convert.resp_to_pb(RateLimitResp(limit=5, remaining=4))
+                for _ in pb_req.requests
+            ]
+        )
+
+
+def test_peer_rpc_injection_exercises_retry_then_gives_up():
+    """One budgeted injected error is absorbed by a retry; an unbounded
+    error rule exhausts the budget and surfaces the failure."""
+
+    async def run():
+        FAULTS.configure("peer_rpc:error:n=1")
+        stub = _OkStub()
+        c = PeerClient(
+            BehaviorConfig(peer_retries=2, peer_backoff=0.001,
+                           peer_backoff_max=0.002),
+            "127.0.0.1:1",
+        )
+        c.stub = stub
+        r = RateLimitReq(name="f", unique_key="k", hits=1, limit=5,
+                         duration=1000, behavior=Behavior.NO_BATCHING)
+        resps = await c.get_peer_rate_limits([r])
+        assert resps[0].remaining == 4
+        assert stub.calls == 1  # injected fault fired BEFORE the stub
+
+        FAULTS.configure("peer_rpc:error")  # every attempt now fails
+        with pytest.raises(FaultError):
+            await c.get_peer_rate_limits([r])
+
+    asyncio.run(run())
+
+
+class _HostBackend:
+    # deliberately NOT inline_decide: decides must ride the queued
+    # flusher path, where the device_submit injection point lives
+
+    def decide(self, reqs, gnp):
+        return [RateLimitResp(limit=r.limit, remaining=r.limit - r.hits)
+                for r in reqs]
+
+    def update_globals(self, updates):
+        pass
+
+
+def test_device_submit_injection_fails_batch_not_flusher():
+    """An injected device_submit error must fail THAT batch's callers
+    and leave the flusher alive for the next batch — the same contract
+    as a real submit failure."""
+
+    async def run():
+        b = DeviceBatcher(_HostBackend(), batch_wait=0.0)
+        b.start()
+        try:
+            FAULTS.configure("device_submit:error:n=1")
+            r = RateLimitReq(name="f", unique_key="k", hits=1, limit=5,
+                             duration=1000)
+            res = await asyncio.gather(
+                b.decide([r], [False]), b.decide([r], [False]),
+                return_exceptions=True,
+            )
+            assert any(isinstance(x, FaultError) for x in res)
+            # flusher survived: a later decide succeeds
+            FAULTS.clear()
+            out = await b.decide([r], [False])
+            assert out[0].remaining == 4
+        finally:
+            await b.stop()
+
+    asyncio.run(run())
+
+
+def test_injection_counts_metric():
+    from gubernator_tpu.serve import metrics
+
+    inj = FaultInjector()
+    inj.configure("peer_serve:delay=1ms")
+
+    async def run():
+        before = metrics.FAULTS_INJECTED.labels(
+            point="peer_serve", action="delay"
+        )._value.get()
+        await inj.inject("peer_serve")
+        assert metrics.FAULTS_INJECTED.labels(
+            point="peer_serve", action="delay"
+        )._value.get() == before + 1
+
+    asyncio.run(run())
