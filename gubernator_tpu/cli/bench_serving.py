@@ -305,6 +305,9 @@ def run_zipf10m(args) -> int:
             "GUBER_PREP_THREADS": os.environ.get(
                 "GUBER_PREP_THREADS", "<default>"
             ),
+            "GUBER_PREP_AT_ARRIVAL": os.environ.get(
+                "GUBER_PREP_AT_ARRIVAL", "1"
+            ),
         },
         notes=(
             "depth rows share one fixed store footprint; throughput "
@@ -395,11 +398,22 @@ def main(argv=None) -> int:
         "raise toward 16 when the device sits behind a high-latency "
         "tunnel",
     )
+    parser.add_argument(
+        "--prep-at-arrival",
+        choices=["0", "1"],
+        default=None,
+        help="override GUBER_PREP_AT_ARRIVAL for every node this "
+        "harness boots (r9 host-prep pipeline A/B; default: env / on)",
+    )
     args = parser.parse_args(argv)
     if args.fetch_depth is not None:
         import os
 
         os.environ["GUBER_FETCH_DEPTH"] = str(args.fetch_depth)
+    if args.prep_at_arrival is not None:
+        import os
+
+        os.environ["GUBER_PREP_AT_ARRIVAL"] = args.prep_at_arrival
     if args.scenario == "zipf10m":
         if args.backend == "exact":
             # config 4 is a device scenario (the exact backend decides

@@ -33,6 +33,7 @@ from gubernator_tpu.api.types import (
     RateLimitResp,
     Status,
     millisecond_now,
+    resps_from_columns,
 )
 from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.kernels import (
@@ -440,6 +441,131 @@ def pad_request_sorted(
     return req, order
 
 
+def groups_from_sorted_keys(
+    skey_sorted: np.ndarray, kh_padded: np.ndarray, n: int, B: int
+) -> "BatchGroups":
+    """Duplicate-key group structure of an ALREADY-SORTED key stream —
+    the presorted-submit twin of _np_presort_grouped's grouping pass
+    (one O(n) diff instead of the argsort it no longer needs). `skey`
+    ties define groups exactly as the flush-time path's sorted stream
+    would, so the padded BatchGroups are bit-identical."""
+    is_leader = np.empty(n, bool)
+    if n:
+        is_leader[0] = True
+        np.not_equal(skey_sorted[1:n], skey_sorted[: n - 1],
+                     out=is_leader[1:])
+    group_id_n = np.cumsum(is_leader).astype(np.int32) - 1
+    leader_pos_n = np.flatnonzero(is_leader).astype(np.int32)
+    G_real = int(leader_pos_n.shape[0])
+    G = choose_bucket(group_rungs(B), max(G_real, 1))
+    return build_groups(
+        kh_padded, group_id_n, leader_pos_n, G_real, n, B, G
+    )
+
+
+def pad_sorted_fields(fields: dict, n: int, B: int) -> "BatchRequest":
+    """BatchRequest from device-dtype arrays ALREADY in sorted order
+    (arrival-time prep + merge combine): pure pad — repeat the last
+    sorted row with valid=False, the same tail pad_request_sorted
+    emits."""
+
+    def pad(x, dtype):
+        out = np.empty(B, dtype)
+        out[:n] = x
+        out[n:] = out[n - 1] if n else 0
+        return out
+
+    valid = np.zeros(B, bool)
+    valid[:n] = True
+    return BatchRequest(
+        key_hash=pad(fields["key_hash"], np.uint64),
+        hits=pad(fields["hits"], np.int32),
+        limit=pad(fields["limit"], np.int32),
+        duration=pad(fields["duration"], np.int32),
+        algo=pad(fields["algo"], np.int32),
+        gnp=pad(fields["gnp"], bool),
+        valid=valid,
+    )
+
+
+def _gather_clip_sorted(fields: dict, order: np.ndarray, n: int) -> dict:
+    """Device-dtype clip + gather of one group's fields into sorted
+    order. Native gather_pad helpers when built (one GIL-free C call
+    per field — arrival preps run while the serving loop is hot, so op
+    count is wall time); numpy fallback is elementwise-identical."""
+    if _marshal is not None and n:
+        return dict(
+            key_hash=_marshal.gather_pad_u64(
+                fields["key_hash"], order, n
+            ),
+            hits=_marshal.gather_pad_i64_clip(
+                fields["hits"], order, n, -_I32_SAT, _I32_SAT
+            ),
+            limit=_marshal.gather_pad_i64_clip(
+                fields["limit"], order, n, -_I32_SAT, _I32_SAT
+            ),
+            duration=_marshal.gather_pad_i64_clip(
+                fields["duration"], order, n, TIME_FLOOR,
+                MAX_DURATION_MS,
+            ),
+            algo=_marshal.gather_pad_i32(fields["algo"], order, n),
+            gnp=_marshal.gather_pad_u8(
+                np.asarray(fields["gnp"], bool).view(np.uint8), order, n
+            ).view(bool),
+        )
+    return dict(
+        key_hash=np.asarray(fields["key_hash"], np.uint64)[order],
+        hits=_sat_i32(fields["hits"])[order],
+        limit=_sat_i32(fields["limit"])[order],
+        duration=_sat_duration(fields["duration"])[order],
+        algo=np.asarray(fields["algo"], np.int32)[order],
+        gnp=np.asarray(fields["gnp"], bool)[order],
+    )
+
+
+def prep_run_single(fields: dict, store_buckets: int) -> dict:
+    """Arrival-time per-group prep for the single-device engine:
+    presort one group by (bucket, fingerprint) and clip every field
+    into its device dtype, producing a sorted run the flush-time merge
+    combine (serve/prep.py) stitches into one device batch. `order` is
+    the caller index of sorted row j; `counts` is the single-shard row
+    count (shape [1], mirroring the mesh engine's per-shard counts so
+    the merge is engine-agnostic). One fused native call when built
+    (guber_prep_run — prep threads stay off the interpreter); the
+    numpy fallback below is bit-identical."""
+    if _hn is not None and getattr(_hn, "_HAS_PREP_RUN", False):
+        return _hn.prep_run(
+            fields, store_buckets, 1, -_I32_SAT, _I32_SAT, TIME_FLOOR,
+            MAX_DURATION_MS,
+        )
+    kh = np.ascontiguousarray(fields["key_hash"], np.uint64)
+    n = kh.shape[0]
+    order = _presort(kh, store_buckets)
+    sorted_fields = _gather_clip_sorted(fields, order, n)
+    return dict(
+        n=n,
+        # the sort key is elementwise in the key hash, so computing it
+        # on the SORTED hashes equals gathering the unsorted keys
+        skey=group_sort_key_np(sorted_fields["key_hash"], store_buckets),
+        order=order,
+        counts=np.array([n], np.int64),
+        fields=sorted_fields,
+    )
+
+
+def build_presorted_request(
+    buckets: Sequence[int], fields: dict, skey: np.ndarray, n: int
+):
+    """(req, groups, B) for an already-sorted batch — the merge-combine
+    twin of pad_request_sorted(with_groups=True), minus the argsort it
+    no longer needs. Byte-identical outputs are pinned by
+    tests/test_prep_pipeline.py."""
+    B = choose_bucket(buckets, n)
+    req = pad_sorted_fields(fields, n, B)
+    groups = groups_from_sorted_keys(skey, req.key_hash, n, B)
+    return req, groups, B
+
+
 def unpermute_responses(order: np.ndarray, sorted_arrays):
     """Inverse of pad_request_sorted's row order: one O(B) numpy store
     per response array (`out[order] = sorted`)."""
@@ -545,16 +671,7 @@ class TpuEngine:
         handle."""
         if handle is None:
             return []
-        status, rlimit, remaining, reset = self.decide_wait(handle)
-        return [
-            RateLimitResp(
-                status=Status(int(status[i])),
-                limit=int(rlimit[i]),
-                remaining=int(remaining[i]),
-                reset_time=int(reset[i]),
-            )
-            for i in range(status.shape[0])
-        ]
+        return resps_from_columns(*self.decide_wait(handle))
 
     def get_rate_limits(
         self,
@@ -613,6 +730,98 @@ class TpuEngine:
         # may rebase/reset the clock before this batch's wait, and the
         # in-flight engine-ms outputs must convert against THEIR epoch
         return (packed, order, n, req.key_hash.shape[0], self.clock.epoch)
+
+    def prep_run(self, fields: dict) -> dict:
+        """Arrival-time per-group prep (serve/batcher.py): see
+        prep_run_single."""
+        return prep_run_single(fields, self.config.slots)
+
+    def merge_prepped(self, runs):
+        """Merge the caller groups' pre-sorted runs into one dispatch-
+        ready batch (the submit thread's `merge` stage). With the
+        native lib this is ONE GIL-free fused pass — merge + field
+        materialization + padding + group stream (guber_merge_runs) —
+        leaving only build_groups' G-sized assembly in numpy; the
+        fallback is serve/prep.py's searchsorted merge plus the padded
+        build. Output feeds decide_submit_merged."""
+        n = int(sum(r["n"] for r in runs))
+        B = choose_bucket(self.buckets, n)
+        if _hn is not None and getattr(_hn, "_HAS_MERGE", False) and n:
+            m = _hn.merge_runs_native(runs, B, g_rungs=group_rungs(B))
+            req = BatchRequest(
+                key_hash=m["key_hash"], hits=m["hits"],
+                limit=m["limit"], duration=m["duration"],
+                algo=m["algo"], gnp=m["gnp"], valid=m["valid"],
+            )
+            groups = BatchGroups(
+                key_hash=m["group_key_hash"],
+                leader_pos=m["leader_pos"],
+                end_pos=m["group_end"],
+                valid=m["group_valid"],
+                group_id=m["group_id"],
+            )
+            return dict(
+                req=req, groups=groups, order=m["order"], n=n, B=B
+            )
+        from gubernator_tpu.serve.prep import merge_runs
+
+        m = merge_runs(runs)
+        req, groups, B = build_presorted_request(
+            self.buckets, m["fields"], m["skey"], n
+        )
+        order_p = np.empty(B, np.int32)
+        order_p[:n] = m["order"]
+        order_p[n:] = np.arange(n, B, dtype=np.int32)
+        return dict(req=req, groups=groups, order=order_p, n=n, B=B)
+
+    def decide_submit_merged(self, merged: dict, now: int):
+        """Dispatch a merge_prepped batch: epoch bookkeeping + the
+        jitted call, nothing else — the submit thread's `dispatch`
+        stage. Returns the standard decide_wait handle."""
+        e_now = self._engine_now(now)
+        self.store, packed = _decide_packed_jit(
+            self.store, merged["req"], e_now, merged["groups"]
+        )
+        return (
+            packed, merged["order"], merged["n"], merged["B"],
+            self.clock.epoch,
+        )
+
+    def decide_submit_presorted(
+        self,
+        fields: dict,
+        skey: np.ndarray,
+        order: Optional[np.ndarray],
+        counts: np.ndarray,
+        now: int,
+    ):
+        """Dispatch a batch whose host presort already happened
+        (arrival-time prep + merge combine): `fields` are device-dtype
+        request arrays in sorted (bucket, fingerprint) order, `skey`
+        the matching sorted composite keys, `order[k]` the caller index
+        of sorted row k (None = identity, for callers that discard the
+        handle). Pads + derives the duplicate-key group structure in
+        O(n) and dispatches — no argsort anywhere. Device fields are
+        byte-identical to decide_submit on the same unsorted batch
+        (tests/test_prep_pipeline.py); returns the same opaque handle
+        for decide_wait. `counts` is accepted for signature parity with
+        the mesh engine and unused here (one shard)."""
+        n = skey.shape[0]
+        if n == 0:
+            return None
+        e_now = self._engine_now(now)
+        req, groups, B = build_presorted_request(
+            self.buckets, fields, skey, n
+        )
+        order_p = np.empty(B, np.int32)
+        order_p[:n] = (
+            order if order is not None else np.arange(n, dtype=np.int32)
+        )
+        order_p[n:] = np.arange(n, B, dtype=np.int32)
+        self.store, packed = _decide_packed_jit(
+            self.store, req, e_now, groups
+        )
+        return (packed, order_p, n, B, self.clock.epoch)
 
     def decide_wait(
         self, handle

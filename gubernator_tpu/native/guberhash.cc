@@ -1143,4 +1143,199 @@ void guber_unflatten_resp(const int32_t* packed, const int32_t* order,
   }
 }
 
+// Sorted-run merge combine (r9): stable k-way merge of per-group
+// PRE-SORTED runs (serve/batcher.py arrival-time prep), fused with the
+// field materialization + request padding the flush path needs — one
+// GIL-free pass replacing the flattened batch's concat + full radix
+// sort + marshal. Stability contract (pinned python-side): equal sort
+// keys resolve in run order, and runs arrive in caller order, so the
+// merged permutation equals np.argsort(concat, kind="stable").
+//
+// Inputs are k parallel pointer tables (one entry per run) of the
+// sorted skey / device-dtype fields / within-run caller order, plus
+// per-run lengths ns[k] and flattened-batch base offsets bases[k].
+// Outputs: merged skey[n] (group derivation + mesh slicing),
+// order_out[B] (global caller index; tail = identity, the engine's
+// padding convention), the six padded field arrays [B] (tail repeats
+// the last merged row, valid=0 — pad_request_sorted's convention), and
+// the duplicate-key group stream (group_id[n], leader_pos[n], g_real).
+// Pass B == n to skip padding (the mesh path lays out per-shard
+// sub-batches from the flat merged stream instead).
+// When n_rungs > 0, the group stream is additionally PADDED to the
+// smallest rung G >= max(g_real, 1) of g_rungs (engine.group_rungs'
+// ladder, engine.build_groups' conventions): gkh/glead/gend/gvalid
+// sized G (caller allocates g_rungs[n_rungs-1]), group_id_out sized B
+// with the padding tail pointing at the last real group, and the
+// picked G returned through g_pick_out — so the whole merge + pad +
+// group build is one GIL-free call. n_rungs == 0 skips the padding
+// (the mesh path lays out per-shard groups itself).
+int64_t guber_merge_runs(
+    const uint64_t* const* skeys, const uint64_t* const* khs,
+    const int32_t* const* hits, const int32_t* const* limits,
+    const int32_t* const* durs, const int32_t* const* algos,
+    const uint8_t* const* gnps, const int32_t* const* orders,
+    const int64_t* ns, const int64_t* bases, int64_t k, int64_t B,
+    const int64_t* g_rungs, int64_t n_rungs, uint64_t* skey_out,
+    int32_t* order_out, uint64_t* kh_out, int32_t* hits_out,
+    int32_t* limit_out, int32_t* dur_out, int32_t* algo_out,
+    uint8_t* gnp_out, uint8_t* valid_out, int32_t* group_id_out,
+    int32_t* leader_pos_out, uint64_t* gkh_out, int32_t* gend_out,
+    uint8_t* gvalid_out, int64_t* g_real_out, int64_t* g_pick_out) {
+  int64_t n = 0;
+  for (int64_t r = 0; r < k; ++r) n += ns[r];
+  if (n > B) return -1;
+  // binary min-heap of run heads ordered by (key, run index): the run
+  // tie-break is what keeps equal keys in caller order (runs are
+  // caller-ordered), matching a stable sort of the concatenation
+  struct Head {
+    uint64_t key;
+    int64_t run;
+  };
+  std::vector<Head> heap;
+  heap.reserve((size_t)k);
+  std::vector<int64_t> pos((size_t)k, 0);
+  auto lt = [](const Head& a, const Head& b) {
+    return a.key < b.key || (a.key == b.key && a.run < b.run);
+  };
+  auto sift_down = [&](size_t i) {
+    const size_t sz = heap.size();
+    for (;;) {
+      size_t s = i, l = 2 * i + 1, r2 = 2 * i + 2;
+      if (l < sz && lt(heap[l], heap[s])) s = l;
+      if (r2 < sz && lt(heap[r2], heap[s])) s = r2;
+      if (s == i) return;
+      std::swap(heap[i], heap[s]);
+      i = s;
+    }
+  };
+  for (int64_t r = 0; r < k; ++r)
+    if (ns[r] > 0) heap.push_back({skeys[r][0], r});
+  for (size_t i = heap.size(); i-- > 0;) sift_down(i);
+
+  int64_t g = -1;
+  uint64_t prev_key = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t r = heap[0].run;
+    const int64_t j = pos[(size_t)r]++;
+    const uint64_t key = heap[0].key;
+    skey_out[i] = key;
+    order_out[i] = (int32_t)(orders[r][j] + bases[r]);
+    kh_out[i] = khs[r][j];
+    hits_out[i] = hits[r][j];
+    limit_out[i] = limits[r][j];
+    dur_out[i] = durs[r][j];
+    algo_out[i] = algos[r][j];
+    gnp_out[i] = gnps[r][j];
+    valid_out[i] = 1;
+    if (i == 0 || key != prev_key) {
+      leader_pos_out[++g] = (int32_t)i;
+      prev_key = key;
+    }
+    group_id_out[i] = (int32_t)g;
+    if (j + 1 < ns[r]) {
+      heap[0].key = skeys[r][j + 1];
+    } else {
+      heap[0] = heap.back();
+      heap.pop_back();
+    }
+    if (!heap.empty()) sift_down(0);
+  }
+  const int64_t g_real = g + 1;
+  *g_real_out = g_real;
+  // padding tail: repeat the last merged row with valid=0; order maps
+  // padding rows to themselves (engine padding conventions)
+  // (see guber_prep_run below for the arrival-side producer)
+  for (int64_t i = n; i < B; ++i) {
+    order_out[i] = (int32_t)i;
+    kh_out[i] = n ? kh_out[n - 1] : 0;
+    hits_out[i] = n ? hits_out[n - 1] : 0;
+    limit_out[i] = n ? limit_out[n - 1] : 0;
+    dur_out[i] = n ? dur_out[n - 1] : 0;
+    algo_out[i] = n ? algo_out[n - 1] : 0;
+    gnp_out[i] = n ? gnp_out[n - 1] : 0;
+    valid_out[i] = 0;
+  }
+  if (n_rungs > 0) {
+    // padded group build, engine.build_groups conventions: pick the
+    // smallest rung holding the real groups, real slots get
+    // leader/end/key, the final real group owns the request padding
+    // tail, padded slots carry leader=B / end=B-1 / valid=0, and
+    // padded request rows point at the last real group
+    const int64_t g_need = g_real > 1 ? g_real : 1;
+    int64_t G = 0;
+    for (int64_t r = 0; r < n_rungs; ++r) {
+      if (g_rungs[r] >= g_need) {
+        G = g_rungs[r];
+        break;
+      }
+    }
+    if (G == 0) return -3;  // ladder cannot hold the group count
+    *g_pick_out = G;
+    for (int64_t q = 0; q < g_real; ++q) {
+      const int32_t lead = leader_pos_out[q];
+      gkh_out[q] = kh_out[lead];
+      gend_out[q] =
+          q + 1 < g_real ? leader_pos_out[q + 1] - 1 : (int32_t)(B - 1);
+      gvalid_out[q] = 1;
+    }
+    const uint64_t k_pad = B ? kh_out[B - 1] : 0;
+    for (int64_t q = g_real; q < G; ++q) {
+      leader_pos_out[q] = (int32_t)B;
+      gkh_out[q] = k_pad;
+      gend_out[q] = (int32_t)(B - 1);
+      gvalid_out[q] = 0;
+    }
+    const int32_t gid_pad = (int32_t)(g_real > 0 ? g_real - 1 : 0);
+    for (int64_t i = n; i < B; ++i) group_id_out[i] = gid_pad;
+  }
+  return 0;
+}
+
+// Arrival-time per-group prep (r9): ONE call fusing the sharded
+// presort (guber_presort_sharded), the device-dtype clip+gather of all
+// six request fields, and the composite sort-key stream the merge
+// orders by — the producer side of guber_merge_runs. One GIL-free
+// call per enqueued group keeps the prep pool's threads off the
+// interpreter while the serving loop is hot. n_shards == 1 degrades
+// to the single-device (bucket, fingerprint) order: the owner bits
+// are zero, so the composite key equals group_sort_key_np's.
+int64_t guber_prep_run(const uint64_t* key_hash, const int64_t* hits,
+                       const int64_t* limits, const int64_t* durs,
+                       const int32_t* algos, const uint8_t* gnps,
+                       int64_t n, uint64_t buckets, int64_t n_shards,
+                       int64_t lo, int64_t hi, int64_t dlo, int64_t dhi,
+                       int32_t* order_out, int64_t* counts_out,
+                       uint64_t* skey_out, uint64_t* kh_out,
+                       int32_t* hits_out, int32_t* limit_out,
+                       int32_t* dur_out, int32_t* algo_out,
+                       uint8_t* gnp_out) {
+  guber_presort_sharded(key_hash, n, buckets, (uint64_t)n_shards,
+                        order_out, counts_out);
+  int bucket_bits = 0;
+  while ((1ULL << bucket_bits) < buckets) ++bucket_bits;
+  if (bucket_bits < 1) bucket_bits = 1;  // python max(bit_length-1, 1)
+  const uint64_t bmask = buckets - 1;
+  for (int64_t i = 0; i < n; ++i) {
+    const int64_t j = order_out[i];
+    const uint64_t kh = key_hash[j];
+    kh_out[i] = kh;
+    uint64_t owner =
+        n_shards > 1 ? splitmix64(kh ^ SHARD_SALT) % (uint64_t)n_shards
+                     : 0;
+    const uint64_t bkt = splitmix64(kh ^ BUCKET_SALT) & bmask;
+    uint64_t fp = kh >> 32;
+    if (fp == 0) fp = 1;
+    skey_out[i] = (owner << (32 + bucket_bits)) | (bkt << 32) | fp;
+    int64_t h = hits[j];
+    hits_out[i] = (int32_t)(h < lo ? lo : (h > hi ? hi : h));
+    int64_t l = limits[j];
+    limit_out[i] = (int32_t)(l < lo ? lo : (l > hi ? hi : l));
+    int64_t d = durs[j];
+    dur_out[i] = (int32_t)(d < dlo ? dlo : (d > dhi ? dhi : d));
+    algo_out[i] = algos[j];
+    gnp_out[i] = gnps[j];
+  }
+  return 0;
+}
+
 }  // extern "C"

@@ -509,6 +509,198 @@ def prep_sharded(
     return order, counts, take_idx, fields, groups, B, G
 
 
+try:
+    _lib.guber_merge_runs.restype = ctypes.c_int64
+    _vpp = ctypes.POINTER(ctypes.c_void_p)
+    _lib.guber_merge_runs.argtypes = [
+        _vpp, _vpp, _vpp, _vpp, _vpp, _vpp, _vpp, _vpp,
+        _i64p, _i64p, ctypes.c_int64, ctypes.c_int64,
+        _i64p, ctypes.c_int64,
+        _u64p, _i32p, _u64p, _i32p, _i32p, _i32p, _i32p, _u8p, _u8p,
+        _i32p, _i32p, _u64p, _i32p, _u8p, _i64p, _i64p,
+    ]
+    _HAS_MERGE = True
+except AttributeError:
+    _HAS_MERGE = False
+
+try:
+    _lib.guber_prep_run.restype = ctypes.c_int64
+    _lib.guber_prep_run.argtypes = [
+        _u64p, _i64p, _i64p, _i64p, _i32p, _u8p,
+        ctypes.c_int64, ctypes.c_uint64, ctypes.c_int64,
+        ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        _i32p, _i64p, _u64p, _u64p, _i32p, _i32p, _i32p, _i32p, _u8p,
+    ]
+    _HAS_PREP_RUN = True
+except AttributeError:
+    _HAS_PREP_RUN = False
+
+
+def prep_run(fields: dict, buckets: int, n_shards: int,
+             lo: int, hi: int, dlo: int, dhi: int) -> dict:
+    """Fused arrival-time per-group prep (guber_prep_run): sharded
+    presort + device-dtype clip/gather of all six fields + the merged
+    composite sort-key stream, in ONE GIL-free call — the producer
+    side of merge_runs_native. Output layout matches the engines'
+    numpy prep_run fallbacks bit-for-bit."""
+    if not _HAS_PREP_RUN:
+        raise AttributeError(
+            "libguberhash.so predates guber_prep_run; rebuild with "
+            "make -C gubernator_tpu/native"
+        )
+    kh = np.ascontiguousarray(fields["key_hash"], np.uint64)
+    hits = np.ascontiguousarray(fields["hits"], np.int64)
+    limit = np.ascontiguousarray(fields["limit"], np.int64)
+    duration = np.ascontiguousarray(fields["duration"], np.int64)
+    algo = np.ascontiguousarray(fields["algo"], np.int32)
+    gnp = np.ascontiguousarray(np.asarray(fields["gnp"], bool).view(np.uint8))
+    n = kh.shape[0]
+    order = np.empty(n, np.int32)
+    counts = np.empty(n_shards, np.int64)
+    skey = np.empty(n, np.uint64)
+    kh_o = np.empty(n, np.uint64)
+    hits_o = np.empty(n, np.int32)
+    lim_o = np.empty(n, np.int32)
+    dur_o = np.empty(n, np.int32)
+    algo_o = np.empty(n, np.int32)
+    gnp_o = np.empty(n, np.uint8)
+    rc = _lib.guber_prep_run(
+        _ptr(kh, ctypes.c_uint64), _ptr(hits, ctypes.c_int64),
+        _ptr(limit, ctypes.c_int64), _ptr(duration, ctypes.c_int64),
+        _ptr(algo, ctypes.c_int32), _ptr(gnp, ctypes.c_uint8),
+        n, ctypes.c_uint64(buckets), n_shards, lo, hi, dlo, dhi,
+        _ptr(order, ctypes.c_int32), _ptr(counts, ctypes.c_int64),
+        _ptr(skey, ctypes.c_uint64), _ptr(kh_o, ctypes.c_uint64),
+        _ptr(hits_o, ctypes.c_int32), _ptr(lim_o, ctypes.c_int32),
+        _ptr(dur_o, ctypes.c_int32), _ptr(algo_o, ctypes.c_int32),
+        _ptr(gnp_o, ctypes.c_uint8),
+    )
+    if rc != 0:
+        raise RuntimeError(f"guber_prep_run failed: rc={rc}")
+    run = dict(
+        n=n, skey=skey, order=order, counts=counts,
+        fields=dict(
+            key_hash=kh_o, hits=hits_o, limit=lim_o, duration=dur_o,
+            algo=algo_o, gnp=gnp_o.view(bool),
+        ),
+    )
+    run["_addrs"] = run_addrs(run)
+    return run
+
+
+def run_addrs(run: dict) -> tuple:
+    """Raw data addresses of one prep run's arrays, in
+    guber_merge_runs' table column order. prep_run stamps this into the
+    run at arrival time so the flush-time merge pays zero per-run
+    ctypes-interface construction on the submit thread."""
+    f = run["fields"]
+    return (
+        run["skey"].ctypes.data,
+        f["key_hash"].ctypes.data,
+        f["hits"].ctypes.data,
+        f["limit"].ctypes.data,
+        f["duration"].ctypes.data,
+        f["algo"].ctypes.data,
+        f["gnp"].ctypes.data,
+        run["order"].ctypes.data,
+    )
+
+
+def merge_runs_native(runs, B: int, g_rungs=None) -> dict:
+    """Fused k-way merge of pre-sorted per-group runs (guber_merge_runs):
+    one GIL-free pass produces the merged sort-key stream, the global
+    caller-order permutation, all six device-dtype field arrays padded
+    to B rows (tail repeats the last merged row, valid=False — the
+    engine's padding convention; pass B == n for a flat merge), and the
+    duplicate-key group stream. `runs` are engine prep_run dicts in
+    caller order; ties across runs resolve in run order, so the merged
+    permutation equals np.argsort(concat, kind='stable') — the
+    merge-combine equivalence contract (tests/test_prep_pipeline.py).
+
+    Returns dict(n, skey[n], order[B], key_hash/hits/limit/duration/
+    algo[B], gnp/valid[B] (bool), group_id[n], leader_pos[n], G_real).
+    With `g_rungs` (engine.group_rungs(B)), the group stream is padded
+    in the same pass to the smallest fitting rung G — build_groups'
+    conventions — and the dict gains G, group_key_hash/group_end/
+    group_valid [:G], padded leader_pos [:G], and a B-sized group_id.
+    """
+    if not _HAS_MERGE:
+        raise AttributeError(
+            "libguberhash.so predates guber_merge_runs; rebuild with "
+            "make -C gubernator_tpu/native"
+        )
+    k = len(runs)
+    n = int(sum(r["n"] for r in runs))
+    assert B >= n, (B, n)
+
+    # pointer tables from the per-run address tuples prep stamped at
+    # ARRIVAL (run_addrs below) — `.ctypes.data` per array here would
+    # cost ~8k ctypes-interface constructions of pure submit-thread
+    # Python, which is exactly the wall this path exists to remove.
+    # The run dicts keep the arrays alive for the duration of the call.
+    addrs = [r.get("_addrs") or run_addrs(r) for r in runs]
+    tabs = [
+        (ctypes.c_void_p * k)(*[a[col] for a in addrs])
+        for col in range(8)
+    ]
+    ns = np.asarray([r["n"] for r in runs], np.int64)
+    bases = np.zeros(k, np.int64)
+    np.cumsum(ns[:-1], out=bases[1:])
+
+    if g_rungs is not None:
+        rungs = np.ascontiguousarray(g_rungs, np.int64)
+        g_max = int(rungs[-1])
+    else:
+        rungs = np.empty(0, np.int64)
+        g_max = 0
+    skey = np.empty(n, np.uint64)
+    order = np.empty(B, np.int32)
+    kh = np.empty(B, np.uint64)
+    hits = np.empty(B, np.int32)
+    limit = np.empty(B, np.int32)
+    dur = np.empty(B, np.int32)
+    algo = np.empty(B, np.int32)
+    gnp = np.empty(B, np.uint8)
+    valid = np.empty(B, np.uint8)
+    gid = np.empty(max(B if g_rungs is not None else n, 1), np.int32)
+    lead = np.empty(max(n, g_max, 1), np.int32)
+    gkh = np.empty(max(g_max, 1), np.uint64)
+    gend = np.empty(max(g_max, 1), np.int32)
+    gvalid = np.empty(max(g_max, 1), np.uint8)
+    g_real = ctypes.c_int64(0)
+    g_pick = ctypes.c_int64(0)
+    rc = _lib.guber_merge_runs(
+        *tabs,
+        _ptr(ns, ctypes.c_int64), _ptr(bases, ctypes.c_int64), k, B,
+        _ptr(rungs, ctypes.c_int64), rungs.shape[0],
+        _ptr(skey, ctypes.c_uint64), _ptr(order, ctypes.c_int32),
+        _ptr(kh, ctypes.c_uint64), _ptr(hits, ctypes.c_int32),
+        _ptr(limit, ctypes.c_int32), _ptr(dur, ctypes.c_int32),
+        _ptr(algo, ctypes.c_int32), _ptr(gnp, ctypes.c_uint8),
+        _ptr(valid, ctypes.c_uint8), _ptr(gid, ctypes.c_int32),
+        _ptr(lead, ctypes.c_int32), _ptr(gkh, ctypes.c_uint64),
+        _ptr(gend, ctypes.c_int32), _ptr(gvalid, ctypes.c_uint8),
+        ctypes.byref(g_real), ctypes.byref(g_pick),
+    )
+    if rc != 0:
+        raise RuntimeError(f"guber_merge_runs failed: rc={rc}")
+    out = dict(
+        n=n, skey=skey, order=order, key_hash=kh, hits=hits,
+        limit=limit, duration=dur, algo=algo, gnp=gnp.view(bool),
+        valid=valid.view(bool), G_real=int(g_real.value),
+    )
+    if g_rungs is not None:
+        G = int(g_pick.value)
+        out.update(
+            G=G, group_id=gid, leader_pos=lead[:G],
+            group_key_hash=gkh[:G], group_end=gend[:G],
+            group_valid=gvalid[:G].view(bool),
+        )
+    else:
+        out.update(group_id=gid[:n], leader_pos=lead[:n])
+    return out
+
+
 def unflatten_resp(packed, order, counts, n: int, b_sub: int) -> np.ndarray:
     """[4, n] response columns from a mesh packed matrix
     ([n_shards, 4*b_sub + stats] int32): the native twin of

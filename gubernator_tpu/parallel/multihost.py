@@ -403,6 +403,50 @@ class MultiHostMeshEngine:
         assert self.is_leader
         return self.inner.decide_wait(handle)
 
+    def prep_run(self, fields: dict) -> dict:
+        """Leader-local arrival-time prep (serve/batcher.py): pure host
+        work, no collective — followers receive the already-sorted run
+        via decide_submit_presorted's lockstep message and never
+        re-sort, so the prep cost is paid once per cluster."""
+        assert self.is_leader
+        return self.inner.prep_run(fields)
+
+    def merge_prepped(self, runs):
+        """Leader-side merge of pre-sorted runs. Returns the FLAT
+        merged form (serve/prep.py) — deliberately not the padded
+        per-shard layout, because it doubles as the lockstep wire
+        format decide_submit_merged broadcasts; each process derives
+        its identical [n_shards, B_sub] layout locally."""
+        assert self.is_leader
+        from gubernator_tpu.serve.prep import merge_runs
+
+        return merge_runs(runs)
+
+    def decide_submit_merged(self, merged, now):
+        """Dispatch a merge_prepped batch across the lockstep fleet."""
+        return self.decide_submit_presorted(
+            merged["fields"], merged["skey"], merged["order"],
+            merged["counts"], now,
+        )
+
+    def decide_submit_presorted(self, fields, skey, order, counts, now):
+        """Merge-combine sibling of decide_submit: broadcasts the
+        SORTED batch (fields + sort keys + per-shard counts), so
+        followers skip the presort entirely and only issue the
+        identical jitted call. `order` stays leader-local — it exists
+        only to unpermute responses, which followers never fetch."""
+        assert self.is_leader
+        msg = {"kind": "decide_p", "skey": skey, "counts": counts,
+               "now": now}
+        msg.update(fields)
+        self._lockstep(msg)
+        try:
+            return self.inner.decide_submit_presorted(
+                fields, skey, order, counts, now
+            )
+        finally:
+            self._done()
+
     def update_globals(self, key_hash, limit, remaining, reset_time, is_over,
                        now=None):
         assert self.is_leader
@@ -496,6 +540,21 @@ class MultiHostMeshEngine:
                 # transfer per step (plus it would serialize the
                 # leader's fetch pipeline through follower acks)
                 self.inner.decide_submit(**msg)
+            elif kind == "decide_p":
+                # merge-combined batch: already sorted + clipped on the
+                # leader; order=None (identity) — the handle is
+                # discarded, responses are leader-only
+                self.inner.decide_submit_presorted(
+                    {
+                        k: msg[k]
+                        for k in ("key_hash", "hits", "limit",
+                                  "duration", "algo", "gnp")
+                    },
+                    msg["skey"],
+                    None,
+                    msg["counts"],
+                    msg["now"],
+                )
             elif kind == "reset":
                 self.inner.reset()
             elif kind == "upsert":

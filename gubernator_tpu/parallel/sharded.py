@@ -220,6 +220,7 @@ try:  # native radix presort with shard partitioning (guberhash.cc)
     )
     _prep_native = _hn.prep_sharded if _hn._HAS_PREP else None
 except (ImportError, AttributeError, OSError):  # pragma: no cover
+    _hn = None
     _presort_sharded = _np_presort_sharded
     _presort_sharded_grouped = _np_presort_sharded_grouped
     _prep_native = None
@@ -389,13 +390,38 @@ def pad_request_sharded(
     if not with_groups:
         return req, order, take_idx
 
-    # per-shard group structure with LOCAL indices (each shard's kernel
-    # sees only its own [B_sub] sub-batch); padding conventions come from
-    # the single source of truth, engine.build_groups, called per shard.
-    # Global group ids are contiguous in shard order (shard boundaries
-    # break groups), so shard s's groups are exactly
-    # gstarts[s]..gstarts[s+1] and its first group id IS gstarts[s].
-    from gubernator_tpu.core.engine import build_groups
+    groups = stack_shard_groups(
+        req.key_hash, gid_g, lp_g, gcounts, counts32, starts, n_shards,
+        B_sub, group_rung,
+    )
+    return req, order, take_idx, groups
+
+
+def stack_shard_groups(
+    req_kh: np.ndarray,
+    gid_g: np.ndarray,
+    lp_g: np.ndarray,
+    gcounts: np.ndarray,
+    counts32: np.ndarray,
+    starts: np.ndarray,
+    n_shards: int,
+    B_sub: int,
+    group_rung: Optional[int] = None,
+) -> BatchGroups:
+    """Per-shard group structure with LOCAL indices (each shard's kernel
+    sees only its own [B_sub] sub-batch); padding conventions come from
+    the single source of truth, engine.build_groups, called per shard.
+    Global group ids are contiguous in shard order (shard boundaries
+    break groups), so shard s's groups are exactly
+    gstarts[s]..gstarts[s+1] and its first group id IS gstarts[s].
+    Shared by the flush-time presort path (pad_request_sharded) and the
+    merge-combine path (MeshEngine.decide_submit_presorted) so the two
+    can never drift."""
+    from gubernator_tpu.core.engine import (
+        build_groups,
+        choose_bucket,
+        group_rungs,
+    )
 
     gstarts = np.zeros(n_shards + 1, np.int64)
     np.cumsum(gcounts, out=gstarts[1:])
@@ -416,7 +442,7 @@ def pad_request_sharded(
         cs = int(counts32[s])
         per_shard.append(
             build_groups(
-                req.key_hash[s],
+                req_kh[s],
                 gid_g[starts[s] : starts[s] + cs] - int(gstarts[s]),
                 lp_g[gstarts[s] : gstarts[s] + gc] - int(starts[s]),
                 gc,
@@ -425,10 +451,129 @@ def pad_request_sharded(
                 G_sub,
             )
         )
-    groups = BatchGroups(
+    return BatchGroups(
         *(np.stack(leaves) for leaves in zip(*per_shard))
     )
-    return req, order, take_idx, groups
+
+
+def sharded_sort_keys_np(
+    key_hash: np.ndarray, store_buckets: int, n_shards: int
+) -> np.ndarray:
+    """Composite host sort key of the sharded presort order —
+    (owner_shard | bucket | fingerprint), the same packing
+    _np_presort_sharded and guber_presort_sharded order by."""
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    kh = np.asarray(key_hash, np.uint64)
+    owner = owner_of_np(kh, n_shards)
+    bucket_bits = max(int(store_buckets).bit_length() - 1, 1)
+    return (
+        owner.astype(np.uint64) << np.uint64(32 + bucket_bits)
+    ) | group_sort_key_np(kh, store_buckets)
+
+
+def prep_run_sharded(
+    fields: dict, store_buckets: int, n_shards: int
+) -> dict:
+    """Arrival-time per-group prep for the mesh engine: presort one
+    group by (owner, bucket, fingerprint), clip fields to device
+    dtypes, and count rows per shard — a sorted run the flush-time
+    merge combine (serve/prep.py) stitches into one sharded batch.
+    One fused native call when built (guber_prep_run); the numpy
+    fallback below is bit-identical."""
+    from gubernator_tpu.core.engine import _gather_clip_sorted
+
+    if _hn is not None and getattr(_hn, "_HAS_PREP_RUN", False):
+        from gubernator_tpu.core.store import (
+            COUNTER_MAX,
+            MAX_DURATION_MS,
+            TIME_FLOOR,
+        )
+
+        return _hn.prep_run(
+            fields, store_buckets, n_shards, -COUNTER_MAX, COUNTER_MAX,
+            TIME_FLOOR, MAX_DURATION_MS,
+        )
+    kh = np.ascontiguousarray(fields["key_hash"], np.uint64)
+    n = kh.shape[0]
+    order, counts = _presort_sharded(kh, store_buckets, n_shards)
+    sorted_fields = _gather_clip_sorted(fields, order, n)
+    return dict(
+        n=n,
+        # elementwise in the key hash, so computed on the sorted hashes
+        skey=sharded_sort_keys_np(
+            sorted_fields["key_hash"], store_buckets, n_shards
+        ),
+        order=order,
+        counts=np.asarray(counts, np.int64),
+        fields=sorted_fields,
+    )
+
+
+def build_presorted_sharded(
+    sub_buckets: Sequence[int],
+    store_buckets: int,
+    n_shards: int,
+    fields: dict,
+    skey: np.ndarray,
+    counts: np.ndarray,
+):
+    """(req, take_idx, groups, B_sub) for an already-sorted sharded
+    batch — the merge-combine twin of pad_request_sharded
+    (with_groups=True), minus the argsort it no longer needs.
+    Byte-identical outputs are pinned by tests/test_prep_pipeline.py.
+    """
+    from gubernator_tpu.core.engine import choose_bucket
+
+    n = skey.shape[0]
+    counts32 = np.asarray(counts, np.int64)
+    starts = np.zeros(n_shards + 1, np.int64)
+    np.cumsum(counts32, out=starts[1:])
+    maxc = max(int(counts32.max()), 1)
+    if maxc > max(sub_buckets):
+        _warn_ladder_overflow(max(sub_buckets), maxc)
+    B_sub = choose_bucket(extend_ladder(sub_buckets, maxc), maxc)
+    # padded cell (s, j) reads merged sorted row starts[s]+min(j,
+    # count-1) — the same repeat-pad/clamp pad_request_sharded
+    # applies, but gathering from the sorted stream directly
+    # (sorted_x[src] == x[order][src] == x[idx])
+    j = np.arange(B_sub, dtype=np.int64)[None, :]
+    src = starts[:-1, None] + np.minimum(
+        j, np.maximum(counts32[:, None] - 1, 0)
+    )
+    np.clip(src, 0, max(n - 1, 0), out=src)
+    valid = j < counts32[:, None]
+    req = BatchRequest(
+        key_hash=fields["key_hash"][src],
+        hits=fields["hits"][src],
+        limit=fields["limit"][src],
+        duration=fields["duration"][src],
+        algo=fields["algo"][src],
+        gnp=fields["gnp"][src],
+        valid=valid,
+    )
+    # group structure straight off the sorted key stream (skey ties ==
+    # comp ties of _np_presort_sharded_grouped): one diff pass replaces
+    # the grouped argsort
+    is_leader = np.empty(n, bool)
+    is_leader[0] = True
+    np.not_equal(skey[1:], skey[:-1], out=is_leader[1:])
+    gid_g = np.cumsum(is_leader).astype(np.int32) - 1
+    lp_g = np.flatnonzero(is_leader).astype(np.int32)
+    bucket_bits = max(int(store_buckets).bit_length() - 1, 1)
+    g_owner = (skey[lp_g] >> np.uint64(32 + bucket_bits)).astype(
+        np.int64
+    )
+    gcounts = np.bincount(g_owner, minlength=n_shards).astype(np.int64)
+    groups = stack_shard_groups(
+        req.key_hash, gid_g, lp_g, gcounts, counts32, starts, n_shards,
+        B_sub,
+    )
+    shard_of_k = np.repeat(np.arange(n_shards, dtype=np.int64), counts32)
+    take_idx = shard_of_k * B_sub + (
+        np.arange(n, dtype=np.int64) - starts[shard_of_k]
+    )
+    return req, take_idx, groups, B_sub
 
 
 def _shard_sync_globals(
@@ -686,6 +831,73 @@ class MeshEngine:
             take_idx = take_idx.copy()
         # epoch captured at submit: a later submit may rebase before this
         # batch's wait (same contract as TpuEngine.decide_submit)
+        return (packed, order, take_idx, n, B_sub, self.clock.epoch)
+
+    def prep_run(self, fields: dict) -> dict:
+        """Arrival-time per-group prep (serve/batcher.py): see
+        prep_run_sharded."""
+        return prep_run_sharded(fields, self.config.slots, self.n)
+
+    def merge_prepped(self, runs):
+        """Merge pre-sorted per-group runs into one dispatch-ready
+        sharded batch (the submit thread's `merge` stage): a flat
+        fused native merge when available (serve/prep.py dispatches to
+        guber_merge_runs), then the per-shard [n_shards, B_sub] layout
+        + group structure via build_presorted_sharded. Output feeds
+        decide_submit_merged."""
+        from gubernator_tpu.serve.prep import merge_runs
+
+        m = merge_runs(runs)
+        req, take_idx, groups, B_sub = build_presorted_sharded(
+            self.sub_buckets, self.config.slots, self.n, m["fields"],
+            m["skey"], m["counts"],
+        )
+        return dict(
+            req=req, groups=groups, order=m["order"],
+            take_idx=take_idx, n=m["order"].shape[0], B_sub=B_sub,
+        )
+
+    def decide_submit_merged(self, merged: dict, now: int):
+        """Dispatch a merge_prepped batch (mesh): epoch bookkeeping +
+        the jitted shard_map call. Returns the standard decide_wait
+        handle."""
+        e_now = self._engine_now(now)
+        self.store, packed = self._step(
+            self.store, merged["req"], merged["groups"], e_now
+        )
+        return (
+            packed, merged["order"], merged["take_idx"], merged["n"],
+            merged["B_sub"], self.clock.epoch,
+        )
+
+    def decide_submit_presorted(
+        self,
+        fields: dict,
+        skey: np.ndarray,
+        order: Optional[np.ndarray],
+        counts: np.ndarray,
+        now: int,
+    ):
+        """Mesh sibling of TpuEngine.decide_submit_presorted: dispatch a
+        batch whose (owner, bucket, fingerprint) presort already
+        happened at arrival time. Slices the merged sorted stream into
+        contiguous per-shard sub-batches ([n_shards, B_sub] repeat-pad,
+        identical to pad_request_sharded's layout), derives the
+        per-shard duplicate-key group structure from the sorted key
+        stream in O(n), and dispatches. `order` may be None (identity)
+        for callers that discard the handle — the lockstep follower
+        path. Returns the standard decide_wait handle."""
+        n = skey.shape[0]
+        if n == 0:
+            return None
+        e_now = self._engine_now(now)
+        req, take_idx, groups, B_sub = build_presorted_sharded(
+            self.sub_buckets, self.config.slots, self.n, fields, skey,
+            counts,
+        )
+        if order is None:
+            order = np.arange(n, dtype=np.int32)
+        self.store, packed = self._step(self.store, req, groups, e_now)
         return (packed, order, take_idx, n, B_sub, self.clock.epoch)
 
     def decide_wait(

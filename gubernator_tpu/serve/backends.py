@@ -28,7 +28,12 @@ from __future__ import annotations
 from dataclasses import replace
 from typing import List, Optional, Sequence, Tuple
 
-from gubernator_tpu.api.types import RateLimitReq, RateLimitResp, Status
+from gubernator_tpu.api.types import (
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    resps_from_columns,
+)
 from gubernator_tpu.core.cache import LRUCache
 from gubernator_tpu.core.engine import TpuEngine
 from gubernator_tpu.core.oracle import get_rate_limit
@@ -114,6 +119,40 @@ class _ArrayOps:
             gnp=np.asarray(list(gnp), bool),
         )
 
+    def prep_group(self, fields: dict) -> dict:
+        """Arrival-time per-group prep (serve/batcher.py): presort +
+        clip one caller group on a prep-pool thread, so it sits in the
+        batcher queue as a sorted run the flush-time merge combine
+        stitches without re-sorting. `gnp` defaults to all-False like
+        decide_submit_arrays' flush path."""
+        if "gnp" not in fields:
+            import numpy as np
+
+            fields = dict(fields)
+            fields["gnp"] = np.zeros(fields["key_hash"].shape[0], bool)
+        return self.engine.prep_run(fields)
+
+    def prep_reqs(self, reqs, gnp) -> dict:
+        """prep_group for a request-object group: batch hashing +
+        array conversion first (the other half of the flush work that
+        moves to arrival time)."""
+        return self.prep_group(self.arrays_from_reqs(reqs, gnp))
+
+    def merge_prepped(self, runs):
+        """Merge the groups' pre-sorted runs into one dispatch-ready
+        batch (the submit thread's `merge` stage; engine-specific
+        layout)."""
+        return self.engine.merge_prepped(runs)
+
+    def decide_submit_merged(self, merged, now: Optional[int] = None):
+        """Dispatch one merge_prepped batch. Same handle contract as
+        decide_submit_arrays; fetch with decide_wait_arrays."""
+        from gubernator_tpu.api.types import millisecond_now
+
+        if now is None:
+            now = millisecond_now()
+        return self.engine.decide_submit_merged(merged, now)
+
     def decide_submit_arrays(self, fields: dict, now: Optional[int] = None):
         from gubernator_tpu.api.types import millisecond_now
 
@@ -134,15 +173,7 @@ class _ArrayOps:
 
     @staticmethod
     def resps_from_arrays(status, limit, remaining, reset):
-        return [
-            RateLimitResp(
-                status=Status(int(status[i])),
-                limit=int(limit[i]),
-                remaining=int(remaining[i]),
-                reset_time=int(reset[i]),
-            )
-            for i in range(len(status))
-        ]
+        return resps_from_columns(status, limit, remaining, reset)
 
 
 class TpuBackend(_ArrayOps):
@@ -210,6 +241,14 @@ class MeshBackend(_ArrayOps):
             self.decide_wait = None
             self.decide_submit_arrays = None
             self.decide_wait_arrays = None
+        if not hasattr(engine, "prep_run"):
+            # likewise for the arrival-time prep surface (r9): without
+            # engine-side prep_run/merge_prepped the batcher keeps the
+            # flush-time concat+argsort path
+            self.prep_group = None
+            self.prep_reqs = None
+            self.merge_prepped = None
+            self.decide_submit_merged = None
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now

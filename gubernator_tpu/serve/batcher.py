@@ -34,20 +34,48 @@ flush could not submit anyway — building the deep batches that amortize
 per-batch fixed device costs (the big-store full-table writeback pass).
 Idle flush semantics are unchanged: the hold predicate is False whenever
 a slot is free.
+
+Arrival-time prep (r9, GUBER_PREP_AT_ARRIVAL): on array-capable device
+backends, each caller group's host prep — request->array conversion +
+batch hashing (object groups), device-dtype clipping, and the
+ownership/bucket PRE-SORT — is kicked onto a small prep pool the moment
+the group is enqueued, overlapping the queue wait it was going to pay
+anyway (batch_queue measured 16.7ms mean at the r7 profile while
+submit_host burned 32.8ms serialized). By flush time the batch is a set
+of sorted runs; the submit thread k-way MERGES them (serve/prep.py,
+O(n log k)) and dispatches — the only serialized work left. The
+submit-thread interior is stage-attributed as prep/merge/dispatch
+(serve/stages.py); flush-time prep remains as the fallback for
+un-prepped groups and as the whole path when the knob is off
+(the BENCH_SUBMIT_r9.json A/B baseline).
 """
 
 from __future__ import annotations
 
 import asyncio
 import concurrent.futures
+import os
 import time
 from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
 
 from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
 from gubernator_tpu.serve import metrics
 from gubernator_tpu.serve.aio import collect_batch
 from gubernator_tpu.serve.faults import FAULTS, FaultError
 from gubernator_tpu.serve.stages import STAGES
+
+
+def _prep_result(prep: "concurrent.futures.Future"):
+    """Resolve an arrival-prep future on the submit thread. A pool
+    shutdown (stop() racing a flush) surfaces as CancelledError, which
+    is a BaseException the pipelined submit's failure guard would not
+    convert to per-item errors — normalize it here."""
+    try:
+        return prep.result()
+    except concurrent.futures.CancelledError:
+        raise RuntimeError("prep cancelled (batcher stopping)") from None
 
 
 def _item_weight(item) -> int:
@@ -66,9 +94,9 @@ class DeviceBatcher:
         batch_limit: int = 1000,
         fetch_depth: Optional[int] = None,
         deep_batch: bool = False,
+        prep_at_arrival: Optional[bool] = None,
+        prep_threads: Optional[int] = None,
     ):
-        import os
-
         self.backend = backend
         self.batch_wait = batch_wait
         self.batch_limit = batch_limit
@@ -135,6 +163,41 @@ class DeviceBatcher:
         # collect_batch — possibly parked in a batch_wait straggler
         # window — but not yet flushed), and _flushing (mid-flush).
         self._inline = bool(getattr(backend, "inline_decide", False))
+        # arrival-time prep (r9): needs the backend's prep surface
+        # (engine-side presort + merge-combined dispatch). The flag is a
+        # plain attribute read per enqueue/flush so the submit profiler
+        # can A/B it at runtime (scripts/profile_submit.py).
+        self._prep_ok = (
+            callable(getattr(backend, "prep_group", None))
+            and getattr(backend, "merge_prepped", None) is not None
+            and getattr(backend, "decide_submit_merged", None) is not None
+            and getattr(backend, "decide_submit_arrays", None) is not None
+        )
+        if prep_at_arrival is None:
+            prep_at_arrival = os.environ.get(
+                "GUBER_PREP_AT_ARRIVAL", "1"
+            ).lower() not in ("0", "false", "no", "off")
+        self.prep_at_arrival = bool(prep_at_arrival)
+        if prep_threads is None:
+            prep_threads = int(os.environ.get("GUBER_PREP_THREADS", "0"))
+        if prep_threads <= 0:
+            # auto: leave a core for the serving loop — a prep pool as
+            # wide as the box measurably thrashes small hosts (2-core
+            # A/B: pool=2 cost 6% decisions/s vs pool=1 at parity; a
+            # group's prep budget is its whole batch_queue wait, so
+            # narrow pools keep up easily)
+            prep_threads = max(1, min(4, (os.cpu_count() or 2) - 1))
+        self.prep_threads = prep_threads
+        # workers spawn on first submit, so an idle/disabled prep path
+        # costs no threads
+        self._prep_pool = (
+            concurrent.futures.ThreadPoolExecutor(
+                max_workers=self.prep_threads,
+                thread_name_prefix="guber-prep",
+            )
+            if self._prep_ok
+            else None
+        )
         self._flushing = False
         self._live_batch: List = []
         # one-slot park for a group that would have pushed the previous
@@ -159,6 +222,12 @@ class DeviceBatcher:
         self._pending.clear()
         self._submit_pool.shutdown(wait=False)
         self._fetch_pool.shutdown(wait=False)
+        if self._prep_pool is not None:
+            # cancel queued-but-unstarted arrival preps; running ones
+            # finish on their own (their results are simply dropped —
+            # every caller future was already failed above, so no
+            # future is stranded waiting on a prep)
+            self._prep_pool.shutdown(wait=False, cancel_futures=True)
 
     async def drain(self) -> None:
         """Graceful-drain wait: resolves when no queued, collected,
@@ -214,6 +283,8 @@ class DeviceBatcher:
         # time. Groups are flattened at flush and responses sliced back.
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
+        reqs_l = list(reqs)
+        gnp_l = [bool(g) for g in gnp]
         # the second-to-last slot of EVERY queue tuple is the enqueue
         # timestamp — the start of the batch_queue stage (serve/stages).
         # None = unattributed: per-frame stages must count ONLY groups
@@ -221,10 +292,28 @@ class DeviceBatcher:
         # numerator (stage seconds) outgrows its denominator (frame
         # e2e) under direct gRPC/HTTP/peer traffic
         self._queue.put_nowait(
-            ("decide", list(reqs), [bool(g) for g in gnp],
+            ("decide", reqs_l, gnp_l,
+             self._kick_prep("prep_reqs", reqs_l, gnp_l),
              time.monotonic() if frame else None, fut)
         )
         return await fut
+
+    def _kick_prep(self, method: str, *args):
+        """Arrival-time prep kick: schedule this group's conversion +
+        presort on the prep pool NOW, so it overlaps the group's own
+        queue wait. Returns the prep future to ride in the queue tuple,
+        or None when arrival prep is off/unsupported (the flush-time
+        fallback preps it on the submit thread instead). `method` is
+        resolved lazily — backends without the prep surface must not
+        pay (or fail) an attribute lookup per enqueue."""
+        if not (self._prep_ok and self.prep_at_arrival):
+            return None
+        try:
+            return self._prep_pool.submit(
+                getattr(self.backend, method), *args
+            )
+        except RuntimeError:  # pool shut down: stop() raced the caller
+            return None
 
     async def decide_arrays(self, fields: dict, frame: bool = True):
         """Array-group decide — the edge bridge's pre-hashed fast path.
@@ -236,10 +325,13 @@ class DeviceBatcher:
         exposing decide_submit_arrays (the device backends).
         `frame=False` keeps a group out of the per-frame stage clock —
         a chunked frame flags only its first chunk, so one frame
-        contributes one batch_queue/device span, not one per chunk."""
-        if fields["key_hash"].shape[0] == 0:
-            import numpy as np
+        contributes one batch_queue/device span, not one per chunk.
 
+        Empty-group contract (pinned by tests/test_prep_pipeline.py):
+        a zero-row `key_hash` resolves immediately to four EMPTY
+        int64 arrays — the canonical wire dtype, regardless of the
+        narrower dtypes a real device batch returns."""
+        if fields["key_hash"].shape[0] == 0:
             z = np.empty(0, np.int64)
             return z, z, z, z
         if self._closed:
@@ -248,6 +340,7 @@ class DeviceBatcher:
         fut = loop.create_future()
         self._queue.put_nowait(
             ("decide_arrays", fields,
+             self._kick_prep("prep_group", fields),
              time.monotonic() if frame else None, fut)
         )
         return await fut
@@ -349,14 +442,21 @@ class DeviceBatcher:
 
         if not decide_items:
             return
+        if self._prep_ok and self.prep_at_arrival:
+            # merge-combine path (r9): every group is (or can be) a
+            # pre-sorted run; the submit thread merges runs instead of
+            # re-sorting the flattened batch. Object-only batches ride
+            # it too — their conversion/hashing happened at arrival.
+            await self._flush_merged(decide_items, t_collect)
+            return
         if any(b[0] == "decide_arrays" for b in decide_items):
             # mixed/array batch: flatten everything to dense arrays and
             # take the array submit path (bridge gates array groups to
             # array-capable backends, so decide_submit_arrays exists)
             await self._flush_arrays(decide_items, t_collect)
             return
-        reqs = [r for _, rs, _, _, _ in decide_items for r in rs]
-        gnp = [g for _, _, gs, _, _ in decide_items for g in gs]
+        reqs = [r for it in decide_items for r in it[1]]
+        gnp = [g for it in decide_items for g in it[2]]
         t0 = time.monotonic()
         submit = getattr(self.backend, "decide_submit", None)
         if submit is None:
@@ -447,6 +547,61 @@ class DeviceBatcher:
         # is the same list object _run handed to _flush.
         self._live_batch.clear()
 
+    def _prep_of(self, it):
+        """The arrival-prep future riding a decide queue tuple (None =
+        un-prepped; flush preps it on the submit thread)."""
+        return it[3] if it[0] == "decide" else it[2]
+
+    async def _flush_merged(self, decide_items, t_collect) -> None:
+        """Merge-combine flush (r9): resolve every group's pre-sorted
+        run (arrival prep result, or flush-time prep for stragglers),
+        k-way merge the runs into one sorted batch, and dispatch — no
+        concat + full argsort anywhere. The submit-thread interior is
+        stage-attributed as prep (fallback prep + waiting out unfinished
+        arrival preps), merge, and dispatch; with arrival prep keeping
+        up, prep ~ 0 and merge+dispatch are all that remains serialized.
+        Runs inside submit_call so a conversion error fails THIS batch's
+        callers, never the flusher task."""
+        lens = [
+            it[1]["key_hash"].shape[0]
+            if it[0] == "decide_arrays"
+            else len(it[1])
+            for it in decide_items
+        ]
+
+        def submit_call():
+            t0 = time.monotonic()
+            runs = []
+            for it in decide_items:
+                p = self._prep_of(it)
+                if p is not None:
+                    runs.append(_prep_result(p))
+                elif it[0] == "decide":
+                    runs.append(
+                        self.backend.prep_reqs(
+                            it[1], [bool(g) for g in it[2]]
+                        )
+                    )
+                else:
+                    runs.append(self.backend.prep_group(it[1]))
+            t1 = time.monotonic()
+            merged = self.backend.merge_prepped(runs)
+            t2 = time.monotonic()
+            handle = self.backend.decide_submit_merged(merged)
+            t3 = time.monotonic()
+            STAGES.add("prep", t1 - t0)
+            STAGES.add("merge", t2 - t1)
+            STAGES.add("dispatch", t3 - t2)
+            return handle
+
+        await self._submit_pipelined(
+            submit_call,
+            decide_items,
+            lambda handle, submit_s: self._finish_arrays(
+                handle, decide_items, lens, submit_s, t_collect
+            ),
+        )
+
     async def _flush_arrays(self, decide_items, t_collect) -> None:
         """Array-path sibling of the pipelined branch in _flush: convert
         request-object groups, concatenate all groups into one dense
@@ -465,8 +620,12 @@ class DeviceBatcher:
         ]
 
         def submit_call():
-            import numpy as np
-
+            # flush-time prep baseline: record the same prep/dispatch
+            # sub-stages the merged path does (merge has no analogue —
+            # the full argsort hides inside decide_submit_arrays'
+            # dispatch), so the BENCH_SUBMIT_r9 A/B compares the same
+            # submit-thread interior either way
+            t0 = time.monotonic()
             parts = []
             for it in decide_items:
                 if it[0] == "decide":
@@ -489,7 +648,12 @@ class DeviceBatcher:
                 )
                 for k in self.backend.ARRAY_FIELDS
             }
-            return self.backend.decide_submit_arrays(fields)
+            t1 = time.monotonic()
+            handle = self.backend.decide_submit_arrays(fields)
+            t2 = time.monotonic()
+            STAGES.add("prep", t1 - t0)
+            STAGES.add("dispatch", t2 - t1)
+            return handle
 
         await self._submit_pipelined(
             submit_call,
@@ -585,7 +749,8 @@ class DeviceBatcher:
         # future request with no error surfaced). Responses come back
         # flat in flatten order; slice one span per caller group.
         k = 0
-        for _, rs, _, _, fut in decide_items:
+        for it in decide_items:
+            rs, fut = it[1], it[-1]
             span = resps[k : k + len(rs)]
             k += len(rs)
             if not fut.done():

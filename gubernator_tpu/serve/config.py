@@ -192,6 +192,26 @@ class ServerConfig:
     # are untouched, so latency under light load does not change; under
     # saturation per-request latency grows toward one deep-batch period.
     device_deep_batch: bool = False
+    # Arrival-time host prep (r9, GUBER_PREP_AT_ARRIVAL): convert +
+    # hash + ownership/bucket-presort each caller group on a small prep
+    # pool WHEN IT IS ENQUEUED, so groups sit in the device queue as
+    # sorted runs and the submit thread only k-way MERGES them before
+    # dispatch (O(n log k), serve/prep.py) — instead of paying
+    # flatten + concat + full argsort serialized at flush. Only takes
+    # effect on array-capable device backends; decisions are
+    # byte-identical either way (tests/test_prep_pipeline.py).
+    # GUBER_PREP_AT_ARRIVAL=0 restores flush-time prep, the pre-r9
+    # behavior and the A/B baseline of BENCH_SUBMIT_r9.json.
+    # None defers to DeviceBatcher, the single owner of the env read
+    # (same contract as device_fetch_depth / GUBER_FETCH_DEPTH below);
+    # library embedders may pin True/False here instead.
+    prep_at_arrival: Optional[bool] = None
+    # Python prep-pool width. 0 = defer to DeviceBatcher, which owns
+    # the GUBER_PREP_THREADS env read (auto default: min(4, cores-1) —
+    # leave a core for the serving loop). The same env var also sizes
+    # the NATIVE prep pool inside libguberhash (guberhash.cc, default
+    # = cores); one knob governs both tiers of host prep parallelism.
+    prep_threads: int = 0
     # in-flight device batches the batcher keeps before stalling submits.
     # 2 suffices co-located (PCIe fetch ~0.1ms); raise toward ~16 when
     # the accelerator sits behind a high-latency link (fetches pipeline,
@@ -323,6 +343,8 @@ class ServerConfig:
                 "GUBER_DEVICE_DEEP_BATCH is a device-batching mode; the "
                 "exact backend decides inline and cannot use it"
             )
+        if self.prep_threads < 0:
+            raise ValueError("GUBER_PREP_THREADS must be >= 0")
         if self.store_mib < 0 or self.store_target_keys < 0:
             raise ValueError(
                 "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
@@ -477,6 +499,11 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         ),
         device_deep_batch=_get(env, "GUBER_DEVICE_DEEP_BATCH")
         in ("1", "true", "yes"),
+        # prep_at_arrival / prep_threads deliberately NOT resolved
+        # here: their None/0 defaults defer to DeviceBatcher, the
+        # single owner of the GUBER_PREP_AT_ARRIVAL /
+        # GUBER_PREP_THREADS env reads (batcher.py __init__) — the
+        # same contract as device_fetch_depth below
         # device_fetch_depth deliberately NOT resolved here: the field's
         # None default defers to DeviceBatcher, the single owner of the
         # GUBER_FETCH_DEPTH env read (batcher.py __init__)

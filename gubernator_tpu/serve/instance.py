@@ -63,6 +63,8 @@ class Instance:
             batch_limit=conf.device_batch_limit,
             fetch_depth=getattr(conf, "device_fetch_depth", None),
             deep_batch=getattr(conf, "device_deep_batch", False),
+            prep_at_arrival=getattr(conf, "prep_at_arrival", None),
+            prep_threads=getattr(conf, "prep_threads", None) or None,
         )
         self.global_mgr = GlobalManager(conf.behaviors, self)
         self.picker = ConsistentHashPicker()

@@ -258,9 +258,12 @@ class Server:
         if has_prep:
             log.info(
                 "native prep: %d thread(s) (GUBER_PREP_THREADS), "
-                "writeback=%s (GUBER_WRITEBACK)",
+                "writeback=%s (GUBER_WRITEBACK), arrival prep %s "
+                "(GUBER_PREP_AT_ARRIVAL)",
                 _hn.prep_threads(),
                 os.environ.get("GUBER_WRITEBACK", "auto"),
+                "on" if self.instance.batcher.prep_at_arrival
+                and self.instance.batcher._prep_ok else "off",
             )
         else:
             log.info(

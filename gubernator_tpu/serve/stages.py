@@ -35,8 +35,24 @@ Stages form two families:
   recorded once per device batch. They do NOT tile frame e2e (one
   batch serves many frames) but attribute the `device` span's
   interior: host submit (presort + dispatch) vs device fetch wait.
+  The r9 host-prep pipeline splits submit_host's interior further:
+  prep + merge + dispatch tile the submit_call body (submit_host
+  additionally includes the submit-executor queue wait, so it can
+  exceed their sum). None of these enter per-frame coverage — the
+  r7 contract (frame-flagged groups only) is untouched.
 
     submit_host      decide_submit* call on the submit thread
+                     (admission -> handle, incl. executor queueing)
+    prep             submit-thread group prep: flush-time fallback
+                     conversion/presort of un-prepped groups, plus
+                     waiting out arrival preps that hadn't finished
+                     (~0 when GUBER_PREP_AT_ARRIVAL keeps up)
+    merge            k-way merge of the groups' pre-sorted runs into
+                     one sorted batch (serve/prep.py); absent on the
+                     flush-time baseline path, whose full argsort
+                     hides inside dispatch
+    dispatch         backend decide_submit_presorted/_arrays call:
+                     pad + group-derive + device dispatch
     fetch_wait       decide_wait* span on the fetch pool
 
 - **per-call stages** (`PER_CALL`): recorded once per
@@ -65,7 +81,7 @@ PER_FRAME = (
     "device",
     "encode",
 )
-PER_BATCH = ("submit_host", "fetch_wait")
+PER_BATCH = ("submit_host", "prep", "merge", "dispatch", "fetch_wait")
 PER_CALL = ("instance_route",)
 
 
