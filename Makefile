@@ -1,5 +1,5 @@
 .PHONY: all native proto test bench readme readme-check profile-stages \
-	profile-submit chaos clean
+	profile-submit profile-shed chaos clean
 
 all: native proto
 
@@ -46,6 +46,20 @@ SUBMIT_OUT ?= BENCH_SUBMIT.json
 profile-submit: native
 	python scripts/profile_submit.py --seconds $(SUBMIT_SECONDS) \
 	  --rounds $(ROUNDS) --json $(SUBMIT_OUT)
+
+# over-limit shed cache A/B (r10): the bench_serving shed workload
+# through the compiled edge door with instance.shed flipped between
+# interleaved rounds, one series per over-limit share; reports paired
+# per-round speedups + monotonicity. Overridable:
+# make profile-shed SHED_SECONDS=5 SHED_ROUNDS=8 SHED_OUT=x.json
+SHED_SECONDS ?= 3
+SHED_ROUNDS ?= 6
+SHED_SHARES ?= 0.0,0.5,0.9
+SHED_OUT ?= BENCH_SHED.json
+profile-shed: native
+	python scripts/profile_shed.py --seconds $(SHED_SECONDS) \
+	  --rounds $(SHED_ROUNDS) --shares $(SHED_SHARES) \
+	  --json $(SHED_OUT)
 
 # chaos soak (r8): 3-node cluster under load with a peer killed +
 # restarted mid-run and GUBER_FAULT_SPEC injection active; asserts

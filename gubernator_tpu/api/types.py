@@ -126,6 +126,20 @@ def millisecond_now() -> int:
     return time.time_ns() // 1_000_000
 
 
+def over_limit_resp(limit: int, reset_time: int) -> RateLimitResp:
+    """The frozen token-bucket refusal: status OVER_LIMIT, remaining 0.
+    This is the exact response an existing zero-remaining token window
+    returns for every hit-carrying request until it expires (the
+    verdict the over-limit shed cache serves host-side,
+    serve/shedcache.py)."""
+    return RateLimitResp(
+        status=Status.OVER_LIMIT,
+        limit=limit,
+        remaining=0,
+        reset_time=reset_time,
+    )
+
+
 def resps_from_columns(status, limit, remaining, reset) -> List[RateLimitResp]:
     """RateLimitResp list from four parallel numpy response columns —
     the single device-array -> object seam (engine response fetch,

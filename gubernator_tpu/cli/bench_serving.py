@@ -322,6 +322,160 @@ def run_zipf10m(args) -> int:
     return 0
 
 
+def run_shed(args) -> int:
+    """Over-limit-heavy serving scenario (r10): the shed cache's home
+    turf, through the SHIPPED boot path.
+
+    Boots env knobs -> config_from_env (GUBER_SHED_CACHE honored and
+    recorded) -> make_backend -> Instance, then drives token-bucket
+    traffic whose OVER-LIMIT SHARE is controlled per round: a hot pool
+    of limit-1 keys (over limit from their second hit, frozen for the
+    whole run) mixed with never-over keys at the round's target ratio.
+    Each round reports measured over-limit share, decisions/s, and the
+    shed cache's hit rate — the skew ladder `make profile-shed` A/Bs
+    ON vs OFF over the edge door (BENCH_SHED_r10.json).
+    """
+    import asyncio
+    import os
+
+    from gubernator_tpu.api.types import RateLimitReq, Status
+    from gubernator_tpu.serve.config import config_from_env
+    from gubernator_tpu.serve.instance import Instance
+    from gubernator_tpu.serve.server import make_backend
+
+    if args.backend != "exact":
+        import jax
+
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            str(_compile_cache_dir().resolve()),
+        )
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", 0.0
+        )
+
+    env = dict(os.environ)
+    env.setdefault("GUBER_BACKEND", args.backend)
+    # a syntactically-valid self address: the in-process instance never
+    # dials itself, but the ring refuses port 0 at connect()
+    env.setdefault("GUBER_GRPC_ADDRESS", "127.0.0.1:19099")
+    conf = config_from_env(env)
+    backend = make_backend(conf)
+    shares = [
+        float(s) for s in args.shed_shares.split(",") if s.strip()
+    ]
+    rows = []
+
+    async def run_rounds():
+        from gubernator_tpu.api.types import PeerInfo
+
+        warmup = getattr(backend, "warmup", None)
+        if warmup is not None:
+            print("warmup (ladder compiles)...", file=sys.stderr)
+            await asyncio.to_thread(warmup)
+        inst = Instance(conf, backend)
+        inst.start()
+        await inst.set_peers(
+            [PeerInfo(address=conf.resolved_advertise(), is_owner=True)]
+        )
+        try:
+            HOT, COLD, GROUP = 512, 4096, 256
+
+            def batch_for(share: float, w: int, i: int):
+                cut = int(share * GROUP)
+                reqs = []
+                for j in range(GROUP):
+                    if j < cut:
+                        k, limit = f"h{(i * 31 + j) % HOT}", 1
+                    else:
+                        k, limit = (
+                            f"c{(w * 7919 + i * GROUP + j) % COLD}",
+                            1_000_000_000,
+                        )
+                    reqs.append(
+                        RateLimitReq(
+                            name="shed", unique_key=k, hits=1,
+                            limit=limit, duration=600_000,
+                        )
+                    )
+                return reqs
+
+            for share in shares:
+                # warm pass freezes the hot pool over limit
+                for i in range(4):
+                    await inst.get_rate_limits(batch_for(1.0, 0, i))
+                if inst.shed is not None:
+                    inst.shed.reset_counters()
+                stop_at = time.monotonic() + args.seconds
+                done = over = 0
+
+                async def worker(w: int):
+                    nonlocal done, over
+                    i = 0
+                    while time.monotonic() < stop_at:
+                        resps = await inst.get_rate_limits(
+                            batch_for(share, w, i)
+                        )
+                        done += len(resps)
+                        over += sum(
+                            1 for r in resps
+                            if r.status == Status.OVER_LIMIT
+                        )
+                        i += 1
+
+                t0 = time.monotonic()
+                await asyncio.gather(*[worker(w) for w in range(8)])
+                elapsed = time.monotonic() - t0
+                shed_stats = (
+                    inst.shed.stats() if inst.shed is not None else None
+                )
+                r = dict(
+                    metric="shed_overlimit_serving",
+                    target_over_limit_share=share,
+                    over_limit_share=round(over / done, 4) if done else 0,
+                    decisions_per_sec=round(done / elapsed, 1),
+                    seconds=round(elapsed, 3),
+                    shed=shed_stats,
+                )
+                print(
+                    f"share {share:.2f}: "
+                    f"{r['decisions_per_sec']:>12,.0f} dec/s  "
+                    f"(over-limit {r['over_limit_share']:.2f}, shed "
+                    f"hit-rate "
+                    f"{shed_stats['hit_rate'] if shed_stats else '-'}"
+                    f")",
+                    file=sys.stderr,
+                )
+                rows.append(r)
+        finally:
+            await inst.stop()
+
+    asyncio.run(run_rounds())
+    doc = dict(
+        scenario="shed_overlimit",
+        backend=conf.backend,
+        served_via=(
+            "config_from_env -> make_backend -> Instance "
+            "(instance-tier shed screen); the bridge-tier A/B lives "
+            "in scripts/profile_shed.py"
+        ),
+        env_knobs={
+            "GUBER_BACKEND": conf.backend,
+            "GUBER_SHED_CACHE": env.get("GUBER_SHED_CACHE", "1"),
+            "GUBER_SHED_CACHE_KEYS": env.get(
+                "GUBER_SHED_CACHE_KEYS", "<default>"
+            ),
+            "GUBER_PREP_AT_ARRIVAL": env.get(
+                "GUBER_PREP_AT_ARRIVAL", "1"
+            ),
+        },
+        rows=rows,
+    )
+    if args.json:
+        print(json.dumps(doc))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="serving benchmarks")
     parser.add_argument("--backend", default="exact")
@@ -331,10 +485,19 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--scenario",
         default="cluster",
-        choices=["cluster", "zipf10m"],
+        choices=["cluster", "zipf10m", "shed"],
         help="cluster = the reference benchmark suite over localhost "
         "gRPC; zipf10m = BASELINE config 4 through the shipped serving "
-        "config (deep-batch ladder, GUBER_STORE_MIB-sized store)",
+        "config (deep-batch ladder, GUBER_STORE_MIB-sized store); "
+        "shed = over-limit-heavy skew ladder through the shipped boot "
+        "path (the r10 shed cache's workload; GUBER_SHED_CACHE "
+        "honored and recorded, over-limit share reported per round)",
+    )
+    parser.add_argument(
+        "--shed-shares",
+        default="0.0,0.3,0.6,0.9",
+        help="shed scenario: comma list of target over-limit traffic "
+        "shares, one measurement round each",
     )
     parser.add_argument(
         "--depths",
@@ -414,6 +577,15 @@ def main(argv=None) -> int:
         import os
 
         os.environ["GUBER_PREP_AT_ARRIVAL"] = args.prep_at_arrival
+    if args.scenario == "shed":
+        if args.backend == "exact":
+            print(
+                "shed is a device scenario by default: using "
+                "--backend tpu (pass GUBER_BACKEND=exact to force)",
+                file=sys.stderr,
+            )
+            args.backend = "tpu"
+        return run_shed(args)
     if args.scenario == "zipf10m":
         if args.backend == "exact":
             # config 4 is a device scenario (the exact backend decides

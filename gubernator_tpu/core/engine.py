@@ -632,6 +632,10 @@ class TpuEngine:
             store = jax.device_put(store, device)
         self.store: Store = store
         self.stats = EngineStats()
+        # bumped by every reset(): the store-wipe epoch the over-limit
+        # shed cache checks so a clock-jump reset (or warmup's cleanup)
+        # invalidates every cached verdict (serve/shedcache.py)
+        self.reset_generation = 0
 
     # -- public API ---------------------------------------------------------
 
@@ -949,6 +953,7 @@ class TpuEngine:
         if self.device is not None:
             store = jax.device_put(store, self.device)
         self.store = store
+        self.reset_generation += 1
 
     def _bucket(self, n: int) -> int:
         return choose_bucket(self.buckets, n)

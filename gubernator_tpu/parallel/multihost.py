@@ -350,6 +350,13 @@ class MultiHostMeshEngine:
     def stats(self):
         return self.inner.stats
 
+    @property
+    def reset_generation(self):
+        # store-wipe epoch for the over-limit shed cache; follower
+        # stores reset in lockstep with the leader's, so the leader's
+        # counter is authoritative for the whole mesh
+        return self.inner.reset_generation
+
     # -- leader API ---------------------------------------------------------
 
     def _lockstep(self, msg: dict) -> None:

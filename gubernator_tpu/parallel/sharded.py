@@ -716,6 +716,9 @@ class MeshEngine:
         self.sub_buckets = sub_batch_ladder(self.buckets)
         self.clock = EpochClock()
         self.stats = EngineStats()
+        # store-wipe epoch for the over-limit shed cache (see
+        # core/engine.py reset_generation)
+        self.reset_generation = 0
 
         Ps = P(self.axes)  # leading dim over all mesh axes, host-major
         sharding = NamedSharding(self.mesh, Ps)
@@ -775,6 +778,7 @@ class MeshEngine:
 
     def reset(self) -> None:
         self.store = self._fresh_store()
+        self.reset_generation += 1
 
     def _engine_now(self, now: int) -> np.int32:
         e, delta, reset_required = self.clock.advance(now)

@@ -90,6 +90,12 @@ class ExactBackend:
         s = self.cache.stats()
         return dict(size=s.size, hit=s.hit, miss=s.miss)
 
+    def shed_generation(self) -> int:
+        """Store-wipe epoch for the over-limit shed cache: the host LRU
+        never wholesale-resets, so cached verdicts only die by their
+        own expiry/purge rules."""
+        return 0
+
 
 class _ArrayOps:
     """Array-level decide surface shared by the device backends.
@@ -174,6 +180,12 @@ class _ArrayOps:
     @staticmethod
     def resps_from_arrays(status, limit, remaining, reset):
         return resps_from_columns(status, limit, remaining, reset)
+
+    def shed_generation(self) -> int:
+        """Engine store-wipe epoch (core/engine.py reset_generation):
+        the over-limit shed cache clears itself whenever this moves, so
+        a clock-jump store reset can never leave stale host verdicts."""
+        return self.engine.reset_generation
 
 
 class TpuBackend(_ArrayOps):

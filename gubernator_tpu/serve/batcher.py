@@ -424,19 +424,35 @@ class DeviceBatcher:
                 STAGES.add("batch_queue", t_collect - it[-2])
 
         inline = self._inline
-        for _, updates, _t_enq, fut in global_items:
+        if global_items:
+            # coalesced install (r10): ONE backend call — and one
+            # to_thread hop — per flush batch instead of one per caller
+            # group. Safe to concatenate: installs are last-writer-wins
+            # upserts applied in list order, identical to the former
+            # sequential per-group calls; the total is bounded by
+            # batch_limit (collect_batch weighs update rows like decide
+            # rows), which config.validate pins under the engine's
+            # bucket ladder. Per-caller futures still resolve/fail
+            # individually.
+            all_updates = [
+                u for _, updates, _t_enq, _fut in global_items
+                for u in updates
+            ]
             try:
                 if inline:
-                    self.backend.update_globals(updates)
+                    self.backend.update_globals(all_updates)
                 else:
                     await asyncio.to_thread(
-                        self.backend.update_globals, updates
+                        self.backend.update_globals, all_updates
                     )
-                if not fut.done():
-                    fut.set_result(None)
             except Exception as e:
-                if not fut.done():
-                    fut.set_exception(e)
+                for _, _updates, _t_enq, fut in global_items:
+                    if not fut.done():
+                        fut.set_exception(e)
+            else:
+                for _, _updates, _t_enq, fut in global_items:
+                    if not fut.done():
+                        fut.set_result(None)
             # a cancel mid-call propagates to _run's handler, which fails
             # this and every remaining item in the batch
 

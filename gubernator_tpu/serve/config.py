@@ -212,6 +212,19 @@ class ServerConfig:
     # the NATIVE prep pool inside libguberhash (guberhash.cc, default
     # = cores); one knob governs both tiers of host prep parallelism.
     prep_threads: int = 0
+    # Over-limit shed cache (r10, serve/shedcache.py): a bounded host
+    # LRU of frozen token-bucket over-limit verdicts consulted BEFORE a
+    # request enters the batcher — at the instance tier (gRPC/HTTP/
+    # peer/owner-forwarded traffic) and at the edge bridge (pre-hashed
+    # and folded string frames). Shed is gated to provably
+    # byte-identical cases (token bucket, hits > 0, matching limit/
+    # duration, now < reset_time); invalidation is device-authoritative
+    # (entries expire at reset_time, GLOBAL installs purge their keys,
+    # engine store resets clear everything). GUBER_SHED_CACHE=0
+    # disables; GUBER_SHED_CACHE_KEYS bounds the LRU (footprint linted
+    # at boot like the store sizing pass).
+    shed_cache: bool = True
+    shed_cache_keys: int = 1 << 16
     # in-flight device batches the batcher keeps before stalling submits.
     # 2 suffices co-located (PCIe fetch ~0.1ms); raise toward ~16 when
     # the accelerator sits behind a high-latency link (fetches pipeline,
@@ -345,6 +358,8 @@ class ServerConfig:
             )
         if self.prep_threads < 0:
             raise ValueError("GUBER_PREP_THREADS must be >= 0")
+        if self.shed_cache_keys < 0:
+            raise ValueError("GUBER_SHED_CACHE_KEYS must be >= 0")
         if self.store_mib < 0 or self.store_target_keys < 0:
             raise ValueError(
                 "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
@@ -499,6 +514,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         ),
         device_deep_batch=_get(env, "GUBER_DEVICE_DEEP_BATCH")
         in ("1", "true", "yes"),
+        shed_cache=_get(env, "GUBER_SHED_CACHE", "1").lower()
+        not in ("0", "false", "no", "off"),
+        shed_cache_keys=_get_int(env, "GUBER_SHED_CACHE_KEYS", 1 << 16),
         # prep_at_arrival / prep_threads deliberately NOT resolved
         # here: their None/0 defaults defer to DeviceBatcher, the
         # single owner of the GUBER_PREP_AT_ARRIVAL /

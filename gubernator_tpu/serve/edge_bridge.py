@@ -99,6 +99,14 @@ Frame protocol (little-endian, lengths in bytes):
       well-formed GEB3 response carrying per-item "node draining"
       errors instead.
 
+Over-limit shedding (r10): before a decoded frame's items enqueue
+toward the device, they are screened against the instance's over-limit
+shed cache (serve/shedcache.py) — frozen token-bucket refusals answer
+at the bridge, the residue rides the batcher, and the responses stitch
+back in frame order. Applies to the pre-hashed framings (GEB6/GEB7)
+and the string fold alike; object-path string items get the same
+treatment inside Instance.get_rate_limits.
+
 Non-windowed frames (GEB1/GEB6) keep their one-in-flight round-trip
 semantics for version-skewed edges; a bridge serves both framings on
 the same connection. Malformed input closes the connection.
@@ -601,6 +609,53 @@ class EdgeBridge:
             np.concatenate([p[j] for p in parts]) for j in range(4)
         )
 
+    async def _decide_arrays_shed(self, fields: dict, n: int):
+        """Over-limit shed screen in front of the batcher (r10,
+        serve/shedcache.py): items whose frozen token-bucket refusal
+        is cached host-side are answered HERE and never enqueue; only
+        the residue rides the device, and its responses stitch back in
+        frame order (and repopulate the cache). Screen + stitch time
+        is the frame's `shed` stage — a fully-shed frame has no
+        batch_queue/device span at all, and this stage is what tiles
+        that part of its e2e, so the r7 frame-coverage contract keeps
+        no hole. Shared by the pre-hashed fast path and the string
+        fold."""
+        shed = getattr(self.instance, "shed", None)
+        if shed is None:
+            return await self._decide_arrays_chunked(fields, n)
+        t0 = time.monotonic()
+        shed.refresh_generation()
+        screened = shed.screen_fields(fields)
+        if screened is None:
+            STAGES.add("shed", time.monotonic() - t0)
+            res = await self._decide_arrays_chunked(fields, n)
+            # population is shed work too: without the stage add, a
+            # cold-cache frame's observe walk would sit between the
+            # device and encode spans as a coverage hole
+            t1 = time.monotonic()
+            shed.observe_fields(fields, res)
+            STAGES.add("shed", time.monotonic() - t1)
+            return res
+        mask, (status, limit, remaining, reset) = screened
+        keep = ~mask
+        n_res = int(keep.sum())
+        if n_res == 0:
+            STAGES.add("shed", time.monotonic() - t0)
+            return status, limit, remaining, reset
+        residue = {k: v[keep] for k, v in fields.items()}
+        STAGES.add("shed", time.monotonic() - t0)
+        rs, rl, rr, rt = await self._decide_arrays_chunked(
+            residue, n_res
+        )
+        t1 = time.monotonic()
+        shed.observe_fields(residue, (rs, rl, rr, rt))
+        status[keep] = rs
+        limit[keep] = rl
+        remaining[keep] = rr
+        reset[keep] = rt
+        STAGES.add("shed", time.monotonic() - t1)
+        return status, limit, remaining, reset
+
     async def _decide_fast(self, payload: bytes, n: int):
         """Decode one pre-hashed payload and run it through the batcher.
         Returns the packed n x 25-byte response records."""
@@ -631,7 +686,7 @@ class EdgeBridge:
         self.instance.traffic.observe_hashes(fields["key_hash"])
         STAGES.add("bridge_decode", time.monotonic() - t_dec)
         status, limit, remaining, reset = (
-            await self._decide_arrays_chunked(fields, n)
+            await self._decide_arrays_shed(fields, n)
         )
         t_enc = time.monotonic()
         out = np.empty(n, dtype=resp_dt)
@@ -769,7 +824,7 @@ class EdgeBridge:
 
         self.instance.traffic.observe(full, fields["key_hash"])
         status, limit, remaining, reset = (
-            await self._decide_arrays_chunked(fields, n)
+            await self._decide_arrays_shed(fields, n)
         )
         t_enc = time.monotonic()
         out = np.zeros(n, dtype=_string_resp_dtype())

@@ -5,7 +5,10 @@ contract (reference gubernator.go:41-322) with an asyncio + batched-device
 execution model:
 
 - GetRateLimits validates each entry, decides key ownership on the ring,
-  and splits the batch three ways: locally-owned requests coalesce into
+  screens the over-limit shed cache (serve/shedcache.py: frozen
+  token-bucket refusals answer host-side, before the batcher or any
+  forward RPC), and splits the residue three ways: locally-owned
+  requests coalesce into
   device batches; GLOBAL non-owned requests answer from local replicas
   (with hits queued to the gossip manager); other non-owned requests
   forward to their owner peer (micro-batched per peer unless NO_BATCHING).
@@ -70,6 +73,21 @@ class Instance:
         self.picker = ConsistentHashPicker()
         self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
         self.traffic = TrafficStats()
+        # over-limit shed cache (r10, serve/shedcache.py): host-side
+        # answers for frozen token-bucket refusals, consulted before
+        # anything enqueues toward the device. Shared with the edge
+        # bridge, which screens its array frames against the same
+        # cache. None = disabled (GUBER_SHED_CACHE=0 or a zero bound).
+        shed_keys = getattr(conf, "shed_cache_keys", 0)
+        if getattr(conf, "shed_cache", False) and shed_keys > 0:
+            from gubernator_tpu.serve.shedcache import ShedCache
+
+            self.shed = ShedCache(
+                shed_keys,
+                generation_fn=getattr(backend, "shed_generation", None),
+            )
+        else:
+            self.shed = None
 
     def start(self) -> None:
         self.batcher.start()
@@ -101,9 +119,13 @@ class Instance:
         out: List[Optional[RateLimitResp]] = [None] * len(reqs)
         local: List[Tuple[int, RateLimitReq, bool]] = []  # idx, req, gnp
         forwards: List[Tuple[int, RateLimitReq, PeerClient]] = []
-        observed: List[str] = []
         t_route0 = time.monotonic()
 
+        # validation pass first so the whole batch's fingerprints hash
+        # in ONE native call — the routing pass below consults the
+        # over-limit shed cache with them, and the response hooks use
+        # them to populate it (fps: out-index -> fingerprint)
+        valid: List[Tuple[int, RateLimitReq, str]] = []
         for i, r in enumerate(reqs):
             if not r.unique_key:
                 out[i] = RateLimitResp(
@@ -115,8 +137,19 @@ class Instance:
                     error="field 'namespace' cannot be empty"
                 )
                 continue
-            key = r.hash_key()
-            observed.append(key)
+            valid.append((i, r, r.hash_key()))
+
+        hashes = (
+            slot_hash_batch([k for _, _, k in valid]) if valid else None
+        )
+        shed = self.shed
+        if shed is not None:
+            shed.refresh_generation()
+        fps = {}
+
+        for j, (i, r, key) in enumerate(valid):
+            h = int(hashes[j])
+            fps[i] = h
             try:
                 peer = self.get_peer(key)
             except Exception as e:
@@ -127,20 +160,45 @@ class Instance:
                     )
                 )
                 continue
+            # over-limit shed screen (serve/shedcache.py): a cached
+            # frozen refusal answers here — no batcher, no forward RPC.
+            # GLOBAL side effects are preserved exactly as the
+            # non-shed path would produce them: non-owners still
+            # aggregate the hit toward the owner, owners still queue
+            # the status broadcast (the broadcast loop's peeks carry
+            # hits=0 and therefore always bypass the shed).
+            verdict = (
+                shed.lookup_resp(h, r) if shed is not None else None
+            )
             if peer.is_owner:
+                if verdict is not None:
+                    if r.behavior == Behavior.GLOBAL:
+                        self.global_mgr.queue_update(r)
+                    out[i] = verdict
+                    continue
                 local.append((i, r, False))
             elif r.behavior == Behavior.GLOBAL:
                 # replica answer + async hit forward (gubernator.go:133-140)
                 self.global_mgr.queue_hit(r)
+                if verdict is not None:
+                    out[i] = verdict
+                    continue
                 local.append((i, r, True))
             else:
+                if verdict is not None:
+                    # parity with forward(): forwarded answers carry
+                    # the owner tag, shed or not
+                    verdict.metadata["owner"] = peer.host
+                    out[i] = verdict
+                    continue
                 forwards.append((i, r, peer))
 
-        if observed:
-            self.traffic.observe(observed, slot_hash_batch(observed))
+        if valid:
+            self.traffic.observe([k for _, _, k in valid], hashes)
         # instance-side routing overhead (validation + ring lookups +
-        # sketches), attributed apart from the batcher's queue/device
-        # stages — the string path's own cost in the stage profile
+        # shed screen + sketches), attributed apart from the batcher's
+        # queue/device stages — the string path's own cost in the
+        # stage profile
         STAGES.add("instance_route", time.monotonic() - t_route0)
 
         async def forward(i, r, peer):
@@ -148,6 +206,8 @@ class Instance:
             try:
                 resp = await peer.get_peer_rate_limit(r)
                 resp.metadata["owner"] = peer.host
+                if shed is not None:
+                    shed.observe_resps([fps[i]], [r], [resp])
             except Exception as e:
                 degraded = await self._degraded_fallback([(i, r)], peer, e)
                 if degraded is not None:
@@ -174,6 +234,12 @@ class Instance:
                 for (i, r), resp in zip(items, resps):
                     resp.metadata["owner"] = peer.host
                     out[i] = resp
+                if shed is not None:
+                    shed.observe_resps(
+                        [fps[i] for i, _ in items],
+                        [r for _, r in items],
+                        resps,
+                    )
             except Exception as e:
                 degraded = await self._degraded_fallback(items, peer, e)
                 if degraded is not None:
@@ -217,6 +283,10 @@ class Instance:
                 )
                 for (i, _, _), resp in zip(local, resps):
                     out[i] = resp
+                if shed is not None:
+                    shed.observe_resps(
+                        [fps[i] for i, _, _ in local], local_reqs, resps
+                    )
             except Exception as e:
                 for i, r, _ in local:
                     out[i] = RateLimitResp(
@@ -286,14 +356,64 @@ class Instance:
                 # owner-side injection point: a chaos spec can make THIS
                 # node a slow/failing owner for its peers' forwards
                 await FAULTS.inject("peer_serve")
-            return await self.decide_local(reqs, [False] * len(reqs))
+            shed = self.shed
+            if shed is None:
+                return await self.decide_local(reqs, [False] * len(reqs))
+            # owner-side shed screen: forwarded items for a frozen
+            # over-limit key are answered without a device trip; the
+            # residue decides normally and its responses populate the
+            # cache. Forwarded GLOBAL hits keep their broadcast side
+            # effect (decide_local would have queued the update).
+            shed.refresh_generation()
+            hashes = slot_hash_batch([r.hash_key() for r in reqs])
+            out: List[Optional[RateLimitResp]] = [None] * len(reqs)
+            residue: List[Tuple[int, RateLimitReq]] = []
+            res_fps: List[int] = []
+            for i, r in enumerate(reqs):
+                verdict = shed.lookup_resp(int(hashes[i]), r)
+                if verdict is not None:
+                    if r.behavior == Behavior.GLOBAL:
+                        self.global_mgr.queue_update(r)
+                    out[i] = verdict
+                else:
+                    residue.append((i, r))
+                    res_fps.append(int(hashes[i]))
+            if residue:
+                resps = await self.decide_local(
+                    [r for _, r in residue], [False] * len(residue)
+                )
+                shed.observe_resps(
+                    res_fps, [r for _, r in residue], resps
+                )
+                for (i, _), resp in zip(residue, resps):
+                    out[i] = resp
+            return [
+                o if o is not None else RateLimitResp() for o in out
+            ]
         except Exception as e:
             return [RateLimitResp(error=str(e)) for _ in reqs]
 
     async def update_peer_globals(
         self, updates: Sequence[Tuple[str, RateLimitResp]]
     ) -> None:
-        await self.batcher.update_globals(list(updates))
+        if self.shed is None or not updates:
+            await self.batcher.update_globals(list(updates))
+            return
+        # device-authoritative invalidation: an owner broadcast
+        # replaced these keys' replicas, so any cached verdict for
+        # them is no longer provably current (the next hit reads the
+        # fresh replica and repopulates). Purge BEFORE the install
+        # (stop shedding from the doomed entries immediately) and
+        # AGAIN after it: an in-flight decide that resolved during the
+        # install await could otherwise re-insert the PRE-install
+        # verdict just after the first purge and shadow the fresh
+        # replica until its old reset_time.
+        hashes = slot_hash_batch([k for k, _ in updates])
+        self.shed.purge(hashes)
+        try:
+            await self.batcher.update_globals(list(updates))
+        finally:
+            self.shed.purge(hashes)
 
     def health_check(self) -> HealthCheckResp:
         """Membership health (set_peers) merged with live breaker state:

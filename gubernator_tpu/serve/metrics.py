@@ -119,6 +119,25 @@ STAGE_SAMPLES = Gauge(
     ["stage"],
     registry=REGISTRY,
 )
+SHED_HITS = Gauge(
+    "shed_hits_total",
+    "Requests answered from the host over-limit shed cache instead of "
+    "the device (serve/shedcache.py; exported lazily at scrape like the "
+    "stage totals — the hot path only bumps a plain int)",
+    registry=REGISTRY,
+)
+SHED_LOOKUPS = Gauge(
+    "shed_lookups_total",
+    "Shed-cache consults for gate-eligible requests (token bucket, "
+    "hits > 0); shed hit rate = shed_hits_total / shed_lookups_total",
+    registry=REGISTRY,
+)
+SHED_ENTRIES = Gauge(
+    "shed_entries",
+    "Live over-limit verdicts in the host shed cache (bounded by "
+    "GUBER_SHED_CACHE_KEYS)",
+    registry=REGISTRY,
+)
 FAULTS_INJECTED = Counter(
     "faults_injected_total",
     "Injected faults fired (serve/faults.py, GUBER_FAULT_SPEC) — a "

@@ -271,6 +271,35 @@ class Server:
                 "fallbacks active"
             )
 
+        shed = self.instance.shed
+        if shed is not None:
+            # boot-time sizing lint, like the store footprint pass in
+            # make_backend: an over-provisioned shed bound is host
+            # memory that can never hold a live verdict
+            from gubernator_tpu.serve.shedcache import (
+                footprint_mib,
+                lint_footprint,
+            )
+
+            cap = 0
+            stats = self.backend.stats()
+            if "size" not in stats:  # device backends: rows * slots
+                try:
+                    sc = self.conf.store_config(logger=log)
+                    cap = sc.rows * sc.slots
+                except Exception:
+                    cap = 0
+            lint = lint_footprint(shed.capacity, cap)
+            if lint:
+                log.warning("%s", lint)
+            log.info(
+                "over-limit shed cache: %d keys (~%.1f MiB) "
+                "(GUBER_SHED_CACHE / GUBER_SHED_CACHE_KEYS)",
+                shed.capacity, footprint_mib(shed.capacity),
+            )
+        else:
+            log.info("over-limit shed cache: off (GUBER_SHED_CACHE=0)")
+
         if self.conf.http_address:
             await self._start_http()
         if self.conf.edge_socket or self.conf.edge_tcp:
@@ -512,6 +541,13 @@ class Server:
                 metrics.PEER_BREAKER_STATE.labels(peer=peer.host).set(
                     peer.breaker.state_code
                 )
+        # shed-cache totals export lazily at scrape time too: the hot
+        # path only bumps plain ints (serve/shedcache.py)
+        shed = self.instance.shed
+        if shed is not None:
+            metrics.SHED_HITS.set(shed.hits)
+            metrics.SHED_LOOKUPS.set(shed.lookups)
+            metrics.SHED_ENTRIES.set(len(shed))
         # stage totals export lazily at scrape time: the hot path only
         # touches the plain-float accumulator (serve/stages.py)
         from gubernator_tpu.serve.stages import STAGES
@@ -545,9 +581,18 @@ class Server:
         the decomposition that says which stage to attack next."""
         from gubernator_tpu.serve.stages import STAGES
 
+        shed = self.instance.shed
         if request.query.get("reset") in ("1", "true"):
             STAGES.reset()
-        return web.json_response(STAGES.snapshot())
+            if shed is not None:
+                shed.reset_counters()
+        body = STAGES.snapshot()
+        # over-limit shed cache counters ride along (entries, hits,
+        # lookups, hit_rate): the shed stage's spans above say where
+        # the time went, this says how much work never became a stage
+        if shed is not None:
+            body["shed_cache"] = shed.stats()
+        return web.json_response(body)
 
     async def _http_debug_profile(self, request: web.Request):
         """Capture a JAX/XLA device profile for ?ms= milliseconds (default

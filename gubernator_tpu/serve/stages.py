@@ -25,6 +25,13 @@ Stages form two families:
                      transit) and attributes time spent ABOVE that
                      floor: window queueing + socket backlog.
     bridge_decode    frame payload -> numpy fields / request objects
+    shed             over-limit shed-cache screen of the frame's items
+                     (serve/shedcache.py, r10) — the host-side answer
+                     path for frozen token-bucket refusals. Items it
+                     sheds never enqueue; a fully-shed frame has no
+                     batch_queue/device span at all, and this stage is
+                     what tiles that part of its e2e (the frame-
+                     coverage contract keeps no hole)
     batch_queue      batcher enqueue -> flusher collect (per group)
     device           flusher collect -> responses resolved (per group;
                      covers submit + device execute + fetch + any wait
@@ -77,6 +84,7 @@ from typing import Dict, Tuple
 PER_FRAME = (
     "edge_to_bridge",
     "bridge_decode",
+    "shed",
     "batch_queue",
     "device",
     "encode",

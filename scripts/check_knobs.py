@@ -1,0 +1,105 @@
+"""GUBER_* knob documentation drift gate (r10 satellite).
+
+Every `GUBER_*` environment knob the package actually READS must be
+documented in BOTH example.conf and docs/operations.md — the same
+no-drift contract the README benchmark tables have
+(scripts/gen_readme_tables.py --check), wired as a tier-1 test
+(tests/test_check_knobs.py).
+
+"Read" is detected by AST, not grep: a GUBER_* string literal appearing
+as an argument of a call (`os.environ.get("GUBER_X")`,
+`_get(env, "GUBER_X")`, `env.get("GUBER_X", ...)`) or as a subscript
+index (`os.environ["GUBER_X"]`). Docstrings and comments never count,
+so documenting a knob can't satisfy the gate by accident, and
+prefix-only mentions ("GUBER_DIST_") are excluded.
+
+Usage: python scripts/check_knobs.py   # exit 0 = documented, 1 = drift
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import re
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = ROOT / "gubernator_tpu"
+TARGETS = ("example.conf", "docs/operations.md")
+
+KNOB_RE = re.compile(r"^GUBER_[A-Z0-9_]*[A-Z0-9]$")
+
+
+def _knob_strings(node) -> set:
+    """GUBER_* literals in call arguments / subscript indices of one
+    AST node."""
+    found = set()
+    candidates = []
+    if isinstance(node, ast.Call):
+        candidates = list(node.args) + [kw.value for kw in node.keywords]
+    elif isinstance(node, ast.Subscript):
+        sl = node.slice
+        # py<3.9 wraps the index in ast.Index; on 3.9+ the slice IS
+        # the expression node (an ast.Constant has a .value attribute
+        # holding the raw string, so a blind getattr would unwrap one
+        # level too far and never match isinstance below)
+        if sl.__class__.__name__ == "Index":  # pragma: no cover - <3.9
+            sl = sl.value
+        candidates = [sl]
+    for c in candidates:
+        if isinstance(c, ast.Constant) and isinstance(c.value, str):
+            if KNOB_RE.match(c.value):
+                found.add(c.value)
+    return found
+
+
+def read_knobs() -> dict:
+    """knob -> sorted list of repo-relative files reading it."""
+    knobs: dict = {}
+    for path in sorted(PACKAGE.rglob("*.py")):
+        rel = path.relative_to(ROOT)
+        if "proto/gen" in str(rel) or "__pycache__" in str(rel):
+            continue
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as e:  # pragma: no cover - repo is parseable
+            print(f"cannot parse {rel}: {e}", file=sys.stderr)
+            sys.exit(2)
+        for node in ast.walk(tree):
+            for k in _knob_strings(node):
+                knobs.setdefault(k, set()).add(str(rel))
+    return {k: sorted(v) for k, v in sorted(knobs.items())}
+
+
+def main() -> int:
+    knobs = read_knobs()
+    if not knobs:
+        print("no GUBER_* knob reads found — scanner broken?",
+              file=sys.stderr)
+        return 2
+    texts = {t: (ROOT / t).read_text() for t in TARGETS}
+    missing = {
+        t: [k for k in knobs if k not in text]
+        for t, text in texts.items()
+    }
+    ok = True
+    for t, miss in missing.items():
+        for k in miss:
+            ok = False
+            print(
+                f"{t}: missing knob {k} (read by "
+                f"{', '.join(knobs[k])})",
+                file=sys.stderr,
+            )
+    if ok:
+        print(
+            f"{len(knobs)} GUBER_* knobs read by the package, all "
+            f"documented in {' and '.join(TARGETS)}",
+            file=sys.stderr,
+        )
+        return 0
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

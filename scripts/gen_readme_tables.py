@@ -404,6 +404,40 @@ def table_host_prep() -> str:
     return "\n".join(lines)
 
 
+def table_shed() -> str:
+    """Over-limit shed cache A/B (r10), from BENCH_SHED_r10.json: the
+    bridge-tier screen's decisions/s OFF vs ON per over-limit traffic
+    share, paired interleaved rounds (r9 methodology)."""
+    doc = json.loads((ROOT / "BENCH_SHED_r10.json").read_text())
+    lines = [
+        "| over-limit share | decisions/s OFF (median) "
+        "| decisions/s ON | paired speedup |",
+        "|---|---|---|---|",
+    ]
+    for share, s in doc["series"].items():
+        dec = s["median_decisions_per_sec"]
+        lines.append(
+            f"| {float(share):.0%} | {dec['off']:,.0f} "
+            f"| {dec['on']:,.0f} | {s['paired_speedup']:.2f}x |"
+        )
+    lines.append("")
+    lines.append(
+        f"({doc['rounds_per_share']} interleaved OFF/ON pairs per "
+        f"share, {doc['conns']} connections x "
+        f"{doc['batch_items']}-item windowed GEB7 frames on the "
+        f"bridge socket; paired win monotone in over-limit share: "
+        f"**{doc['monotone_in_over_limit_share']}**, top share "
+        f"**{doc['top_share_paired_speedup']:.2f}x**. Scope"
+        + (
+            " and the container acceptance note are"
+            if "acceptance_note" in doc
+            else " is"
+        )
+        + " in the artifact.)"
+    )
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -414,6 +448,7 @@ TABLES = {
     "edge-cluster-table": table_edge_cluster,
     "resilience-knobs-table": table_resilience_knobs,
     "host-prep-table": table_host_prep,
+    "shed-table": table_shed,
 }
 
 

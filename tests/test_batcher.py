@@ -393,6 +393,67 @@ def test_decide_after_stop_raises(loop_run):
     loop_run(scenario())
 
 
+def test_update_globals_coalesce_one_backend_call(loop_run):
+    """r10 satellite: all `globals` groups of one flush batch land in
+    ONE backend.update_globals call (one to_thread hop instead of N),
+    in enqueue order, with per-caller futures still resolved
+    individually — and failed individually when the coalesced call
+    raises."""
+
+    class Recorder:
+        def __init__(self):
+            self.calls = []
+            self.fail_next = False
+
+        def decide(self, reqs, gnp):
+            return [RateLimitResp(limit=r.limit) for r in reqs]
+
+        def update_globals(self, updates):
+            self.calls.append([k for k, _ in updates])
+            if self.fail_next:
+                self.fail_next = False
+                raise RuntimeError("install exploded")
+
+    async def scenario():
+        be = Recorder()
+        b = DeviceBatcher(be, batch_wait=0.05, batch_limit=100)
+        b.start()
+        # three caller groups enqueue inside one straggler window ->
+        # one flush batch -> ONE backend call with all six keys
+        tasks = [
+            asyncio.ensure_future(
+                b.update_globals(
+                    [
+                        (f"g{i}a", RateLimitResp(limit=1)),
+                        (f"g{i}b", RateLimitResp(limit=1)),
+                    ]
+                )
+            )
+            for i in range(3)
+        ]
+        await asyncio.gather(*tasks)
+        assert len(be.calls) == 1, be.calls
+        assert be.calls[0] == [
+            "g0a", "g0b", "g1a", "g1b", "g2a", "g2b"
+        ]
+        # a coalesced-call failure fails EVERY caller group's future
+        be.fail_next = True
+        fails = [
+            asyncio.ensure_future(
+                b.update_globals([(f"f{i}", RateLimitResp(limit=1))])
+            )
+            for i in range(2)
+        ]
+        results = await asyncio.gather(*fails, return_exceptions=True)
+        assert all(
+            isinstance(r, RuntimeError) and "exploded" in str(r)
+            for r in results
+        ), results
+        await b.stop()
+
+    loop_run(scenario())
+
+
 def test_inline_fast_path_never_overtakes_collected_items(loop_run):
     """An inline decide must not run ahead of work the flusher already
     drained into its batch while parked in a batch_wait straggler

@@ -1,0 +1,629 @@
+"""Over-limit shed cache (r10): differential identity + invalidation.
+
+The shed contract under test (serve/shedcache.py): with the cache ON,
+every response is byte-identical to the cache-OFF pipeline — the shed
+only answers requests whose verdict is a frozen token-bucket refusal
+the device would echo verbatim. The suites here pin:
+
+- randomized differential identity ON vs OFF over the exact backend
+  AND the device (tpu-on-cpu) backend: mixed token/leaky algorithms,
+  duplicate keys per batch, peeks, oversized hits, mid-window
+  limit/duration changes, and clock advances across reset boundaries
+  (a shared fake clock drives both pipelines so reset_time compares
+  exactly);
+- peeks (hits=0) bypass the shed entirely;
+- the reset_time expiry boundary: the first post-reset hit reaches the
+  device (and recreates the window there);
+- GLOBAL-update invalidation: an UpdatePeerGlobals install purges its
+  keys so a replica reset is never shadowed by a stale verdict;
+- owned-GLOBAL sheds preserve the broadcast side effect (queue_update);
+- bridge-tier shed under windowed multi-frame load (GEB7): shed items
+  never reach the batcher, responses stitch back in frame order, and
+  the `shed` stage keeps the frame-coverage contract;
+- the engine reset-generation clears the cache.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    PeerInfo,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+    millisecond_now,
+)
+from gubernator_tpu.core.store import StoreConfig
+from gubernator_tpu.serve.backends import ExactBackend, TpuBackend
+from gubernator_tpu.serve.config import ServerConfig
+from gubernator_tpu.serve.instance import Instance
+from gubernator_tpu.serve.shedcache import ShedCache
+
+T0 = 1_700_000_000_000
+ADDR = "127.0.0.1:7971"
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self) -> int:
+        return self.t
+
+
+# -- ShedCache unit gates ---------------------------------------------------
+
+
+def test_lookup_gates_and_expiry():
+    clock = FakeClock()
+    c = ShedCache(8, now_fn=clock)
+    c._observe_one(42, 1, 10, 1000, 0, int(Status.OVER_LIMIT), 10, 0,
+                   clock.t + 500, clock.t)
+    assert len(c) == 1
+    r = RateLimitReq(name="n", unique_key="k", hits=1, limit=10,
+                     duration=1000)
+    assert c.lookup_resp(42, r).reset_time == clock.t + 500
+    # param mismatch is a miss, not a drop
+    r2 = RateLimitReq(name="n", unique_key="k", hits=1, limit=11,
+                      duration=1000)
+    assert c.lookup_resp(42, r2) is None and len(c) == 1
+    # peek and leaky bypass (not even a lookup)
+    lk = c.lookups
+    assert c.lookup_resp(
+        42, RateLimitReq(name="n", unique_key="k", hits=0, limit=10,
+                         duration=1000)
+    ) is None
+    assert c.lookup_resp(
+        42, RateLimitReq(name="n", unique_key="k", hits=1, limit=10,
+                         duration=1000,
+                         algorithm=Algorithm.LEAKY_BUCKET)
+    ) is None
+    assert c.lookups == lk
+    # expiry boundary: at now == reset_time the entry is dead (the
+    # first post-reset hit must reach the device)
+    clock.t += 500
+    assert c.lookup_resp(42, r) is None
+    assert len(c) == 0
+
+
+def test_lru_bound_and_observe_drop():
+    clock = FakeClock()
+    c = ShedCache(4, now_fn=clock)
+    for h in range(6):
+        c._observe_one(h, 1, 5, 1000, 0, int(Status.OVER_LIMIT), 5, 0,
+                       clock.t + 9999, clock.t)
+    assert len(c) == 4  # bounded; oldest evicted
+    assert 0 not in c._entries and 5 in c._entries
+    # an under-limit response for a cached fingerprint drops it
+    c._observe_one(5, 1, 5, 1000, 0, int(Status.UNDER_LIMIT), 5, 3,
+                   clock.t + 9999, clock.t)
+    assert 5 not in c._entries
+    # a leaky request for a cached fingerprint drops it (algo switch)
+    c._observe_one(4, 1, 5, 1000, 1, int(Status.UNDER_LIMIT), 5, 4, 0,
+                   clock.t)
+    assert 4 not in c._entries
+
+
+def test_observe_confirmation_vs_contradiction():
+    """The device answers an existing window's hits with the STORED
+    limit, so a param-mismatched request's response ECHOES the cached
+    window — it must confirm the entry, not drop it (mixed-param
+    traffic would otherwise thrash the cache on the hottest keys).
+    Only a response contradicting the cached window drops it."""
+    clock = FakeClock()
+    c = ShedCache(8, now_fn=clock)
+    reset = clock.t + 9999
+    c._observe_one(9, 1, 10, 1000, 0, int(Status.OVER_LIMIT), 10, 0,
+                   reset, clock.t)
+    # req_limit=20 mismatches, but the response echoes the stored
+    # window (limit 10, same reset): keep
+    c._observe_one(9, 1, 20, 1000, 0, int(Status.OVER_LIMIT), 10, 0,
+                   reset, clock.t)
+    assert c._entries[9] == (10, 1000, reset)
+    # a different reset means the window was recreated: drop
+    c._observe_one(9, 1, 20, 1000, 0, int(Status.OVER_LIMIT), 10, 0,
+                   reset + 5, clock.t)
+    assert 9 not in c._entries
+
+
+def test_generation_clears():
+    gen = [0]
+    c = ShedCache(8, now_fn=FakeClock(), generation_fn=lambda: gen[0])
+    c._observe_one(1, 1, 5, 1000, 0, int(Status.OVER_LIMIT), 5, 0,
+                   T0 + 9999, T0)
+    c.refresh_generation()
+    assert len(c) == 1
+    gen[0] += 1  # engine store wiped
+    c.refresh_generation()
+    assert len(c) == 0
+
+
+# -- instance harness -------------------------------------------------------
+
+
+async def _mk_instance(backend, shed: bool) -> Instance:
+    conf = ServerConfig(
+        grpc_address=ADDR, advertise_address=ADDR, shed_cache=shed
+    )
+    inst = Instance(conf, backend)
+    inst.start()
+    await inst.set_peers([PeerInfo(address=ADDR, is_owner=True)])
+    return inst
+
+
+def _pin_clock(monkeypatch, clock):
+    """Route every now() the serving pipeline reads through the fake
+    clock: the oracle (exact backend), the engine module (device
+    backends' module-level import), and api.types (the backends'
+    call-time local imports)."""
+    import gubernator_tpu.api.types as types_mod
+    import gubernator_tpu.core.engine as engine_mod
+    import gubernator_tpu.core.oracle as oracle_mod
+
+    monkeypatch.setattr(types_mod, "millisecond_now", clock)
+    monkeypatch.setattr(engine_mod, "millisecond_now", clock)
+    monkeypatch.setattr(oracle_mod, "millisecond_now", clock)
+
+
+def _assert_same(a, b, ctx):
+    assert (
+        a.status, a.limit, a.remaining, a.reset_time, a.error
+    ) == (
+        b.status, b.limit, b.remaining, b.reset_time, b.error
+    ), (ctx, a, b)
+
+
+def _fuzz_stream(rng, keys, steps):
+    """Random request batches: mixed algorithms (pinned per key so the
+    streams stay meaningful), duplicate keys, peeks, oversized hits,
+    mid-window limit/duration changes, clock advances across resets."""
+    for step in range(steps):
+        n = int(rng.integers(1, 7))
+        batch = []
+        for _ in range(n):
+            k = int(rng.integers(len(keys)))
+            batch.append(
+                RateLimitReq(
+                    name="shedfuzz",
+                    unique_key=keys[k],
+                    hits=int(rng.choice([0, 1, 1, 1, 2, 9])),
+                    limit=int(rng.choice([1, 1, 2, 3, 50])),
+                    duration=int(rng.choice([400, 2000, 60_000])),
+                    algorithm=Algorithm(k % 2),
+                )
+            )
+        yield step, batch, int(rng.choice([0, 0, 1, 7, 150, 500, 2500]))
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_differential_identity_fuzz_exact(monkeypatch, seed):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        on = await _mk_instance(ExactBackend(10_000), shed=True)
+        off = await _mk_instance(ExactBackend(10_000), shed=False)
+        on.shed.now_fn = clock
+        try:
+            rng = np.random.default_rng(seed)
+            keys = [f"k{i}" for i in range(14)]
+            for step, batch, dt in _fuzz_stream(rng, keys, 350):
+                clock.t += dt
+                a = await on.get_rate_limits(batch)
+                b = await off.get_rate_limits(batch)
+                for x, y, r in zip(a, b, batch):
+                    _assert_same(x, y, (step, r))
+            assert on.shed.hits > 0, "fuzz never exercised a shed"
+        finally:
+            await on.stop()
+            await off.stop()
+
+    asyncio.run(run())
+
+
+def test_differential_identity_fuzz_device(monkeypatch):
+    """Same identity contract across the DEVICE pipeline (tpu backend
+    on cpu): instance -> batcher -> arrival prep -> merged submit ->
+    kernel, shed ON vs OFF."""
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    def be():
+        return TpuBackend(
+            StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)
+        )
+
+    async def run():
+        on = await _mk_instance(be(), shed=True)
+        off = await _mk_instance(be(), shed=False)
+        on.shed.now_fn = clock
+        try:
+            rng = np.random.default_rng(5)
+            keys = [f"d{i}" for i in range(12)]
+            for step, batch, dt in _fuzz_stream(rng, keys, 120):
+                clock.t += dt
+                a = await on.get_rate_limits(batch)
+                b = await off.get_rate_limits(batch)
+                for x, y, r in zip(a, b, batch):
+                    _assert_same(x, y, (step, r))
+            assert on.shed.hits > 0, "fuzz never exercised a shed"
+        finally:
+            await on.stop()
+            await off.stop()
+
+    asyncio.run(run())
+
+
+def test_peek_bypass_and_post_reset_hit_reaches_device(monkeypatch):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        inst = await _mk_instance(ExactBackend(1000), shed=True)
+        inst.shed.now_fn = clock
+        try:
+            def req(hits=1):
+                return RateLimitReq(
+                    name="pb", unique_key="x", hits=hits, limit=1,
+                    duration=1000,
+                )
+
+            r1 = (await inst.get_rate_limits([req()]))[0]
+            assert r1.status == Status.UNDER_LIMIT  # creation, rem 0
+            r2 = (await inst.get_rate_limits([req()]))[0]
+            assert r2.status == Status.OVER_LIMIT  # frozen; now cached
+            assert len(inst.shed) == 1
+            r3 = (await inst.get_rate_limits([req()]))[0]
+            assert inst.shed.hits == 1  # shed
+            _assert_same(r2, r3, "frozen verdict")
+            # a peek bypasses the shed but gets the same frozen answer
+            lk = inst.shed.lookups
+            r4 = (await inst.get_rate_limits([req(hits=0)]))[0]
+            assert inst.shed.lookups == lk
+            _assert_same(r2, r4, "peek")
+            # cross the reset boundary: the next hit must reach the
+            # device and recreate the window there
+            clock.t = r2.reset_time + 1
+            hits_before = inst.shed.hits
+            r5 = (await inst.get_rate_limits([req()]))[0]
+            assert inst.shed.hits == hits_before  # not shed
+            assert r5.status == Status.UNDER_LIMIT  # fresh window
+            assert r5.reset_time == clock.t + 1000
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_global_update_purges_cached_verdict(monkeypatch):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        on = await _mk_instance(ExactBackend(1000), shed=True)
+        off = await _mk_instance(ExactBackend(1000), shed=False)
+        on.shed.now_fn = clock
+        try:
+            def req():
+                return RateLimitReq(
+                    name="g", unique_key="y", hits=1, limit=1,
+                    duration=60_000,
+                )
+
+            for inst in (on, off):
+                await inst.get_rate_limits([req(), req()])
+            assert len(on.shed) == 1
+            # owner-side reset arrives as a replica install: the shed
+            # entry must die with it, or GLOBAL mode would keep
+            # serving the stale refusal
+            key = req().hash_key()
+
+            def fresh():
+                # one object per install: the exact backend stores the
+                # replica object itself and mutates it in place
+                return RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=1, remaining=1,
+                    reset_time=clock.t + 60_000,
+                )
+
+            for inst in (on, off):
+                await inst.update_peer_globals([(key, fresh())])
+            assert len(on.shed) == 0
+            a = (await on.get_rate_limits([req()]))[0]
+            b = (await off.get_rate_limits([req()]))[0]
+            _assert_same(a, b, "post-install identity")
+        finally:
+            await on.stop()
+            await off.stop()
+
+    asyncio.run(run())
+
+
+def test_owned_global_shed_preserves_broadcast_side_effect(monkeypatch):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        inst = await _mk_instance(ExactBackend(1000), shed=True)
+        inst.shed.now_fn = clock
+        queued = []
+        inst.global_mgr.queue_update = lambda r: queued.append(
+            r.hash_key()
+        )
+        try:
+            def req():
+                return RateLimitReq(
+                    name="gb", unique_key="z", hits=1, limit=1,
+                    duration=60_000, behavior=Behavior.GLOBAL,
+                )
+
+            await inst.get_rate_limits([req(), req()])
+            n_before = len(queued)
+            assert n_before > 0
+            r = (await inst.get_rate_limits([req()]))[0]
+            assert inst.shed.hits >= 1 and r.status == Status.OVER_LIMIT
+            # the shed answer still queued the owner's status broadcast
+            assert len(queued) == n_before + 1
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_serve_screen_identity(monkeypatch):
+    """Owner-side forwarded batches (get_peer_rate_limits) screen the
+    same cache: identity with the unscreened pipeline, shed hits
+    recorded."""
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        on = await _mk_instance(ExactBackend(1000), shed=True)
+        off = await _mk_instance(ExactBackend(1000), shed=False)
+        on.shed.now_fn = clock
+        try:
+            reqs = [
+                RateLimitReq(name="ps", unique_key="w", hits=1,
+                             limit=1, duration=60_000)
+                for _ in range(3)
+            ]
+            for inst in (on, off):
+                await inst.get_peer_rate_limits(reqs)
+            a = await on.get_peer_rate_limits(reqs)
+            b = await off.get_peer_rate_limits(reqs)
+            for x, y in zip(a, b):
+                _assert_same(x, y, "peer serve")
+            assert on.shed.hits >= 3
+        finally:
+            await on.stop()
+            await off.stop()
+
+    asyncio.run(run())
+
+
+# -- bridge tier ------------------------------------------------------------
+
+
+def _wfast(fid, rec, ring_hash):
+    from gubernator_tpu.serve.edge_bridge import MAGIC_WFAST_REQ
+
+    payload = rec.tobytes()
+    return (
+        struct.pack("<II", MAGIC_WFAST_REQ, len(rec))
+        + struct.pack("<IIQ", fid, ring_hash, 0)
+        + struct.pack("<I", len(payload))
+        + payload
+    )
+
+
+async def _read_wfast_resp(reader):
+    from gubernator_tpu.serve.edge_bridge import (
+        MAGIC_WFAST_RESP,
+        _fast_dtypes,
+    )
+
+    magic, n = struct.unpack("<II", await reader.readexactly(8))
+    assert magic == MAGIC_WFAST_RESP, hex(magic)
+    (fid,) = struct.unpack("<I", await reader.readexactly(4))
+    _, resp_dt = _fast_dtypes()
+    rec = np.frombuffer(
+        await reader.readexactly(n * resp_dt.itemsize), dtype=resp_dt
+    )
+    return fid, rec
+
+
+def test_bridge_tier_shed_windowed_frames():
+    """GEB7 frames screen the shed cache before the batcher: a frame of
+    frozen refusals is answered without a device trip, mixed frames
+    stitch shed + device rows in order, multiple frames stay in flight,
+    and the `shed` stage appears in the clock."""
+    from gubernator_tpu.serve.edge_bridge import EdgeBridge
+    from gubernator_tpu.serve.stages import STAGES
+
+    path = "/tmp/guber-shed-bridge-test.sock"
+
+    async def run():
+        backend = TpuBackend(
+            StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)
+        )
+        inst = await _mk_instance(backend, shed=True)
+        bridge = EdgeBridge(inst, path)
+        await bridge.start()
+        try:
+            from tests.test_edge_bridge import _read_hello
+
+            reader, writer = await asyncio.open_unix_connection(path)
+            _flags, rhash, _nodes = await _read_hello(reader)
+
+            from gubernator_tpu.serve.edge_bridge import _fast_dtypes
+
+            req_dt, _ = _fast_dtypes()
+
+            def recs(key_hashes, limit=1):
+                rec = np.zeros(len(key_hashes), req_dt)
+                rec["key_hash"] = key_hashes
+                rec["hits"] = 1
+                rec["limit"] = limit
+                rec["duration"] = 60_000
+                return rec
+
+            # frame 1: duplicate key drains the window; follower rows
+            # come back (OVER, remaining 0) and populate the cache
+            writer.write(_wfast(1, recs([7, 7, 7, 7]), rhash))
+            await writer.drain()
+            fid, rec = await asyncio.wait_for(_read_wfast_resp(reader), 30)
+            assert fid == 1
+            assert rec["status"].tolist() == [0, 1, 1, 1]
+            frozen_reset = int(rec["reset_time"][3])
+            assert len(inst.shed) == 1
+
+            # frame 2: fully shed — no device batch happens
+            batches_before = backend.stats()["batches"]
+            hits_before = inst.shed.hits
+            writer.write(_wfast(2, recs([7, 7, 7]), rhash))
+            await writer.drain()
+            fid, rec = await asyncio.wait_for(_read_wfast_resp(reader), 30)
+            assert fid == 2
+            assert rec["status"].tolist() == [1, 1, 1]
+            assert rec["remaining"].tolist() == [0, 0, 0]
+            assert rec["reset_time"].tolist() == [frozen_reset] * 3
+            assert inst.shed.hits == hits_before + 3
+            assert backend.stats()["batches"] == batches_before
+
+            # frame 3: mixed shed + residue rows stitch back in order
+            writer.write(_wfast(3, recs([7, 8, 7, 8]), rhash))
+            await writer.drain()
+            fid, rec = await asyncio.wait_for(_read_wfast_resp(reader), 30)
+            assert fid == 3
+            # key 7 rows frozen; key 8 rows are a fresh creation group
+            # (leader UNDER rem 0, follower OVER rem 0)
+            assert rec["status"].tolist() == [1, 0, 1, 1]
+            assert rec["reset_time"][0] == frozen_reset
+            assert rec["reset_time"][2] == frozen_reset
+            assert backend.stats()["batches"] == batches_before + 1
+
+            # two frames in flight, fully shed: ids match out of the
+            # window regardless of completion order
+            writer.write(_wfast(4, recs([7, 7]), rhash))
+            writer.write(_wfast(5, recs([8, 8]), rhash))
+            await writer.drain()
+            got = {}
+            for _ in range(2):
+                fid, rec = await asyncio.wait_for(
+                    _read_wfast_resp(reader), 30
+                )
+                got[fid] = rec["status"].tolist()
+            assert got[4] == [1, 1] and got[5] == [1, 1]
+
+            snap = STAGES.snapshot()
+            assert "shed" in snap["stages"]
+            assert "shed" in snap["per_frame_stages"]
+            writer.close()
+        finally:
+            await bridge.stop()
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_bridge_string_fold_shed():
+    """The GEB1 string fold rides the same screen: the second frame for
+    a frozen key sheds, and the response stays a well-formed GEB3."""
+    from gubernator_tpu.serve.edge_bridge import MAGIC_RESP, EdgeBridge
+
+    path = "/tmp/guber-shed-fold-test.sock"
+
+    async def run():
+        backend = TpuBackend(
+            StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)
+        )
+        inst = await _mk_instance(backend, shed=True)
+        bridge = EdgeBridge(inst, path)
+        await bridge.start()
+        try:
+            from tests.test_edge_bridge import (
+                _frame,
+                _item,
+                _read_hello,
+            )
+
+            reader, writer = await asyncio.open_unix_connection(path)
+            await _read_hello(reader)
+
+            async def roundtrip():
+                writer.write(_frame([
+                    _item(b"fold", b"hot", hits=1, limit=1,
+                          duration=60_000),
+                    _item(b"fold", b"hot", hits=1, limit=1,
+                          duration=60_000),
+                ]))
+                await writer.drain()
+                magic, n = struct.unpack(
+                    "<II", await reader.readexactly(8)
+                )
+                assert magic == MAGIC_RESP and n == 2
+                out = []
+                for _ in range(n):
+                    status, limit, remaining, reset = struct.unpack(
+                        "<Bqqq", await reader.readexactly(25)
+                    )
+                    (elen,) = struct.unpack(
+                        "<H", await reader.readexactly(2)
+                    )
+                    await reader.readexactly(elen)
+                    (olen,) = struct.unpack(
+                        "<H", await reader.readexactly(2)
+                    )
+                    await reader.readexactly(olen)
+                    out.append((status, limit, remaining, reset))
+                return out
+
+            first = await asyncio.wait_for(roundtrip(), 30)
+            assert [s for s, *_ in first] == [0, 1]
+            assert len(inst.shed) == 1
+            hits = inst.shed.hits
+            second = await asyncio.wait_for(roundtrip(), 30)
+            assert [s for s, *_ in second] == [1, 1]
+            assert [r for *_, r in second] == [first[1][3]] * 2
+            assert inst.shed.hits == hits + 2
+            writer.close()
+        finally:
+            await bridge.stop()
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_engine_reset_generation_clears_instance_cache(monkeypatch):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        backend = TpuBackend(
+            StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)
+        )
+        inst = await _mk_instance(backend, shed=True)
+        inst.shed.now_fn = clock
+        try:
+            def req():
+                return RateLimitReq(
+                    name="rg", unique_key="q", hits=1, limit=1,
+                    duration=60_000,
+                )
+
+            await inst.get_rate_limits([req(), req()])
+            assert len(inst.shed) == 1
+            backend.engine.reset()  # store wiped (clock-jump path)
+            r = (await inst.get_rate_limits([req()]))[0]
+            # fresh store: the request recreated the window instead of
+            # being answered from a stale cached refusal
+            assert r.status == Status.UNDER_LIMIT
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
