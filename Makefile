@@ -61,12 +61,15 @@ profile-shed: native
 	  --rounds $(SHED_ROUNDS) --shares $(SHED_SHARES) \
 	  --json $(SHED_OUT)
 
-# chaos soak (r8): 3-node cluster under load with a peer killed +
-# restarted mid-run and GUBER_FAULT_SPEC injection active; asserts
-# bounded error rate, breaker recovery, graceful drain. SECONDS/OUT
-# overridable: make chaos SECONDS=60 OUT=chaos.json
+# chaos soak (r8, + r11 quota-amnesia phase): 3-node cluster under load
+# with a peer killed + restarted mid-run and GUBER_FAULT_SPEC injection
+# active; asserts bounded error rate, breaker recovery, graceful drain,
+# and that a tracked over-limit key STAYS over-limit across owner
+# SIGKILL -> successor takeover -> restart -> reconcile
+# (GUBER_REPLICATION bucket replication). SECONDS/OUT overridable:
+# make chaos SECONDS=60 OUT=chaos.json
 CHAOS_SECONDS ?= 30
-CHAOS_OUT ?= BENCH_CHAOS_r8.json
+CHAOS_OUT ?= BENCH_CHAOS_r11.json
 chaos:
 	python scripts/chaos_soak.py --seconds $(CHAOS_SECONDS) \
 	  --json $(CHAOS_OUT)

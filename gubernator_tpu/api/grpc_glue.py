@@ -38,8 +38,8 @@ def add_v1_servicer(server: grpc.Server, servicer) -> None:
 
 
 def add_peers_servicer(server: grpc.Server, servicer) -> None:
-    """servicer must expose GetPeerRateLimits(req, ctx) and
-    UpdatePeerGlobals(req, ctx)."""
+    """servicer must expose GetPeerRateLimits(req, ctx),
+    UpdatePeerGlobals(req, ctx) and ReplicateBuckets(req, ctx)."""
     handlers = {
         "GetPeerRateLimits": grpc.unary_unary_rpc_method_handler(
             servicer.GetPeerRateLimits,
@@ -50,6 +50,11 @@ def add_peers_servicer(server: grpc.Server, servicer) -> None:
             servicer.UpdatePeerGlobals,
             request_deserializer=peers_pb2.UpdatePeerGlobalsReq.FromString,
             response_serializer=peers_pb2.UpdatePeerGlobalsResp.SerializeToString,
+        ),
+        "ReplicateBuckets": grpc.unary_unary_rpc_method_handler(
+            servicer.ReplicateBuckets,
+            request_deserializer=peers_pb2.ReplicateBucketsReq.FromString,
+            response_serializer=peers_pb2.ReplicateBucketsResp.SerializeToString,
         ),
     }
     server.add_generic_rpc_handlers(
@@ -86,4 +91,9 @@ class PeersV1Stub:
             f"/{PEERS_SERVICE}/UpdatePeerGlobals",
             request_serializer=peers_pb2.UpdatePeerGlobalsReq.SerializeToString,
             response_deserializer=peers_pb2.UpdatePeerGlobalsResp.FromString,
+        )
+        self.ReplicateBuckets = channel.unary_unary(
+            f"/{PEERS_SERVICE}/ReplicateBuckets",
+            request_serializer=peers_pb2.ReplicateBucketsReq.SerializeToString,
+            response_deserializer=peers_pb2.ReplicateBucketsResp.FromString,
         )

@@ -83,6 +83,24 @@ class LRUCache:
         self._data.move_to_end(key)
         return rec.value, True
 
+    def peek(
+        self, key: Hashable, now: Optional[int] = None
+    ) -> Tuple[Optional[Any], bool]:
+        """Non-mutating read: no recency move, no hit/miss accounting,
+        no expired-entry deletion — get()'s observable state is
+        untouched. This is the exact backend's snapshot surface for
+        bucket replication (serve/replication.py): the flush loop must
+        be able to read owned windows without perturbing what the
+        serving path would do next (replication ON == OFF, provably)."""
+        rec = self._data.get(key)
+        if rec is None:
+            return None, False
+        if now is None:
+            now = millisecond_now()
+        if rec.expire_at < now:
+            return None, False
+        return rec.value, True
+
     def remove(self, key: Hashable) -> None:
         self._data.pop(key, None)
 

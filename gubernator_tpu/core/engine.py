@@ -875,6 +875,75 @@ class TpuEngine:
             )
         )
 
+    def snapshot_read(
+        self, key_hash: np.ndarray, now: Optional[int] = None
+    ) -> List[Optional[Tuple[int, int, int, int, bool]]]:
+        """NON-MUTATING host read of the store rows for these uint64 key
+        hashes: per key, (limit, duration, remaining, reset_time_unix,
+        over) for a live token window, or None (missing, expired, or
+        leaky — leaky state refills continuously and is out of the
+        replication scope, serve/replication.py). Nothing is written:
+        no eviction, no expiry deletion, no stats — which is what makes
+        bucket replication provably invisible to the decision stream
+        (replication ON == OFF byte-identical without failures).
+
+        Reads one gather of the addressed bucket rows, not the whole
+        table. Thread contract: call from the batcher's single submit
+        thread (DeviceBatcher.run_serialized) so the gather can never
+        race a store-donating dispatch."""
+        n = int(key_hash.shape[0])
+        if n == 0:
+            return []
+        if self.clock.epoch is None:
+            return [None] * n  # nothing ever decided
+        if now is None:
+            now = millisecond_now()
+        from gubernator_tpu.core.store import (
+            FLAG_ALGO_LEAKY,
+            FLAG_STICKY_OVER,
+            L_DURATION,
+            L_EXPIRE,
+            L_FLAGS,
+            L_LIMIT,
+            L_REMAINING,
+            L_TAG,
+            bucket_index,
+            fingerprints,
+        )
+
+        kh = jnp.asarray(np.ascontiguousarray(key_hash, dtype=np.uint64))
+        b = bucket_index(kh, self.config.slots)
+        fp = fingerprints(kh)
+        rows = jnp.take(self.store.entries, b, axis=0)  # [n, ways, LANES]
+        match = rows[..., L_TAG] == fp[:, None]
+        way = jnp.argmax(match, axis=1)
+        ent = jnp.take_along_axis(rows, way[:, None, None], axis=1)[:, 0, :]
+        found = np.asarray(match.any(axis=1))
+        ent = np.asarray(ent)
+        e_now = int(self.clock.to_engine(now))
+        out: List[Optional[Tuple[int, int, int, int, bool]]] = []
+        flags_col = ent[:, L_FLAGS]
+        for i in range(n):
+            if not found[i] or int(ent[i, L_EXPIRE]) < e_now:
+                out.append(None)  # miss, or entry past its reset
+                continue
+            flags = int(flags_col[i])
+            if flags & FLAG_ALGO_LEAKY:
+                out.append(None)
+                continue
+            remaining = int(ent[i, L_REMAINING])
+            reset_time = int(
+                self.clock.from_engine(np.int64(ent[i, L_EXPIRE]))
+            )
+            out.append((
+                int(ent[i, L_LIMIT]),
+                int(ent[i, L_DURATION]),
+                remaining,
+                reset_time,
+                bool(flags & FLAG_STICKY_OVER) or remaining == 0,
+            ))
+        return out
+
     def update_globals(
         self, updates: Sequence[Tuple[str, RateLimitResp]], now: Optional[int] = None
     ) -> None:

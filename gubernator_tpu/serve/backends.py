@@ -90,6 +90,35 @@ class ExactBackend:
         s = self.cache.stats()
         return dict(size=s.size, hit=s.hit, miss=s.miss)
 
+    def snapshot_read(self, keys, now=None):
+        """Bucket-replication snapshot surface (serve/replication.py):
+        per key, (limit, duration, remaining, reset_time, over) for a
+        live token window, else None. NON-MUTATING via LRUCache.peek —
+        no recency move, no stats, no expiry deletion — so the flush
+        loop is invisible to the decision stream (replication ON == OFF
+        without failures). Leaky state (_LeakyState) is out of scope.
+        Token windows here don't persist the creating duration (the
+        cached RateLimitResp has none); the manager backfills it from
+        the dirtying request's params."""
+        if now is None:
+            from gubernator_tpu.api.types import millisecond_now
+
+            now = millisecond_now()
+        out = []
+        for key in keys:
+            v, ok = self.cache.peek(key, now)
+            if not ok or not isinstance(v, RateLimitResp):
+                out.append(None)
+                continue
+            out.append((
+                v.limit,
+                0,  # duration not persisted; caller backfills
+                v.remaining,
+                v.reset_time,
+                v.status == Status.OVER_LIMIT or v.remaining == 0,
+            ))
+        return out
+
     def shed_generation(self) -> int:
         """Store-wipe epoch for the over-limit shed cache: the host LRU
         never wholesale-resets, so cached verdicts only die by their
@@ -181,6 +210,17 @@ class _ArrayOps:
     def resps_from_arrays(status, limit, remaining, reset):
         return resps_from_columns(status, limit, remaining, reset)
 
+    def snapshot_read(self, keys, now=None):
+        """Bucket-replication snapshot surface over the device store:
+        hash the keys once and gather their rows non-mutatingly
+        (core/engine.py TpuEngine.snapshot_read). MUST run on the
+        batcher's single submit thread (DeviceBatcher.run_serialized)
+        so the gather never races a store-donating dispatch; the
+        replication manager honors that contract."""
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        return self.engine.snapshot_read(slot_hash_batch(list(keys)), now)
+
     def shed_generation(self) -> int:
         """Engine store-wipe epoch (core/engine.py reset_generation):
         the over-limit shed cache clears itself whenever this moves, so
@@ -261,6 +301,12 @@ class MeshBackend(_ArrayOps):
             self.prep_reqs = None
             self.merge_prepped = None
             self.decide_submit_merged = None
+        if not hasattr(engine, "snapshot_read"):
+            # bucket replication needs the engine's non-mutating row
+            # read (r11); the sharded engines don't expose it yet —
+            # Instance refuses GUBER_REPLICATION=1 on such backends at
+            # boot instead of failing at the first flush
+            self.snapshot_read = None
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now

@@ -345,6 +345,18 @@ class DeviceBatcher:
         )
         return await fut
 
+    async def run_serialized(self, fn, *args):
+        """Run `fn(*args)` on the single submit thread, serialized with
+        every device dispatch. Bucket replication's snapshot reads
+        (serve/replication.py) use this: the store gather is
+        non-mutating but must not overlap a decide that DONATES the
+        store buffer, and the one-wide submit pool is exactly that
+        ordering guarantee."""
+        if self._closed:
+            raise RuntimeError("DeviceBatcher is stopped")
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(self._submit_pool, fn, *args)
+
     async def update_globals(self, updates) -> None:
         """Replica installs funnel through the same flusher queue so the
         backend stays single-threaded."""

@@ -61,6 +61,14 @@ class BehaviorConfig:
     breaker_window: int = 20  # GUBER_BREAKER_WINDOW
     breaker_cooldown: float = 1.0  # GUBER_BREAKER_COOLDOWN_MS
     breaker_probes: int = 1  # GUBER_BREAKER_PROBES
+    # GLOBAL gossip backlog bound (GUBER_GLOBAL_BACKLOG, r11): maximum
+    # distinct keys held in each of GlobalManager's aggregation dicts
+    # (_hits and _updates). An unreachable owner used to let the hit
+    # backlog grow without limit for the whole outage; past the cap,
+    # NEW keys are dropped (existing keys keep aggregating for free)
+    # and counted in global_backlog_dropped_total{queue} — fail-loud,
+    # like the shed-cache footprint lint.
+    global_backlog: int = 1 << 17
 
     def effective_peer_timeout(self) -> float:
         return self.peer_timeout if self.peer_timeout > 0 else self.batch_timeout
@@ -89,6 +97,8 @@ class BehaviorConfig:
             )
         if self.breaker_cooldown < 0:
             raise ValueError("GUBER_BREAKER_COOLDOWN_MS must be >= 0")
+        if self.global_backlog < 1:
+            raise ValueError("GUBER_GLOBAL_BACKLOG must be >= 1")
 
 
 @dataclass
@@ -225,6 +235,27 @@ class ServerConfig:
     # at boot like the store sizing pass).
     shed_cache: bool = True
     shed_cache_keys: int = 1 << 16
+    # Bucket replication (r11, serve/replication.py; GUBER_REPLICATION=1
+    # to enable, OFF by default): owned bucket windows are snapshot-read
+    # (non-mutating) every replication_sync_wait and shipped to each
+    # key's ring SUCCESSOR over the new ReplicateBuckets peer RPC, so a
+    # SIGKILLed owner's quota state survives takeover — an over-limit
+    # key stays over-limit instead of resetting to a full window.
+    # Receivers hold snapshots in a bounded standby table consulted
+    # ONLY on takeover (first owned touch after a ring change, a
+    # breaker-open successor forward, or a reconcile handback install);
+    # with no failures, replication ON is byte-identical to OFF
+    # (tests/test_replication.py pins it differentially).
+    replication: bool = False
+    # Flush window for the owner->successor snapshot loop; also the
+    # handback retry tick. Staleness bound on takeover state: one
+    # window + one RTT.
+    replication_sync_wait: float = 0.1  # GUBER_REPLICATION_SYNC_WAIT_MS
+    # Bound on the receiver-side standby table (LRU of snapshots per
+    # node) and on the sender-side dirty-key backlog; entries dropped
+    # past either bound are counted in replication_dropped_total.
+    replication_standby_keys: int = 1 << 16  # GUBER_REPLICATION_STANDBY_KEYS
+    replication_backlog: int = 1 << 16  # GUBER_REPLICATION_BACKLOG
     # in-flight device batches the batcher keeps before stalling submits.
     # 2 suffices co-located (PCIe fetch ~0.1ms); raise toward ~16 when
     # the accelerator sits behind a high-latency link (fetches pipeline,
@@ -360,6 +391,13 @@ class ServerConfig:
             raise ValueError("GUBER_PREP_THREADS must be >= 0")
         if self.shed_cache_keys < 0:
             raise ValueError("GUBER_SHED_CACHE_KEYS must be >= 0")
+        if self.replication_sync_wait < 0:
+            raise ValueError("GUBER_REPLICATION_SYNC_WAIT_MS must be >= 0")
+        if self.replication_standby_keys < 1 or self.replication_backlog < 1:
+            raise ValueError(
+                "GUBER_REPLICATION_STANDBY_KEYS / GUBER_REPLICATION_BACKLOG "
+                "must be >= 1"
+            )
         if self.store_mib < 0 or self.store_target_keys < 0:
             raise ValueError(
                 "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
@@ -463,6 +501,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
             env, "GUBER_BREAKER_COOLDOWN_MS", 1.0
         ),
         breaker_probes=_get_int(env, "GUBER_BREAKER_PROBES", 1),
+        global_backlog=_get_int(env, "GUBER_GLOBAL_BACKLOG", 1 << 17),
     )
     peers = [
         p.strip()
@@ -517,6 +556,16 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         shed_cache=_get(env, "GUBER_SHED_CACHE", "1").lower()
         not in ("0", "false", "no", "off"),
         shed_cache_keys=_get_int(env, "GUBER_SHED_CACHE_KEYS", 1 << 16),
+        replication=_get(env, "GUBER_REPLICATION") in ("1", "true", "yes"),
+        replication_sync_wait=_get_float_ms(
+            env, "GUBER_REPLICATION_SYNC_WAIT_MS", 0.1
+        ),
+        replication_standby_keys=_get_int(
+            env, "GUBER_REPLICATION_STANDBY_KEYS", 1 << 16
+        ),
+        replication_backlog=_get_int(
+            env, "GUBER_REPLICATION_BACKLOG", 1 << 16
+        ),
         # prep_at_arrival / prep_threads deliberately NOT resolved
         # here: their None/0 defaults defer to DeviceBatcher, the
         # single owner of the GUBER_PREP_AT_ARRIVAL /

@@ -823,6 +823,13 @@ class EdgeBridge:
         import numpy as np
 
         self.instance.traffic.observe(full, fields["key_hash"])
+        repl = getattr(self.instance, "repl", None)
+        if repl is not None:
+            # folded frames are all-owned by construction: their
+            # windows must dirty the replication queue like any other
+            # owner decide (pre-hashed fast frames carry no key
+            # strings and cannot — documented scope limit)
+            repl.queue_dirty_fields(full, fields)
         status, limit, remaining, reset = (
             await self._decide_arrays_shed(fields, n)
         )

@@ -28,6 +28,7 @@ from gubernator_tpu.api.types import Behavior, RateLimitReq
 from gubernator_tpu.serve.config import BehaviorConfig
 from gubernator_tpu.serve.metrics import (
     GLOBAL_ASYNC_DURATIONS,
+    GLOBAL_BACKLOG_DROPPED,
     GLOBAL_BROADCAST_DURATIONS,
     GLOBAL_TASK_RESTARTS,
 )
@@ -50,6 +51,38 @@ SUPERVISE_RESET_S = 60.0
 SEND_FANOUT = 16
 
 
+async def supervise(name: str, loop_factory) -> None:
+    """Keep a gossip-style background loop alive: an unexpected death
+    restarts it with bounded exponential backoff instead of only
+    logging (the pre-r8 behavior left GLOBAL gossip silently dead for
+    the rest of the process). A loop that ran healthily for longer than
+    SUPERVISE_RESET_S before dying restarts at the BASE backoff, not
+    the escalated one. Restarts are counted in
+    global_task_restarts_total{task}. Shared by GlobalManager and
+    ReplicationManager (serve/replication.py)."""
+    backoff = SUPERVISE_BACKOFF_S
+    while True:
+        started = time.monotonic()
+        try:
+            await loop_factory()
+            return  # loops are infinite; a clean return means done
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            if time.monotonic() - started > SUPERVISE_RESET_S:
+                backoff = SUPERVISE_BACKOFF_S
+            log.error(
+                "%s loop died: %r; restarting in %.2fs",
+                name, e, backoff, exc_info=e,
+            )
+            try:
+                GLOBAL_TASK_RESTARTS.labels(task=name).inc()
+            except Exception:  # pragma: no cover - defensive
+                pass
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, SUPERVISE_BACKOFF_MAX_S)
+
+
 class GlobalManager:
     def __init__(self, conf: BehaviorConfig, instance):
         self.conf = conf
@@ -59,6 +92,7 @@ class GlobalManager:
         self._hits_event = asyncio.Event()
         self._updates_event = asyncio.Event()
         self._tasks = []
+        self._dropped = {"hits": 0, "updates": 0}
 
     def start(self) -> None:
         if not self._tasks:
@@ -72,32 +106,9 @@ class GlobalManager:
             ]
 
     async def _supervise(self, name: str, loop_factory) -> None:
-        """Keep a gossip loop alive: an unexpected death restarts it
-        with bounded exponential backoff instead of only logging (the
-        pre-r8 behavior left GLOBAL gossip silently dead for the rest
-        of the process). Restarts are counted in
-        global_task_restarts_total{task}."""
-        backoff = SUPERVISE_BACKOFF_S
-        while True:
-            started = time.monotonic()
-            try:
-                await loop_factory()
-                return  # loops are infinite; a clean return means done
-            except asyncio.CancelledError:
-                raise
-            except Exception as e:
-                if time.monotonic() - started > SUPERVISE_RESET_S:
-                    backoff = SUPERVISE_BACKOFF_S
-                log.error(
-                    "global manager %s loop died: %r; restarting in "
-                    "%.2fs", name, e, backoff, exc_info=e,
-                )
-                try:
-                    GLOBAL_TASK_RESTARTS.labels(task=name).inc()
-                except Exception:  # pragma: no cover - defensive
-                    pass
-                await asyncio.sleep(backoff)
-                backoff = min(backoff * 2, SUPERVISE_BACKOFF_MAX_S)
+        # the plain task name keeps the metric label stable
+        # (global_task_restarts_total{task="async_hits"|"broadcasts"})
+        await supervise(name, loop_factory)
 
     async def stop(self) -> None:
         for t in self._tasks:
@@ -127,20 +138,46 @@ class GlobalManager:
 
     def queue_hit(self, r: RateLimitReq) -> None:
         """Aggregate a non-owner hit for async forwarding
-        (global.go:62-64,78-86)."""
+        (global.go:62-64,78-86). Bounded: an unreachable owner must not
+        grow the backlog for the whole outage — past
+        GUBER_GLOBAL_BACKLOG distinct keys, NEW keys are dropped (and
+        counted); keys already aggregating keep accumulating for free."""
         key = r.hash_key()
         cur = self._hits.get(key)
         if cur is not None:
             cur.hits += r.hits
+        elif len(self._hits) >= self.conf.global_backlog:
+            self._drop("hits")
+            return
         else:
             self._hits[key] = replace(r)
         self._hits_event.set()
 
     def queue_update(self, r: RateLimitReq) -> None:
         """Mark an owned GLOBAL key for status broadcast
-        (global.go:66-68,164-165)."""
-        self._updates[r.hash_key()] = replace(r)
+        (global.go:66-68,164-165). Bounded like queue_hit."""
+        key = r.hash_key()
+        if key not in self._updates and (
+            len(self._updates) >= self.conf.global_backlog
+        ):
+            self._drop("updates")
+            return
+        self._updates[key] = replace(r)
         self._updates_event.set()
+
+    def _drop(self, queue: str) -> None:
+        self._dropped[queue] += 1
+        n = self._dropped[queue]
+        if n & (n - 1) == 0:  # log at powers of two, not per drop
+            log.warning(
+                "GLOBAL %s backlog full (GUBER_GLOBAL_BACKLOG=%d): "
+                "%d new key(s) dropped so far this process",
+                queue, self.conf.global_backlog, n,
+            )
+        try:
+            GLOBAL_BACKLOG_DROPPED.labels(queue=queue).inc()
+        except Exception:  # pragma: no cover - defensive
+            pass
 
     # -- loops --------------------------------------------------------------
 

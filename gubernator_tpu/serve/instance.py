@@ -88,12 +88,35 @@ class Instance:
             )
         else:
             self.shed = None
+        # bucket replication (r11, serve/replication.py): owned windows
+        # snapshot to each key's ring successor so a killed owner's
+        # quota state survives takeover. OFF by default
+        # (GUBER_REPLICATION=0); requires the backend's non-mutating
+        # snapshot surface — refused loudly at boot otherwise.
+        if getattr(conf, "replication", False):
+            if getattr(backend, "snapshot_read", None) is None:
+                raise ValueError(
+                    "GUBER_REPLICATION=1 needs a backend with a "
+                    "non-mutating snapshot_read surface (exact/tpu); "
+                    f"backend '{conf.backend}' does not expose one"
+                )
+            from gubernator_tpu.serve.replication import (
+                ReplicationManager,
+            )
+
+            self.repl = ReplicationManager(conf, self)
+        else:
+            self.repl = None
 
     def start(self) -> None:
         self.batcher.start()
         self.global_mgr.start()
+        if self.repl is not None:
+            self.repl.start()
 
     async def stop(self) -> None:
+        if self.repl is not None:
+            await self.repl.stop()
         await self.global_mgr.stop()
         await self.batcher.stop()
         for peer in self.picker.peers():
@@ -145,6 +168,10 @@ class Instance:
         shed = self.shed
         if shed is not None:
             shed.refresh_generation()
+        repl = self.repl
+        # takeover seeds (r11): owned first touches whose key has a
+        # replicated standby snapshot install it BEFORE deciding
+        seeds: List[Tuple[int, str, object]] = []
         fps = {}
 
         for j, (i, r, key) in enumerate(valid):
@@ -171,11 +198,17 @@ class Instance:
                 shed.lookup_resp(h, r) if shed is not None else None
             )
             if peer.is_owner:
+                if repl is not None:
+                    repl.queue_dirty(r)
                 if verdict is not None:
                     if r.behavior == Behavior.GLOBAL:
                         self.global_mgr.queue_update(r)
                     out[i] = verdict
                     continue
+                if repl is not None:
+                    s = repl.standby_pop(key)
+                    if s is not None:
+                        seeds.append((i, key, s))
                 local.append((i, r, False))
             elif r.behavior == Behavior.GLOBAL:
                 # replica answer + async hit forward (gubernator.go:133-140)
@@ -209,6 +242,10 @@ class Instance:
                 if shed is not None:
                     shed.observe_resps([fps[i]], [r], [resp])
             except Exception as e:
+                taken = await self._takeover_fallback([(i, r)], peer, e)
+                if taken is not None:
+                    out[i] = taken[0]
+                    return
                 degraded = await self._degraded_fallback([(i, r)], peer, e)
                 if degraded is not None:
                     out[i] = degraded[0]
@@ -241,6 +278,11 @@ class Instance:
                         resps,
                     )
             except Exception as e:
+                taken = await self._takeover_fallback(items, peer, e)
+                if taken is not None:
+                    for (i, _), resp in zip(items, taken):
+                        out[i] = resp
+                    return
                 degraded = await self._degraded_fallback(items, peer, e)
                 if degraded is not None:
                     for (i, _), resp in zip(items, degraded):
@@ -274,6 +316,13 @@ class Instance:
             for p, items in grouped.items()
         ]
 
+        seeded_idx: List[int] = []
+        if seeds:
+            # install the standby snapshots BEFORE the batch decides;
+            # the awaited install funnels through the same flusher
+            # queue as the decide, so ordering is guaranteed and the
+            # first owned touch continues the dead owner's window
+            seeded_idx = await self._seed_standby(seeds)
         if local:
             local_reqs = [r for _, r, _ in local]
             gnp = [g for _, _, g in local]
@@ -297,7 +346,101 @@ class Instance:
                     )
         if tasks:
             await asyncio.gather(*tasks)
+        for i in seeded_idx:
+            resp = out[i]
+            if resp is not None and not resp.error:
+                resp.metadata["replicated"] = "true"
         return [r if r is not None else RateLimitResp() for r in out]
+
+    async def _install_seeds(self, seeds) -> bool:
+        """Install popped standby snapshots ((key, Snapshot) pairs)
+        into the local store through the UpdatePeerGlobals machinery —
+        which also purges shed-cache entries for those keys, keeping
+        the r10 invalidation rules intact. Returns False on install
+        failure: the caller's decide then proceeds un-seeded (a fresh
+        window — amnesia for those keys, not an outage)."""
+        from gubernator_tpu.serve.replication import snapshot_resp
+
+        try:
+            await self.update_peer_globals(
+                [(k, snapshot_resp(s)) for k, s in seeds]
+            )
+        except Exception as e:
+            log.warning("standby seed install failed: %s", e)
+            return False
+        self.repl.note_seeded(seeds)
+        return True
+
+    async def _seed_standby(self, seeds) -> List[int]:
+        """(out_index, key, Snapshot) triples -> installed; returns the
+        out-indices seeded (their responses get
+        metadata["replicated"]="true")."""
+        if not await self._install_seeds([(k, s) for _, k, s in seeds]):
+            return []
+        return [i for i, _, _ in seeds]
+
+    async def _takeover_local(self, reqs: Sequence[RateLimitReq]):
+        """Decide items locally in a dead owner's stead (this node is
+        their ring successor): seed first touches from the standby
+        table, and track every key for the reconcile handback once the
+        owner returns."""
+        repl = self.repl
+        seeds = []
+        for r in reqs:
+            repl.mark_taken(r)
+            s = repl.standby_pop(r.hash_key())
+            if s is not None:
+                seeds.append((r.hash_key(), s))
+        if seeds:
+            await self._install_seeds(seeds)
+        return await self.decide_local(reqs, [False] * len(reqs))
+
+    async def _takeover_fallback(self, items, peer, exc):
+        """Successor takeover (GUBER_REPLICATION=1): a forward that
+        failed because its owner is unreachable (breaker open — which
+        fails fast, so this is usually cheap — retries exhausted, or
+        deadline) is routed to each key's ring SUCCESSOR: the node the
+        consistent hash elects on owner removal, and the one holding
+        the replicated standby snapshots. Served locally when the
+        successor is this node, via one forwarded group otherwise (the
+        remote successor seeds from its own standby table in
+        get_peer_rate_limits). Responses carry metadata owner=successor
+        and replicated="true". Returns the responses or None
+        (replication off / no distinct successor / successor also
+        unreachable — the caller then falls through to degraded mode
+        and per-item errors, the r8 ladder)."""
+        repl = self.repl
+        if repl is None:
+            return None
+        out: List[Optional[RateLimitResp]] = [None] * len(items)
+        by_succ: dict = {}
+        for j, (_, r) in enumerate(items):
+            try:
+                succ = self.picker.get_successor(r.hash_key())
+            except Exception:
+                succ = None
+            if succ is None or succ.host == peer.host:
+                return None
+            by_succ.setdefault(succ, []).append(j)
+        try:
+            for succ, idxs in by_succ.items():
+                reqs = [items[j][1] for j in idxs]
+                if succ.is_owner:
+                    resps = await self._takeover_local(reqs)
+                else:
+                    resps = await succ.get_peer_rate_limits_grouped(reqs)
+                for j, resp in zip(idxs, resps):
+                    if not resp.error:
+                        resp.metadata["owner"] = succ.host
+                        resp.metadata["replicated"] = "true"
+                    out[j] = resp
+        except Exception as e2:
+            log.warning(
+                "takeover route for %d item(s) failed (owner '%s': %s; "
+                "successor: %s)", len(items), peer.host, exc, e2,
+            )
+            return None
+        return out
 
     async def _degraded_fallback(self, items, peer, exc):
         """Degraded mode (GUBER_DEGRADED_LOCAL=1): a forward that failed
@@ -356,6 +499,8 @@ class Instance:
                 # owner-side injection point: a chaos spec can make THIS
                 # node a slow/failing owner for its peers' forwards
                 await FAULTS.inject("peer_serve")
+            if self.repl is not None:
+                await self._peer_serve_replication(reqs)
             shed = self.shed
             if shed is None:
                 return await self.decide_local(reqs, [False] * len(reqs))
@@ -393,9 +538,49 @@ class Instance:
         except Exception as e:
             return [RateLimitResp(error=str(e)) for _ in reqs]
 
+    async def _peer_serve_replication(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> None:
+        """Owner-side replication hooks for a forwarded batch: owned
+        keys dirty the snapshot queue; keys the ring says ANOTHER node
+        owns were routed here by a peer's takeover fallback — track
+        them for the reconcile handback; and any first touch with a
+        standby snapshot seeds the store before the batch decides."""
+        repl = self.repl
+        seeds = []
+        for r in reqs:
+            key = r.hash_key()
+            try:
+                own = self.get_peer(key).is_owner
+            except Exception:
+                own = True
+            if own:
+                repl.queue_dirty(r)
+            else:
+                repl.mark_taken(r)
+            s = repl.standby_pop(key)
+            if s is not None:
+                seeds.append((key, s))
+        if seeds:
+            await self._install_seeds(seeds)
+
+    async def replicate_buckets(self, owner: str, snaps) -> None:
+        """ReplicateBuckets receive path (peers.proto): file or install
+        another owner's bucket snapshots (serve/replication.py
+        install). A node with replication off accepts and ignores —
+        knob/version skew across the fleet must not fail the sender."""
+        if self.repl is None:
+            return
+        await self.repl.install(owner, snaps)
+
     async def update_peer_globals(
         self, updates: Sequence[Tuple[str, RateLimitResp]]
     ) -> None:
+        if self.repl is not None and updates:
+            # an owner broadcasting status for these keys is alive and
+            # authoritative: any replicated standby snapshot for them
+            # is superseded (the reconcile contract, r11)
+            self.repl.standby_purge([k for k, _ in updates])
         if self.shed is None or not updates:
             await self.batcher.update_globals(list(updates))
             return

@@ -181,6 +181,57 @@ GLOBAL_TASK_RESTARTS = Counter(
     ["task"],
     registry=REGISTRY,
 )
+GLOBAL_BACKLOG_DROPPED = Counter(
+    "global_backlog_dropped_total",
+    "GLOBAL gossip entries dropped because the aggregation backlog hit "
+    "GUBER_GLOBAL_BACKLOG distinct keys (an unreachable owner no longer "
+    "grows the hit backlog without bound); labelled by queue (hits | "
+    "updates)",
+    ["queue"],
+    registry=REGISTRY,
+)
+REPLICATION_SNAPSHOTS_SENT = Counter(
+    "replication_snapshots_sent_total",
+    "Owned-bucket snapshots shipped to ring successors (and reconcile "
+    "handbacks to returned owners) over ReplicateBuckets "
+    "(GUBER_REPLICATION=1, serve/replication.py)",
+    registry=REGISTRY,
+)
+REPLICATION_STANDBY_ENTRIES = Gauge(
+    "replication_standby_entries",
+    "Live snapshots in the receiver-side standby table (bounded by "
+    "GUBER_REPLICATION_STANDBY_KEYS; consulted only on takeover)",
+    registry=REGISTRY,
+)
+REPLICATED_TAKEOVERS = Counter(
+    "replicated_takeovers_total",
+    "First-touch decisions seeded from a standby snapshot after a "
+    "takeover (owner dead or removed) instead of starting a fresh "
+    'window; the seeded responses carry metadata["replicated"]="true"',
+    registry=REGISTRY,
+)
+REPLICATION_RECONCILES = Counter(
+    "replication_reconciles_total",
+    "Snapshots installed directly into the LOCAL store because this "
+    "node owns their keys (reconcile handback from the interim "
+    "successor after an owner returns)",
+    registry=REGISTRY,
+)
+REPLICATION_LAG = Gauge(
+    "replication_lag_seconds",
+    "Age of the last snapshot applied at takeover/reconcile time "
+    "(receiver clock minus the owner's snapshot_ms stamp; bounded by "
+    "one GUBER_REPLICATION_SYNC_WAIT_MS window + RTT when healthy)",
+    registry=REGISTRY,
+)
+REPLICATION_DROPPED = Counter(
+    "replication_dropped_total",
+    "Replication entries dropped at a bound: dirty-backlog keys past "
+    "GUBER_REPLICATION_BACKLOG, standby evictions past "
+    "GUBER_REPLICATION_STANDBY_KEYS",
+    ["what"],
+    registry=REGISTRY,
+)
 DRAIN_DURATION = Gauge(
     "drain_duration_seconds",
     "Wall time of the last graceful drain (SIGTERM: deregister, refuse "

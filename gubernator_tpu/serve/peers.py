@@ -272,6 +272,37 @@ class PeerClient:
 
         await self._call_resilient(call, idempotent=True, timeout=timeout)
 
+    async def replicate_buckets(self, snaps, owner: str) -> None:
+        """Ship owned-bucket snapshots to this peer (the key's ring
+        successor, or — for a reconcile handback — its returned owner).
+        `snaps`: sequence of serve/replication.Snapshot. Installs are
+        last-write-wins by (reset_time, snapshot_ms), so retries and
+        duplicate deliveries are always safe."""
+        pb_req = peers_pb2.ReplicateBucketsReq(
+            owner=owner,
+            buckets=[
+                peers_pb2.BucketSnapshot(
+                    key=s.key,
+                    algorithm=s.algorithm,
+                    limit=s.limit,
+                    duration=s.duration,
+                    remaining=s.remaining,
+                    reset_time=s.reset_time,
+                    status=s.status,
+                    snapshot_ms=s.snapshot_ms,
+                )
+                for s in snaps
+            ],
+        )
+        timeout = self.conf.global_timeout
+
+        async def call() -> None:
+            await self.stub.ReplicateBuckets(
+                pb_req, timeout=timeout or None
+            )
+
+        await self._call_resilient(call, idempotent=True, timeout=timeout)
+
     # -- resilience envelope (r8) -------------------------------------------
 
     async def _call_resilient(
@@ -457,6 +488,28 @@ class ConsistentHashPicker:
         if i == len(self._keys):
             i = 0
         return self._by_point[self._keys[i]]
+
+    def get_successor(self, key: str) -> Optional[PeerClient]:
+        """The peer that would own `key` if its current owner left the
+        ring: the next ring point after the key's, skipping points
+        belonging to the owner itself (wraparound like get()). This is
+        where the consistent hash routes the key on owner removal, so
+        it is both the replication target (serve/replication.py) and
+        the takeover route when the owner's breaker opens. None when
+        the ring has fewer than two distinct hosts."""
+        if not self._keys:
+            return None
+        point = self._hash(key)
+        i = bisect.bisect_left(self._keys, point)
+        if i == len(self._keys):
+            i = 0
+        owner = self._by_point[self._keys[i]]
+        n = len(self._keys)
+        for step in range(1, n):
+            peer = self._by_point[self._keys[(i + step) % n]]
+            if peer.host != owner.host:
+                return peer
+        return None
 
     def self_owned_mask(self, keys: Sequence[str]):
         """bool[len(keys)]: the key's ring successor is this server

@@ -167,6 +167,25 @@ class PeersV1Servicer:
         await self.instance.update_peer_globals(updates)
         return peers_pb2.UpdatePeerGlobalsResp()
 
+    async def ReplicateBuckets(self, request, context):
+        from gubernator_tpu.serve.replication import Snapshot
+
+        snaps = [
+            Snapshot(
+                key=b.key,
+                algorithm=b.algorithm,
+                limit=b.limit,
+                duration=b.duration,
+                remaining=b.remaining,
+                reset_time=b.reset_time,
+                status=b.status,
+                snapshot_ms=b.snapshot_ms,
+            )
+            for b in request.buckets
+        ]
+        await self.instance.replicate_buckets(request.owner, snaps)
+        return peers_pb2.ReplicateBucketsResp()
+
 
 def register_servicers(grpc_server, instance: Instance):
     """Embed gubernator in a caller-owned `grpc.aio` server.
@@ -300,6 +319,22 @@ class Server:
         else:
             log.info("over-limit shed cache: off (GUBER_SHED_CACHE=0)")
 
+        repl = self.instance.repl
+        if repl is not None:
+            from gubernator_tpu.serve.replication import footprint_mib
+
+            log.info(
+                "bucket replication: on — window %.0f ms, standby "
+                "bound %d keys (~%.1f MiB), backlog %d "
+                "(GUBER_REPLICATION / GUBER_REPLICATION_SYNC_WAIT_MS / "
+                "GUBER_REPLICATION_STANDBY_KEYS / "
+                "GUBER_REPLICATION_BACKLOG)",
+                repl.sync_wait * 1e3, repl.standby_cap,
+                footprint_mib(repl.standby_cap), repl.backlog_cap,
+            )
+        else:
+            log.info("bucket replication: off (GUBER_REPLICATION=0)")
+
         if self.conf.http_address:
             await self._start_http()
         if self.conf.edge_socket or self.conf.edge_tcp:
@@ -395,6 +430,14 @@ class Server:
             if await step("http", self._http_runner.cleanup()):
                 self._http_runner = None
         await step("global_flush", self.instance.global_mgr.drain())
+        if self.instance.repl is not None:
+            # ship still-dirty owned windows to their successors (and
+            # attempt one handback round) before the batcher runs dry —
+            # a SIGTERMed owner must not take its freshest quota state
+            # down with it
+            await step(
+                "replication_flush", self.instance.repl.drain()
+            )
         await step("batcher", self.instance.batcher.drain())
         timings["total"] = time.monotonic() - t0
         try:
@@ -548,6 +591,10 @@ class Server:
             metrics.SHED_HITS.set(shed.hits)
             metrics.SHED_LOOKUPS.set(shed.lookups)
             metrics.SHED_ENTRIES.set(len(shed))
+        if self.instance.repl is not None:
+            metrics.REPLICATION_STANDBY_ENTRIES.set(
+                self.instance.repl.standby_len
+            )
         # stage totals export lazily at scrape time: the hot path only
         # touches the plain-float accumulator (serve/stages.py)
         from gubernator_tpu.serve.stages import STAGES

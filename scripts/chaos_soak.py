@@ -1,37 +1,48 @@
 """Chaos soak: a 3-node cluster under load while a peer is killed,
 restarted, and faults are injected — the end-to-end proof of the r8
 resilience layer (deadlines, retries, circuit breaker, degraded mode,
-graceful drain).
+graceful drain) and, since r11, of bucket replication (GUBER_REPLICATION:
+owner death without quota amnesia).
 
 Timeline (one soak):
 
   phase 0  boot 3 daemons (exact backend, static full-mesh peers,
-           GUBER_DEGRADED_LOCAL=1, breaker/retry knobs pinned,
-           GUBER_FAULT_SPEC latency+error injection on the observer
-           node) and drive HTTP load at all of them
-  phase 1  healthy baseline
+           GUBER_DEGRADED_LOCAL=1, GUBER_REPLICATION=1, breaker/retry
+           knobs pinned, GUBER_FAULT_SPEC latency+error injection on
+           the observer node) and drive HTTP load at all of them
+  phase 1  healthy baseline; drive a tracked victim-owned key
+           OVER-LIMIT (the quota-amnesia canary) and let the owner's
+           replication flush ship it to the ring successor
   phase 2  SIGKILL the victim node mid-load; the observer's breaker
-           must trip (health goes unhealthy, "circuit open"), and
-           victim-owned keys are answered DEGRADED from local stores
-           (metadata.degraded=true), not errored
+           must trip (health goes unhealthy, "circuit open");
+           victim-owned keys get successor-takeover answers
+           (metadata.replicated=true; degraded-local remains the
+           fallback rung) — and the amnesia canary STAYS over-limit
+           (pre-r11 it provably reset to a full window)
   phase 3  restart the victim; measure recovery = time from the victim
            serving again to the observer forwarding to it successfully
            (breaker half-open probe -> closed); must be within 2
-           breaker cooldowns ("health intervals")
+           breaker cooldowns. Then the reconcile handback lands and
+           the canary is over-limit ON THE REBORN OWNER — no amnesia
+           across the restart either; its lag is recorded
   phase 4  SIGTERM the drain node under load: the daemon must
-           deregister, finish in-flight work, and exit 0 within
+           deregister, finish in-flight work (incl. the new
+           replication_flush drain step), and exit 0 within
            GUBER_DRAIN_TIMEOUT_MS + stop margin, with every accepted
            request answered (no in-flight loss)
 
-Acceptance (exit code != 0 on violation, ISSUE 3):
+Acceptance (exit code != 0 on violation, ISSUE 3 + ISSUE 7):
   - served error rate (item errors + accepted-but-unanswered requests
     on ALIVE nodes) < 5% over the soak
   - breaker trips after the kill and recovers within 2 cooldowns of
     the victim returning
+  - the amnesia canary never answers UNDER_LIMIT: not during takeover,
+    not after the restart/reconcile cycle (bounded reconcile poll)
   - drain exits 0 within the budget; no in-flight request lost
   - injected faults actually fired (faults_injected_total > 0)
 
-Writes the measured soak to --json (BENCH_CHAOS_r8.json).
+Writes the measured soak to --json (BENCH_CHAOS_r11.json), including
+takeover/reconcile lags and the replication_lag_seconds metric.
 """
 
 from __future__ import annotations
@@ -57,6 +68,11 @@ from tests._util import free_ports  # noqa: E402
 BREAKER_COOLDOWN_MS = 1000
 DRAIN_TIMEOUT_MS = 3000
 FAULT_SPEC = "peer_rpc:delay=20ms:p=0.1,peer_rpc:error:p=0.02"
+REPLICATION_SYNC_WAIT_MS = 50
+# amnesia canary window: tiny limit, long duration (must outlive the
+# whole kill -> takeover -> restart -> reconcile cycle)
+AMNESIA_LIMIT = 5
+AMNESIA_DURATION_MS = 600_000
 
 OBSERVER, DRAIN_NODE, VICTIM = 0, 1, 2
 
@@ -88,6 +104,8 @@ class Cluster:
             GUBER_BREAKER_FAILURES="3",
             GUBER_BREAKER_COOLDOWN_MS=str(BREAKER_COOLDOWN_MS),
             GUBER_DRAIN_TIMEOUT_MS=str(DRAIN_TIMEOUT_MS),
+            GUBER_REPLICATION="1",
+            GUBER_REPLICATION_SYNC_WAIT_MS=str(REPLICATION_SYNC_WAIT_MS),
         )
         env.pop("GUBER_FAULT_SPEC", None)
         env.pop("GUBER_ETCD_ENDPOINTS", None)
@@ -174,7 +192,7 @@ class LoadGen:
         self.workers = workers
         self.alive = set(range(cluster.n))
         self.counts = {
-            "ok": 0, "degraded": 0, "item_error": 0,
+            "ok": 0, "degraded": 0, "replicated": 0, "item_error": 0,
             "inflight_loss": 0, "refused": 0,
         }
         self._lock = threading.Lock()
@@ -233,6 +251,8 @@ class LoadGen:
                             self.counts["item_error"] += 1
                         elif r["metadata"].get("degraded") == "true":
                             self.counts["degraded"] += 1
+                        elif r["metadata"].get("replicated") == "true":
+                            self.counts["replicated"] += 1
                         else:
                             self.counts["ok"] += 1
             except urllib.error.URLError as e:
@@ -275,6 +295,79 @@ def find_victim_keys(cluster, victim_addr, want=8):
     return keys
 
 
+def amnesia_req(key, hits):
+    return {"name": "amnesia", "uniqueKey": key, "hits": hits,
+            "limit": AMNESIA_LIMIT, "duration": AMNESIA_DURATION_MS}
+
+
+def find_amnesia_key(cluster, victim_addr):
+    """A victim-owned key under the canary's OWN name/params — it must
+    not collide with the load generator's key set, whose windows use
+    different limits."""
+    for i in range(512):
+        key = f"amn{i}"
+        out = post_limits(cluster.http[OBSERVER], [amnesia_req(key, 0)])
+        r = out["responses"][0]
+        if not r["error"] and r["metadata"].get("owner") == victim_addr:
+            return key
+    raise RuntimeError("no victim-owned amnesia key in 512 tries")
+
+
+def peek_amnesia(cluster, node, key):
+    """One hits=0 canary sample (peeks read the stored window without
+    decrementing it, so sampling can never drive the key over-limit by
+    itself and mask an amnesia reset)."""
+    return post_limits(
+        cluster.http[node], [amnesia_req(key, 0)]
+    )["responses"][0]
+
+
+def drive_amnesia_over(cluster, victim_addr, key):
+    """Exhaust the canary window on its owner (remaining -> 0), then
+    poll a peek until the OWNER answers OVER_LIMIT (not a degraded or
+    takeover stand-in — injected faults can bounce individual drives)."""
+    for _ in range(5):
+        r = post_limits(
+            cluster.http[OBSERVER], [amnesia_req(key, AMNESIA_LIMIT)]
+        )["responses"][0]
+        if not r["error"] and not r["metadata"].get("degraded"):
+            break
+        time.sleep(0.1)
+
+    def owner_says_over():
+        try:
+            r = peek_amnesia(cluster, OBSERVER, key)
+        except OSError:
+            return False
+        return (
+            not r["error"]
+            and r["status"] == "OVER_LIMIT"
+            and r["metadata"].get("owner") == victim_addr
+            and r["metadata"].get("degraded") != "true"
+            and r["metadata"].get("replicated") != "true"
+        )
+
+    return poll_until(owner_says_over, 5.0, interval=0.1,
+                      what="amnesia canary never went over-limit")
+
+
+def scrape_replication_metrics(cluster, node):
+    """replication_* gauges/counters from one node's /metrics."""
+    out = {}
+    try:
+        txt = get_text(f"http://127.0.0.1:{cluster.http[node]}/metrics")
+    except OSError:
+        return out
+    for line in txt.splitlines():
+        for name in ("replication_lag_seconds",
+                     "replicated_takeovers_total",
+                     "replication_reconciles_total",
+                     "replication_snapshots_sent_total"):
+            if line.startswith(name + " "):
+                out[name] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
 def poll_until(pred, timeout, interval=0.1, what=""):
     deadline = time.monotonic() + timeout
     while time.monotonic() < deadline:
@@ -289,7 +382,7 @@ def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=20.0,
                     help="approximate total soak length")
-    ap.add_argument("--json", default="BENCH_CHAOS_r8.json")
+    ap.add_argument("--json", default="BENCH_CHAOS_r11.json")
     args = ap.parse_args()
     phase = max(2.0, args.seconds / 5.0)
 
@@ -297,12 +390,14 @@ def main() -> int:
     gen = None
     failures = []
     result = {
-        "soak": "chaos_3node_kill_restart_drain",
+        "soak": "chaos_3node_kill_restart_drain_amnesia",
         "backend": "exact",
         "nodes": 3,
         "fault_spec": FAULT_SPEC,
         "breaker_cooldown_ms": BREAKER_COOLDOWN_MS,
         "drain_timeout_ms": DRAIN_TIMEOUT_MS,
+        "replication_sync_wait_ms": REPLICATION_SYNC_WAIT_MS,
+        "amnesia_limit": AMNESIA_LIMIT,
         "phase_seconds": phase,
     }
     victim_addr = f"127.0.0.1:{cluster.grpc[VICTIM]}"
@@ -317,6 +412,11 @@ def main() -> int:
               f"{cluster.log_dir}", file=sys.stderr)
 
         victim_keys = find_victim_keys(cluster, victim_addr)
+        # quota-amnesia canary (r11): a victim-owned key under its own
+        # params, driven over-limit BEFORE the kill so its frozen
+        # refusal replicates to the ring successor
+        amnesia_key = find_amnesia_key(cluster, victim_addr)
+        result["amnesia_key"] = amnesia_key
         keys = [f"vk{i}" for i in range(64)] + [
             f"ck{i}" for i in range(128)
         ]
@@ -325,6 +425,12 @@ def main() -> int:
 
         # phase 1: healthy baseline
         time.sleep(phase)
+        if not drive_amnesia_over(cluster, victim_addr, amnesia_key):
+            failures.append(
+                "amnesia canary never went over-limit on its owner"
+            )
+        # let the owner's replication flush ship the frozen window
+        time.sleep(max(0.5, 6 * REPLICATION_SYNC_WAIT_MS / 1e3))
 
         # phase 2: kill the victim mid-run. The load generator stops
         # targeting it first (a real LB routes around a dead listener);
@@ -359,16 +465,60 @@ def main() -> int:
             failures.append("breaker never tripped after the kill")
         time.sleep(phase)
 
-        # degraded answers must actually be happening for victim keys
+        # victim keys must be answered by a stand-in, not errored:
+        # successor takeover (replicated=true) first, degraded-local as
+        # the fallback rung
         out = post_limits(cluster.http[OBSERVER], [{
             "name": "chaos", "uniqueKey": victim_keys[0], "hits": 0,
             "limit": 10_000_000, "duration": 3_600_000,
         }])
         r = out["responses"][0]
-        if r["error"] or r["metadata"].get("degraded") != "true":
+        if r["error"] or (
+            r["metadata"].get("degraded") != "true"
+            and r["metadata"].get("replicated") != "true"
+        ):
             failures.append(
-                f"victim-owned key not served degraded during the "
-                f"outage: {r}"
+                f"victim-owned key not served replicated/degraded "
+                f"during the outage: {r}"
+            )
+
+        # quota-amnesia assert, takeover half: the canary STAYS
+        # over-limit while its owner is dead. Sampled as peeks at the
+        # DRAIN node (no fault injection there): each one forwards,
+        # fails fast on the open breaker, and is answered by the ring
+        # successor from the replicated standby snapshot.
+        outage = {"over": 0, "under": 0, "other": 0}
+        t_first_over = None
+        for _ in range(8):
+            try:
+                r = peek_amnesia(cluster, DRAIN_NODE, amnesia_key)
+            except OSError:
+                outage["other"] += 1
+                continue
+            if r["error"]:
+                outage["other"] += 1
+            elif r["status"] == "OVER_LIMIT":
+                outage["over"] += 1
+                if (
+                    t_first_over is None
+                    and r["metadata"].get("replicated") == "true"
+                ):
+                    t_first_over = round(time.monotonic() - t_kill, 2)
+            else:
+                outage["under"] += 1
+            time.sleep(0.1)
+        result["amnesia_outage_samples"] = outage
+        result["takeover_first_over_s"] = t_first_over
+        if outage["under"] > 0:
+            failures.append(
+                f"QUOTA AMNESIA during takeover: canary answered "
+                f"UNDER_LIMIT {outage['under']}x while its owner was "
+                f"dead ({outage})"
+            )
+        if outage["over"] == 0:
+            failures.append(
+                f"no OVER_LIMIT takeover answers for the canary during "
+                f"the outage ({outage})"
             )
 
         # phase 3: restart the victim; recovery clock starts when IT
@@ -391,6 +541,7 @@ def main() -> int:
                     not r["error"]
                     and r["metadata"].get("owner") == victim_addr
                     and r["metadata"].get("degraded") != "true"
+                    and r["metadata"].get("replicated") != "true"
                 )
             except OSError:
                 return False
@@ -407,6 +558,61 @@ def main() -> int:
                 f"breaker recovery took {result['recovery_s']}s "
                 f"(bound: 2 cooldowns = {bound_s}s + 1s poll margin)"
             )
+
+        # quota-amnesia assert, reconcile half: the interim successor
+        # hands the canary's window back (retried every replication
+        # tick; the attempt doubles as a breaker probe), so the REBORN
+        # owner — which restarted with an empty store — answers
+        # OVER_LIMIT again within the documented lag bound, and stays
+        # there. Peeks only: sampling must not re-drive the window.
+        lag_bound_s = 2 * BREAKER_COOLDOWN_MS / 1e3 + 2.0
+
+        def owner_over_again():
+            try:
+                r = peek_amnesia(cluster, VICTIM, amnesia_key)
+            except OSError:
+                return False
+            return not r["error"] and r["status"] == "OVER_LIMIT"
+
+        reconciled = poll_until(
+            owner_over_again, lag_bound_s, interval=0.1,
+            what="canary never over-limit on the restarted owner",
+        )
+        result["reconcile_lag_s"] = round(time.monotonic() - t_back, 2)
+        if not reconciled:
+            failures.append(
+                f"QUOTA AMNESIA after restart: canary not over-limit "
+                f"on the reborn owner within {lag_bound_s}s"
+            )
+        else:
+            # stability: once reconciled it must STAY over-limit, both
+            # on the owner and through a forwarding peer
+            stable = {"over": 0, "under": 0, "other": 0}
+            for node in (VICTIM, DRAIN_NODE, VICTIM, DRAIN_NODE,
+                         VICTIM, DRAIN_NODE):
+                try:
+                    r = peek_amnesia(cluster, node, amnesia_key)
+                except OSError:
+                    stable["other"] += 1
+                    continue
+                if r["error"]:
+                    stable["other"] += 1
+                elif r["status"] == "OVER_LIMIT":
+                    stable["over"] += 1
+                else:
+                    stable["under"] += 1
+                time.sleep(0.05)
+            result["amnesia_reconciled_samples"] = stable
+            if stable["under"] > 0:
+                failures.append(
+                    f"canary flapped back under-limit after the "
+                    f"reconcile ({stable})"
+                )
+        result["replication_metrics"] = {
+            "victim": scrape_replication_metrics(cluster, VICTIM),
+            "observer": scrape_replication_metrics(cluster, OBSERVER),
+            "drain_node": scrape_replication_metrics(cluster, DRAIN_NODE),
+        }
         time.sleep(phase)
 
         # phase 4: graceful drain of a node under load
@@ -439,8 +645,8 @@ def main() -> int:
         counts = gen.snapshot()
         result["counts"] = counts
         served = (
-            counts["ok"] + counts["degraded"] + counts["item_error"]
-            + counts["inflight_loss"]
+            counts["ok"] + counts["degraded"] + counts["replicated"]
+            + counts["item_error"] + counts["inflight_loss"]
         )
         errors = counts["item_error"] + counts["inflight_loss"]
         result["error_rate"] = round(errors / served, 4) if served else 1.0
@@ -457,8 +663,10 @@ def main() -> int:
                 f"{counts['inflight_loss']} accepted item(s) never "
                 f"answered (in-flight loss)"
             )
-        if counts["degraded"] == 0:
-            failures.append("no degraded answers — outage never bit?")
+        if counts["degraded"] + counts["replicated"] == 0:
+            failures.append(
+                "no replicated/degraded answers — outage never bit?"
+            )
 
         # the injected faults must actually have fired, and the breaker
         # must have cycled open -> closed, or this soak proved nothing

@@ -353,11 +353,34 @@ def table_resilience_knobs() -> str:
         ("`GUBER_BREAKER_PROBES`", str(b.breaker_probes),
          "Half-open probe count: all succeeding closes the breaker, "
          "any failing re-opens it"),
+        ("`GUBER_REPLICATION`",
+         "1" if s["replication"].default else "0 (off)",
+         "Owner->successor bucket replication (r11): owned token "
+         "windows snapshot to each key's ring successor, so a killed "
+         "owner's over-limit keys STAY over-limit through takeover "
+         "and restart (no quota amnesia); takeover answers carry "
+         '`metadata["replicated"]="true"`. With no failures, ON is '
+         "byte-identical to OFF"),
+        ("`GUBER_REPLICATION_SYNC_WAIT_MS`",
+         ms(s["replication_sync_wait"].default),
+         "Replication flush window (also the reconcile-handback retry "
+         "tick); takeover staleness bound = one window + RTT"),
+        ("`GUBER_REPLICATION_STANDBY_KEYS` / `_BACKLOG`",
+         f"{s['replication_standby_keys'].default} / "
+         f"{s['replication_backlog'].default}",
+         "Bounds on the receiver-side standby snapshot table and the "
+         "sender-side dirty/handback queues (drops counted in "
+         "`replication_dropped_total`)"),
+        ("`GUBER_GLOBAL_BACKLOG`", str(b.global_backlog),
+         "Max distinct keys aggregating in each GLOBAL gossip queue — "
+         "an unreachable owner can no longer grow the hit backlog "
+         "unboundedly (drops in `global_backlog_dropped_total`)"),
         ("`GUBER_DEGRADED_LOCAL`",
          "1" if s["degraded_local"].default else "0 (off)",
          'Answer owner-unreachable items from the LOCAL store with '
          '`metadata["degraded"]="true"` instead of erroring '
-         "(availability over global accuracy)"),
+         "(availability over global accuracy; with replication on, "
+         "successor takeover is tried first)"),
         ("`GUBER_DRAIN_TIMEOUT_MS`", ms(s["drain_timeout"].default),
          "SIGTERM drain budget: deregister, refuse new edge frames "
          "(GEBR drain code), finish in-flight work, flush batcher + "

@@ -1,10 +1,13 @@
 """The chaos soak (scripts/chaos_soak.py) as a test: 3 real daemons,
 one SIGKILLed + restarted mid-load, fault injection active, drain under
 load — asserting bounded error rate, breaker recovery within 2
-cooldowns, and zero in-flight loss. Marked `slow` (tier-1 runs
-`-m 'not slow'`); the fast deterministic slice of the same machinery is
-tests/test_faults.py + tests/test_resilience.py. Run it directly with
-`make chaos` or `pytest -m slow tests/test_chaos_soak.py`.
+cooldowns, zero in-flight loss, and (r11) NO QUOTA AMNESIA: a tracked
+over-limit key stays over-limit through owner SIGKILL -> successor
+takeover -> restart -> reconcile (GUBER_REPLICATION). Marked `slow`
+(tier-1 runs `-m 'not slow'`); the fast deterministic slice of the same
+machinery is tests/test_faults.py + tests/test_resilience.py +
+tests/test_replication.py. Run it directly with `make chaos` or
+`pytest -m slow tests/test_chaos_soak.py`.
 """
 
 import json
@@ -35,4 +38,10 @@ def test_chaos_soak_passes(tmp_path):
     assert doc["inflight_loss"] == 0
     assert doc["recovery_s"] <= doc["recovery_bound_s"] + 1.0
     assert doc["faults_injected"] > 0
-    assert doc["counts"]["degraded"] > 0
+    assert doc["counts"]["degraded"] + doc["counts"]["replicated"] > 0
+    # the quota-amnesia contract (r11): never under-limit during the
+    # outage, over-limit again on the reborn owner, and stable after
+    assert doc["amnesia_outage_samples"]["under"] == 0
+    assert doc["amnesia_outage_samples"]["over"] > 0
+    assert doc["reconcile_lag_s"] is not None
+    assert doc["amnesia_reconciled_samples"]["under"] == 0
