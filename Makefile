@@ -1,5 +1,5 @@
 .PHONY: all native proto test bench readme readme-check profile-stages \
-	profile-submit profile-shed chaos clean
+	profile-submit profile-shed chaos perf-gate clean
 
 all: native proto
 
@@ -60,6 +60,21 @@ profile-shed: native
 	python scripts/profile_shed.py --seconds $(SHED_SECONDS) \
 	  --rounds $(SHED_ROUNDS) --shares $(SHED_SHARES) \
 	  --json $(SHED_OUT)
+
+# continuous front-door perf gate (r12): replays the committed workload
+# shapes (stages r7, submit r9, shed r10) with interleaved paired A/B
+# rounds plus the public-door ladder (gRPC vs GEB client vs HTTP
+# binary), and FAILS on a paired ratio more than PERF_GATE_THRESHOLD
+# below the committed PERF_GATE_BASELINE.json manifest. Overridable:
+# make perf-gate PERF_GATE_THRESHOLD=0.15 PERF_SECONDS=5 PERF_ROUNDS=6
+PERF_GATE_THRESHOLD ?= 0.10
+PERF_SECONDS ?= 3
+PERF_ROUNDS ?= 4
+PERF_OUT ?= BENCH_FRONTDOOR.json
+perf-gate:
+	python scripts/perf_gate.py --seconds $(PERF_SECONDS) \
+	  --rounds $(PERF_ROUNDS) --threshold $(PERF_GATE_THRESHOLD) \
+	  --json $(PERF_OUT)
 
 # chaos soak (r8, + r11 quota-amnesia phase): 3-node cluster under load
 # with a peer killed + restarted mid-run and GUBER_FAULT_SPEC injection

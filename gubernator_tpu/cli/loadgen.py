@@ -1,35 +1,126 @@
 """CLI load generator.
 
-`python -m gubernator_tpu.cli.loadgen <address>` replays a pool of random
-token-bucket limits through a concurrent fan-out forever, dumping
-OVER_LIMIT responses (the reference's cmd/gubernator-cli).
+`python -m gubernator_tpu.cli.loadgen <address>` replays a pool of
+random token-bucket limits through a concurrent fan-out forever,
+dumping OVER_LIMIT responses (the reference's cmd/gubernator-cli).
+
+r12: `--protocol {grpc,geb,http}` picks the door. r10's profiling
+showed the loadgen ITSELF was the ceiling through the gRPC door — its
+per-item protobuf encode capped offered load at ~110k dec/s no matter
+what the serving side did (the masking problem). The `geb` protocol
+speaks credit-windowed binary frames via gubernator_tpu.client_geb
+(against a daemon's GUBER_GEB_PORT door or a bridge socket path), and
+`http` POSTs binary GEB frames to the gateway's /v1/geb door — both
+keep the generator off the critical path and exercise the new client
+end to end.
+
+`--share S` (0..1) switches the workload to the shed-r10 shape: hot
+limit-1 keys frozen over limit mixed with never-over keys so a
+fraction ~S of items answer OVER_LIMIT (`--share 0` = all cold). The
+default workload (no --share) keeps the reference CLI's random pool.
+`--json` prints one machine-readable summary line on stdout (implies
+--quiet), which scripts/perf_gate.py consumes.
 """
 
 import argparse
 import asyncio
+import json
 import sys
 import time
 
 from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, Status
 from gubernator_tpu.client import AsyncV1Client, random_string
 
+HOT_KEYS = 512
+COLD_KEYS = 4096
+
+
+def _shed_pool(share: float, batch: int):
+    """Pre-built batch rotation in the shed-r10 workload shape: the
+    first `share` of each batch hits hot limit-1 keys (over limit
+    after their first touch), the rest never-over keys."""
+    cut = int(share * batch)
+    pools = []
+    for i in range(8):
+        reqs = []
+        for j in range(batch):
+            if j < cut:
+                key, limit = f"shed_h{(i * 31 + j) % HOT_KEYS}", 1
+            else:
+                key = f"shed_c{(i * batch + j) % COLD_KEYS}"
+                limit = 1_000_000_000
+            reqs.append(
+                RateLimitReq(
+                    name="loadgen",
+                    unique_key=key,
+                    hits=1,
+                    limit=limit,
+                    duration=600_000,
+                    algorithm=Algorithm.TOKEN_BUCKET,
+                    behavior=Behavior.BATCHING,
+                )
+            )
+        pools.append(reqs)
+    return pools
+
+
+#: per-call bound so a wedged server surfaces as counted errors, never
+#: workers hung past the duration (the pre-r12 grpc path used 5s; the
+#: binary doors serve deep pipelines, so give them headroom)
+CALL_TIMEOUT = 30.0
+
+
+def _make_client(protocol: str, address: str, window: int, mode: str):
+    if protocol == "grpc":
+        return AsyncV1Client(address)
+    if protocol == "geb":
+        from gubernator_tpu.client_geb import AsyncGebClient
+
+        return AsyncGebClient(
+            address, window=window, mode=mode, timeout=CALL_TIMEOUT
+        )
+    if protocol == "http":
+        from gubernator_tpu.client_geb import AsyncHttpGebClient
+
+        base = (
+            address
+            if address.startswith("http")
+            else f"http://{address}"
+        )
+        return AsyncHttpGebClient(base, mode=mode, timeout=CALL_TIMEOUT)
+    raise ValueError(f"unknown protocol {protocol!r}")
+
 
 async def run(
-    address: str, keys: int, concurrency: int, batch: int, duration: float
-) -> None:
-    client = AsyncV1Client(address)
-    pool = [
-        RateLimitReq(
-            name=f"ID-{i:04d}",
-            unique_key=random_string("id-"),
-            hits=1,
-            limit=(i % 100) + 1,
-            duration=((i % 50) + 1) * 1000,
-            algorithm=Algorithm.TOKEN_BUCKET,
-            behavior=Behavior.BATCHING,
-        )
-        for i in range(keys)
-    ]
+    address: str,
+    keys: int,
+    concurrency: int,
+    batch: int,
+    duration: float,
+    protocol: str = "grpc",
+    share: float = -1.0,
+    window: int = 0,
+    mode: str = "auto",
+    quiet: bool = False,
+    json_out: bool = False,
+) -> dict:
+    client = _make_client(protocol, address, window, mode)
+    if share >= 0.0:
+        batches = _shed_pool(share, batch)
+    else:
+        pool = [
+            RateLimitReq(
+                name=f"ID-{i:04d}",
+                unique_key=random_string("id-"),
+                hits=1,
+                limit=(i % 100) + 1,
+                duration=((i % 50) + 1) * 1000,
+                algorithm=Algorithm.TOKEN_BUCKET,
+                behavior=Behavior.BATCHING,
+            )
+            for i in range(keys)
+        ]
+        batches = None
 
     stats = {"sent": 0, "over": 0, "errors": 0}
     stop_at = time.monotonic() + duration if duration > 0 else None
@@ -37,10 +128,19 @@ async def run(
     async def worker(wid: int):
         i = wid
         while stop_at is None or time.monotonic() < stop_at:
-            reqs = [pool[(i + j) % len(pool)] for j in range(batch)]
-            i += batch * concurrency
+            if batches is not None:
+                reqs = batches[i % len(batches)]
+                i += 1
+            else:
+                reqs = [pool[(i + j) % len(pool)] for j in range(batch)]
+                i += batch * concurrency
             try:
-                resps = await client.get_rate_limits(reqs, timeout=5)
+                if protocol == "grpc":
+                    resps = await client.get_rate_limits(
+                        reqs, timeout=CALL_TIMEOUT
+                    )
+                else:  # geb/http bound via their client-level timeout
+                    resps = await client.get_rate_limits(reqs)
             except Exception as e:
                 stats["errors"] += 1
                 print(f"error: {e}", file=sys.stderr)
@@ -50,7 +150,8 @@ async def run(
             for r in resps:
                 if r.status == Status.OVER_LIMIT:
                     stats["over"] += 1
-                    print(f"over the limit: {r}")
+                    if not quiet:
+                        print(f"over the limit: {r}")
 
     started = time.monotonic()
     try:
@@ -58,26 +159,82 @@ async def run(
     finally:
         elapsed = time.monotonic() - started
         rate = stats["sent"] / elapsed if elapsed > 0 else 0.0
+        summary = dict(
+            protocol=protocol,
+            sent=stats["sent"],
+            over_limit=stats["over"],
+            errors=stats["errors"],
+            seconds=round(elapsed, 4),
+            decisions_per_sec=round(rate, 1),
+            over_limit_share=round(
+                stats["over"] / stats["sent"], 4
+            )
+            if stats["sent"]
+            else 0.0,
+        )
         print(
             f"sent={stats['sent']} over_limit={stats['over']} "
             f"errors={stats['errors']} rate={rate:.0f}/s",
             file=sys.stderr,
         )
+        if json_out:
+            print(json.dumps(summary))
         await client.close()
+    return summary
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="gubernator-tpu load generator")
     parser.add_argument("address", nargs="?", default="127.0.0.1:9090")
+    parser.add_argument(
+        "--protocol",
+        choices=("grpc", "geb", "http"),
+        default="grpc",
+        help="front door: gRPC protobuf, binary GEB frames "
+        "(daemon GUBER_GEB_PORT or a bridge socket path), or binary "
+        "GEB over HTTP POST /v1/geb",
+    )
     parser.add_argument("--keys", type=int, default=2000)
     parser.add_argument("--concurrency", type=int, default=10)
     parser.add_argument("--batch", type=int, default=32)
     parser.add_argument(
         "--duration", type=float, default=0.0, help="seconds; 0 = forever"
     )
+    parser.add_argument(
+        "--share", type=float, default=-1.0,
+        help="shed-r10 workload shape with this over-limit share "
+        "(0..1); negative = the default random pool",
+    )
+    parser.add_argument(
+        "--window", type=int, default=0,
+        help="geb protocol: cap the credit window (0 = the server's "
+        "advertised window; 1 = round-trip, the pre-r7 shape)",
+    )
+    parser.add_argument(
+        "--mode", choices=("auto", "fast", "string"), default="auto",
+        help="geb/http framing: pre-hashed fast records vs string "
+        "items (auto negotiates via the hello)",
+    )
+    parser.add_argument("--quiet", action="store_true",
+                        help="don't print each OVER_LIMIT response")
+    parser.add_argument("--json", action="store_true",
+                        help="one JSON summary line on stdout "
+                        "(implies --quiet)")
     args = parser.parse_args(argv)
     asyncio.run(
-        run(args.address, args.keys, args.concurrency, args.batch, args.duration)
+        run(
+            args.address,
+            args.keys,
+            args.concurrency,
+            args.batch,
+            args.duration,
+            protocol=args.protocol,
+            share=args.share,
+            window=args.window,
+            mode=args.mode,
+            quiet=args.quiet or args.json,
+            json_out=args.json,
+        )
     )
     return 0
 

@@ -27,6 +27,7 @@ from gubernator_tpu.api.types import (
     RateLimitResp,
     SECOND,
 )
+from gubernator_tpu.endpoints import parse_endpoint
 
 __all__ = [
     "V1Client",
@@ -40,11 +41,27 @@ __all__ = [
 ]
 
 
+def _grpc_target(endpoint: str) -> str:
+    """Validate a client endpoint through the shared parser (r12): the
+    fleet's endpoint grammar is 'host:port' (IPv4/hostname) split on
+    the last colon, and an IPv6 literal is refused LOUDLY here instead
+    of misparsing downstream — the same rule every bridge/daemon
+    config site applies (gubernator_tpu.endpoints)."""
+    kind, addr = parse_endpoint(endpoint, "client endpoint")
+    if kind != "tcp":
+        raise ValueError(
+            f"client endpoint {endpoint!r} is a unix path; the gRPC "
+            f"client needs 'host:port' (unix sockets are the GEB "
+            f"client's job, gubernator_tpu.client_geb)"
+        )
+    return f"{addr[0]}:{addr[1]}"
+
+
 class V1Client:
     """Blocking client over an insecure channel (reference client.go:38-49)."""
 
     def __init__(self, endpoint: str = "127.0.0.1:81"):
-        self.channel = grpc.insecure_channel(endpoint)
+        self.channel = grpc.insecure_channel(_grpc_target(endpoint))
         self.stub = V1Stub(self.channel)
 
     def get_rate_limits(
@@ -78,7 +95,7 @@ class AsyncV1Client:
     """asyncio flavor of V1Client."""
 
     def __init__(self, endpoint: str = "127.0.0.1:81"):
-        self.channel = grpc.aio.insecure_channel(endpoint)
+        self.channel = grpc.aio.insecure_channel(_grpc_target(endpoint))
         self.stub = V1Stub(self.channel)
 
     async def get_rate_limits(

@@ -1,0 +1,948 @@
+"""First-class GEB client protocol (r12): the windowed binary frame
+protocol as a PUBLIC client surface.
+
+r10's profiling found the doors clients could actually reach (gRPC
+protobuf, HTTP JSON) ceiling at ~110k dec/s on this class of box while
+the internal windowed GEB framing sustains 340-560k on the same
+hardware — the serialization/RPC tier, not the engine, was the
+front-door bottleneck. This module closes that gap from the client
+side: a JAX-free client (like `gubernator_tpu.client`) that speaks the
+bridge wire protocol directly to
+
+  - a daemon's GEB listener (`GUBER_GEB_PORT`, serve/edge_bridge.py
+    GebListener) — 'host:port',
+  - a co-located bridge socket — '/path.sock' or 'unix:/path.sock',
+
+with hello/version negotiation, credit-windowed pipelining (up to the
+server's advertised window of frames in flight per connection,
+completed out of order), reconnect, and the GEBR drain/stale-ring
+refusal semantics of r7/r8 honored.
+
+Framing choice (`mode`):
+
+  - 'string' — GEB2 windowed string frames (GEB1 against a pre-r7
+    server). Items carry name/key; the daemon validates, routes, and
+    forwards exactly as the gRPC door does. Correct on ANY topology.
+  - 'fast' — GEB7 windowed pre-hashed frames (GEB6 legacy). The client
+    hashes `name_key` itself and the daemon's array path decides the
+    items locally with no per-item Python — the edge binary's fast
+    path, from a library. Requires the client and the store to run the
+    SAME slot hash (the hello's HELLO_XXH64 bit advertises the
+    server's implementation) and, because fast frames bypass instance
+    routing, keys this node actually owns.
+  - 'auto' (default) — fast when the hello advertises it, the hash
+    implementations agree, and the ring is single-node (where every
+    key is owned by construction); string otherwise, and per batch for
+    requests fast framing cannot carry (GLOBAL/NO_BATCHING behaviors,
+    empty name/key). Multi-node fast routing remains the compiled
+    edge's job.
+
+Delivery semantics: a frame refused by GEBR (stale ring or drain) was
+NOT served — retrying it (elsewhere) is safe, and the raised error
+says so. A connection lost mid-flight leaves in-doubt frames
+(`GebConnectionError`); whether their hits were applied is unknown,
+the same at-most-once stance as the peer-forwarding tier.
+
+The wire constants here are deliberate duplicates of
+serve/edge_bridge.py's (this module must not import the serving tier);
+tests/test_geb_client.py pins them equal.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import struct
+import threading
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+)
+from gubernator_tpu.endpoints import parse_endpoint
+
+__all__ = [
+    "AsyncGebClient",
+    "GebClient",
+    "AsyncHttpGebClient",
+    "GebError",
+    "GebStaleRingError",
+    "GebDrainingError",
+    "GebConnectionError",
+    "GEB_CONTENT_TYPE",
+    "GEB_HTTP_PATH",
+]
+
+# -- wire constants (mirrors of serve/edge_bridge.py, test-pinned) ----------
+
+MAGIC_REQ = 0x31424547  # 'GEB1'
+MAGIC_RESP = 0x33424547  # 'GEB3'
+MAGIC_HELLO = 0x49424547  # 'GEBI'
+MAGIC_FAST_REQ = 0x36424547  # 'GEB6'
+MAGIC_FAST_RESP = 0x35424547  # 'GEB5'
+MAGIC_STALE = 0x52424547  # 'GEBR'
+MAGIC_WREQ = 0x32424547  # 'GEB2'
+MAGIC_WRESP = 0x34424547  # 'GEB4'
+MAGIC_WFAST_REQ = 0x37424547  # 'GEB7'
+MAGIC_WFAST_RESP = 0x38424547  # 'GEB8'
+
+HELLO_FAST = 1
+HELLO_WINDOWED = 2
+HELLO_XXH64 = 4
+
+DRAIN_FRAME_ID = 0xFFFFFFFF
+
+_HDR = struct.Struct("<II")
+_ITEM_FIX = struct.Struct("<qqqBB")
+_RESP_FIX = struct.Struct("<Bqqq")
+_WFAST_HDR = struct.Struct("<IIQ")  # frame_id | ring_hash | t_sent_us
+_WREQ_HDR = struct.Struct("<IQ")  # frame_id | t_sent_us
+_U16 = struct.Struct("<H")
+_U32 = struct.Struct("<I")
+_FAST_REQ = struct.Struct("<QqqqB")  # key_hash|hits|limit|duration|algo
+
+#: content type gating the HTTP gateway's binary door (POST /v1/geb)
+GEB_CONTENT_TYPE = "application/x-guber-geb"
+GEB_HTTP_PATH = "/v1/geb"
+
+#: frames beyond this refuse client-side: the daemon chunks at its own
+#: batch ladder, but an unbounded frame is an unbounded host alloc
+MAX_FRAME_ITEMS = 65536
+
+
+class GebError(Exception):
+    """Protocol-level client error."""
+
+
+class GebStaleRingError(GebError):
+    """The server refused the frame: routed under a stale membership
+    view (GEBR). The frame was NOT served; reconnecting re-reads the
+    hello (fresh ring) and retrying is safe."""
+
+
+class GebDrainingError(GebError):
+    """The server is draining (GEBR drain code): this frame was NOT
+    served and the listener is closing. Retry against another node."""
+
+
+class GebConnectionError(GebError):
+    """Connection lost with frames in flight: whether their hits were
+    applied is unknown (at-most-once ambiguity, like a failed peer
+    forward). Peek-only batches are always safe to retry."""
+
+
+# -- client-side slot hashing (fast framing) --------------------------------
+
+_hash_batch = None
+_hash_checked = False
+
+
+def _load_hasher() -> None:
+    global _hash_batch, _hash_checked
+    if _hash_checked:
+        return
+    _hash_checked = True
+    try:
+        # ctypes + numpy only — no JAX (gubernator_tpu.native)
+        from gubernator_tpu.native import hashlib_native
+
+        _hash_batch = hashlib_native.hash_batch
+    except Exception:
+        _hash_batch = None
+
+
+def client_hash_is_native() -> bool:
+    """True when this process hashes with the native XXH64 library —
+    must match the server's HELLO_XXH64 bit for fast framing."""
+    _load_hasher()
+    return _hash_batch is not None
+
+
+def client_hash_batch(keys: Sequence[str]):
+    """uint64 slot hashes, identical to the daemon's
+    core.hashing.slot_hash_batch for the same implementation tier:
+    native XXH64 when the shared library loads, else the blake2b-8
+    fallback (byte-identical to core.hashing._slot_hash_batch_py).
+    Kept here, not imported, because `gubernator_tpu.core` enables
+    JAX x64 at import and this client must stay JAX-free."""
+    import numpy as np
+
+    _load_hasher()
+    if _hash_batch is not None:
+        return _hash_batch(list(keys))
+    return np.array(
+        [
+            int.from_bytes(
+                hashlib.blake2b(
+                    k.encode("utf-8"), digest_size=8
+                ).digest(),
+                "little",
+            )
+            for k in keys
+        ],
+        dtype=np.uint64,
+    )
+
+
+# -- hello ------------------------------------------------------------------
+
+
+@dataclass
+class Hello:
+    """Parsed GEBI hello: capability flags, credit window, ring
+    fingerprint, and the live membership (grpc address, that node's
+    frame-door endpoint, is_self)."""
+
+    flags: int = 0
+    ring_hash: int = 0
+    nodes: List[Tuple[bool, str, str]] = field(default_factory=list)
+
+    @property
+    def windowed(self) -> bool:
+        return bool(self.flags & HELLO_WINDOWED)
+
+    @property
+    def fast(self) -> bool:
+        return bool(self.flags & HELLO_FAST)
+
+    @property
+    def xxh64(self) -> bool:
+        return bool(self.flags & HELLO_XXH64)
+
+    @property
+    def window(self) -> int:
+        return max(1, self.flags >> 16) if self.windowed else 1
+
+
+async def read_hello(reader: asyncio.StreamReader) -> Hello:
+    magic, flags, rhash, n_nodes = struct.unpack(
+        "<IIII", await reader.readexactly(16)
+    )
+    if magic != MAGIC_HELLO:
+        raise GebError(
+            f"endpoint did not speak GEB (hello magic {magic:#x})"
+        )
+    if n_nodes > 4096:
+        raise GebError(f"implausible hello node count {n_nodes}")
+    nodes = []
+    for _ in range(n_nodes):
+        is_self, glen = struct.unpack(
+            "<BH", await reader.readexactly(3)
+        )
+        grpc = (await reader.readexactly(glen)).decode()
+        (blen,) = _U16.unpack(await reader.readexactly(2))
+        bridge = (await reader.readexactly(blen)).decode()
+        nodes.append((bool(is_self), grpc, bridge))
+    return Hello(flags=flags, ring_hash=rhash, nodes=nodes)
+
+
+def parse_hello_bytes(buf: bytes) -> Hello:
+    """Parse one complete hello from a byte buffer (the HTTP door's
+    GET /v1/geb response)."""
+    if len(buf) < 16:
+        raise GebError("short hello")
+    magic, flags, rhash, n_nodes = struct.unpack_from("<IIII", buf, 0)
+    if magic != MAGIC_HELLO:
+        raise GebError(f"bad hello magic {magic:#x}")
+    if n_nodes > 4096:
+        raise GebError(f"implausible hello node count {n_nodes}")
+    off = 16
+    nodes = []
+    try:
+        for _ in range(n_nodes):
+            is_self, glen = struct.unpack_from("<BH", buf, off)
+            off += 3
+            grpc = buf[off : off + glen].decode()
+            off += glen
+            (blen,) = _U16.unpack_from(buf, off)
+            off += 2
+            bridge = buf[off : off + blen].decode()
+            off += blen
+            nodes.append((bool(is_self), grpc, bridge))
+    except (struct.error, UnicodeDecodeError) as e:
+        raise GebError(f"malformed hello: {e}") from None
+    return Hello(flags=flags, ring_hash=rhash, nodes=nodes)
+
+
+# -- frame codec ------------------------------------------------------------
+
+
+def _fast_eligible(reqs: Sequence[RateLimitReq]) -> bool:
+    """Fast records carry (hash, hits, limit, duration, algo) only: no
+    behavior, no validation-error channel. GLOBAL/NO_BATCHING items
+    and empty names/keys must ride string frames."""
+    return all(
+        r.behavior == Behavior.BATCHING and r.name and r.unique_key
+        for r in reqs
+    )
+
+
+def encode_fast_payload(reqs: Sequence[RateLimitReq]) -> bytes:
+    """n x 33-byte pre-hashed records (the edge binary's encoding)."""
+    import numpy as np
+
+    hashes = client_hash_batch([r.hash_key() for r in reqs])
+    rec = np.zeros(
+        len(reqs),
+        dtype=np.dtype(
+            [
+                ("key_hash", "<u8"),
+                ("hits", "<i8"),
+                ("limit", "<i8"),
+                ("duration", "<i8"),
+                ("algo", "u1"),
+            ]
+        ),
+    )
+    rec["key_hash"] = hashes
+    rec["hits"] = [r.hits for r in reqs]
+    rec["limit"] = [r.limit for r in reqs]
+    rec["duration"] = [r.duration for r in reqs]
+    rec["algo"] = [int(r.algorithm) for r in reqs]
+    return rec.tobytes()
+
+
+def encode_string_payload(reqs: Sequence[RateLimitReq]) -> bytes:
+    parts = []
+    for r in reqs:
+        name = r.name.encode()
+        key = r.unique_key.encode()
+        if len(name) > 0xFFFF or len(key) > 0xFFFF:
+            raise GebError("name/unique_key exceed 65535 bytes")
+        parts.append(_U16.pack(len(name)))
+        parts.append(name)
+        parts.append(_U16.pack(len(key)))
+        parts.append(key)
+        parts.append(
+            _ITEM_FIX.pack(
+                r.hits,
+                r.limit,
+                r.duration,
+                int(r.algorithm),
+                int(r.behavior),
+            )
+        )
+    return b"".join(parts)
+
+
+def decode_fast_body(body: bytes, n: int) -> List[RateLimitResp]:
+    if len(body) != n * 25:
+        raise GebError("fast response length mismatch")
+    out = []
+    off = 0
+    for _ in range(n):
+        st, limit, rem, reset = _RESP_FIX.unpack_from(body, off)
+        off += _RESP_FIX.size
+        out.append(
+            RateLimitResp(
+                status=Status(st) if st in (0, 1) else Status.UNDER_LIMIT,
+                limit=limit,
+                remaining=rem,
+                reset_time=reset,
+            )
+        )
+    return out
+
+
+def decode_string_body(body: bytes, n: int) -> List[RateLimitResp]:
+    """Parse n GEB3/GEB4 response items (varlen error/owner) from a
+    complete buffer."""
+    out = []
+    off = 0
+    try:
+        for _ in range(n):
+            st, limit, rem, reset = _RESP_FIX.unpack_from(body, off)
+            off += _RESP_FIX.size
+            (elen,) = _U16.unpack_from(body, off)
+            off += 2
+            err = body[off : off + elen].decode()
+            off += elen
+            (olen,) = _U16.unpack_from(body, off)
+            off += 2
+            owner = body[off : off + olen].decode()
+            off += olen
+            out.append(_string_resp(st, limit, rem, reset, err, owner))
+    except (struct.error, UnicodeDecodeError) as e:
+        raise GebError(f"malformed string response: {e}") from None
+    if off != len(body):
+        raise GebError("trailing bytes in string response")
+    return out
+
+
+def _string_resp(st, limit, rem, reset, err, owner) -> RateLimitResp:
+    r = RateLimitResp(
+        status=Status(st) if st in (0, 1) else Status.UNDER_LIMIT,
+        limit=limit,
+        remaining=rem,
+        reset_time=reset,
+        error=err,
+    )
+    if owner:
+        r.metadata["owner"] = owner
+    return r
+
+
+def build_frame(
+    reqs: Sequence[RateLimitReq],
+    fast: bool,
+    windowed: bool,
+    frame_id: int = 0,
+    ring_hash: int = 0,
+    t_sent_us: int = 0,
+) -> Tuple[bytes, bool]:
+    """Encode one request frame; returns (bytes, is_fast)."""
+    if not reqs:
+        raise GebError("empty request batch")
+    if len(reqs) > MAX_FRAME_ITEMS:
+        raise GebError(
+            f"batch of {len(reqs)} exceeds the {MAX_FRAME_ITEMS}-item "
+            f"frame bound; split it"
+        )
+    use_fast = fast and _fast_eligible(reqs)
+    if use_fast:
+        payload = encode_fast_payload(reqs)
+        if windowed:
+            hdr = _HDR.pack(MAGIC_WFAST_REQ, len(reqs)) + _WFAST_HDR.pack(
+                frame_id, ring_hash, t_sent_us
+            )
+        else:
+            hdr = _HDR.pack(MAGIC_FAST_REQ, len(reqs)) + _U32.pack(
+                ring_hash
+            )
+        return hdr + _U32.pack(len(payload)) + payload, True
+    payload = encode_string_payload(reqs)
+    if windowed:
+        hdr = _HDR.pack(MAGIC_WREQ, len(reqs)) + _WREQ_HDR.pack(
+            frame_id, t_sent_us
+        )
+    else:
+        hdr = _HDR.pack(MAGIC_REQ, len(reqs))
+    return hdr + _U32.pack(len(payload)) + payload, use_fast
+
+
+# -- async client -----------------------------------------------------------
+
+
+class AsyncGebClient:
+    """Asyncio GEB client: one connection, up to the negotiated credit
+    window of frames in flight, completed out of order. Concurrent
+    `get_rate_limits` calls pipeline onto the same connection — that
+    is the throughput model (the r7 windowed protocol); one call alone
+    still pays a single round trip."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        window: int = 0,
+        mode: str = "auto",
+        timeout: Optional[float] = None,
+    ):
+        if mode not in ("auto", "fast", "string"):
+            raise ValueError("mode must be 'auto', 'fast', or 'string'")
+        self._kind, self._addr = parse_endpoint(
+            endpoint, "GEB endpoint"
+        )
+        self.endpoint = endpoint
+        self.mode = mode
+        self.timeout = timeout
+        self._want_window = window
+        self.hello: Optional[Hello] = None
+        self._reader: Optional[asyncio.StreamReader] = None
+        self._writer = None
+        self._read_task: Optional[asyncio.Task] = None
+        self._sem: Optional[asyncio.Semaphore] = None
+        self._inflight: dict = {}
+        self._next_id = 1
+        self._use_fast = False
+        self._windowed = True
+        self._window = 1
+        self._legacy_lock: Optional[asyncio.Lock] = None
+        self._conn_lock: Optional[asyncio.Lock] = None
+        self._closed = False
+
+    # -- connection ---------------------------------------------------------
+
+    async def connect(self) -> Hello:
+        """Open (or reuse) the connection and return the parsed hello.
+        Reconnecting after a failure re-reads the hello — a GEBR
+        stale-ring refusal is healed exactly this way."""
+        if self._conn_lock is None:
+            self._conn_lock = asyncio.Lock()
+        async with self._conn_lock:
+            if self._writer is not None:
+                return self.hello
+            if self._closed:
+                raise GebError("client is closed")
+            if self._kind == "unix":
+                reader, writer = await asyncio.open_unix_connection(
+                    self._addr
+                )
+            else:
+                host, port = self._addr
+                reader, writer = await asyncio.open_connection(host, port)
+            try:
+                hello = await read_hello(reader)
+            except Exception:
+                writer.close()
+                raise
+            self._negotiate(hello)
+            self.hello = hello
+            self._reader, self._writer = reader, writer
+            self._inflight = {}
+            self._sem = asyncio.Semaphore(self._window)
+            self._legacy_lock = asyncio.Lock()
+            if self._windowed:
+                self._read_task = asyncio.ensure_future(
+                    self._read_loop(reader, writer)
+                )
+            return hello
+
+    def _negotiate(self, hello: Hello) -> None:
+        self._windowed = hello.windowed
+        self._window = hello.window
+        if self._want_window > 0:
+            self._window = max(1, min(self._window, self._want_window))
+        if self.mode == "string":
+            self._use_fast = False
+            return
+        if self.mode == "fast":
+            if not hello.fast:
+                raise GebError(
+                    "mode='fast' but the server does not advertise the "
+                    "pre-hashed fast path (non-array backend or "
+                    "GUBER_EDGE_FAST=0)"
+                )
+            # forced: the caller asserts topology/hash agreement
+            self._use_fast = True
+            return
+        # auto: fast only when provably sound — hash implementations
+        # agree and the ring is single-node (fast frames bypass
+        # instance routing; multi-node fast routing is the edge's job)
+        self._use_fast = (
+            hello.fast
+            and hello.xxh64 == client_hash_is_native()
+            and len(hello.nodes) <= 1
+        )
+
+    def _conn_lost(self, exc: Optional[BaseException]) -> None:
+        """Fail everything still in flight and reset so the next call
+        reconnects fresh (new hello, new ring)."""
+        inflight, self._inflight = self._inflight, {}
+        self._reader = None
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        # cancel the reader so a stale loop can't outlive its
+        # connection (its own teardown is writer-identity-guarded, so
+        # even an uncancellable straggler cannot touch a successor)
+        task = self._read_task
+        self._read_task = None
+        if task is not None and task is not asyncio.current_task():
+            task.cancel()
+        for fut in inflight.values():
+            if not fut.done():
+                fut.set_exception(
+                    exc
+                    if isinstance(exc, GebError)
+                    else GebConnectionError(
+                        f"connection to {self.endpoint} lost with "
+                        f"frames in flight ({exc!r}); delivery unknown"
+                    )
+                )
+
+    async def close(self) -> None:
+        self._closed = True
+        task = self._read_task
+        self._conn_lost(GebError("client closed"))
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+
+    async def __aenter__(self):
+        await self.connect()
+        return self
+
+    async def __aexit__(self, *a):
+        await self.close()
+
+    # -- request path -------------------------------------------------------
+
+    async def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        timeout: Optional[float] = None,
+    ) -> List[RateLimitResp]:
+        """Serve one batch as one frame. Under concurrency, calls
+        pipeline up to the credit window; responses match by frame id
+        regardless of completion order."""
+        await self.connect()
+        if not self._windowed:
+            return await self._legacy_roundtrip(reqs, timeout)
+        loop = asyncio.get_running_loop()
+        fid = self._next_id
+        self._next_id = (self._next_id + 1) & 0x7FFFFFFF or 1
+        frame, is_fast = build_frame(
+            reqs,
+            fast=self._use_fast,
+            windowed=True,
+            frame_id=fid,
+            ring_hash=self.hello.ring_hash,
+            t_sent_us=int(loop.time() * 1e6),
+        )
+        fut = loop.create_future()
+        sem = self._sem
+        await sem.acquire()
+        writer = self._writer
+        if writer is None:
+            sem.release()
+            raise GebConnectionError("connection lost before send")
+        self._inflight[fid] = fut
+        try:
+            writer.write(frame)
+            await writer.drain()
+        except (ConnectionError, OSError) as e:
+            self._inflight.pop(fid, None)
+            sem.release()
+            self._conn_lost(e)
+            raise GebConnectionError(
+                f"send to {self.endpoint} failed: {e}"
+            ) from e
+        try:
+            resps = await asyncio.wait_for(
+                fut, timeout if timeout is not None else self.timeout
+            )
+        except asyncio.TimeoutError:
+            # the window slot is wedged (frame may still be in service
+            # server-side): the connection is no longer accountable —
+            # drop it so state can't leak into later calls
+            self._conn_lost(
+                GebConnectionError("frame timed out; connection reset")
+            )
+            raise
+        finally:
+            sem.release()
+        if len(resps) != len(reqs):
+            raise GebError(
+                f"response count {len(resps)} != request {len(reqs)}"
+            )
+        return resps
+
+    async def _legacy_roundtrip(self, reqs, timeout):
+        """Pre-r7 server: one frame in flight per connection
+        (GEB1/GEB6 framings, version-skew fallback)."""
+        frame, is_fast = build_frame(
+            reqs,
+            fast=self._use_fast,
+            windowed=False,
+            ring_hash=self.hello.ring_hash,
+        )
+
+        async def roundtrip():
+            async with self._legacy_lock:
+                writer, reader = self._writer, self._reader
+                if writer is None:
+                    raise GebConnectionError("connection lost")
+                writer.write(frame)
+                await writer.drain()
+                magic, n = _HDR.unpack(await reader.readexactly(8))
+                if magic == MAGIC_STALE:
+                    raise GebStaleRingError(
+                        "frame refused: stale ring (GEBR)"
+                    )
+                if is_fast:
+                    if magic != MAGIC_FAST_RESP:
+                        raise GebError(f"bad response magic {magic:#x}")
+                    return decode_fast_body(
+                        await reader.readexactly(n * 25), n
+                    )
+                if magic != MAGIC_RESP:
+                    raise GebError(f"bad response magic {magic:#x}")
+                return await _read_string_items(reader, n)
+
+        try:
+            return await asyncio.wait_for(
+                roundtrip(),
+                timeout if timeout is not None else self.timeout,
+            )
+        except (
+            GebStaleRingError,
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+            asyncio.TimeoutError,
+        ) as e:
+            self._conn_lost(None if isinstance(e, GebError) else e)
+            if isinstance(e, GebStaleRingError):
+                raise
+            if isinstance(e, asyncio.TimeoutError):
+                raise
+            raise GebConnectionError(
+                f"round trip to {self.endpoint} failed: {e}"
+            ) from e
+
+    # -- response reader ----------------------------------------------------
+
+    async def _read_loop(self, reader, writer):
+        exc: Optional[BaseException] = None
+        try:
+            while True:
+                magic, n = _HDR.unpack(await reader.readexactly(8))
+                if magic == MAGIC_STALE:
+                    # GEBR: second word is the refused frame id; every
+                    # frame still in flight was refused un-served too
+                    # (the server closes the connection behind it)
+                    if n == DRAIN_FRAME_ID:
+                        exc = GebDrainingError(
+                            f"{self.endpoint} is draining; frame not "
+                            f"served (safe to retry elsewhere)"
+                        )
+                    else:
+                        exc = GebStaleRingError(
+                            "frame refused: routed under a stale ring "
+                            "(GEBR); reconnect re-reads the hello"
+                        )
+                    return
+                (fid,) = _U32.unpack(await reader.readexactly(4))
+                if magic == MAGIC_WFAST_RESP:
+                    resps = decode_fast_body(
+                        await reader.readexactly(n * 25), n
+                    )
+                elif magic == MAGIC_WRESP:
+                    resps = await _read_string_items(reader, n)
+                else:
+                    raise GebError(f"bad response magic {magic:#x}")
+                fut = self._inflight.pop(fid, None)
+                if fut is not None and not fut.done():
+                    fut.set_result(resps)
+        except (
+            asyncio.IncompleteReadError,
+            ConnectionError,
+            OSError,
+        ) as e:
+            exc = e
+        except asyncio.CancelledError:
+            return
+        except Exception as e:  # protocol desync
+            exc = e
+        finally:
+            # identity guard: only tear down the connection THIS loop
+            # was reading. After a timeout/reconnect, self._writer is
+            # a successor connection with its own loop and in-flight
+            # table — a stale loop's exit must not fail it.
+            if self._writer is writer or self._writer is None:
+                self._conn_lost(exc)
+
+
+async def _read_string_items(reader, n: int) -> List[RateLimitResp]:
+    out = []
+    for _ in range(n):
+        st, limit, rem, reset = _RESP_FIX.unpack(
+            await reader.readexactly(_RESP_FIX.size)
+        )
+        (elen,) = _U16.unpack(await reader.readexactly(2))
+        err = (await reader.readexactly(elen)).decode()
+        (olen,) = _U16.unpack(await reader.readexactly(2))
+        owner = (await reader.readexactly(olen)).decode()
+        out.append(_string_resp(st, limit, rem, reset, err, owner))
+    return out
+
+
+# -- sync client ------------------------------------------------------------
+
+
+class GebClient:
+    """Blocking GEB client: the async client on a dedicated event-loop
+    thread, so `get_rate_limits` is a plain call (the V1Client shape)
+    while the connection underneath still pipelines — concurrent calls
+    from several threads share the credit window."""
+
+    def __init__(
+        self,
+        endpoint: str,
+        window: int = 0,
+        mode: str = "auto",
+        timeout: Optional[float] = 30.0,
+    ):
+        self._client = AsyncGebClient(
+            endpoint, window=window, mode=mode, timeout=timeout
+        )
+        self._loop = asyncio.new_event_loop()
+        self._thread = threading.Thread(
+            target=self._loop.run_forever,
+            name="guber-geb-client",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def _run(self, coro, timeout: Optional[float] = None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self._loop)
+        try:
+            return fut.result(timeout)
+        except TimeoutError:
+            fut.cancel()
+            raise
+
+    def connect(self) -> Hello:
+        return self._run(self._client.connect())
+
+    @property
+    def hello(self) -> Optional[Hello]:
+        return self._client.hello
+
+    def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        timeout: Optional[float] = None,
+    ) -> List[RateLimitResp]:
+        return self._run(self._client.get_rate_limits(reqs, timeout))
+
+    def get_rate_limits_pipelined(
+        self, batches: Sequence[Sequence[RateLimitReq]]
+    ) -> List[List[RateLimitResp]]:
+        """Serve many batches as concurrently pipelined frames (up to
+        the credit window in flight); results in input order."""
+
+        async def run_all():
+            return await asyncio.gather(
+                *[self._client.get_rate_limits(b) for b in batches]
+            )
+
+        return self._run(run_all())
+
+    def close(self) -> None:
+        try:
+            self._run(self._client.close(), timeout=5.0)
+        finally:
+            self._loop.call_soon_threadsafe(self._loop.stop)
+            self._thread.join(timeout=5.0)
+            self._loop.close()
+
+    def __enter__(self):
+        self.connect()
+        return self
+
+    def __exit__(self, *a):
+        self.close()
+
+
+# -- HTTP binary door -------------------------------------------------------
+
+
+class AsyncHttpGebClient:
+    """Binary GEB frames over the HTTP gateway (POST /v1/geb,
+    content-type gated) for clients behind HTTP-only infrastructure:
+    no protobuf, no JSON — one legacy-framed GEB payload per request
+    body. GET /v1/geb returns the hello (ring + capability flags), so
+    fast framing negotiates exactly like the socket client; a GEBR
+    body heals by re-reading the hello and retrying once."""
+
+    def __init__(
+        self, base_url: str, mode: str = "auto", timeout: float = 30.0
+    ):
+        if mode not in ("auto", "fast", "string"):
+            raise ValueError("mode must be 'auto', 'fast', or 'string'")
+        self.base_url = base_url.rstrip("/")
+        self.mode = mode
+        self.timeout = timeout
+        self.hello: Optional[Hello] = None
+        self._use_fast = False
+        self._session = None
+
+    async def _ensure(self) -> None:
+        if self._session is None:
+            import aiohttp  # lazy: server-side dep, not a client one
+
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout)
+            )
+        if self.hello is None:
+            async with self._session.get(
+                self.base_url + GEB_HTTP_PATH
+            ) as resp:
+                if resp.status != 200:
+                    raise GebError(
+                        f"GET {GEB_HTTP_PATH} -> {resp.status} (no "
+                        f"binary door on this gateway?)"
+                    )
+                hello = parse_hello_bytes(await resp.read())
+            self.hello = hello
+            if self.mode == "string":
+                self._use_fast = False
+            elif self.mode == "fast":
+                if not hello.fast:
+                    raise GebError(
+                        "mode='fast' but the gateway does not "
+                        "advertise the fast path"
+                    )
+                self._use_fast = True
+            else:
+                self._use_fast = (
+                    hello.fast
+                    and hello.xxh64 == client_hash_is_native()
+                    and len(hello.nodes) <= 1
+                )
+
+    async def get_rate_limits(
+        self, reqs: Sequence[RateLimitReq], _retried: bool = False
+    ) -> List[RateLimitResp]:
+        await self._ensure()
+        frame, is_fast = build_frame(
+            reqs,
+            fast=self._use_fast,
+            windowed=False,
+            ring_hash=self.hello.ring_hash,
+        )
+        async with self._session.post(
+            self.base_url + GEB_HTTP_PATH,
+            data=frame,
+            headers={"Content-Type": GEB_CONTENT_TYPE},
+        ) as resp:
+            if resp.status != 200:
+                raise GebError(
+                    f"POST {GEB_HTTP_PATH} -> {resp.status}: "
+                    f"{(await resp.read())[:200]!r}"
+                )
+            body = await resp.read()
+        magic, n = _HDR.unpack_from(body, 0)
+        if magic == MAGIC_STALE:
+            if n == DRAIN_FRAME_ID:
+                raise GebDrainingError("gateway draining; frame not served")
+            if _retried:
+                raise GebStaleRingError("stale ring after hello refresh")
+            self.hello = None  # re-read the ring, retry once
+            return await self.get_rate_limits(reqs, _retried=True)
+        if is_fast:
+            if magic != MAGIC_FAST_RESP:
+                raise GebError(f"bad response magic {magic:#x}")
+            out = decode_fast_body(body[8:], n)
+        else:
+            if magic != MAGIC_RESP:
+                raise GebError(f"bad response magic {magic:#x}")
+            out = decode_string_body(body[8:], n)
+        if len(out) != len(reqs):
+            # positional pairing downstream: a truncating proxy or
+            # miscounting server must fail loudly, never misattribute
+            raise GebError(
+                f"response count {len(out)} != request {len(reqs)}"
+            )
+        return out
+
+    async def close(self) -> None:
+        if self._session is not None:
+            await self._session.close()
+            self._session = None
+
+    async def __aenter__(self):
+        await self._ensure()
+        return self
+
+    async def __aexit__(self, *a):
+        await self.close()

@@ -30,6 +30,7 @@ class LocalCluster:
         device_batch_wait: float = 0.0,
         http_addresses: Optional[Sequence[str]] = None,
         device_batch_limit: Optional[int] = None,
+        geb_ports: Optional[Sequence[int]] = None,
     ):
         """`http_addresses` (parallel to `addresses`) additionally serves
         each node's HTTP JSON gateway — the harness default is gRPC-only
@@ -47,10 +48,18 @@ class LocalCluster:
         self.http_addresses = (
             list(http_addresses) if http_addresses else [""] * len(addresses)
         )
-        if len(self.http_addresses) != len(self.addresses):
+        # `geb_ports` (parallel, r12): additionally serve each node's
+        # GEB client-protocol door (GUBER_GEB_PORT); 0 = off per node
+        self.geb_ports = (
+            list(geb_ports) if geb_ports else [0] * len(addresses)
+        )
+        if len(self.http_addresses) != len(self.addresses) or len(
+            self.geb_ports
+        ) != len(self.addresses):
             # zip would silently truncate and leave nodes never started
             raise ValueError(
-                f"http_addresses ({len(self.http_addresses)}) must match "
+                f"http_addresses ({len(self.http_addresses)}) / "
+                f"geb_ports ({len(self.geb_ports)}) must match "
                 f"addresses ({len(self.addresses)})"
             )
         self.servers: List[Server] = []
@@ -101,7 +110,9 @@ class LocalCluster:
             raise failure[0]
 
     async def _start_all(self) -> None:
-        for addr, http_addr in zip(self.addresses, self.http_addresses):
+        for addr, http_addr, geb_port in zip(
+            self.addresses, self.http_addresses, self.geb_ports
+        ):
             conf = ServerConfig(
                 grpc_address=addr,
                 http_address=http_addr,
@@ -111,6 +122,7 @@ class LocalCluster:
                 ),
                 device_batch_wait=self._device_batch_wait,
                 backend="exact",
+                geb_port=geb_port,
             )
             if self._device_batch_limit is not None:
                 conf.device_batch_limit = self._device_batch_limit

@@ -68,6 +68,19 @@ def slot_hash_batch(keys: List[str]) -> np.ndarray:
     return _slot_hash_batch_py(keys)
 
 
+def using_native_hash() -> bool:
+    """True when slot_hash_batch resolves to the native XXH64 hasher.
+
+    A PRE-hashing peer (the compiled edge, the GEB client's fast
+    framing) computes slot hashes in its own process; its keys land in
+    the right store rows only when both sides run the SAME
+    implementation. The bridge hello advertises this bit (HELLO_XXH64,
+    serve/edge_bridge.py) so a fast client can verify agreement instead
+    of silently splitting buckets between two hash functions."""
+    _load_native()
+    return _native_batch is not None
+
+
 def slot_hash(key: str) -> int:
     """64-bit slot hash of one key (same implementation as the batch path)."""
     return int(slot_hash_batch([key])[0])

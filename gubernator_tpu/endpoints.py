@@ -1,0 +1,82 @@
+"""Shared endpoint parsing for every client/bridge surface (r12).
+
+The GEB frame protocol and the bridge/daemon config all carry endpoints
+as 'host:port' split on the LAST colon, or a unix-socket path. An IPv6
+literal ('[::1]:9100', bare '::1') silently misparses under that rule —
+the bracketed host handed to the resolver, or the whole address
+mistaken for a unix path. r7 refused IPv6 loudly at the BRIDGE config
+sites (edge.cc endpoint_is_ipv6ish, serve/edge_bridge.py
+reject_ipv6_endpoint); this module is the one shared helper so the
+client tier (client.py, client_geb.py) and the serving tier agree on
+the rule instead of each growing its own misparse. Hostnames and IPv4
+only, by design, fleet-wide.
+
+JAX-free and dependency-free: importable from the packaged clients.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+
+def endpoint_is_ipv6ish(spec: str) -> bool:
+    """True when `spec` looks like an IPv6 literal — the shapes the
+    last-colon split would silently misparse (r7's rule, mirrored from
+    edge.cc endpoint_is_ipv6ish)."""
+    return "[" in spec or "]" in spec or spec.count(":") > 1
+
+
+def reject_ipv6_endpoint(spec: str, what: str) -> str:
+    """Refuse an IPv6-ish endpoint loudly at parse time instead of
+    misparsing it silently (ADVICE r5 #2). Returns `spec` for
+    chaining."""
+    if endpoint_is_ipv6ish(spec):
+        raise ValueError(
+            f"{what} {spec!r} looks like an IPv6 literal; endpoints "
+            f"must be 'host:port' with an IPv4 address or hostname "
+            f"(the wire protocol splits on the last ':')"
+        )
+    return spec
+
+
+def parse_endpoint(
+    spec: str, what: str = "endpoint"
+) -> Tuple[str, Union[str, Tuple[str, int]]]:
+    """Parse one endpoint spec into ('unix', path) or
+    ('tcp', (host, port)).
+
+    Accepted shapes:
+      'host:port'            TCP (IPv4 address or hostname only)
+      '/path/to.sock'        unix socket (absolute path)
+      'unix:/path/to.sock'   unix socket, explicit scheme
+
+    Anything IPv6-ish is refused loudly (see endpoint_is_ipv6ish); a
+    TCP spec with a missing/empty/non-numeric port raises ValueError
+    naming `what`, never a downstream resolver error.
+    """
+    if not spec:
+        raise ValueError(f"{what} cannot be empty")
+    if spec.startswith("unix:"):
+        path = spec[len("unix:"):]
+        if not path:
+            raise ValueError(f"{what} {spec!r} has an empty unix path")
+        return ("unix", path)
+    if spec.startswith("/"):
+        return ("unix", spec)
+    reject_ipv6_endpoint(spec, what)
+    host, sep, port = spec.rpartition(":")
+    if not sep or not host:
+        raise ValueError(
+            f"{what} {spec!r} must be 'host:port' or a unix socket path"
+        )
+    try:
+        port_n = int(port)
+    except ValueError:
+        raise ValueError(
+            f"{what} {spec!r} has a non-numeric port {port!r}"
+        ) from None
+    if not (0 < port_n < 65536):
+        raise ValueError(
+            f"{what} {spec!r} port must be in 1..65535"
+        )
+    return ("tcp", (host, port_n))

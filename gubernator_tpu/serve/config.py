@@ -166,6 +166,21 @@ class ServerConfig:
     # more window buys only queueing. 0 = GUBER_EDGE_WINDOW (default
     # 32). Exceeding the window is TCP-backpressured, never dropped.
     edge_window: int = 0
+    # Daemon-side GEB client-protocol door (r12): TCP port where the
+    # daemon serves the windowed binary frame protocol directly to
+    # GEB clients (gubernator_tpu.client_geb) — the edge wire protocol
+    # without running the edge binary. 0 = off. Listens on 0.0.0.0;
+    # shares the bridge's frame-service core, so shed screen, string
+    # fold, stage clock, and GEBR drain semantics apply identically.
+    # NOTE the fast-framing trust stance: pre-hashed frames bypass
+    # instance routing (serve/edge_bridge.py GebListener docstring) —
+    # the packaged client only sends them on single-node rings.
+    geb_port: int = 0
+    # Credit window the GEB listener advertises (max frames in flight
+    # per client connection). 0 = the edge_window resolution (default
+    # 32). Per-connection memory bound and pipelining depth, exactly
+    # like GUBER_EDGE_WINDOW.
+    geb_window: int = 0
     # String->array fold (r7 slow-path owner batching, bridge side): a
     # string frame whose items are ALL plain (BATCHING/NO_BATCHING,
     # valid non-empty name/key) and ALL owned by this node skips
@@ -404,6 +419,10 @@ class ServerConfig:
             )
         if self.edge_window < 0:
             raise ValueError("GUBER_EDGE_WINDOW must be >= 0")
+        if not (0 <= self.geb_port < 65536):
+            raise ValueError("GUBER_GEB_PORT must be in 0..65535")
+        if self.geb_window < 0:
+            raise ValueError("GUBER_GEB_WINDOW must be >= 0")
         if self.drain_timeout < 0:
             raise ValueError("GUBER_DRAIN_TIMEOUT_MS must be >= 0")
         # bridge endpoints split host:port on the LAST colon — IPv6
@@ -534,6 +553,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         edge_fast=_get(env, "GUBER_EDGE_FAST", "1").lower()
         not in ("0", "false", "no", "off"),
         edge_window=_get_int(env, "GUBER_EDGE_WINDOW", 0),
+        geb_port=_get_int(env, "GUBER_GEB_PORT", 0),
+        geb_window=_get_int(env, "GUBER_GEB_WINDOW", 0),
         edge_string_fold=_get(env, "GUBER_EDGE_STRING_FOLD", "1").lower()
         not in ("0", "false", "no", "off"),
         dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),

@@ -153,6 +153,14 @@ MAGIC_WFAST_RESP = 0x38424547  # 'GEB8' — windowed pre-hashed response
 
 HELLO_FAST = 1  # hello flags bit 0
 HELLO_WINDOWED = 2  # hello flags bit 1; window size = flags >> 16
+# hello flags bit 2 (r12): this node's slot store hashes with the
+# native XXH64 hasher. A PRE-hashing client (GEB7 fast frames) must run
+# the SAME hash implementation as the store or its keys silently land
+# in different rows than the string path's; the bit lets the client
+# verify agreement at hello time (client_geb.py auto mode) instead of
+# splitting buckets. Pre-r12 edges ignore unknown bits (the compiled
+# edge always hashes XXH64 and ships with the native build).
+HELLO_XXH64 = 4
 
 DEFAULT_WINDOW = 32
 MAX_WINDOW = 1024
@@ -172,19 +180,14 @@ def ring_fingerprint(hosts) -> int:
     return zlib.crc32("\n".join(sorted(hosts)).encode()) & 0xFFFFFFFF
 
 
-def reject_ipv6_endpoint(spec: str, what: str) -> str:
-    """Bridge endpoints are 'host:port' split on the LAST colon — an
-    IPv6 literal ('[::1]:9100', bare '::1') would silently misparse
-    (bracketed host handed to the resolver, or the address mistaken
-    for a unix path). Refuse loudly at config time instead (ADVICE r5
-    #2); document hostnames/IPv4 only. Returns `spec` for chaining."""
-    if "[" in spec or "]" in spec or spec.count(":") > 1:
-        raise ValueError(
-            f"{what} {spec!r} looks like an IPv6 literal; bridge "
-            f"endpoints must be 'host:port' with an IPv4 address or "
-            f"hostname (the frame protocol splits on the last ':')"
-        )
-    return spec
+# endpoint parsing moved to the shared gubernator_tpu.endpoints helper
+# (r12): the client tier (client.py / client_geb.py) applies the same
+# loud IPv6 refusal instead of growing its own misparse. Re-exported
+# here because every pre-r12 config site imports it from this module.
+from gubernator_tpu.endpoints import (  # noqa: E402
+    endpoint_is_ipv6ish,
+    reject_ipv6_endpoint,
+)
 
 
 _HDR = struct.Struct("<II")
@@ -375,29 +378,33 @@ class _ConnWindow:
             t.cancel()
 
 
-class EdgeBridge:
-    """Unix-socket (+ optional TCP) server feeding edge batches into the
-    serving instance. The unix socket serves a co-located edge; the TCP
-    listener serves edges fronting OTHER nodes of the cluster, which
-    ship pre-hashed frames for keys this node owns (cluster fast path,
-    r5). Windowed framing (r7) lets one connection carry `window`
-    concurrent frames."""
+class FrameService:
+    """Shared frame-service core (r12): one connection/frame engine
+    serving the GEB wire protocol into the serving instance —
+    hello, windowed + legacy framings, string fold, shed screen, stage
+    clock, drain/GEBR semantics. Listeners are the subclasses' job:
+
+      EdgeBridge   unix socket (co-located compiled edge) + optional
+                   TCP (edges fronting other cluster nodes) — the
+                   trusted internal cluster door (r5/r7)
+      GebListener  the daemon's client-facing GEB door
+                   (GUBER_GEB_PORT, r12) — the same protocol without
+                   running the edge binary
+
+    plus `serve_frame_bytes` for the body-per-request shape (the HTTP
+    gateway's protobuf-free POST /v1/geb door). One core means the
+    three doors cannot drift: a frame decodes, sheds, batches, and
+    encodes identically wherever it arrives."""
 
     def __init__(
         self,
         instance,
-        path: str,
-        tcp_address: str = "",
-        peer_bridges: Optional[dict] = None,
         fast_enabled: bool = True,
         window: int = 0,
         string_fold: bool = True,
+        peer_bridges: Optional[dict] = None,
     ):
         self.instance = instance
-        self.path = path
-        if tcp_address:
-            reject_ipv6_endpoint(tcp_address, "GUBER_EDGE_TCP")
-        self.tcp_address = tcp_address
         self.fast_enabled = fast_enabled
         self.string_fold = string_fold
         # explicit grpc_addr -> bridge_addr overrides (config
@@ -406,13 +413,14 @@ class EdgeBridge:
         self.peer_bridges = peer_bridges or {}
         for spec in self.peer_bridges.values():
             reject_ipv6_endpoint(spec, "GUBER_EDGE_PEER_BRIDGES entry")
-        # 0 = default; GUBER_EDGE_WINDOW is parsed once, in
-        # config_from_env (server boots pass conf.edge_window here)
+        # 0 = default; GUBER_EDGE_WINDOW / GUBER_GEB_WINDOW are parsed
+        # once, in config_from_env (server boots pass the conf value)
         if window <= 0:
             window = DEFAULT_WINDOW
         self.window = max(1, min(int(window), MAX_WINDOW))
-        self._server: Optional[asyncio.AbstractServer] = None
-        self._tcp_server: Optional[asyncio.AbstractServer] = None
+        #: asyncio servers the subclass started; drain()/stop() close
+        #: them generically
+        self._servers: list = []
         # live connection writers: stop() must actively close them —
         # py3.12's Server.wait_closed() waits for HANDLERS to finish,
         # and a connected-but-idle edge parks its handler in
@@ -428,20 +436,12 @@ class EdgeBridge:
         # (picker object, fingerprint) — see _ring_hash
         self._ring_hash_cache: Optional[tuple] = None
 
-    async def start(self) -> None:
-        self._stopping = False
-        self._draining = False
-        if self.path:
-            self._server = await asyncio.start_unix_server(
-                self._serve_conn, path=self.path
-            )
-            log.info("edge bridge listening on %s", self.path)
-        if self.tcp_address:
-            host, _, port = self.tcp_address.rpartition(":")
-            self._tcp_server = await asyncio.start_server(
-                self._serve_conn, host=host or "0.0.0.0", port=int(port)
-            )
-            log.info("edge bridge listening on tcp %s", self.tcp_address)
+    def _bridge_advert_port(self) -> str:
+        """Port peers' frame doors are advertised on in the hello
+        (symmetric-fleet convention: every node listens on the same
+        port). Empty = advertise peers door-less; subclasses with a
+        TCP listener override."""
+        return ""
 
     async def drain(self, timeout: float) -> None:
         """Graceful drain: stop accepting connections, refuse NEW
@@ -451,9 +451,8 @@ class EdgeBridge:
         written; stop() closes them afterwards. No accepted frame is
         dropped unless the timeout expires."""
         self._draining = True
-        for srv in (self._server, self._tcp_server):
-            if srv is not None:
-                srv.close()
+        for srv in self._servers:
+            srv.close()
         deadline = time.monotonic() + max(0.0, timeout)
         while self._active_frames > 0 and time.monotonic() < deadline:
             await asyncio.sleep(0.01)
@@ -469,17 +468,14 @@ class EdgeBridge:
         # below looks) — it checks this flag on entry and exits instead
         # of parking in readexactly under wait_closed
         self._stopping = True
-        for srv in (self._server, self._tcp_server):
-            if srv is not None:
-                srv.close()
+        for srv in self._servers:
+            srv.close()
         # unblock parked handlers BEFORE wait_closed (see _conns note)
         for w in list(self._conns):
             w.close()
-        for srv in (self._server, self._tcp_server):
-            if srv is not None:
-                await srv.wait_closed()
-        self._server = None
-        self._tcp_server = None
+        for srv in self._servers:
+            await srv.wait_closed()
+        self._servers = []
 
     def _arrays_ok(self) -> bool:
         """The array decide path needs a backend that takes arrays —
@@ -543,12 +539,16 @@ class EdgeBridge:
                 peers = sorted(picker.peers(), key=lambda p: p.host)
             except Exception:
                 peers = []
-        bridge_port = ""
-        if self.tcp_address:
-            bridge_port = self.tcp_address.rpartition(":")[2]
+        bridge_port = self._bridge_advert_port()
         flags = HELLO_WINDOWED | (self.window << 16)
         if self._fast_ok():
             flags |= HELLO_FAST
+            from gubernator_tpu.core.hashing import using_native_hash
+
+            # hash-implementation bit (r12): pre-hashing clients check
+            # it against their own hasher before choosing fast framing
+            if using_native_hash():
+                flags |= HELLO_XXH64
         parts = [
             struct.pack(
                 "<IIII",
@@ -577,6 +577,12 @@ class EdgeBridge:
             parts.append(struct.pack("<H", len(bridge)))
             parts.append(bridge)
         return b"".join(parts)
+
+    def hello_bytes(self) -> bytes:
+        """The encoded GEBI hello — public accessor for the HTTP
+        binary door (GET /v1/geb serves it so a fast client can
+        negotiate without a socket)."""
+        return self._hello()
 
     async def _decide_arrays_chunked(self, fields: dict, n: int):
         """Run one frame's array fields through the batcher, splitting
@@ -1097,7 +1103,14 @@ class EdgeBridge:
                     # the GEB1 string reader predates GEBR entirely (a
                     # stale magic is a hard protocol failure there), so
                     # drain-refuse with a well-formed GEB3 response
-                    # carrying per-item errors — degraded, in-protocol
+                    # carrying per-item errors — degraded, in-protocol.
+                    # Wire count bounded by the payload's minimum
+                    # bytes/item first: this branch allocates n
+                    # responses and the GEB door is client-facing.
+                    if n > len(payload) // 30:
+                        raise ValueError(
+                            "item count exceeds payload bound"
+                        )
                     writer.write(
                         encode_response_frame(
                             [
@@ -1127,3 +1140,200 @@ class EdgeBridge:
             wstate.cancel_all()
             self._conns.discard(writer)
             writer.close()
+
+    async def serve_frame_bytes(self, data: bytes) -> bytes:
+        """Serve ONE complete request frame carried as a byte string
+        and return the complete encoded response frame — the body-per-
+        request shape of the HTTP gateway's protobuf-free POST /v1/geb
+        door (serve/server.py). All four request framings are accepted
+        (GEB1/GEB6 legacy, GEB2/GEB7 windowed — the windowed frame ids
+        are echoed but carry no pipelining here: HTTP gives each frame
+        its own request/response exchange). Malformed input raises
+        ValueError (the gateway answers 400); a stale-ring fast frame
+        or a draining node returns a GEBR frame, exactly as on the
+        socket doors. Runs the same shed screen, stage clock, and
+        drain accounting as a socket frame."""
+        if len(data) < _HDR.size:
+            raise ValueError("short frame")
+        magic, n = _HDR.unpack_from(data, 0)
+        off = _HDR.size
+        t0 = time.monotonic()
+        frame_id: Optional[int] = None
+        frame_ring: Optional[int] = None
+        if magic == MAGIC_WFAST_REQ:
+            if len(data) < off + _WFAST_HDR.size + 4:
+                raise ValueError("short GEB7 header")
+            frame_id, frame_ring, _t_sent = _WFAST_HDR.unpack_from(
+                data, off
+            )
+            off += _WFAST_HDR.size
+        elif magic == MAGIC_WREQ:
+            if len(data) < off + _WREQ_HDR.size + 4:
+                raise ValueError("short GEB2 header")
+            frame_id, _t_sent = _WREQ_HDR.unpack_from(data, off)
+            off += _WREQ_HDR.size
+        elif magic == MAGIC_FAST_REQ:
+            if len(data) < off + 8:
+                raise ValueError("short GEB6 header")
+            (frame_ring,) = struct.unpack_from("<I", data, off)
+            off += 4
+        elif magic != MAGIC_REQ:
+            raise ValueError(f"bad magic {magic:#x}")
+        if len(data) < off + 4:
+            raise ValueError("short frame")
+        (plen,) = struct.unpack_from("<I", data, off)
+        off += 4
+        if off + plen != len(data):
+            raise ValueError("frame length mismatch")
+        payload = bytes(data[off:])
+        if frame_ring is not None and frame_ring != self._ring_hash():
+            metrics.EDGE_STALE_RINGS.inc()
+            return _HDR.pack(
+                MAGIC_STALE, frame_id if frame_id is not None else 0
+            )
+        if self._draining:
+            if magic == MAGIC_REQ:
+                # GEB1 predates GEBR: refuse in-protocol (socket
+                # parity). The wire count is untrusted and this branch
+                # allocates n responses, so bound it by the payload's
+                # minimum bytes/item (30) BEFORE building anything —
+                # a lying header must not be an OOM vector mid-drain.
+                if n > len(payload) // 30:
+                    raise ValueError("item count exceeds payload bound")
+                return encode_response_frame(
+                    [
+                        RateLimitResp(error="node draining")
+                        for _ in range(n)
+                    ]
+                )
+            return _HDR.pack(MAGIC_STALE, DRAIN_FRAME_ID)
+        self._frame_begun()
+        try:
+            if FAULTS.enabled:
+                await FAULTS.inject("edge_frame")
+            if magic in (MAGIC_WFAST_REQ, MAGIC_FAST_REQ):
+                raw = await self._decide_fast(payload, n)
+                if magic == MAGIC_WFAST_REQ:
+                    frame = (
+                        _HDR.pack(MAGIC_WFAST_RESP, n)
+                        + struct.pack("<I", frame_id)
+                        + raw
+                    )
+                else:
+                    frame = _HDR.pack(MAGIC_FAST_RESP, n) + raw
+            elif magic == MAGIC_WREQ:
+                frame = await self._decide_string_frame(
+                    payload, n, magic=MAGIC_WRESP, frame_id=frame_id
+                )
+            else:
+                frame = await self._decide_string_frame(payload, n)
+        finally:
+            self._frame_done()
+        STAGES.add_frame(time.monotonic() - t0)
+        return frame
+
+
+class EdgeBridge(FrameService):
+    """Unix-socket (+ optional TCP) server feeding edge batches into the
+    serving instance. The unix socket serves a co-located edge; the TCP
+    listener serves edges fronting OTHER nodes of the cluster, which
+    ship pre-hashed frames for keys this node owns (cluster fast path,
+    r5). Windowed framing (r7) lets one connection carry `window`
+    concurrent frames. Internal cluster door — see the trust boundary
+    note in the module docstring."""
+
+    def __init__(
+        self,
+        instance,
+        path: str,
+        tcp_address: str = "",
+        peer_bridges: Optional[dict] = None,
+        fast_enabled: bool = True,
+        window: int = 0,
+        string_fold: bool = True,
+    ):
+        super().__init__(
+            instance,
+            fast_enabled=fast_enabled,
+            window=window,
+            string_fold=string_fold,
+            peer_bridges=peer_bridges,
+        )
+        self.path = path
+        if tcp_address:
+            reject_ipv6_endpoint(tcp_address, "GUBER_EDGE_TCP")
+        self.tcp_address = tcp_address
+
+    def _bridge_advert_port(self) -> str:
+        if self.tcp_address:
+            return self.tcp_address.rpartition(":")[2]
+        return ""
+
+    async def start(self) -> None:
+        self._stopping = False
+        self._draining = False
+        if self.path:
+            srv = await asyncio.start_unix_server(
+                self._serve_conn, path=self.path
+            )
+            self._servers.append(srv)
+            log.info("edge bridge listening on %s", self.path)
+        if self.tcp_address:
+            host, _, port = self.tcp_address.rpartition(":")
+            srv = await asyncio.start_server(
+                self._serve_conn, host=host or "0.0.0.0", port=int(port)
+            )
+            self._servers.append(srv)
+            log.info("edge bridge listening on tcp %s", self.tcp_address)
+
+
+class GebListener(FrameService):
+    """The daemon's client-facing GEB door (GUBER_GEB_PORT, r12): the
+    windowed binary frame protocol as a first-class CLIENT protocol,
+    without running the edge binary. Speaks exactly the bridge framing
+    (one shared FrameService core), so gubernator_tpu.client_geb gets
+    hello negotiation, credit-windowed pipelining, out-of-order
+    completion, the shed screen, and GEBR drain/stale semantics
+    against any daemon.
+
+    Peers in the hello advertise THEIR GEB doors under the
+    symmetric-fleet port convention (every node listens on the same
+    GUBER_GEB_PORT), so a topology-aware client can route per owner
+    the way the compiled edge does.
+
+    Trust note: pre-hashed fast frames (GEB6/GEB7) bypass instance
+    routing — like the bridge, this door trusts a matching ring
+    fingerprint and decides the items locally. The packaged client
+    only uses fast framing against single-node rings (client_geb.py
+    auto mode); string frames (GEB2) keep full routing/forwarding
+    semantics on any topology and any client."""
+
+    def __init__(
+        self,
+        instance,
+        address: str,
+        fast_enabled: bool = True,
+        window: int = 0,
+        string_fold: bool = True,
+    ):
+        super().__init__(
+            instance,
+            fast_enabled=fast_enabled,
+            window=window,
+            string_fold=string_fold,
+        )
+        reject_ipv6_endpoint(address, "GUBER_GEB_PORT listener")
+        self.address = address
+
+    def _bridge_advert_port(self) -> str:
+        return self.address.rpartition(":")[2]
+
+    async def start(self) -> None:
+        self._stopping = False
+        self._draining = False
+        host, _, port = self.address.rpartition(":")
+        srv = await asyncio.start_server(
+            self._serve_conn, host=host or "0.0.0.0", port=int(port)
+        )
+        self._servers.append(srv)
+        log.info("GEB client protocol listening on tcp %s", self.address)

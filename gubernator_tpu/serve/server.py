@@ -217,11 +217,19 @@ def register_servicers(grpc_server, instance: Instance):
     return instance
 
 
+#: content type gating the HTTP gateway's binary GEB door (r12) — a
+#: deliberate mirror of client_geb.GEB_CONTENT_TYPE (the client module
+#: must not be a serving-tier dependency; test-pinned equal)
+GEB_CONTENT_TYPE = "application/x-guber-geb"
+
+
 class Server:
     """One daemon: gRPC + HTTP, an Instance, and discovery."""
 
     _profiling = False
     _edge = None
+    _geb = None
+    _geb_core = None
 
     def __init__(self, conf: ServerConfig, backend=None):
         self.conf = conf
@@ -335,6 +343,22 @@ class Server:
         else:
             log.info("bucket replication: off (GUBER_REPLICATION=0)")
 
+        if self.conf.geb_port:
+            from gubernator_tpu.serve.edge_bridge import GebListener
+
+            self._geb = GebListener(
+                self.instance,
+                f"0.0.0.0:{self.conf.geb_port}",
+                fast_enabled=self.conf.edge_fast,
+                window=self.conf.geb_window or self.conf.edge_window,
+                string_fold=self.conf.edge_string_fold,
+            )
+            await self._geb.start()
+            log.info(
+                "GEB client protocol door on :%d (GUBER_GEB_PORT; "
+                "window %d, GUBER_GEB_WINDOW)",
+                self.conf.geb_port, self._geb.window,
+            )
         if self.conf.http_address:
             await self._start_http()
         if self.conf.edge_socket or self.conf.edge_tcp:
@@ -409,6 +433,21 @@ class Server:
             t = time.monotonic()
             await self._edge.drain(remaining())
             timings["edge"] = time.monotonic() - t
+        if self._geb is not None:
+            # the client-protocol door drains like the bridge: answer
+            # accepted frames, GEBR-refuse new ones, close the listener
+            t = time.monotonic()
+            await self._geb.drain(remaining())
+            timings["geb"] = time.monotonic() - t
+        if self.conf.http_address:
+            # HTTP-door frame core: flag it so a frame POSTed
+            # mid-drain gets the GEBR drain body (the HTTP runner
+            # cleanup below bounds the in-flight ones). Built through
+            # _frame_core(), not checked-if-built: a node that saw no
+            # GEB traffic yet must still refuse the first frame that
+            # races the drain, instead of lazily building an
+            # un-flagged core for it
+            self._frame_core()._draining = True
         if self.grpc_server is not None:
             # grace makes stop() self-bounding (handlers are
             # force-cancelled when it expires) — and it must NOT run
@@ -455,6 +494,10 @@ class Server:
         if self._edge is not None:
             await self._edge.stop()
             self._edge = None
+        if self._geb is not None:
+            await self._geb.stop()
+            self._geb = None
+        self._geb_core = None
         if self._pool is not None:
             await self._pool.close()
             self._pool = None
@@ -474,6 +517,11 @@ class Server:
     async def _start_http(self) -> None:
         app = web.Application()
         app.router.add_post("/v1/GetRateLimits", self._http_get_rate_limits)
+        # protobuf-free binary door (r12): one GEB frame per POST body,
+        # content-type gated; GET serves the hello (ring + flags) so a
+        # fast client can negotiate exactly like the socket doors
+        app.router.add_post("/v1/geb", self._http_geb)
+        app.router.add_get("/v1/geb", self._http_geb_hello)
         app.router.add_get("/v1/HealthCheck", self._http_health)
         app.router.add_get("/metrics", self._http_metrics)
         app.router.add_get("/v1/debug/stats", self._http_debug_stats)
@@ -554,6 +602,62 @@ class Server:
                 ]
             }
         )
+
+    def _frame_core(self):
+        """Frame-service core backing the HTTP binary door: the GEB
+        listener when enabled (so drain state is shared), else a
+        lazily-built listenerless FrameService over the same instance
+        — either way the exact decode/shed/batch/encode pipeline the
+        socket doors run (serve/edge_bridge.py)."""
+        if self._geb is not None:
+            return self._geb
+        if self._geb_core is None:
+            from gubernator_tpu.serve.edge_bridge import FrameService
+
+            self._geb_core = FrameService(
+                self.instance,
+                fast_enabled=self.conf.edge_fast,
+                window=self.conf.geb_window or self.conf.edge_window,
+                string_fold=self.conf.edge_string_fold,
+            )
+        return self._geb_core
+
+    async def _http_geb_hello(self, request: web.Request):
+        return web.Response(
+            body=self._frame_core().hello_bytes(),
+            content_type=GEB_CONTENT_TYPE,
+        )
+
+    async def _http_geb(self, request: web.Request):
+        """Binary GEB frame door (r12): the edge wire protocol with an
+        HTTP request body as the transport — for clients whose
+        infrastructure only passes HTTP. Content-type gated so a JSON
+        client posting to the wrong path gets a clear 415, never a
+        frame-decode of its JSON bytes."""
+        if request.content_type != GEB_CONTENT_TYPE:
+            return web.json_response(
+                {
+                    "error": (
+                        f"content-type must be {GEB_CONTENT_TYPE} "
+                        f"(one binary GEB frame per request body)"
+                    )
+                },
+                status=415,
+            )
+        import struct
+
+        body = await request.read()
+        try:
+            resp = await self._frame_core().serve_frame_bytes(body)
+        except (ValueError, struct.error) as e:
+            # struct.error covers truncated varlen payloads that pass
+            # the outer length checks — client garbage, still a 400
+            return web.json_response(
+                {"error": f"bad GEB frame: {e}"}, status=400
+            )
+        except BatchTooLargeError as e:
+            return web.json_response({"error": str(e)}, status=400)
+        return web.Response(body=resp, content_type=GEB_CONTENT_TYPE)
 
     async def _http_health(self, request: web.Request):
         h = self.instance.health_check()

@@ -461,6 +461,45 @@ def table_shed() -> str:
     return "\n".join(lines)
 
 
+def table_frontdoor() -> str:
+    """Public front-door ladder (r12), from BENCH_FRONTDOOR_r12.json:
+    the gRPC protobuf door vs the GEB client protocol vs the HTTP
+    binary door, out-of-process generators, paired interleaved rounds
+    (r9 methodology)."""
+    doc = json.loads((ROOT / "BENCH_FRONTDOOR_r12.json").read_text())
+    med = doc["ladder_median_decisions_per_sec"]
+    paired = doc["paired"]
+    label = {
+        "grpc": "gRPC protobuf (`V1Client`)",
+        "geb": "GEB client protocol (`client_geb`, "
+               "`GUBER_GEB_PORT` door)",
+        "http": "HTTP binary (`POST /v1/geb`)",
+    }
+    ratio = {
+        "grpc": "1.00x (baseline)",
+        "geb": f"**{paired['geb_over_grpc']['median']:.2f}x**",
+        "http": f"{paired['http_over_grpc']['median']:.2f}x",
+    }
+    lines = [
+        "| public door | decisions/s (median) | paired vs gRPC |",
+        "|---|---|---|",
+    ]
+    for k in ("grpc", "geb", "http"):
+        lines.append(f"| {label[k]} | {med[k]:,.0f} | {ratio[k]} |")
+    lines.append("")
+    lines.append(
+        f"({doc['rounds']} interleaved rounds, shed-r10 workload "
+        f"shape (share {doc['share']:.0%}), {doc['batch_items']}-item "
+        f"batches, each door driven by an out-of-process "
+        f"`cli.loadgen --protocol ...`; the same run is the "
+        f"`make perf-gate` regression gate "
+        f"(threshold {doc['gate']['threshold']:.0%}, "
+        f"passed: **{doc['gate']['passed']}**). Scope in the "
+        f"artifact.)"
+    )
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -472,6 +511,7 @@ TABLES = {
     "resilience-knobs-table": table_resilience_knobs,
     "host-prep-table": table_host_prep,
     "shed-table": table_shed,
+    "frontdoor-table": table_frontdoor,
 }
 
 

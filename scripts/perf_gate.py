@@ -1,0 +1,558 @@
+"""Continuous front-door perf gate + protocol ladder — r12.
+
+Seven PRs of serving-tier wins (windowed frames r7, arrival prep r9,
+shed cache r10) had no guard against silent decay: nothing failed when
+a change quietly gave their speedups back. This gate replays the
+committed workload SHAPES with interleaved paired A/B rounds (the r9
+methodology: short adjacent rounds, alternating within-round order, so
+ambient drift on a shared box cancels in the per-round ratio) and
+fails when a guarded paired ratio falls more than PERF_GATE_THRESHOLD
+below the committed baseline (PERF_GATE_BASELINE.json — the manifest
+names which BENCH_* artifact motivates each workload):
+
+  shed_r10    shed-share-0.9 workload, shed cache OFF vs ON
+              (BENCH_SHED_r10.json's screen, bridge tier)
+  submit_r9   saturation workload, GUBER_PREP_AT_ARRIVAL OFF vs ON
+              (BENCH_SUBMIT_r9.json's host-prep pipeline)
+  stages_r7   saturation workload, credit window 1 (round-trip, the
+              pre-r7 shape) vs the full advertised window
+              (BENCH_STAGES_r7/BENCH_SERVING_DEVICE_r7's pipelining)
+  frontdoor_geb_over_grpc / _http_over_grpc
+              the r12 public-door ladder (below)
+
+Paired ratios are deliberately box-speed-invariant: a uniformly slower
+container moves both sides of a pair; only a regression in the guarded
+feature path moves the ratio. `--inject-frame-ms N` adds a real
+per-frame delay (the r8 fault injector, edge_frame point) to the
+B/feature side only — the self-test that proves the gate FAILS when
+the guarded path slows down (tests/test_perf_gate.py).
+
+The same run measures the public front-door ladder on the shed-r10
+workload shape — the gRPC protobuf door vs the GEB client protocol
+(daemon GUBER_GEB_PORT door, gubernator_tpu.client_geb) vs the HTTP
+binary door (POST /v1/geb) — with each generator OUT of process
+(`cli.loadgen --protocol ...`; in-process clients thrash the serving
+GIL, and r10 showed the gRPC generator's own protobuf encode IS the
+ceiling being measured). Writes BENCH_FRONTDOOR_r12.json.
+
+Usage:
+  python scripts/perf_gate.py [--seconds 3] [--rounds 4]
+      [--threshold 0.10] [--baseline PERF_GATE_BASELINE.json]
+      [--json BENCH_FRONTDOOR.json] [--update-baseline]
+      [--inject-frame-ms 0]
+  make perf-gate   # PERF_GATE_THRESHOLD=0.10 overridable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import statistics
+import subprocess
+import sys
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+HTTP_ADDR = "127.0.0.1:29881"
+GRPC_ADDR = "127.0.0.1:29880"
+GEB_PORT = 29882
+SOCK = "/tmp/guber-perf-gate.sock"
+
+GATED = (
+    "shed_r10",
+    "submit_r9",
+    "stages_r7",
+    "frontdoor_geb_over_grpc",
+    "frontdoor_http_over_grpc",
+)
+
+
+def evaluate_gate(baseline: dict, measured: dict, threshold: float):
+    """Compare measured paired ratios against the committed manifest.
+    Returns (passed, rows); a workload fails when its measured ratio
+    is more than `threshold` below the committed value. Workloads in
+    the manifest but not measured (or vice versa) are reported, not
+    silently skipped — a gate that quietly stopped measuring a
+    workload would pass for the wrong reason."""
+    rows = []
+    passed = True
+    base_wl = baseline.get("workloads", {})
+    for name in sorted(set(base_wl) | set(measured)):
+        b = base_wl.get(name)
+        m = measured.get(name)
+        if b is None:
+            rows.append(
+                dict(workload=name, status="unguarded",
+                     measured=m, note="not in the baseline manifest")
+            )
+            continue
+        if m is None:
+            passed = False
+            rows.append(
+                dict(workload=name, status="FAIL", committed=b["committed"],
+                     note="workload not measured this run")
+            )
+            continue
+        floor = b["committed"] * (1.0 - threshold)
+        ok = m >= floor
+        if not ok:
+            passed = False
+        rows.append(
+            dict(
+                workload=name,
+                status="ok" if ok else "FAIL",
+                committed=b["committed"],
+                measured=round(m, 4),
+                floor=round(floor, 4),
+                artifact=b.get("artifact", ""),
+            )
+        )
+    return passed, rows
+
+
+def _loadgen(
+    protocol: str,
+    address: str,
+    seconds: float,
+    share: float,
+    concurrency: int,
+    batch: int,
+    window: int = 0,
+) -> dict:
+    """One out-of-process load window via the real CLI generator."""
+    args = [
+        sys.executable, "-m", "gubernator_tpu.cli.loadgen", address,
+        "--protocol", protocol, "--duration", str(seconds),
+        "--share", str(share), "--concurrency", str(concurrency),
+        "--batch", str(batch), "--window", str(window), "--json",
+    ]
+    out = subprocess.run(
+        args,
+        capture_output=True,
+        text=True,
+        timeout=seconds + 120,
+        cwd=ROOT,
+        env=dict(os.environ, PYTHONPATH=str(ROOT)),
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"loadgen {protocol} failed: {out.stderr[-800:]}"
+        )
+    r = json.loads(out.stdout.strip().splitlines()[-1])
+    if r["errors"]:
+        raise RuntimeError(
+            f"loadgen {protocol} saw {r['errors']} errors: "
+            f"{out.stderr[-800:]}"
+        )
+    return r
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seconds", type=float, default=3.0,
+                    help="per-mode window per round (short micro-"
+                    "rounds per the r9 methodology)")
+    ap.add_argument("--rounds", type=int, default=4,
+                    help="interleaved A/B pairs per workload")
+    ap.add_argument("--threshold", type=float,
+                    default=float(os.environ.get(
+                        "PERF_GATE_THRESHOLD", "0.10")),
+                    help="paired-regression budget (0.10 = fail on a "
+                    ">10%% drop below the committed ratio)")
+    ap.add_argument("--baseline", default=str(ROOT / "PERF_GATE_BASELINE.json"))
+    ap.add_argument("--json", default="", help="write the front-door "
+                    "ladder artifact here")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline manifest from this "
+                    "run's measurements instead of gating")
+    ap.add_argument("--inject-frame-ms", type=float, default=0.0,
+                    help="self-test: inject this per-frame delay into "
+                    "the FEATURE side of every pair (edge_frame fault "
+                    "point) — the gate must then fail")
+    ap.add_argument("--share", type=float, default=0.9,
+                    help="over-limit share of the shed/ladder shape")
+    ap.add_argument(
+        "--device-batch-limit", type=int,
+        default=int(os.environ.get("GUBER_DEVICE_BATCH_LIMIT", "8192")),
+    )
+    ap.add_argument("--concurrency", type=int, default=24,
+                    help="loadgen workers (geb: pipelined frames on "
+                    "one connection)")
+    ap.add_argument("--batch", type=int, default=1000)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", str(ROOT / ".jax_cache")
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+    from gubernator_tpu.cluster import LocalCluster
+    from gubernator_tpu.core.engine import buckets_for_limit
+    from gubernator_tpu.core.store import StoreConfig
+    from gubernator_tpu.serve.backends import TpuBackend
+    from gubernator_tpu.serve.faults import FAULTS
+
+    cluster = LocalCluster(
+        [GRPC_ADDR],
+        backend_factory=lambda: TpuBackend(
+            StoreConfig(rows=16, slots=1 << 12),
+            buckets=buckets_for_limit(args.device_batch_limit),
+        ),
+        http_addresses=[HTTP_ADDR],
+        device_batch_limit=args.device_batch_limit,
+        geb_ports=[GEB_PORT],
+    )
+    print("perf-gate: starting serving stack (device warmup)...",
+          file=sys.stderr)
+    cluster.start(timeout=600)
+
+    async def attach(server, sock):
+        from gubernator_tpu.serve.edge_bridge import EdgeBridge
+
+        bridge = EdgeBridge(server.instance, sock)
+        await bridge.start()
+        return bridge
+
+    pathlib.Path(SOCK).unlink(missing_ok=True)
+    bridge = cluster.run(attach(cluster.servers[0], SOCK))
+    instance = cluster.servers[0].instance
+    shed_obj = instance.shed
+    assert shed_obj is not None, "gate expects the shipped defaults"
+    batcher = instance.batcher
+
+    inject_spec = (
+        f"edge_frame:delay={args.inject_frame_ms}ms"
+        if args.inject_frame_ms > 0
+        else ""
+    )
+
+    def set_inject(on: bool) -> None:
+        FAULTS.configure(inject_spec if on and inject_spec else "")
+
+    def flip_shed(on: bool):
+        async def f():
+            instance.shed = shed_obj if on else None
+
+        cluster.run(f())
+
+    def flip_prep(on: bool):
+        async def f():
+            batcher.prep_at_arrival = on
+
+        cluster.run(f())
+
+    def paired(name, drive_a, drive_b, seconds, rounds):
+        """Interleaved paired rounds: per-round ratio B/A with
+        alternating within-round order; the median paired ratio is
+        the gate metric. `drive_*` run one load window and return
+        decisions/s; the injected self-test delay (if any) applies to
+        the B side only."""
+        rows = []
+        for which, drive in (("a", drive_a), ("b", drive_b)):
+            set_inject(which == "b")
+            drive(min(2.0, seconds))  # warm both paths
+        ratios = []
+        for rnd in range(rounds):
+            order = ("a", "b") if rnd % 2 == 0 else ("b", "a")
+            rates = {}
+            for which in order:
+                set_inject(which == "b")
+                drive = drive_a if which == "a" else drive_b
+                rates[which] = drive(seconds)
+            set_inject(False)
+            ratios.append(rates["b"] / rates["a"])
+            rows.append(dict(round=rnd, a=rates["a"], b=rates["b"],
+                             ratio=round(ratios[-1], 4)))
+            print(
+                f"  {name} round {rnd}: A {rates['a']:>11,.0f} "
+                f"B {rates['b']:>11,.0f} dec/s  ratio "
+                f"{ratios[-1]:.3f}",
+                file=sys.stderr,
+            )
+        return statistics.median(ratios), rows
+
+    measured = {}
+    detail = {}
+    try:
+        def bridge_drive(share, window=0, batch=None):
+            def d(seconds):
+                r = _loadgen(
+                    "geb", SOCK, seconds, share,
+                    args.concurrency, batch or args.batch,
+                    window=window,
+                )
+                return r["decisions_per_sec"]
+
+            return d
+
+        # -- shed_r10: shed cache OFF vs ON, over-limit-heavy shape --
+        print("workload shed_r10 (shed OFF vs ON)...", file=sys.stderr)
+        drive = bridge_drive(args.share)
+
+        def shed_off(s):
+            flip_shed(False)
+            try:
+                return drive(s)
+            finally:
+                flip_shed(True)
+
+        m, rows = paired("shed_r10", shed_off, drive,
+                         args.seconds, args.rounds)
+        measured["shed_r10"], detail["shed_r10"] = m, rows
+
+        # -- submit_r9: arrival prep OFF vs ON, saturation shape -----
+        print("workload submit_r9 (prep OFF vs ON)...", file=sys.stderr)
+        drive0 = bridge_drive(0.0)
+
+        def prep_off(s):
+            flip_prep(False)
+            try:
+                return drive0(s)
+            finally:
+                flip_prep(True)
+
+        m, rows = paired("submit_r9", prep_off, drive0,
+                         args.seconds, args.rounds)
+        measured["submit_r9"], detail["submit_r9"] = m, rows
+
+        # -- stages_r7: window 1 (round-trip) vs full window ---------
+        # smaller frames than the saturation shape: at 1000-item
+        # frames the device work hides the protocol round trip; 100
+        # items makes frame-rate (the thing windowing pipelines) the
+        # measured quantity
+        print("workload stages_r7 (window 1 vs full)...", file=sys.stderr)
+        m, rows = paired(
+            "stages_r7",
+            bridge_drive(0.0, window=1, batch=100),
+            bridge_drive(0.0, batch=100),
+            args.seconds, args.rounds,
+        )
+        measured["stages_r7"], detail["stages_r7"] = m, rows
+
+        # -- front-door ladder: grpc vs geb vs http ------------------
+        print("front-door ladder (grpc / geb / http)...", file=sys.stderr)
+        doors = {
+            "grpc": lambda s: _loadgen(
+                "grpc", GRPC_ADDR, s, args.share,
+                min(args.concurrency, 16), args.batch,
+            ),
+            "geb": lambda s: _loadgen(
+                "geb", f"127.0.0.1:{GEB_PORT}", s, args.share,
+                args.concurrency, args.batch,
+            ),
+            "http": lambda s: _loadgen(
+                "http", HTTP_ADDR, s, args.share,
+                min(args.concurrency, 10), args.batch,
+            ),
+        }
+        for door, d in doors.items():
+            set_inject(door != "grpc")
+            d(min(2.0, args.seconds))  # warm
+        ladder_rows = []
+        for rnd in range(args.rounds):
+            order = (
+                list(doors) if rnd % 2 == 0 else list(reversed(doors))
+            )
+            rates = {}
+            for door in order:
+                # the injected self-test delay slows the frame doors
+                # (geb/http), not the gRPC baseline — a regression in
+                # the new fast paths, which the gate must catch
+                set_inject(door != "grpc")
+                r = doors[door](args.seconds)
+                rates[door] = r["decisions_per_sec"]
+            set_inject(False)
+            ladder_rows.append(dict(round=rnd, **{
+                k: round(v, 1) for k, v in rates.items()
+            }))
+            print(
+                f"  ladder round {rnd}: "
+                + "  ".join(
+                    f"{k} {v:>11,.0f}" for k, v in rates.items()
+                ),
+                file=sys.stderr,
+            )
+        geb_ratios = [r["geb"] / r["grpc"] for r in ladder_rows]
+        http_ratios = [r["http"] / r["grpc"] for r in ladder_rows]
+        measured["frontdoor_geb_over_grpc"] = statistics.median(geb_ratios)
+        measured["frontdoor_http_over_grpc"] = statistics.median(
+            http_ratios
+        )
+    finally:
+        FAULTS.configure("")
+        try:
+            cluster.run(bridge.stop())
+        except Exception:
+            pass
+        cluster.stop()
+        pathlib.Path(SOCK).unlink(missing_ok=True)
+
+    for k, v in measured.items():
+        print(f"measured {k}: {v:.3f}", file=sys.stderr)
+
+    baseline_path = pathlib.Path(args.baseline)
+    if args.update_baseline:
+        manifest = {
+            "schema": "perf_gate_baseline_r12",
+            "comment": (
+                "Committed paired-ratio baselines for `make "
+                "perf-gate` (scripts/perf_gate.py). Each workload "
+                "replays the SHAPE of the named BENCH_* artifact "
+                "with interleaved paired A/B rounds; the gate fails "
+                "when a measured ratio falls more than "
+                "PERF_GATE_THRESHOLD below `committed`. Ratios are "
+                "box-speed-invariant (both sides of a pair share "
+                "the box), so these values transfer across "
+                "containers far better than absolute dec/s."
+            ),
+            "threshold_default": args.threshold,
+            "seconds_per_round": args.seconds,
+            "rounds": args.rounds,
+            "workloads": {
+                "shed_r10": {
+                    "artifact": "BENCH_SHED_r10.json",
+                    "pair": "shed cache OFF vs ON, share "
+                            f"{args.share} shed workload",
+                    "committed": round(measured["shed_r10"], 4),
+                },
+                "submit_r9": {
+                    "artifact": "BENCH_SUBMIT_r9.json",
+                    "pair": "GUBER_PREP_AT_ARRIVAL off vs on, "
+                            "saturation workload",
+                    "committed": round(measured["submit_r9"], 4),
+                },
+                "stages_r7": {
+                    "artifact": "BENCH_STAGES_r7.json",
+                    "pair": "credit window 1 (round-trip) vs full "
+                            "window, saturation workload",
+                    "committed": round(measured["stages_r7"], 4),
+                },
+                "frontdoor_geb_over_grpc": {
+                    "artifact": "BENCH_FRONTDOOR_r12.json",
+                    "pair": "GEB client door vs gRPC protobuf door, "
+                            "shed-r10 shape",
+                    "committed": round(
+                        measured["frontdoor_geb_over_grpc"], 4
+                    ),
+                },
+                "frontdoor_http_over_grpc": {
+                    "artifact": "BENCH_FRONTDOOR_r12.json",
+                    "pair": "HTTP binary /v1/geb door vs gRPC "
+                            "protobuf door, shed-r10 shape",
+                    "committed": round(
+                        measured["frontdoor_http_over_grpc"], 4
+                    ),
+                },
+            },
+        }
+        baseline_path.write_text(json.dumps(manifest, indent=1) + "\n")
+        print(f"baseline manifest written: {baseline_path}",
+              file=sys.stderr)
+        passed, rows = True, []
+    else:
+        baseline = json.loads(baseline_path.read_text())
+        passed, rows = evaluate_gate(baseline, measured, args.threshold)
+        for r in rows:
+            print(f"gate {r['workload']}: {r['status']} "
+                  f"(measured {r.get('measured')} vs committed "
+                  f"{r.get('committed')}, floor {r.get('floor')})",
+                  file=sys.stderr)
+        print(
+            f"perf-gate: {'PASS' if passed else 'FAIL'} "
+            f"(threshold {args.threshold:.0%})",
+            file=sys.stderr,
+        )
+
+    if args.json:
+        geb_med = statistics.median(
+            r["geb"] for r in ladder_rows
+        )
+        doc = {
+            "schema": "bench_frontdoor_r12",
+            "scope": (
+                "single node, tpu backend on this host's CPU; each "
+                "door driven by an OUT-of-process "
+                "`cli.loadgen --protocol {grpc,geb,http}` on the "
+                f"shed-r10 workload shape (share {args.share}: hot "
+                "limit-1 keys frozen over limit + never-over keys), "
+                f"{args.batch}-item batches. gRPC = the protobuf "
+                "door (AsyncV1Client); geb = the windowed GEB client "
+                "protocol against the daemon's GUBER_GEB_PORT door "
+                "(gubernator_tpu.client_geb, credit-window "
+                "pipelining); http = binary GEB frames POSTed to "
+                "/v1/geb. INTERLEAVED rounds with alternating order; "
+                "paired per-round ratios vs the gRPC door are the "
+                "drift-robust headline (r9 methodology). The same "
+                "run replays the r7/r9/r10 paired workloads as the "
+                "perf gate (see `gate`)."
+            ),
+            "host_cpus": os.cpu_count(),
+            "seconds_per_round": args.seconds,
+            "rounds": args.rounds,
+            "share": args.share,
+            "batch_items": args.batch,
+            "concurrency": args.concurrency,
+            "device_batch_limit": args.device_batch_limit,
+            "env_knobs": {
+                "GUBER_GEB_PORT": str(GEB_PORT),
+                "GUBER_SHED_CACHE": "1",
+                "GUBER_PREP_AT_ARRIVAL": os.environ.get(
+                    "GUBER_PREP_AT_ARRIVAL", "1"
+                ),
+                "GUBER_DEVICE_BATCH_LIMIT": str(
+                    args.device_batch_limit
+                ),
+            },
+            "ladder_rows": ladder_rows,
+            "ladder_median_decisions_per_sec": {
+                door: statistics.median(
+                    r[door] for r in ladder_rows
+                )
+                for door in ("grpc", "geb", "http")
+            },
+            "paired": {
+                "geb_over_grpc": {
+                    "ratios": [round(x, 4) for x in geb_ratios],
+                    "median": round(
+                        measured["frontdoor_geb_over_grpc"], 4
+                    ),
+                },
+                "http_over_grpc": {
+                    "ratios": [round(x, 4) for x in http_ratios],
+                    "median": round(
+                        measured["frontdoor_http_over_grpc"], 4
+                    ),
+                },
+            },
+            "acceptance": {
+                "target_geb_over_grpc": 2.5,
+                "met": measured["frontdoor_geb_over_grpc"] >= 2.5,
+                "geb_median_decisions_per_sec": geb_med,
+            },
+            "gate": {
+                "threshold": args.threshold,
+                "measured": {
+                    k: round(v, 4) for k, v in measured.items()
+                },
+                "paired_rounds": detail,
+                "rows": rows,
+                "passed": passed,
+            },
+            "injected_frame_delay_ms": args.inject_frame_ms,
+        }
+        pathlib.Path(args.json).write_text(
+            json.dumps(doc, indent=1) + "\n"
+        )
+        print(f"wrote {args.json}", file=sys.stderr)
+
+    return 0 if (passed or args.update_baseline) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,202 @@
+"""Cross-protocol decision identity (r12): the same request stream
+through the gRPC protobuf door, the GEB client protocol, and the HTTP
+binary door must return byte-identical decisions.
+
+Three separate single-node stacks (one per protocol, own device store
+each) replay one fuzz stream — mixed algorithms, duplicate keys,
+peeks, over-limit freezes, clock advances across reset boundaries —
+under the shared fake clock pattern from r10 (every now() import site
+pinned), so reset_time compares EXACTLY. The GEB door negotiates FAST
+framing (single-node ring, matching hash tier), which makes this the
+client-side hash-parity contract too: a client-hashed GEB7 record must
+land in the same store row as the daemon-hashed gRPC path's.
+
+tpu backend on CPU end to end: instance -> batcher -> arrival prep ->
+merged submit -> kernel, per the r10 device-fuzz pattern.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from _util import free_ports
+from gubernator_tpu.api.types import Algorithm, RateLimitReq
+from gubernator_tpu.cluster import LocalCluster
+from gubernator_tpu.core.store import StoreConfig
+from gubernator_tpu.serve.backends import TpuBackend
+
+T0 = 1_700_000_000_000
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = T0
+
+    def __call__(self):
+        return self.t
+
+
+def _pin_clock(monkeypatch, clock):
+    import gubernator_tpu.api.types as types_mod
+    import gubernator_tpu.core.engine as engine_mod
+    import gubernator_tpu.core.oracle as oracle_mod
+
+    monkeypatch.setattr(types_mod, "millisecond_now", clock)
+    monkeypatch.setattr(engine_mod, "millisecond_now", clock)
+    monkeypatch.setattr(oracle_mod, "millisecond_now", clock)
+
+
+def _be():
+    return TpuBackend(
+        StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)
+    )
+
+
+def _fuzz_stream(rng, keys, steps):
+    for step in range(steps):
+        n = int(rng.integers(1, 7))
+        batch = []
+        for _ in range(n):
+            k = int(rng.integers(len(keys)))
+            batch.append(
+                RateLimitReq(
+                    name="xdoor",
+                    unique_key=keys[k],
+                    hits=int(rng.choice([0, 1, 1, 1, 2, 9])),
+                    limit=int(rng.choice([1, 2, 3, 50])),
+                    duration=int(rng.choice([400, 2000, 60_000])),
+                    algorithm=Algorithm(k % 2),
+                )
+            )
+        yield step, batch, int(rng.choice([0, 0, 1, 7, 150, 500, 2500]))
+
+
+def test_three_door_identity_fuzz(monkeypatch):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    ports = free_ports(6)
+    grpc_addrs = [f"127.0.0.1:{p}" for p in ports[:3]]
+    http_addr = f"127.0.0.1:{ports[3]}"
+    geb_port = ports[4]
+
+    clusters = [
+        # door 0: gRPC; door 1: GEB listener; door 2: HTTP binary
+        LocalCluster([grpc_addrs[0]], backend_factory=_be),
+        LocalCluster(
+            [grpc_addrs[1]], backend_factory=_be, geb_ports=[geb_port]
+        ),
+        LocalCluster(
+            [grpc_addrs[2]], backend_factory=_be,
+            http_addresses=[http_addr],
+        ),
+    ]
+    for c in clusters:
+        c.start()
+        # the shed caches must read the fake clock too (the r10
+        # in-process pattern) or expiry gates would diverge
+        inst = c.servers[0].instance
+        if inst.shed is not None:
+            inst.shed.now_fn = clock
+    try:
+
+        async def run():
+            from gubernator_tpu.client import AsyncV1Client
+            from gubernator_tpu.client_geb import (
+                AsyncGebClient,
+                AsyncHttpGebClient,
+            )
+
+            grpc_c = AsyncV1Client(grpc_addrs[0])
+            geb_c = AsyncGebClient(f"127.0.0.1:{geb_port}")
+            http_c = AsyncHttpGebClient(f"http://{http_addr}")
+            await geb_c.connect()
+            # the point of the exercise: the GEB door negotiated the
+            # pre-hashed fast path (client-side hashing under test)
+            assert geb_c._use_fast
+            rng = np.random.default_rng(13)
+            keys = [f"xk{i}" for i in range(12)]
+            mismatches = []
+            try:
+                for step, batch, dt in _fuzz_stream(rng, keys, 90):
+                    clock.t += dt
+                    a = await grpc_c.get_rate_limits(batch)
+                    b = await geb_c.get_rate_limits(batch)
+                    d = await http_c.get_rate_limits(batch)
+                    for i, (x, y, z) in enumerate(zip(a, b, d)):
+                        tup = lambda r: (  # noqa: E731
+                            int(r.status), r.limit, r.remaining,
+                            r.reset_time, r.error,
+                        )
+                        if not (tup(x) == tup(y) == tup(z)):
+                            mismatches.append(
+                                (step, i, batch[i], tup(x), tup(y),
+                                 tup(z))
+                            )
+            finally:
+                await grpc_c.close()
+                await geb_c.close()
+                await http_c.close()
+            return mismatches
+
+        mismatches = asyncio.run(run())
+        assert not mismatches, mismatches[:5]
+    finally:
+        for c in clusters:
+            c.stop()
+
+
+def test_geb_fast_vs_string_mode_identity(monkeypatch):
+    """The SAME door, fast vs string framing, two fresh stores: the
+    client-side pre-hash plus array path must decide identically to
+    the server-side object path for fast-eligible traffic."""
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    ports = free_ports(4)
+    clusters = [
+        LocalCluster(
+            [f"127.0.0.1:{ports[i]}"], backend_factory=_be,
+            geb_ports=[ports[i + 2]],
+        )
+        for i in range(2)
+    ]
+    for c in clusters:
+        c.start()
+        inst = c.servers[0].instance
+        if inst.shed is not None:
+            inst.shed.now_fn = clock
+    try:
+
+        async def run():
+            from gubernator_tpu.client_geb import AsyncGebClient
+
+            fast = AsyncGebClient(f"127.0.0.1:{ports[2]}", mode="fast")
+            string = AsyncGebClient(
+                f"127.0.0.1:{ports[3]}", mode="string"
+            )
+            rng = np.random.default_rng(29)
+            keys = [f"fs{i}" for i in range(10)]
+            try:
+                await fast.connect()
+                for step, batch, dt in _fuzz_stream(rng, keys, 70):
+                    clock.t += dt
+                    a = await fast.get_rate_limits(batch)
+                    b = await string.get_rate_limits(batch)
+                    for x, y, r in zip(a, b, batch):
+                        assert (
+                            int(x.status), x.limit, x.remaining,
+                            x.reset_time,
+                        ) == (
+                            int(y.status), y.limit, y.remaining,
+                            y.reset_time,
+                        ), (step, r, x, y)
+            finally:
+                await fast.close()
+                await string.close()
+
+        asyncio.run(run())
+    finally:
+        for c in clusters:
+            c.stop()

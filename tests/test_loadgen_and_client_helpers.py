@@ -38,6 +38,50 @@ def test_sleep_until_reset_waits_until_window():
     assert time.monotonic() - t0 < 0.05
 
 
+def test_endpoint_parse_helper():
+    """The shared endpoint parser (r12): TCP vs unix shapes, and the
+    loud IPv6 refusal every client/bridge site now goes through
+    instead of a silent last-colon misparse."""
+    from gubernator_tpu.endpoints import (
+        endpoint_is_ipv6ish,
+        parse_endpoint,
+        reject_ipv6_endpoint,
+    )
+
+    assert parse_endpoint("10.0.0.1:81") == ("tcp", ("10.0.0.1", 81))
+    assert parse_endpoint("svc.local:9090") == (
+        "tcp", ("svc.local", 9090),
+    )
+    assert parse_endpoint("/tmp/x.sock") == ("unix", "/tmp/x.sock")
+    assert parse_endpoint("unix:/tmp/x.sock") == ("unix", "/tmp/x.sock")
+
+    for bad in ("[::1]:81", "::1", "fe80::1:81", "2001:db8::7:9090"):
+        assert endpoint_is_ipv6ish(bad), bad
+        with pytest.raises(ValueError, match="IPv6"):
+            parse_endpoint(bad, "test endpoint")
+        with pytest.raises(ValueError, match="IPv6"):
+            reject_ipv6_endpoint(bad, "test endpoint")
+    for bad in ("", "hostonly", ":81", "host:", "host:abc", "host:0"):
+        with pytest.raises(ValueError):
+            parse_endpoint(bad, "test endpoint")
+
+
+def test_clients_refuse_ipv6_endpoints_loudly():
+    """Both packaged clients route through the shared parser: an IPv6
+    endpoint raises at construction with a message naming the rule,
+    never a downstream resolver/unix-path misparse."""
+    from gubernator_tpu.client import V1Client
+    from gubernator_tpu.client_geb import AsyncGebClient, GebClient
+
+    for ctor in (V1Client, AsyncGebClient, GebClient):
+        with pytest.raises(ValueError, match="IPv6"):
+            ctor("[::1]:81")
+    # the gRPC client also refuses unix paths with guidance (they are
+    # the GEB client's transport)
+    with pytest.raises(ValueError, match="unix"):
+        V1Client("/tmp/some.sock")
+
+
 def test_loadgen_against_cluster(capsys):
     """The load generator's replay loop end to end: bounded duration run
     against a 2-node cluster; every request answered, OVER_LIMIT
@@ -66,5 +110,39 @@ def test_loadgen_against_cluster(capsys):
         # small limits (1..100) replayed for 2s: some keys must trip
         out = capsys.readouterr().out
         assert "over the limit" in out
+    finally:
+        cluster.stop()
+
+
+def test_loadgen_geb_protocol_and_shed_shape():
+    """`--protocol geb` end to end against a daemon GEB door with the
+    shed-r10 workload shape: the generator must speak the binary
+    client protocol (no gRPC involved), hit roughly the requested
+    over-limit share, and report a machine-readable summary."""
+    import asyncio
+
+    from _util import free_ports
+    from gubernator_tpu.cli import loadgen
+
+    g, geb = free_ports(2)
+    cluster = LocalCluster(
+        [f"127.0.0.1:{g}"],
+        backend_factory=lambda: ExactBackend(10_000),
+        geb_ports=[geb],
+    )
+    cluster.start()
+    try:
+        summary = asyncio.run(
+            loadgen.run(
+                f"127.0.0.1:{geb}", keys=0, concurrency=4, batch=50,
+                duration=1.0, protocol="geb", share=0.5, quiet=True,
+            )
+        )
+        assert summary["protocol"] == "geb"
+        assert summary["errors"] == 0
+        assert summary["sent"] > 0
+        # hot keys freeze over limit after their first touch, so the
+        # measured share converges on the target from below
+        assert 0.3 <= summary["over_limit_share"] <= 0.55, summary
     finally:
         cluster.stop()
