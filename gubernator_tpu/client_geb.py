@@ -114,6 +114,25 @@ GEB_HTTP_PATH = "/v1/geb"
 #: batch ladder, but an unbounded frame is an unbounded host alloc
 MAX_FRAME_ITEMS = 65536
 
+#: hard cap on one frame's payload bytes, mirroring the server's
+#: read-side bound (edge_bridge.MAX_FRAME_PAYLOAD, test-pinned): the
+#: server kills any connection advertising more before buffering it,
+#: so refuse loudly here instead of dying with a dropped connection
+MAX_FRAME_PAYLOAD = 8 << 20
+
+
+def _check_wire_count(n: int) -> int:
+    """Bound a server-supplied response item count BEFORE sizing a
+    read from it — the mirror of the server's lying-length defense: a
+    byzantine or desynced peer advertising a ~4G count must raise, not
+    buffer gigabytes toward readexactly."""
+    if n > MAX_FRAME_ITEMS:
+        raise GebError(
+            f"response item count {n} exceeds the "
+            f"{MAX_FRAME_ITEMS}-item frame bound"
+        )
+    return n
+
 
 class GebError(Exception):
     """Protocol-level client error."""
@@ -338,9 +357,13 @@ def decode_fast_body(body: bytes, n: int) -> List[RateLimitResp]:
     for _ in range(n):
         st, limit, rem, reset = _RESP_FIX.unpack_from(body, off)
         off += _RESP_FIX.size
+        if st not in (0, 1):
+            # a corrupted or future-version status must fail loudly,
+            # never decode fail-open as "allowed"
+            raise GebError(f"bad status byte {st:#x} in fast response")
         out.append(
             RateLimitResp(
-                status=Status(st) if st in (0, 1) else Status.UNDER_LIMIT,
+                status=Status(st),
                 limit=limit,
                 remaining=rem,
                 reset_time=reset,
@@ -375,8 +398,11 @@ def decode_string_body(body: bytes, n: int) -> List[RateLimitResp]:
 
 
 def _string_resp(st, limit, rem, reset, err, owner) -> RateLimitResp:
+    if st not in (0, 1):
+        # fail loudly, never fail-open as "allowed" (see decode_fast_body)
+        raise GebError(f"bad status byte {st:#x} in string response")
     r = RateLimitResp(
-        status=Status(st) if st in (0, 1) else Status.UNDER_LIMIT,
+        status=Status(st),
         limit=limit,
         remaining=rem,
         reset_time=reset,
@@ -404,8 +430,21 @@ def build_frame(
             f"frame bound; split it"
         )
     use_fast = fast and _fast_eligible(reqs)
+    payload = (
+        encode_fast_payload(reqs)
+        if use_fast
+        else encode_string_payload(reqs)
+    )
+    if len(payload) > MAX_FRAME_PAYLOAD:
+        # in practice only string frames with very long names/keys get
+        # here (a max-item fast frame is ~2.1 MiB), but both framings
+        # are bounded: the server refuses anything beyond the cap by
+        # killing the connection, so fail loudly before the wire
+        raise GebError(
+            f"frame payload of {len(payload)} bytes exceeds the "
+            f"{MAX_FRAME_PAYLOAD}-byte bound; split the batch"
+        )
     if use_fast:
-        payload = encode_fast_payload(reqs)
         if windowed:
             hdr = _HDR.pack(MAGIC_WFAST_REQ, len(reqs)) + _WFAST_HDR.pack(
                 frame_id, ring_hash, t_sent_us
@@ -415,7 +454,6 @@ def build_frame(
                 ring_hash
             )
         return hdr + _U32.pack(len(payload)) + payload, True
-    payload = encode_string_payload(reqs)
     if windowed:
         hdr = _HDR.pack(MAGIC_WREQ, len(reqs)) + _WREQ_HDR.pack(
             frame_id, t_sent_us
@@ -546,9 +584,17 @@ class AsyncGebClient:
             task.cancel()
         for fut in inflight.values():
             if not fut.done():
+                # only GEBR refusals carry per-frame semantics that
+                # hold for EVERY frame in flight (the server refused
+                # them all un-served — retry is safe); any other
+                # failure, including a decode error on one response,
+                # leaves the others' delivery unknown and must surface
+                # as the connection-loss type, not the trigger's
                 fut.set_exception(
                     exc
-                    if isinstance(exc, GebError)
+                    if isinstance(
+                        exc, (GebStaleRingError, GebDrainingError)
+                    )
                     else GebConnectionError(
                         f"connection to {self.endpoint} lost with "
                         f"frames in flight ({exc!r}); delivery unknown"
@@ -657,6 +703,7 @@ class AsyncGebClient:
                     raise GebStaleRingError(
                         "frame refused: stale ring (GEBR)"
                     )
+                _check_wire_count(n)
                 if is_fast:
                     if magic != MAGIC_FAST_RESP:
                         raise GebError(f"bad response magic {magic:#x}")
@@ -673,16 +720,18 @@ class AsyncGebClient:
                 timeout if timeout is not None else self.timeout,
             )
         except (
-            GebStaleRingError,
+            GebError,
             asyncio.IncompleteReadError,
             ConnectionError,
             OSError,
             asyncio.TimeoutError,
         ) as e:
+            # ANY failure here leaves the one-frame-in-flight stream
+            # unaccountable (response half-read or never read): drop
+            # the connection so leftover bytes can't be parsed as the
+            # next call's response header
             self._conn_lost(None if isinstance(e, GebError) else e)
-            if isinstance(e, GebStaleRingError):
-                raise
-            if isinstance(e, asyncio.TimeoutError):
+            if isinstance(e, (GebError, asyncio.TimeoutError)):
                 raise
             raise GebConnectionError(
                 f"round trip to {self.endpoint} failed: {e}"
@@ -711,6 +760,7 @@ class AsyncGebClient:
                         )
                     return
                 (fid,) = _U32.unpack(await reader.readexactly(4))
+                _check_wire_count(n)
                 if magic == MAGIC_WFAST_RESP:
                     resps = decode_fast_body(
                         await reader.readexactly(n * 25), n
@@ -911,6 +961,12 @@ class AsyncHttpGebClient:
                     f"{(await resp.read())[:200]!r}"
                 )
             body = await resp.read()
+        if len(body) < _HDR.size:
+            # a truncating proxy or empty 200 body stays inside the
+            # module's GebError contract, not a raw struct.error
+            raise GebError(
+                f"short response frame ({len(body)} bytes)"
+            )
         magic, n = _HDR.unpack_from(body, 0)
         if magic == MAGIC_STALE:
             if n == DRAIN_FRAME_ID:

@@ -191,6 +191,15 @@ class ServerConfig:
     # errors, and misrouted items still take the full instance path.
     # GUBER_EDGE_STRING_FOLD=0 restores the pre-r7 all-objects path.
     edge_string_fold: bool = True
+    # Read-side payload cap on the trusted edge->bridge door, in MiB
+    # (r12 hardening): the bridge refuses a frame header advertising
+    # more BEFORE buffering a byte of it. The default (256) clears the
+    # largest legal frame at the edge's default --batch-limit of 1000
+    # items (u16-length names/keys, ~131 KB/item worst case); raise it
+    # in lockstep if you raise --batch-limit with very long keys. The
+    # client-facing GEB doors bound at 8 MiB regardless
+    # (edge_bridge.MAX_FRAME_PAYLOAD, matched by the packaged client).
+    edge_max_frame_mib: int = 256
 
     # multi-host mesh (GUBER_DIST_*): one jax.distributed program over
     # several hosts; process 0 serves (backend=multihost), others run the
@@ -419,6 +428,8 @@ class ServerConfig:
             )
         if self.edge_window < 0:
             raise ValueError("GUBER_EDGE_WINDOW must be >= 0")
+        if self.edge_max_frame_mib <= 0:
+            raise ValueError("GUBER_EDGE_MAX_FRAME_MIB must be > 0")
         if not (0 <= self.geb_port < 65536):
             raise ValueError("GUBER_GEB_PORT must be in 0..65535")
         if self.geb_window < 0:
@@ -557,6 +568,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         geb_window=_get_int(env, "GUBER_GEB_WINDOW", 0),
         edge_string_fold=_get(env, "GUBER_EDGE_STRING_FOLD", "1").lower()
         not in ("0", "false", "no", "off"),
+        edge_max_frame_mib=_get_int(env, "GUBER_EDGE_MAX_FRAME_MIB", 256),
         dist_coordinator=_get(env, "GUBER_DIST_COORDINATOR"),
         dist_num_processes=_get_int(env, "GUBER_DIST_NUM_PROCESSES", 1),
         dist_process_id=_get_int(env, "GUBER_DIST_PROCESS_ID", 0),

@@ -170,6 +170,39 @@ MAX_WINDOW = 1024
 #: stale-ring refusal (safe: re-route, reconnect fails)
 DRAIN_FRAME_ID = 0xFFFFFFFF
 
+#: hard cap on one frame's u32 payload length on the CLIENT-facing
+#: doors (GebListener, POST /v1/geb). The wire's plen is untrusted
+#: there: without this bound an unauthenticated connection could
+#: advertise a ~4 GiB payload and stream it into server memory before
+#: any validation runs. 8 MiB covers every frame the packaged client
+#: can legally build (its 65536-item bound is ~2.1 MiB of fast
+#: records; only very long names/keys approach the byte bound, which
+#: it enforces too) and is mirrored (test-pinned) in client_geb.py,
+#: which refuses oversized frames client-side before they hit the wire.
+MAX_FRAME_PAYLOAD = 8 << 20
+
+#: the trusted edge->bridge door's default cap (GUBER_EDGE_MAX_FRAME_MIB
+#: to widen). The compiled edge chunks at --batch-limit items but has
+#: no byte bound and no split logic, and its items may legally carry
+#: u16-length names/keys — a 1000-item batch of ~10 KB keys is a
+#: legitimate >8 MiB frame that must keep flowing. 256 MiB clears the
+#: theoretical max legal frame at the default batch limit
+#: (1000 x ~131 KB) while still refusing a lying ~4 GiB header
+#: outright.
+EDGE_MAX_FRAME_PAYLOAD = 256 << 20
+
+
+def bound_payload_len(plen: int, cap: int = MAX_FRAME_PAYLOAD) -> int:
+    """Validate an untrusted wire payload length BEFORE buffering it;
+    raises ValueError (= close the connection / 400 the request) on an
+    oversized frame."""
+    if plen > cap:
+        raise ValueError(
+            f"frame payload of {plen} bytes exceeds the "
+            f"{cap}-byte bound"
+        )
+    return plen
+
 
 def ring_fingerprint(hosts) -> int:
     """crc32 fingerprint of a membership set. Covers only the gRPC
@@ -403,10 +436,15 @@ class FrameService:
         window: int = 0,
         string_fold: bool = True,
         peer_bridges: Optional[dict] = None,
+        max_payload: int = MAX_FRAME_PAYLOAD,
     ):
         self.instance = instance
         self.fast_enabled = fast_enabled
         self.string_fold = string_fold
+        # per-door read-side payload cap: the client-facing doors bound
+        # at MAX_FRAME_PAYLOAD; the trusted edge bridge passes
+        # EDGE_MAX_FRAME_PAYLOAD (see the constants' rationale)
+        self.max_payload = max_payload
         # explicit grpc_addr -> bridge_addr overrides (config
         # GUBER_EDGE_PEER_BRIDGES); falls back to the symmetric-fleet
         # port convention for unlisted peers
@@ -1017,7 +1055,9 @@ class FrameService:
                     (plen,) = struct.unpack(
                         "<I", await reader.readexactly(4)
                     )
-                    payload = await reader.readexactly(plen)
+                    payload = await reader.readexactly(
+                        bound_payload_len(plen, self.max_payload)
+                    )
                     if (
                         frame_ring is not None
                         and frame_ring != self._ring_hash()
@@ -1066,7 +1106,9 @@ class FrameService:
                     frame_ring, plen = struct.unpack(
                         "<II", await reader.readexactly(8)
                     )
-                    payload = await reader.readexactly(plen)
+                    payload = await reader.readexactly(
+                        bound_payload_len(plen, self.max_payload)
+                    )
                     if frame_ring != self._ring_hash():
                         metrics.EDGE_STALE_RINGS.inc()
                         log.warning(
@@ -1098,7 +1140,9 @@ class FrameService:
                 (plen,) = struct.unpack(
                     "<I", await reader.readexactly(4)
                 )
-                payload = await reader.readexactly(plen)
+                payload = await reader.readexactly(
+                    bound_payload_len(plen, self.max_payload)
+                )
                 if self._draining:
                     # the GEB1 string reader predates GEBR entirely (a
                     # stale magic is a hard protocol failure there), so
@@ -1183,6 +1227,7 @@ class FrameService:
             raise ValueError("short frame")
         (plen,) = struct.unpack_from("<I", data, off)
         off += 4
+        bound_payload_len(plen, self.max_payload)
         if off + plen != len(data):
             raise ValueError("frame length mismatch")
         payload = bytes(data[off:])
@@ -1251,6 +1296,7 @@ class EdgeBridge(FrameService):
         fast_enabled: bool = True,
         window: int = 0,
         string_fold: bool = True,
+        max_payload: int = EDGE_MAX_FRAME_PAYLOAD,
     ):
         super().__init__(
             instance,
@@ -1258,6 +1304,7 @@ class EdgeBridge(FrameService):
             window=window,
             string_fold=string_fold,
             peer_bridges=peer_bridges,
+            max_payload=max_payload,
         )
         self.path = path
         if tcp_address:

@@ -383,6 +383,7 @@ class Server:
                 fast_enabled=self.conf.edge_fast,
                 window=self.conf.edge_window,
                 string_fold=self.conf.edge_string_fold,
+                max_payload=self.conf.edge_max_frame_mib << 20,
             )
             await self._edge.start()
 
@@ -646,7 +647,35 @@ class Server:
             )
         import struct
 
-        body = await request.read()
+        from gubernator_tpu.serve.edge_bridge import MAX_FRAME_PAYLOAD
+
+        # this door's legal frames exceed aiohttp's 1 MiB default
+        # client_max_size (a full 65536-item fast frame is ~2.1 MiB),
+        # so it reads the raw stream under its OWN cap — the socket
+        # doors' payload bound plus frame-header slack — rather than
+        # raising the app-wide bound for the JSON routes too. Not
+        # request.read(): that enforces (only) the app-wide limit.
+        max_body = MAX_FRAME_PAYLOAD + 64
+        if (request.content_length or 0) > max_body:
+            return web.json_response(
+                {"error": "GEB frame exceeds the payload bound"},
+                status=413,
+            )
+        chunks, got = [], 0
+        while True:
+            # StreamReader.read(n) short-reads, so loop to EOF,
+            # bailing the moment the cap is crossed
+            chunk = await request.content.read(1 << 16)
+            if not chunk:
+                break
+            got += len(chunk)
+            if got > max_body:
+                return web.json_response(
+                    {"error": "GEB frame exceeds the payload bound"},
+                    status=413,
+                )
+            chunks.append(chunk)
+        body = b"".join(chunks)
         try:
             resp = await self._frame_core().serve_frame_bytes(body)
         except (ValueError, struct.error) as e:

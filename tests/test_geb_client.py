@@ -74,6 +74,7 @@ def test_wire_constants_match_bridge():
         "MAGIC_FAST_RESP", "MAGIC_STALE", "MAGIC_WREQ", "MAGIC_WRESP",
         "MAGIC_WFAST_REQ", "MAGIC_WFAST_RESP", "HELLO_FAST",
         "HELLO_WINDOWED", "HELLO_XXH64", "DRAIN_FRAME_ID",
+        "MAX_FRAME_PAYLOAD",
     ):
         assert getattr(cg, name) == getattr(eb, name), name
     from gubernator_tpu.serve.server import GEB_CONTENT_TYPE
@@ -493,6 +494,41 @@ def test_http_binary_door_content_type_and_roundtrip():
         out = decode_string_body(body[8:], n)
         assert [x.remaining for x in out] == [4, 4, 4]
 
+        # >1 MiB LEGAL frame: aiohttp's default client_max_size (1
+        # MiB) would 413 this before the handler runs — the door must
+        # size its body bound to the max legal GEB frame instead
+        from gubernator_tpu.api.types import RateLimitReq
+
+        big_reqs = [
+            RateLimitReq(
+                name="api", unique_key="K" * 50_000 + str(i),
+                hits=1, limit=5, duration=60_000,
+            )
+            for i in range(24)
+        ]
+        frame, _ = build_frame(big_reqs, fast=False, windowed=False)
+        assert len(frame) > (1 << 20)
+        req = urllib.request.Request(
+            base + "/v1/geb", frame,
+            {"Content-Type": GEB_CONTENT_TYPE},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = r.read()
+        magic, n = struct.unpack_from("<II", body, 0)
+        assert n == 24
+        out = decode_string_body(body[8:], n)
+        assert [x.remaining for x in out] == [4] * 24
+
+        # past the payload bound: 413 from the door's own cap (the
+        # app-wide client_max_size stays at the JSON routes' 1 MiB)
+        req = urllib.request.Request(
+            base + "/v1/geb", b"\x00" * ((8 << 20) + 128),
+            {"Content-Type": GEB_CONTENT_TYPE},
+        )
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=30)
+        assert e.value.code == 413
+
         # wrong content type: a clear 415, never a frame decode
         req = urllib.request.Request(
             base + "/v1/geb", b'{"requests": []}',
@@ -530,3 +566,84 @@ def test_http_binary_door_content_type_and_roundtrip():
             assert "error" in json.loads(e.value.read())
     finally:
         c.stop()
+
+
+def test_unknown_status_byte_fails_loudly():
+    """A corrupted or future-version status byte must raise GebError —
+    never decode fail-open as UNDER_LIMIT while every other malformed
+    field in the module fails loudly."""
+    import gubernator_tpu.client_geb as cg
+
+    bad_fast = struct.pack("<Bqqq", 7, 5, 4, 1)
+    with pytest.raises(cg.GebError, match="status"):
+        cg.decode_fast_body(bad_fast, 1)
+    bad_string = bad_fast + struct.pack("<H", 0) + struct.pack("<H", 0)
+    with pytest.raises(cg.GebError, match="status"):
+        cg.decode_string_body(bad_string, 1)
+
+
+def test_wire_count_bound_mirrors_server():
+    """A server-supplied response count beyond the frame bound raises
+    before sizing a read from it — the client-side mirror of the
+    server's lying-length defense."""
+    import gubernator_tpu.client_geb as cg
+
+    assert cg._check_wire_count(5) == 5
+    with pytest.raises(cg.GebError, match="item count"):
+        cg._check_wire_count(cg.MAX_FRAME_ITEMS + 1)
+
+
+def test_oversized_payload_refused_client_side():
+    """A string frame whose payload would cross MAX_FRAME_PAYLOAD is
+    refused loudly before the wire — the server's read-side bound
+    kills the connection for anything larger."""
+    import gubernator_tpu.client_geb as cg
+    from gubernator_tpu.api.types import RateLimitReq
+
+    reqs = [
+        RateLimitReq(
+            name="n", unique_key="K" * 60_000, hits=1, limit=5,
+            duration=60_000,
+        )
+        for _ in range(150)
+    ]
+    with pytest.raises(cg.GebError, match="payload"):
+        cg.build_frame(reqs, fast=False, windowed=False)
+
+
+def test_http_client_short_body_raises_geberror():
+    """A truncating proxy or an empty 200 body surfaces as GebError
+    (the module's contract), not a raw struct.error."""
+    import gubernator_tpu.client_geb as cg
+    from aiohttp import web
+
+    async def run():
+        (port,) = free_ports(1)
+
+        async def hello(request):
+            return web.Response(
+                body=struct.pack("<IIII", cg.MAGIC_HELLO, 0, 0, 0),
+                content_type=cg.GEB_CONTENT_TYPE,
+            )
+
+        async def post(request):
+            return web.Response(
+                body=b"\x01", content_type=cg.GEB_CONTENT_TYPE
+            )
+
+        app = web.Application()
+        app.router.add_get("/v1/geb", hello)
+        app.router.add_post("/v1/geb", post)
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", port)
+        await site.start()
+        try:
+            c = cg.AsyncHttpGebClient(f"http://127.0.0.1:{port}")
+            with pytest.raises(cg.GebError, match="short response"):
+                await c.get_rate_limits(_reqs(1))
+            await c.close()
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())

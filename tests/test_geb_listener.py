@@ -24,6 +24,7 @@ from gubernator_tpu.serve.edge_bridge import (
     MAGIC_REQ,
     MAGIC_WFAST_REQ,
     MAGIC_WREQ,
+    MAX_FRAME_PAYLOAD,
     GebListener,
 )
 
@@ -45,6 +46,14 @@ def test_geb_port_knobs_parse_and_validate():
         config_from_env({"GUBER_GEB_PORT": "70000"})
     with pytest.raises(ValueError):
         config_from_env({"GUBER_GEB_WINDOW": "-1"})
+
+    # trusted-door payload cap (the client doors bound at 8 MiB fixed)
+    assert config_from_env({}).edge_max_frame_mib == 256
+    assert config_from_env(
+        {"GUBER_EDGE_MAX_FRAME_MIB": "512"}
+    ).edge_max_frame_mib == 512
+    with pytest.raises(ValueError):
+        config_from_env({"GUBER_EDGE_MAX_FRAME_MIB": "0"})
 
 
 def test_geb_listener_refuses_ipv6_address():
@@ -132,6 +141,17 @@ def _hostile_corpus(rng, ring_hash):
     yield struct.pack("<II", MAGIC_REQ, 1) + struct.pack(
         "<I", len(payload)
     ) + payload
+    # lying u32 payload length advertising up to ~4 GiB: must be
+    # refused at the header, never buffered toward
+    yield struct.pack("<II", MAGIC_REQ, 1) + struct.pack(
+        "<I", 0xFFFFFFFF
+    )
+    yield struct.pack("<II", MAGIC_WREQ, 1) + struct.pack(
+        "<IQ", 3, 0
+    ) + struct.pack("<I", MAX_FRAME_PAYLOAD + 1)
+    yield struct.pack("<II", MAGIC_FAST_REQ, 1) + struct.pack(
+        "<II", ring_hash, 0x40000000
+    )
     # truncated mid-payload (sender hangs up after half)
     good = _good_frame()
     yield good[: len(good) // 2]
@@ -214,6 +234,89 @@ def test_hostile_frames_never_kill_the_listener(seed):
             wb.close()
         finally:
             await lst.stop()
+
+    asyncio.run(run())
+
+
+def test_oversized_payload_length_closes_connection():
+    """A frame header advertising a payload beyond MAX_FRAME_PAYLOAD
+    must close the connection IMMEDIATELY — not sit buffering toward a
+    multi-GiB plen (the remote memory-exhaustion vector on this
+    client-facing door). EOF, not a read timeout, is the pin: the old
+    behavior blocked waiting for the advertised bytes."""
+
+    async def run():
+        (port,) = free_ports(1)
+        lst = GebListener(_Instance(), f"127.0.0.1:{port}")
+        await lst.start()
+        try:
+            for hdr in (
+                struct.pack("<II", MAGIC_REQ, 1)
+                + struct.pack("<I", 0xFFFFFFFF),
+                struct.pack("<II", MAGIC_WREQ, 1)
+                + struct.pack("<IQ", 3, 0)
+                + struct.pack("<I", MAX_FRAME_PAYLOAD + 1),
+                struct.pack("<II", MAGIC_FAST_REQ, 1)
+                + struct.pack("<II", 0, 0x40000000),
+            ):
+                reader, writer = await asyncio.open_connection(
+                    "127.0.0.1", port
+                )
+                await _drain_hello(reader)
+                writer.write(hdr)
+                await writer.drain()
+                data = await asyncio.wait_for(reader.read(4096), 5)
+                assert data == b"", hdr[:8]
+                writer.close()
+        finally:
+            await lst.stop()
+
+    asyncio.run(run())
+
+
+def test_edge_bridge_keeps_headroom_for_large_legal_frames():
+    """Per-door payload caps: the client-facing GEB door bounds at
+    MAX_FRAME_PAYLOAD, but the trusted edge bridge must keep serving
+    legal >8 MiB frames (the compiled edge batches u16-length keys at
+    --batch-limit items with no byte bound and no split logic)."""
+    import tempfile
+
+    from gubernator_tpu.serve.edge_bridge import (
+        EDGE_MAX_FRAME_PAYLOAD,
+        EdgeBridge,
+    )
+
+    assert EDGE_MAX_FRAME_PAYLOAD > MAX_FRAME_PAYLOAD
+
+    async def run():
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "e.sock")
+            br = EdgeBridge(_Instance(), path)
+            await br.start()
+            try:
+                reader, writer = await asyncio.open_unix_connection(
+                    path
+                )
+                await _drain_hello(reader)
+                items = b"".join(
+                    _item(b"api", b"K" * 60_000 + str(i).encode())
+                    for i in range(200)
+                )
+                assert len(items) > MAX_FRAME_PAYLOAD
+                writer.write(
+                    struct.pack("<II", MAGIC_REQ, 200)
+                    + struct.pack("<I", len(items))
+                    + items
+                )
+                await writer.drain()
+                magic, n = struct.unpack(
+                    "<II",
+                    await asyncio.wait_for(reader.readexactly(8), 15),
+                )
+                assert n == 200
+                writer.close()
+            finally:
+                await br.stop()
 
     asyncio.run(run())
 
