@@ -153,6 +153,178 @@ async def _attach_edge_bridge(server, sock_path):
     return bridge
 
 
+def _jax_cache():
+    import jax
+
+    jax.config.update(
+        "jax_compilation_cache_dir", str(_compile_cache_dir().resolve())
+    )
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+async def _boot_stack(conf, metric, depth):
+    """Boot the SHIPPED stack (make_backend -> warmup -> Instance);
+    returns (instance, backend, warmup_seconds)."""
+    import asyncio
+
+    from gubernator_tpu.serve.instance import Instance
+    from gubernator_tpu.serve.server import make_backend
+
+    backend = make_backend(conf)
+    print(f"{metric} depth {depth}: warmup (ladder compiles)...",
+          file=sys.stderr)
+    t0 = time.monotonic()
+    await asyncio.to_thread(backend.warmup)
+    warm_s = time.monotonic() - t0
+    inst = Instance(conf, backend)
+    inst.start()
+    return inst, backend, warm_s
+
+
+async def _prefill_sequential(inst, n_ids, group, limit, duration):
+    """Saturate the exact tier: drive `n_ids` SEQUENTIAL ids (same
+    params as the measured traffic) so the measured window runs at the
+    steady state the scenario is about — tier pressure, not a cold
+    store. The zipf head's small ids overlap these, so hot keys decide
+    exactly while the tail fights for ways."""
+    import asyncio
+
+    import numpy as np
+
+    from gubernator_tpu.cli import keystreams
+
+    n_chunks = -(-n_ids // group)
+
+    async def filler(w: int, W: int):
+        ones = np.ones(group, np.int64)
+        algo = np.zeros(group, np.int32)
+        for c in range(w, n_chunks, W):
+            ids = np.arange(
+                c * group, (c + 1) * group, dtype=np.uint64
+            )
+            await inst.batcher.decide_arrays(
+                dict(
+                    key_hash=keystreams.hash_ids(ids), hits=ones,
+                    limit=ones * limit, duration=ones * duration,
+                    algo=algo,
+                )
+            )
+
+    t0 = time.monotonic()
+    await asyncio.gather(*[filler(w, 8) for w in range(8)])
+    print(
+        f"prefill: {n_ids:,} sequential ids in "
+        f"{time.monotonic() - t0:.0f}s", file=sys.stderr,
+    )
+
+
+async def _measure_window(
+    inst, backend, pool, depth, seconds, group, metric, limit=1000,
+    duration=60_000, churn=False, key_space=1 << 40,
+) -> dict:
+    """One timed window of pre-hashed key traffic through the
+    batcher's array door — the zipf10m/zipf100m/key-churn scenarios'
+    one measurement loop. `churn=True` advances the whole pool by a
+    fresh phase every pass (keystreams.churn_pool) so no key is ever
+    hot twice."""
+    import asyncio
+
+    import numpy as np
+
+    from gubernator_tpu.cli import keystreams
+
+    stop_at = time.monotonic() + seconds
+    done_rows = 0
+    base = backend.stats()
+
+    async def worker(w: int):
+        nonlocal done_rows
+        i = w * 101
+        ones = np.ones(group, np.int64)
+        algo = np.zeros(group, np.int32)
+        passes = 0
+        while time.monotonic() < stop_at:
+            if churn:
+                # every pass is a FRESH key set: the adversarial
+                # tier-thrash stream (ROADMAP item 4). One GROUP-sized
+                # pool per pass (worker-disjoint phase stride), not a
+                # full staging pool — regenerating 2^18 hashed ids per
+                # submitted group was measured event-loop cost, not
+                # system-under-test cost
+                passes += 1
+                kh = keystreams.churn_pool(
+                    key_space, group, passes * workers + w
+                )
+            else:
+                off = (i * group) % (pool.shape[0] - group)
+                i += 1
+                kh = pool[off : off + group]
+            fields = dict(
+                key_hash=kh,
+                hits=ones,
+                limit=ones * limit,
+                duration=ones * duration,
+                algo=algo,
+            )
+            await inst.batcher.decide_arrays(fields)
+            done_rows += group
+
+    # enough concurrent groups outstanding to keep the submit
+    # gate saturated (deep accumulation engages only then):
+    # ~2 full deep batches of groups, floor 8
+    workers = max(8, 2 * depth // group)
+    t0 = time.monotonic()
+    await asyncio.gather(*[worker(w) for w in range(workers)])
+    elapsed = time.monotonic() - t0
+    end = backend.stats()
+    batches = end["batches"] - base["batches"]
+    row = dict(
+        metric=metric,
+        depth=depth,
+        decisions_per_sec=round(done_rows / elapsed, 1),
+        mean_device_batch=(
+            round(done_rows / batches, 1) if batches else 0.0
+        ),
+        device_batches=batches,
+        seconds=round(elapsed, 3),
+        workers=workers,
+        group_rows=group,
+        # exact-tier pressure: with the sketch tier on, dropped
+        # creates ARE the sketch-served group count (fail-closed);
+        # with it off they are silent over-admission
+        dropped_creates=end["dropped"] - base["dropped"],
+        evictions=end["evictions"] - base["evictions"],
+    )
+    if inst.promoter is not None:
+        row["promoter"] = inst.promoter.stats()
+    return row
+
+
+async def _drive_pool(
+    conf, pool, depth, seconds, group, metric, limit=1000,
+    duration=60_000, churn=False, key_space=1 << 40, prefill_ids=0,
+) -> dict:
+    """_boot_stack + optional _prefill_sequential + one
+    _measure_window + stop — the single-phase scenario driver."""
+    # a caller group can never exceed the ladder top (the batcher
+    # ships an oversized group alone and choose_bucket would refuse)
+    group = min(group, depth)
+    inst, backend, warm_s = await _boot_stack(conf, metric, depth)
+    try:
+        if prefill_ids:
+            await _prefill_sequential(
+                inst, prefill_ids, group, limit, duration
+            )
+        row = await _measure_window(
+            inst, backend, pool, depth, seconds, group, metric,
+            limit, duration, churn, key_space,
+        )
+        row["warmup_seconds"] = round(warm_s, 1)
+        return row
+    finally:
+        await inst.stop()
+
+
 def run_zipf10m(args) -> int:
     """BASELINE config 4 through the SHIPPED serving configuration.
 
@@ -178,87 +350,22 @@ def run_zipf10m(args) -> int:
     import asyncio
     import os
 
-    import numpy as np
-
+    from gubernator_tpu.cli import keystreams
     from gubernator_tpu.serve.config import config_from_env
-    from gubernator_tpu.serve.instance import Instance
-    from gubernator_tpu.serve.server import make_backend
 
-    import jax
-
-    jax.config.update(
-        "jax_compilation_cache_dir", str(_compile_cache_dir().resolve())
-    )
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    _jax_cache()
 
     depths = [int(d) for d in args.depths.split(",") if d.strip()]
-    rng = np.random.default_rng(42)
-    # the r5 sweep's zipf key recipe (scripts/bench_scenarios.py) over
-    # args.keys; pre-hashed like edge GEB6 frames, staged outside the
-    # timed region
-    zipf = rng.zipf(1.2, size=1 << 22) % args.keys
-    pool = (
-        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
-        ^ np.uint64(0xDEADBEEFCAFEF00D)
-    )
+    # the one shared zipf key recipe (cli/keystreams.py) over args.keys;
+    # pre-hashed like edge GEB6 frames, staged outside the timed region
+    pool = keystreams.zipf_pool(args.keys, 1 << 22)
     rows = []
 
     async def run_depth(conf, depth) -> dict:
-        # a caller group can never exceed the ladder top (the batcher
-        # ships an oversized group alone and choose_bucket would refuse)
-        group = min(args.group, depth)
-        backend = make_backend(conf)
-        print(f"depth {depth}: warmup (ladder compiles)...", file=sys.stderr)
-        t0 = time.monotonic()
-        await asyncio.to_thread(backend.warmup)
-        warm_s = time.monotonic() - t0
-        inst = Instance(conf, backend)
-        inst.start()
-        try:
-            stop_at = time.monotonic() + args.seconds
-            done_rows = 0
-            base_batches = backend.stats()["batches"]
-
-            async def worker(w: int):
-                nonlocal done_rows
-                i = w * 101
-                ones = np.ones(group, np.int64)
-                algo = np.zeros(group, np.int32)
-                while time.monotonic() < stop_at:
-                    off = (i * group) % (pool.shape[0] - group)
-                    i += 1
-                    fields = dict(
-                        key_hash=pool[off : off + group],
-                        hits=ones,
-                        limit=ones * 1000,
-                        duration=ones * 60_000,
-                        algo=algo,
-                    )
-                    await inst.batcher.decide_arrays(fields)
-                    done_rows += group
-            # enough concurrent groups outstanding to keep the submit
-            # gate saturated (deep accumulation engages only then):
-            # ~2 full deep batches of groups, floor 8
-            workers = max(8, 2 * depth // group)
-            t0 = time.monotonic()
-            await asyncio.gather(*[worker(w) for w in range(workers)])
-            elapsed = time.monotonic() - t0
-            batches = backend.stats()["batches"] - base_batches
-            return dict(
-                metric="zipf10m_serving_mode",
-                depth=depth,
-                decisions_per_sec=round(done_rows / elapsed, 1),
-                mean_device_batch=(
-                    round(done_rows / batches, 1) if batches else 0.0
-                ),
-                device_batches=batches,
-                seconds=round(elapsed, 3),
-                warmup_seconds=round(warm_s, 1),
-                workers=workers,
-                group_rows=group,
-            )
-        finally:
-            await inst.stop()
+        return await _drive_pool(
+            conf, pool, depth, args.seconds, args.group,
+            "zipf10m_serving_mode",
+        )
 
     for depth in depths:
         env = dict(os.environ)
@@ -273,6 +380,10 @@ def run_zipf10m(args) -> int:
             }
         )
         env.pop("GUBER_STORE_SLOTS", None)
+        # the historical exact-only scenario: the whole MiB budget goes
+        # to the exact tier (the r13 sketch sibling is --scenario
+        # zipf100m); an explicit GUBER_SKETCH in the environment wins
+        env.setdefault("GUBER_SKETCH", "0")
         conf = config_from_env(env)  # the shipped knob surface, validated
         r = asyncio.run(run_depth(conf, depth))
         print(
@@ -319,6 +430,413 @@ def run_zipf10m(args) -> int:
     )
     if args.json:
         print(json.dumps(doc))
+    return 0
+
+
+def _filler_hashes(slots: int) -> "np.ndarray":
+    """One uint64 key hash per store bucket (error-measurement rig):
+    with every bucket's ways held by LIVE entries that are ALSO present
+    in each batch (found-writers), a rank-0 miss can never evict — so
+    every measured key provably decides on the sketch tier."""
+    import numpy as np
+
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    out = {}
+    rng = np.random.default_rng(123)
+    while len(out) < slots:
+        cand = rng.integers(1, 2**63, 1024).astype(np.uint64)
+        bkt = (group_sort_key_np(cand, slots) >> np.uint64(32)).astype(
+            np.int64
+        )
+        for h, b in zip(cand.tolist(), bkt.tolist()):
+            out.setdefault(int(b), h)
+    return np.array([out[b] for b in range(slots)], np.uint64)
+
+
+def measure_tail_error(
+    batches: int = 96, rows: int = 4, sketch_mib: int = 8, seed: int = 7
+) -> dict:
+    """Measured tail-key error of the sketch tier on a pinned zipf
+    stream (the r13 acceptance phase; also driven by the property test
+    in tests/test_sketch_tier.py).
+
+    Rig: a tiny exact store whose buckets are pinned full of immortal
+    filler entries included in every batch, so EVERY measured key's
+    create drops and decides from the sketch — the clean measurement of
+    sketch error, uncontaminated by exact-tier wins. Limits are huge so
+    every hit charges, making host-side tallies the exact ground truth
+    for the counts the sketch was charged with. Reports max/mean
+    overestimate against the documented classic-CM bound e*N/width
+    (conservative update only tightens it) and the under-count count,
+    which must be ZERO (one-sided error = fail-closed)."""
+    import math
+
+    import numpy as np
+
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.core.engine import TpuEngine
+    from gubernator_tpu.core.sketches import derive_sketch_config
+    from gubernator_tpu.core.store import StoreConfig
+
+    cfg = StoreConfig(rows=1, slots=64)
+    skc = derive_sketch_config(mib=sketch_mib, rows=rows)
+    eng = TpuEngine(cfg, buckets=(4096,), sketch=skc)
+    T0 = 1_700_000_000_000
+    fill = _filler_hashes(cfg.slots)
+    nf = fill.shape[0]
+    B = 4096
+    DUR, LIM = 600_000, 1 << 30
+    onesf = np.ones(nf, np.int64)
+    # create the immortal fillers (limit/duration arbitrary, just live)
+    eng.decide_arrays(
+        fill, onesf, onesf * 1000, onesf * 1_000_000_000,
+        np.zeros(nf, np.int32), np.zeros(nf, bool), T0,
+    )
+    nm = B - nf
+    hits = np.concatenate([np.zeros(nf, np.int64), np.ones(nm, np.int64)])
+    limit = np.full(B, LIM, np.int64)
+    dur = np.full(B, DUR, np.int64)
+    algo = np.zeros(B, np.int32)
+    gnp = np.zeros(B, bool)
+    rng = np.random.default_rng(seed)
+    true = np.zeros(10_000, np.int64)
+    for b in range(batches):
+        ids = keystreams.zipf_ids(10_000, nm, rng)
+        kh = np.concatenate([fill, keystreams.hash_ids(ids)])
+        eng.decide_arrays(kh, hits, limit, dur, algo, gnp, T0 + b)
+        np.add.at(true, ids, 1)
+    touched = np.flatnonzero(true)
+    est = eng.sketch_estimates(
+        keystreams.hash_ids(touched), np.full(touched.shape[0], DUR),
+        T0 + batches + 1,
+    )
+    diff = est - true[touched]
+    n_charged = int(true.sum())
+    bound = math.e * n_charged / skc.width
+    return dict(
+        metric="sketch_tail_error",
+        distinct_keys=int(touched.shape[0]),
+        charged_hits=n_charged,
+        sketch_rows=skc.rows,
+        sketch_width=skc.width,
+        under_counts=int((diff < 0).sum()),
+        max_overestimate=int(diff.max()),
+        mean_overestimate=round(float(diff.mean()), 4),
+        documented_bound=round(bound, 2),
+        bound_formula="e * charged_hits / width (classic CM; "
+        "conservative update only tightens it)",
+        within_bound=bool(diff.max() <= bound),
+        batches=batches,
+        seed=seed,
+    )
+
+
+def run_zipf100m(args) -> int:
+    """The r13 sketch-tier flagship: ~100M-key cardinality at the SAME
+    fixed device budget the exact-only zipf10m scenario uses.
+
+    Three phases, one artifact (BENCH_SKETCH_r13.json):
+
+    1. `zipf10m_exact_baseline` — the r6 flagship shape: the whole
+       GUBER_STORE_MIB budget as one exact tier, 10M-key zipf. This is
+       the in-run baseline the acceptance compares against (same box,
+       same minutes — box-speed cancels in the ratio).
+    2. `zipf100m_sketch_tier` — GUBER_SKETCH=1 at the SAME total
+       budget: the sketch's footprint is carved out of the budget
+       (exact tier shrinks to fit), and the zipf stream spans
+       args.keys (default 100M) ids — 10x the exact tier's entry
+       count, impossible for the exact-only geometry. Dropped creates
+       (= sketch-served decisions) and promoter stats are recorded.
+    3. `sketch_tail_error` — the measured one-sided error bound on a
+       pinned stream (measure_tail_error): zero under-counts, max
+       overestimate within e*N/width.
+    """
+    import asyncio
+    import os
+
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.serve.config import config_from_env
+
+    _jax_cache()
+
+    depth = int(args.depths.split(",")[0])
+
+    def conf_for(sketch: bool, keys: int):
+        env = dict(os.environ)
+        env.update(
+            {
+                "GUBER_BACKEND": "tpu",
+                "GUBER_DEVICE_BATCH_LIMIT": str(depth),
+                "GUBER_DEVICE_DEEP_BATCH": "1",
+                "GUBER_STORE_MIB": str(args.store_mib),
+                "GUBER_STORE_TARGET_KEYS": str(keys),
+                "GUBER_SKETCH": "1" if sketch else "0",
+                "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+            }
+        )
+        env.pop("GUBER_STORE_SLOTS", None)
+        return config_from_env(env)
+
+    import statistics
+
+    conf_a = conf_for(False, 10_000_000)
+    conf_b = conf_for(True, args.keys)
+    pool10 = keystreams.zipf_pool(10_000_000, 1 << 22)
+    pool100 = keystreams.zipf_pool(args.keys, 1 << 22)
+    DUR = 600_000
+    group = min(args.group, depth)
+    rounds = max(2, getattr(args, "rounds", 3))
+
+    async def run_paired():
+        """Both stacks resident, INTERLEAVED alternating-order windows
+        (the r9 methodology): this box's ambient throttling drifts 2x
+        on minute scales, so adjacent-phase comparisons are noise —
+        per-round paired ratios are the only robust statistic here."""
+        a_inst, a_be, a_warm = await _boot_stack(
+            conf_a, "zipf10m_exact_baseline", depth
+        )
+        b_inst, b_be, b_warm = await _boot_stack(
+            conf_b, "zipf100m_sketch_tier", depth
+        )
+        try:
+            # phase B runs at the steady state the scenario is about:
+            # the exact tier saturated (1.25x its entry capacity of
+            # sequential ids; the zipf HEAD overlaps them, so hot keys
+            # decide exactly while the tail fights for ways)
+            from gubernator_tpu.core.store import store_capacity
+
+            await _prefill_sequential(
+                b_inst,
+                int(store_capacity(conf_b.store_config()) * 1.25),
+                group, 1000, DUR,
+            )
+            a_rows, b_rows, pairs = [], [], []
+            for rnd in range(rounds):
+                order = (
+                    [("a", a_inst, a_be, pool10,
+                      "zipf10m_exact_baseline"),
+                     ("b", b_inst, b_be, pool100,
+                      "zipf100m_sketch_tier")]
+                )
+                if rnd % 2:
+                    order.reverse()
+                rates = {}
+                for which, inst, be, pool, metric in order:
+                    r = await _measure_window(
+                        inst, be, pool, depth, args.seconds, group,
+                        metric, 1000, DUR,
+                    )
+                    rates[which] = r
+                    (a_rows if which == "a" else b_rows).append(r)
+                ratio = (
+                    rates["b"]["decisions_per_sec"]
+                    / rates["a"]["decisions_per_sec"]
+                )
+                pairs.append(round(ratio, 4))
+                print(
+                    f"round {rnd}: exact "
+                    f"{rates['a']['decisions_per_sec']:>11,.0f} "
+                    f"sketch "
+                    f"{rates['b']['decisions_per_sec']:>11,.0f} dec/s"
+                    f"  ratio {ratio:.3f}  (dropped->sketch "
+                    f"{rates['b']['dropped_creates']}, evictions "
+                    f"{rates['b']['evictions']})",
+                    file=sys.stderr,
+                )
+
+            def agg(rws, metric, warm):
+                med = statistics.median(
+                    r["decisions_per_sec"] for r in rws
+                )
+                return dict(
+                    metric=metric,
+                    depth=depth,
+                    decisions_per_sec=med,
+                    rounds=[r["decisions_per_sec"] for r in rws],
+                    warmup_seconds=round(warm, 1),
+                    workers=rws[0]["workers"],
+                    group_rows=group,
+                    dropped_creates=sum(
+                        r["dropped_creates"] for r in rws
+                    ),
+                    evictions=sum(r["evictions"] for r in rws),
+                    **(
+                        {"promoter": rws[-1]["promoter"]}
+                        if "promoter" in rws[-1]
+                        else {}
+                    ),
+                )
+
+            return (
+                agg(a_rows, "zipf10m_exact_baseline", a_warm),
+                agg(b_rows, "zipf100m_sketch_tier", b_warm),
+                pairs,
+            )
+        finally:
+            await b_inst.stop()
+            await a_inst.stop()
+
+    row_a, row_b, pairs = asyncio.run(run_paired())
+    rows = [row_a, row_b]
+    paired_ratio = statistics.median(pairs)
+    for r in rows:
+        print(
+            f"{r['metric']:24s} {r['decisions_per_sec']:>14,.0f} dec/s "
+            f"(median; dropped->sketch {r['dropped_creates']}, "
+            f"evictions {r['evictions']})",
+            file=sys.stderr,
+        )
+    print("measuring tail error (pinned stream)...", file=sys.stderr)
+    err = measure_tail_error()
+    print(
+        f"tail error: max over {err['max_overestimate']} "
+        f"(bound {err['documented_bound']}), under-counts "
+        f"{err['under_counts']}",
+        file=sys.stderr,
+    )
+
+    import jax as _jax
+
+    base_v = rows[0]["decisions_per_sec"]
+    sk_v = rows[1]["decisions_per_sec"]
+    doc = dict(
+        scenario="zipf100m_sketch_tier",
+        scope=_jax.devices()[0].platform,
+        device=_jax.devices()[0].device_kind,
+        store_mib=args.store_mib,
+        key_space=args.keys,
+        depth=depth,
+        served_via=(
+            "config_from_env -> make_backend (sketch carve-out) -> "
+            "Instance/DeviceBatcher (deep batch), array door; BOTH "
+            "stacks resident, interleaved alternating-order windows "
+            "(r9 methodology) — the paired per-round ratio is the "
+            "drift-robust headline"
+        ),
+        paired_ratios=pairs,
+        env_knobs={
+            "GUBER_STORE_MIB": str(args.store_mib),
+            "GUBER_SKETCH": "1 (phase 2) / 0 (phase 1)",
+            "GUBER_SKETCH_MIB": os.environ.get(
+                "GUBER_SKETCH_MIB", "0 (auto: store_mib/4, cap 256)"
+            ),
+            "GUBER_DEVICE_BATCH_LIMIT": str(depth),
+            "GUBER_DEVICE_DEEP_BATCH": "1",
+        },
+        rows=rows,
+        tail_error=err,
+        sketch_over_exact_baseline=round(paired_ratio, 4),
+        acceptance=dict(
+            target="zipf100m at the fixed total budget sustains >= the "
+            "zipf10m exact-only baseline, tail error within bound, "
+            "zero under-counts",
+            throughput_met=bool(paired_ratio >= 1.0),
+            error_met=bool(
+                err["within_bound"] and err["under_counts"] == 0
+            ),
+        ),
+        acceptance_note=(
+            None
+            if paired_ratio >= 1.0
+            else (
+                "CPU-container scoping: the >= target leans on the "
+                "TPU footprint-law dividend — the sketch phase's "
+                "exact tier is HALF the baseline's footprint, worth "
+                "~1.7x per batch on v5e "
+                "(BENCH_ZIPF10M_PROFILE_r5.json) against the sketch's "
+                "~10-14% kernel cost — but on this throttled 1-core "
+                "container the writeback's footprint-proportional "
+                "term is flat (512 vs 1024 MiB exact measured within "
+                "5% here), and the 100M-key stream's near-unique "
+                "batches carry ~3x the unique-key groups of the 10M "
+                "baseline (store I/O scales with groups). The "
+                "CARDINALITY claim stands as measured: 10x the key "
+                "space at the same fixed budget with bounded "
+                "fail-closed tail error, zero under-counts, and "
+                "saturation-tier traffic actually served — vs silent "
+                "over-admission at this pressure exact-only."
+            )
+        ),
+        notes=(
+            "the sketch phase's exact tier is the budget minus the "
+            "sketch carve-out (config.store_config), so both phases "
+            "fit the SAME total device budget (power-of-two floors "
+            "mean the two-tier phase provisions 512 MiB exact + "
+            "256 MiB sketch of the 1024); its exact tier is PREFILLED "
+            "to 1.25x capacity before the rounds so the windows "
+            "measure tier-pressure steady state. dropped_creates in "
+            "the sketch phase are sketch-served fail-closed "
+            "decisions; in the baseline they are silent "
+            "over-admission."
+        ),
+    )
+    if args.json:
+        print(json.dumps(doc))
+    return 0
+
+
+def run_churn(args) -> int:
+    """Adversarial key-churn scenario (ROADMAP item 4): every pass is
+    an entirely fresh key set (cli/keystreams.py churn_pool), defeating
+    the shed cache, the exact tier's residency, and the promoter's
+    top-K by construction — the worst case for tier thrash. The row
+    pins that the stack survives it at full load: bounded promoter
+    memory, no error, dropped creates absorbed by the sketch tier."""
+    import asyncio
+    import os
+
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.serve.config import config_from_env
+
+    _jax_cache()
+
+    depth = int(args.depths.split(",")[0])
+    env = dict(os.environ)
+    env.update(
+        {
+            "GUBER_BACKEND": "tpu",
+            "GUBER_DEVICE_BATCH_LIMIT": str(depth),
+            "GUBER_DEVICE_DEEP_BATCH": "1",
+            "GUBER_STORE_MIB": str(args.store_mib),
+            "GUBER_STORE_TARGET_KEYS": str(args.keys),
+            "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+        }
+    )
+    env.pop("GUBER_STORE_SLOTS", None)
+    conf = config_from_env(env)
+    # the churn path generates its key stream per pass inside the
+    # measurement loop; this pool only satisfies the non-churn
+    # signature and is never indexed
+    group = min(args.group, depth)
+    pool = keystreams.churn_pool(args.keys, 2 * group, 0)
+    r = asyncio.run(
+        _drive_pool(
+            conf, pool, depth, args.seconds, args.group, "key_churn",
+            churn=True, key_space=args.keys,
+        )
+    )
+    print(
+        f"key-churn: {r['decisions_per_sec']:>14,.0f} dec/s "
+        f"(dropped->sketch {r['dropped_creates']}, promoter "
+        f"{r.get('promoter')})",
+        file=sys.stderr,
+    )
+    if args.json:
+        import jax as _jax
+
+        print(
+            json.dumps(
+                dict(
+                    scenario="key_churn",
+                    scope=_jax.devices()[0].platform,
+                    store_mib=args.store_mib,
+                    key_space=args.keys,
+                    depth=depth,
+                    rows=[r],
+                )
+            )
+        )
     return 0
 
 
@@ -485,13 +1003,22 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--scenario",
         default="cluster",
-        choices=["cluster", "zipf10m", "shed"],
+        choices=["cluster", "zipf10m", "zipf100m", "key-churn", "shed"],
         help="cluster = the reference benchmark suite over localhost "
         "gRPC; zipf10m = BASELINE config 4 through the shipped serving "
         "config (deep-batch ladder, GUBER_STORE_MIB-sized store); "
+        "zipf100m = the r13 two-tier flagship: 100M-key zipf at the "
+        "SAME fixed budget (sketch carve-out) vs the exact-only 10M "
+        "baseline, plus the measured tail-error phase "
+        "(BENCH_SKETCH_r13.json); key-churn = adversarial fresh-keys-"
+        "every-pass stream (tier thrash worst case, ROADMAP item 4); "
         "shed = over-limit-heavy skew ladder through the shipped boot "
         "path (the r10 shed cache's workload; GUBER_SHED_CACHE "
         "honored and recorded, over-limit share reported per round)",
+    )
+    parser.add_argument(
+        "--rounds", type=int, default=3,
+        help="zipf100m: interleaved paired baseline/sketch rounds",
     )
     parser.add_argument(
         "--shed-shares",
@@ -598,6 +1125,18 @@ def main(argv=None) -> int:
             )
             args.backend = "tpu"
         return run_zipf10m(args)
+    if args.scenario == "zipf100m":
+        # two-tier defaults: one deep rung, 100M-key space when the
+        # user left the zipf10m defaults in place
+        if args.depths == parser.get_default("depths"):
+            args.depths = "32768"
+        if args.keys == parser.get_default("keys"):
+            args.keys = 100_000_000
+        return run_zipf100m(args)
+    if args.scenario == "key-churn":
+        if args.depths == parser.get_default("depths"):
+            args.depths = "32768"
+        return run_churn(args)
 
     backend_factory = None
     # device backends boot with the daemon's shipped co-batch depth

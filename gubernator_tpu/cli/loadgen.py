@@ -35,19 +35,33 @@ HOT_KEYS = 512
 COLD_KEYS = 4096
 
 
-def _shed_pool(share: float, batch: int):
+def _shed_pool(share: float, batch: int, keyspace: int = 0):
     """Pre-built batch rotation in the shed-r10 workload shape: the
     first `share` of each batch hits hot limit-1 keys (over limit
-    after their first touch), the rest never-over keys."""
+    after their first touch), the rest never-over keys. `keyspace=0`
+    keeps the classic 8-batch/4096-cold-id rotation BIT-IDENTICAL to
+    the r10-r12 workload (the committed PERF_GATE_BASELINE ratios were
+    measured on it). `keyspace>0` (r13) widens the cold pool by
+    pre-building enough batches to actually EMIT that many distinct
+    cold ids (capped at 256 batches — ~2x a 65k-entry store's capacity
+    at 1000-item batches, enough to hold the exact tier at pressure so
+    the sketch tier's drop path carries real load)."""
     cut = int(share * batch)
+    cold_per_batch = max(1, batch - cut)
+    if keyspace > 0:
+        n_pools = min(256, -(-keyspace // cold_per_batch))
+        cold = keyspace
+    else:
+        n_pools = 8
+        cold = COLD_KEYS
     pools = []
-    for i in range(8):
+    for i in range(n_pools):
         reqs = []
         for j in range(batch):
             if j < cut:
                 key, limit = f"shed_h{(i * 31 + j) % HOT_KEYS}", 1
             else:
-                key = f"shed_c{(i * batch + j) % COLD_KEYS}"
+                key = f"shed_c{(i * batch + j) % cold}"
                 limit = 1_000_000_000
             reqs.append(
                 RateLimitReq(
@@ -103,10 +117,11 @@ async def run(
     mode: str = "auto",
     quiet: bool = False,
     json_out: bool = False,
+    keyspace: int = 0,
 ) -> dict:
     client = _make_client(protocol, address, window, mode)
     if share >= 0.0:
-        batches = _shed_pool(share, batch)
+        batches = _shed_pool(share, batch, keyspace)
     else:
         pool = [
             RateLimitReq(
@@ -206,6 +221,12 @@ def main(argv=None) -> int:
         "(0..1); negative = the default random pool",
     )
     parser.add_argument(
+        "--keyspace", type=int, default=0,
+        help="widen the --share workload's cold-key pool to this many "
+        "distinct ids (0 = the classic 4096); sized past the store's "
+        "entry capacity this drives the r13 sketch tier's drop path",
+    )
+    parser.add_argument(
         "--window", type=int, default=0,
         help="geb protocol: cap the credit window (0 = the server's "
         "advertised window; 1 = round-trip, the pre-r7 shape)",
@@ -234,6 +255,7 @@ def main(argv=None) -> int:
             mode=args.mode,
             quiet=args.quiet or args.json,
             json_out=args.json,
+            keyspace=args.keyspace,
         )
     )
     return 0

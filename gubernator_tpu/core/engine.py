@@ -40,6 +40,7 @@ from gubernator_tpu.core.kernels import (
     BatchGroups,
     BatchRequest,
     decide_presorted,
+    decide_presorted_sketch,
     pack_outputs,
     rebase_jit,
     unpack_outputs,
@@ -73,6 +74,17 @@ def _decide_packed_jit(store, req, now, groups=None):
     """decide_presorted + pack_outputs: one host transfer per batch."""
     store, resp, stats = decide_presorted(store, req, now, groups)
     return store, pack_outputs(resp, stats)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _decide_packed_sketch_jit(store, sketch, req, now, groups=None):
+    """Two-tier twin of _decide_packed_jit (r13): store AND sketch
+    donate; the packed transfer layout is identical, so decide_wait
+    serves both variants unchanged."""
+    store, sketch, resp, stats = decide_presorted_sketch(
+        store, sketch, req, now, groups
+    )
+    return store, sketch, pack_outputs(resp, stats)
 
 
 def buckets_for_limit(limit: int) -> tuple:
@@ -622,6 +634,7 @@ class TpuEngine:
         config: StoreConfig = StoreConfig(),
         buckets: Sequence[int] = DEFAULT_BUCKETS,
         device: Optional[jax.Device] = None,
+        sketch=None,
     ):
         self.config = config
         self.buckets = sorted(buckets)
@@ -636,6 +649,31 @@ class TpuEngine:
         # shed cache checks so a clock-jump reset (or warmup's cleanup)
         # invalidates every cached verdict (serve/shedcache.py)
         self.reset_generation = 0
+        # sketch cold tier (r13, core/sketches.SketchConfig or None):
+        # creates the exact tier DROPS to way exhaustion are decided
+        # from a window-keyed count-min estimate instead of being
+        # silently over-admitted. `sketch_on` is the runtime A/B flag
+        # (scripts/perf_gate.py flips it between paired rounds; both
+        # variants compile lazily).
+        self.sketch_config = sketch
+        self.sketch = None
+        self.sketch_on = sketch is not None
+        if sketch is not None:
+            self.sketch = self._new_sketch()
+        # serve-tier hot-key observer (serve/promoter.py): called with
+        # every dispatched BatchRequest (numpy, pre-device) so the
+        # streaming top-K candidate source sees all traffic regardless
+        # of which door it entered through. Must never raise into the
+        # dispatch path; the promoter's hook rate-limits itself.
+        self.observe_hook = None
+
+    def _new_sketch(self):
+        from gubernator_tpu.core.sketches import new_sketch
+
+        sk = new_sketch(self.sketch_config)
+        if self.device is not None:
+            sk = jax.device_put(sk, self.device)
+        return sk
 
     # -- public API ---------------------------------------------------------
 
@@ -694,7 +732,35 @@ class TpuEngine:
             self.reset()
         elif delta is not None:
             self.store = rebase_jit(self.store, np.int32(delta))
+            if self.sketch is not None:
+                # sketch windows are keyed by engine-ms // duration, so
+                # a rebase shifts every window id: clear rather than
+                # carry counts into wrong windows. Rare (~12-day
+                # cadence) and one-sided-safe in the fail-open
+                # direction for at most one window per key — the same
+                # class of loss as the reference's restart contract.
+                self.sketch = self._new_sketch()
         return e
+
+    def _dispatch(self, req, groups, e_now):
+        """The one jitted-dispatch funnel every submit path ends in:
+        feeds the serve-tier hot-key observer (numpy fields, pre-
+        device) and picks the exact-only or two-tier program."""
+        hook = self.observe_hook
+        if hook is not None:
+            try:
+                hook(req)
+            except Exception:  # pragma: no cover - defensive
+                pass  # observability must never fail a dispatch
+        if self.sketch is not None and self.sketch_on:
+            self.store, self.sketch, packed = _decide_packed_sketch_jit(
+                self.store, self.sketch, req, e_now, groups
+            )
+            return packed
+        self.store, packed = _decide_packed_jit(
+            self.store, req, e_now, groups
+        )
+        return packed
 
     def decide_submit(
         self,
@@ -727,9 +793,7 @@ class TpuEngine:
             gnp,
             with_groups=True,
         )
-        self.store, packed = _decide_packed_jit(
-            self.store, req, e_now, groups
-        )
+        packed = self._dispatch(req, groups, e_now)
         # capture the epoch the batch was computed under: a later submit
         # may rebase/reset the clock before this batch's wait, and the
         # in-flight engine-ms outputs must convert against THEIR epoch
@@ -783,9 +847,7 @@ class TpuEngine:
         jitted call, nothing else — the submit thread's `dispatch`
         stage. Returns the standard decide_wait handle."""
         e_now = self._engine_now(now)
-        self.store, packed = _decide_packed_jit(
-            self.store, merged["req"], e_now, merged["groups"]
-        )
+        packed = self._dispatch(merged["req"], merged["groups"], e_now)
         return (
             packed, merged["order"], merged["n"], merged["B"],
             self.clock.epoch,
@@ -822,9 +884,7 @@ class TpuEngine:
             order if order is not None else np.arange(n, dtype=np.int32)
         )
         order_p[n:] = np.arange(n, B, dtype=np.int32)
-        self.store, packed = _decide_packed_jit(
-            self.store, req, e_now, groups
-        )
+        packed = self._dispatch(req, groups, e_now)
         return (packed, order_p, n, B, self.clock.epoch)
 
     def decide_wait(
@@ -1013,6 +1073,16 @@ class TpuEngine:
                 [(f"warmup:{i}", RateLimitResp(limit=1)) for i in range(b)],
                 now=now,
             )
+        if self.sketch is not None:
+            # promoter host-read surfaces (sketch_estimates/live_mask)
+            # run eagerly at power-of-two-padded shapes; compile the
+            # common rungs here so the first flush ticks don't pay
+            # ~0.5s of eager compiles on the serving submit thread
+            for B in (64, 128, 256, 512, 1024):
+                kh = np.arange(1, B + 1, dtype=np.uint64) << np.uint64(32)
+                durs = np.full(B, 1000, np.int64)
+                self.sketch_estimates(kh, durs, now)
+                self.live_mask(kh, now)
         # reset state and counters dirtied by warmup traffic
         self.reset()
         self.stats = EngineStats()
@@ -1022,7 +1092,197 @@ class TpuEngine:
         if self.device is not None:
             store = jax.device_put(store, self.device)
         self.store = store
+        if self.sketch_config is not None:
+            self.sketch = self._new_sketch()
         self.reset_generation += 1
+
+    # -- sketch cold tier surfaces (r13) ------------------------------------
+
+    @staticmethod
+    def _pad_keys_pow2(key_hash: np.ndarray, *cols):
+        """Pad key hashes (+ parallel int64 columns) to a power-of-two
+        length (floor 64) by repeating the last row. The promoter's
+        candidate count changes every tick, and un-jitted device ops
+        compile one eager kernel PER SHAPE — unpadded, each tick paid
+        ~500ms of recompiles on this box. Returns (kh, cols..., n)."""
+        n = int(key_hash.shape[0])
+        B = 1 << max(6, (n - 1).bit_length())
+        kh = np.empty(B, np.uint64)
+        kh[:n] = key_hash
+        kh[n:] = kh[n - 1] if n else 0
+        out = [kh]
+        for c in cols:
+            p = np.empty(B, np.int64)
+            p[:n] = c
+            p[n:] = p[n - 1] if n else 0
+            out.append(p)
+        out.append(n)
+        return tuple(out)
+
+    def _sketch_windows(self, durations: np.ndarray, now: int):
+        """(window_id int64[n], window_end_unix int64[n]) for the
+        current fixed windows of these durations."""
+        from gubernator_tpu.core.sketches import window_id_np
+
+        e_now = int(self.clock.to_engine(now))
+        wid = window_id_np(e_now, durations)
+        d = np.maximum(np.asarray(durations, np.int64), 1)
+        wend_engine = (wid + 1) * d
+        return wid, np.asarray(self.clock.from_engine(wend_engine))
+
+    def sketch_estimates(
+        self,
+        key_hash: np.ndarray,
+        durations: np.ndarray,
+        now: Optional[int] = None,
+    ) -> np.ndarray:
+        """NON-MUTATING current-window count-min estimates int64[n] for
+        these keys (0 when the tier is off or nothing was ever
+        decided). Reads only the addressed counters — a narrow device
+        gather, never the whole sketch. Thread contract: like
+        snapshot_read, call from the batcher's submit thread
+        (DeviceBatcher.run_serialized) so the gather can't race a
+        sketch-donating dispatch."""
+        n = int(key_hash.shape[0])
+        if self.sketch is None or self.clock.epoch is None or n == 0:
+            return np.zeros(n, np.int64)
+        if now is None:
+            now = millisecond_now()
+        from gubernator_tpu.core.sketches import sketch_indices_np
+
+        kh, dur, _n = self._pad_keys_pow2(
+            np.ascontiguousarray(key_hash, np.uint64),
+            np.asarray(durations, np.int64),
+        )
+        wid, _ = self._sketch_windows(dur, now)
+        idx = sketch_indices_np(kh, wid, self.sketch_config)
+        data = self.sketch.data
+        est = None
+        for r in range(idx.shape[0]):
+            c = jnp.take(data[r], jnp.asarray(idx[r]))
+            est = c if est is None else jnp.minimum(est, c)
+        return np.asarray(est, np.int64)[:n]
+
+    def install_windows(
+        self,
+        key_hash: np.ndarray,
+        limit: np.ndarray,
+        remaining: np.ndarray,
+        reset_time: np.ndarray,
+        is_over: np.ndarray,
+        now: Optional[int] = None,
+    ) -> None:
+        """Install token windows for pre-hashed keys — the array-level
+        sibling of update_globals (same upsert kernel, same replica-
+        style entry layout). The sketch promoter migrates a hot key's
+        sketch estimate into an exact bucket through this surface.
+        Batches larger than the bucket ladder's top rung are CHUNKED
+        (installs are per-key upserts, order-free across chunks) — the
+        promoter's candidate count is a config knob (GUBER_SKETCH_TOPK)
+        with no relation to the ladder, and a choose_bucket refusal
+        here would wedge every subsequent promotion tick."""
+        n = int(key_hash.shape[0])
+        if n == 0:
+            return
+        if now is None:
+            now = millisecond_now()
+        self._engine_now(now)  # pin/refresh the epoch
+        top = max(self.buckets)
+        kh = np.ascontiguousarray(key_hash, np.uint64)
+        limit = np.asarray(limit)
+        remaining = np.asarray(remaining)
+        reset_time = np.asarray(reset_time)
+        is_over = np.asarray(is_over, bool)
+        for s in range(0, n, top):
+            e = min(s + top, n)
+            hashes, lim, rem, reset, over, valid = pad_to_bucket(
+                self.buckets,
+                e - s,
+                (kh[s:e], np.uint64),
+                (_sat_i32(limit[s:e]), np.int32),
+                (_sat_i32(remaining[s:e]), np.int32),
+                (self.clock.to_engine(reset_time[s:e]), np.int32),
+                (is_over[s:e], bool),
+            )
+            self.store = upsert_globals_jit(
+                self.store, hashes, lim, rem, reset, over, valid
+            )
+
+    def live_mask(
+        self, key_hash: np.ndarray, now: Optional[int] = None
+    ) -> np.ndarray:
+        """bool[n]: key currently holds a LIVE exact-tier entry (tag
+        match, not expired). Non-mutating; same thread contract as
+        snapshot_read. The promoter screens candidates with this so an
+        install can never clobber live exact state."""
+        n = int(key_hash.shape[0])
+        if n == 0 or self.clock.epoch is None:
+            return np.zeros(n, bool)
+        if now is None:
+            now = millisecond_now()
+        from gubernator_tpu.core.store import (
+            L_EXPIRE,
+            L_TAG,
+            bucket_index,
+            fingerprints,
+        )
+
+        from gubernator_tpu.core.store import LANES
+
+        kh_p, _n = self._pad_keys_pow2(
+            np.ascontiguousarray(key_hash, np.uint64)
+        )
+        kh = jnp.asarray(kh_p)
+        b = bucket_index(kh, self.config.slots)
+        fp = fingerprints(kh)
+        # gather from the canonical [buckets, ways*LANES] shape and
+        # reshape only the gathered rows: the .entries view reshapes
+        # the WHOLE store, which eager mode materializes per call
+        rows = jnp.take(self.store.data, b, axis=0).reshape(
+            kh.shape[0], -1, LANES
+        )
+        match = rows[..., L_TAG] == fp[:, None]
+        e_now = int(self.clock.to_engine(now))
+        live = match & (rows[..., L_EXPIRE] >= e_now)
+        return np.asarray(live.any(axis=1))[:n]
+
+    def promote_from_sketch(
+        self,
+        key_hash: np.ndarray,
+        limits: np.ndarray,
+        durations: np.ndarray,
+        now: Optional[int] = None,
+    ):
+        """Migrate hot sketch-tier keys into exact buckets: read each
+        key's current-window estimate and install a token window with
+        remaining = max(limit - estimate, 0) and reset = the window's
+        end — the key then decides exactly for the rest of the window
+        and re-creates exactly (byte-identical to a fresh key) in the
+        next one. Keys already holding a LIVE exact entry are skipped
+        (their state is authoritative). Returns (installed bool[n],
+        estimate int64[n], reset_unix int64[n], over bool[n]). Thread
+        contract: submit-thread only (DeviceBatcher.run_serialized) —
+        this reads AND upserts the store."""
+        n = int(key_hash.shape[0])
+        if n == 0 or self.sketch is None:
+            z = np.zeros(n, np.int64)
+            return np.zeros(n, bool), z, z, np.zeros(n, bool)
+        if now is None:
+            now = millisecond_now()
+        self._engine_now(now)  # pin the epoch before window math
+        kh = np.ascontiguousarray(key_hash, np.uint64)
+        limits = np.asarray(limits, np.int64)
+        est = self.sketch_estimates(kh, durations, now)
+        _, reset_unix = self._sketch_windows(durations, now)
+        over = est >= limits
+        remaining = np.maximum(limits - est, 0)
+        todo = ~self.live_mask(kh, now)
+        if todo.any():
+            self.install_windows(
+                kh[todo], limits[todo], remaining[todo],
+                reset_unix[todo], over[todo], now,
+            )
+        return todo, est, reset_unix, over
 
     def _bucket(self, n: int) -> int:
         return choose_bucket(self.buckets, n)

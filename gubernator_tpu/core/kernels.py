@@ -109,6 +109,7 @@ from gubernator_tpu.core.store import (
     decode_sort_key,
     fingerprints,
     group_sort_key,
+    mix64,
     rebase,
 )
 
@@ -166,6 +167,35 @@ class BatchGroups(NamedTuple):
     end_pos: jax.Array
     valid: jax.Array
     group_id: jax.Array
+
+
+class Sketch(NamedTuple):
+    """Count-min cold-tier state (r13, core/sketches.SketchConfig):
+    dense int64[rows, width] counters — a one-leaf pytree like Store so
+    the whole sketch donates cleanly through the jitted decide."""
+
+    data: jax.Array  # int64[rows, width]
+
+
+def _sketch_lookup(sketch: Sketch, kh: jax.Array, wid: jax.Array):
+    """Per-group (min-estimate int64[G], per-row index list int32[G])
+    for window-keyed key hashes. MUST stay bit-identical to the host
+    twin core/sketches.sketch_indices_np (test-pinned): the promoter
+    and the error-bound tests read estimates host-side for windows this
+    kernel charged."""
+    from gubernator_tpu.core.sketches import SKETCH_SALTS, WINDOW_MIX
+
+    rows, width = sketch.data.shape
+    base = mix64(kh ^ (wid.astype(jnp.uint64) * jnp.uint64(WINDOW_MIX)))
+    est = None
+    idxs = []
+    for r in range(rows):
+        hr = mix64(base ^ jnp.uint64(SKETCH_SALTS[r]))
+        idx = (hr & jnp.uint64(width - 1)).astype(jnp.int32)
+        idxs.append(idx)
+        c = jnp.take(sketch.data[r], idx)  # narrow unsorted gather [G]
+        est = c if est is None else jnp.minimum(est, c)
+    return est, idxs
 
 
 class BatchResponse(NamedTuple):
@@ -259,57 +289,27 @@ def _use_sweep_writeback(buckets: int, W: int, B: int) -> bool:
     )
 
 
-def _writeback_delta_add(
-    data: jax.Array,  # int32[buckets, ways*LANES]
-    bkt: jax.Array,  # int32[B] bucket per item, sorted non-decreasing,
-    # in range for EVERY row (invalid rows carry a real bucket and simply
-    # add a zero row — cheaper than sentinel indices, which would break
-    # the sorted-index promise when invalid rows are interspersed)
+def _writeback_plan(
+    cand: jax.Array,  # int32[B, ways, LANES] pre-write bucket contents
+    bkt: jax.Array,  # int32[B] bucket per item, sorted non-decreasing
     write_item: jax.Array,  # bool[B] the group member designated to write
-    # (decide: the group leader of a VALID group; upsert_globals: the
-    # LAST duplicate, for last-wins install) — at most one per group
     found: jax.Array,  # bool[B] tag matched in the bucket
     fway: jax.Array,  # int32[B] matching way (valid where found)
     eway: jax.Array,  # int32[B] eviction-candidate way (for misses)
-    new_vals: jax.Array,  # int32[B, LANES] the update for write_item rows
-    cand: jax.Array,  # int32[B, ways, LANES] pre-write bucket contents
     is_b_leader: jax.Array,  # bool[B] first item of its bucket segment
     b_end: jax.Array,  # int32[B] inclusive end of the bucket segment
-) -> Tuple[jax.Array, jax.Array, jax.Array]:
-    """Apply per-entry updates as ONE scatter-ADD of delta rows — no
-    cross-group merge pass at all. Returns (new_data, n_dropped,
-    n_evicted): creates lost to way exhaustion and occupied ways
-    overwritten, the store's over-admission signals.
-
-    Each designated writer adds (new_vals - old_entry_lanes) into its
-    way's lanes of its bucket row; all other positions add zero rows at
-    their own (sorted) bucket index, so the scatter's index stream is the
-    already-sorted bucket stream and duplicate indices are legal by the
-    arithmetic: updates to one bucket touch DISJOINT ways, so the adds
-    compose exactly (old + (new - old) = new; int32 wrap-around in the
-    subtraction self-corrects on the add). Measured on v5e this replaces
-    ~500us of [B,128] segmented select-scans with ~30us of [B,16]
-    cumsums + one add-scatter at B=16384.
-
-    Way-disjointness is guaranteed, not assumed:
-    - two found-groups can never share a way (one tag per way);
-    - miss-groups are RANKED within their bucket and the k-th one claims
-      the k-th EMPTY way, so simultaneous fresh keys colliding in one
-      bucket all persist as long as empty ways remain (the r1 design let
-      only the first write and silently dropped the rest — measured ~50%
-      of creations lost in a cold-start storm on dense buckets);
-    - only the rank-0 miss of a bucket with NO empty way may evict (the
-      earliest-expiry way), and not if a found-group writes that way
-      this batch; later-ranked misses drop. A dropped create costs brief
-      over-admission for that key — the same contract as reference LRU
-      eviction / restart state loss (architecture.md:5-11) — and now
-      happens only once a bucket's EMPTY ways are exhausted within the
-      batch (occupied ways + concurrent fresh keys > ways), instead of
-      on any same-batch collision.
-    """
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Phase 1 of the delta-add writeback: decide, per item, WHO writes
+    WHERE — and which creates drop (way exhaustion) or evict a live
+    occupant. Returns (writer, way, dropped, evicted), all [B]. Split
+    from the scatter apply so the decide kernel can consult `dropped`
+    BEFORE response math: with the sketch cold tier on, a dropped
+    create is decided from the count-min estimate instead of being
+    silently over-admitted (decide_presorted_sketch), so the drop mask
+    must exist before budgets are computed. See _writeback_delta_add
+    for the way-disjointness guarantees this plan enforces."""
     B = bkt.shape[0]
-    buckets, W = data.shape
-    ways = W // LANES
+    ways = cand.shape[1]
     ar = jnp.arange(B, dtype=jnp.int32)
 
     way_ids = jnp.arange(ways, dtype=jnp.int32)[None, :]
@@ -362,6 +362,24 @@ def _writeback_delta_add(
 
     writer = found_w | (miss_w & ~dropped)
     way = jnp.where(found, fway, eway_sel)
+    return writer, way, dropped, evicted
+
+
+def _writeback_apply(
+    data: jax.Array,  # int32[buckets, ways*LANES]
+    bkt: jax.Array,  # int32[B] sorted bucket per item
+    writer: jax.Array,  # bool[B] from _writeback_plan
+    way: jax.Array,  # int32[B] from _writeback_plan
+    new_vals: jax.Array,  # int32[B, LANES] the update for writer rows
+    cand: jax.Array,  # int32[B, ways, LANES] pre-write bucket contents
+) -> jax.Array:
+    """Phase 2: apply the planned updates as ONE scatter-ADD of delta
+    rows (the arithmetic and measured rationale live on
+    _writeback_delta_add)."""
+    B = bkt.shape[0]
+    buckets, W = data.shape
+    ways = W // LANES
+    way_ids = jnp.arange(ways, dtype=jnp.int32)[None, :]
 
     # old entry lanes at the destination way (vector selects; ways static)
     old8 = cand[:, 0]
@@ -374,16 +392,72 @@ def _writeback_delta_add(
         dmask[:, :, None], delta8[:, None, :], 0
     ).reshape(B, W)
 
-    n_dropped = jnp.sum(dropped).astype(jnp.int32)
-    n_evicted = jnp.sum(evicted).astype(jnp.int32)
     if _use_sweep_writeback(buckets, W, B):
         from gubernator_tpu.core.pallas_sweep import _apply_inline
 
-        return _apply_inline(data, bkt, drow), n_dropped, n_evicted
+        return _apply_inline(data, bkt, drow)
+    return data.at[bkt].add(drow, indices_are_sorted=True)
+
+
+def _writeback_delta_add(
+    data: jax.Array,  # int32[buckets, ways*LANES]
+    bkt: jax.Array,  # int32[B] bucket per item, sorted non-decreasing,
+    # in range for EVERY row (invalid rows carry a real bucket and simply
+    # add a zero row — cheaper than sentinel indices, which would break
+    # the sorted-index promise when invalid rows are interspersed)
+    write_item: jax.Array,  # bool[B] the group member designated to write
+    # (decide: the group leader of a VALID group; upsert_globals: the
+    # LAST duplicate, for last-wins install) — at most one per group
+    found: jax.Array,  # bool[B] tag matched in the bucket
+    fway: jax.Array,  # int32[B] matching way (valid where found)
+    eway: jax.Array,  # int32[B] eviction-candidate way (for misses)
+    new_vals: jax.Array,  # int32[B, LANES] the update for write_item rows
+    cand: jax.Array,  # int32[B, ways, LANES] pre-write bucket contents
+    is_b_leader: jax.Array,  # bool[B] first item of its bucket segment
+    b_end: jax.Array,  # int32[B] inclusive end of the bucket segment
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Apply per-entry updates as ONE scatter-ADD of delta rows — no
+    cross-group merge pass at all. Returns (new_data, n_dropped,
+    n_evicted): creates lost to way exhaustion and occupied ways
+    overwritten, the store's over-admission signals. (Composition of
+    _writeback_plan + _writeback_apply; decide_presorted calls the two
+    phases separately so the drop mask can feed the sketch tier.)
+
+    Each designated writer adds (new_vals - old_entry_lanes) into its
+    way's lanes of its bucket row; all other positions add zero rows at
+    their own (sorted) bucket index, so the scatter's index stream is the
+    already-sorted bucket stream and duplicate indices are legal by the
+    arithmetic: updates to one bucket touch DISJOINT ways, so the adds
+    compose exactly (old + (new - old) = new; int32 wrap-around in the
+    subtraction self-corrects on the add). Measured on v5e this replaces
+    ~500us of [B,128] segmented select-scans with ~30us of [B,16]
+    cumsums + one add-scatter at B=16384.
+
+    Way-disjointness is guaranteed, not assumed:
+    - two found-groups can never share a way (one tag per way);
+    - miss-groups are RANKED within their bucket and the k-th one claims
+      the k-th EMPTY way, so simultaneous fresh keys colliding in one
+      bucket all persist as long as empty ways remain (the r1 design let
+      only the first write and silently dropped the rest — measured ~50%
+      of creations lost in a cold-start storm on dense buckets);
+    - only the rank-0 miss of a bucket with NO empty way may evict (the
+      earliest-expiry way), and not if a found-group writes that way
+      this batch; later-ranked misses drop. A dropped create costs brief
+      over-admission for that key — the same contract as reference LRU
+      eviction / restart state loss (architecture.md:5-11) — and now
+      happens only once a bucket's EMPTY ways are exhausted within the
+      batch (occupied ways + concurrent fresh keys > ways), instead of
+      on any same-batch collision. (With the sketch cold tier on, a
+      dropped create is not over-admission at all: the sketch serves it
+      fail-closed — decide_presorted_sketch.)
+    """
+    writer, way, dropped, evicted = _writeback_plan(
+        cand, bkt, write_item, found, fway, eway, is_b_leader, b_end
+    )
     return (
-        data.at[bkt].add(drow, indices_are_sorted=True),
-        n_dropped,
-        n_evicted,
+        _writeback_apply(data, bkt, writer, way, new_vals, cand),
+        jnp.sum(dropped).astype(jnp.int32),
+        jnp.sum(evicted).astype(jnp.int32),
     )
 
 
@@ -393,6 +467,52 @@ def decide_presorted(
     now: jax.Array,
     groups: BatchGroups | None = None,
 ) -> Tuple[Store, BatchResponse, BatchStats]:
+    """Exact-only decide (the pre-r13 surface, unchanged semantics):
+    see _decide_presorted for the full caller contract."""
+    store, _sketch, resp, stats = _decide_presorted(
+        store, req, now, groups, None
+    )
+    return store, resp, stats
+
+
+def decide_presorted_sketch(
+    store: Store,
+    sketch: Sketch,
+    req: BatchRequest,
+    now: jax.Array,
+    groups: BatchGroups | None = None,
+) -> Tuple[Store, Sketch, BatchResponse, BatchStats]:
+    """Two-tier decide (r13): the exact slot store stays the heavy-
+    hitter tier with byte-identical semantics, and creates the exact
+    tier REFUSES are decided from the count-min cold tier instead —
+    both the way-exhaustion drops (the exact-only kernel's silent
+    over-admission case) and, under live-victim protection, creates
+    that would have EVICTED a resident key's live window (the
+    eviction-churn case that dominates at 100M-key pressure). Sketch
+    decisions are fixed-window token math over the window-keyed
+    conservative-update estimate (budget = max(limit - estimate, 0),
+    reset = window end, no store write); the estimate never
+    under-counts the hits the sketch was charged with, so tail-key
+    error is one-sided (fail-closed). Pure; jit with
+    donate_argnums=(0, 1).
+
+    Identity contract (pinned by tests/test_sketch_tier.py): a group
+    touching a LIVE exact entry produces bit-identical (store,
+    response) to decide_presorted, and with no tier pressure (no full
+    buckets) the whole pipeline is byte-identical ON vs OFF. Under
+    pressure, residency can only be BROADER with the tier on (live
+    entries are never churned by tail creates), and every divergent
+    response is at-least-as-restrictive."""
+    return _decide_presorted(store, req, now, groups, sketch)
+
+
+def _decide_presorted(
+    store: Store,
+    req: BatchRequest,
+    now: jax.Array,
+    groups: BatchGroups | None,
+    sketch: Sketch | None,
+) -> Tuple[Store, Sketch | None, BatchResponse, BatchStats]:
     """Evaluate one PRESORTED padded batch; responses come back in the
     same (sorted) order. `now` is int32 engine-ms. Pure; jit with
     donate_argnums=(0,).
@@ -557,6 +677,76 @@ def decide_presorted(
         existing, (g_flg & FLAG_STICKY_OVER) != 0, ~eff_leaky & over_c
     )
 
+    # ---- writeback plan + sketch cold tier (r13) --------------------------
+    # The writer/way/drop plan runs BEFORE response math so the sketch
+    # tier can absorb dropped creates: identical arithmetic to the old
+    # end-of-kernel position (inputs are all lookup-stage values).
+    w_mask = groups.valid & ~leaky_zero
+    ar_G = jnp.arange(G, dtype=jnp.int32)
+    is_b_leader_G = jnp.concatenate(
+        [jnp.array([True]), bkt[1:] != bkt[:-1]]
+    )
+    b_end_G = _segment_ends(is_b_leader_G, ar_G)
+    writer_G, way_G, dropped_G, evicted_G = _writeback_plan(
+        cand, bkt, w_mask, found, fway, eway, is_b_leader_G, b_end_G
+    )
+
+    existing0 = existing  # pre-override: GLOBAL replica serving below
+    sk_g = None
+    if sketch is not None:
+        # Live-victim protection: with the cold tier on, a create whose
+        # eviction victim is still LIVE goes to the sketch instead of
+        # wiping that victim's window — eviction churn (the dominant
+        # failure at 100M-key pressure: every tail create used to cost
+        # some resident key its state, over-admission on its next
+        # touch) becomes a fail-closed sketch decision. Dead/expired
+        # victims still recycle their ways exactly as before, and the
+        # PROMOTER remains the path by which a genuinely hot key claims
+        # a way in a full bucket (its install may evict — heat, not
+        # arrival order, decides residency). Exact-only mode
+        # (sketch=None) keeps the historical evict-on-create contract.
+        v_sel = cand[:, 0]
+        for w in range(1, cand.shape[1]):
+            v_sel = jnp.where((eway == w)[:, None], cand[:, w], v_sel)
+        victim_live = (v_sel[:, L_TAG] != 0) & (
+            v_sel[:, L_EXPIRE] >= now
+        )
+        sk_extra = evicted_G & victim_live
+        dropped_G = dropped_G | sk_extra
+        evicted_G = evicted_G & ~sk_extra
+        writer_G = writer_G & ~sk_extra
+
+        # Sketch-served groups = valid creates the exact tier refused
+        # (way exhaustion, or a live victim under protection). Their
+        # decision is FIXED-WINDOW token math over the window-keyed
+        # count-min estimate (core/sketches.py): budget at batch start
+        # = max(limit - estimate, 0), reset = the window's end, no
+        # sticky state, and leaky requests ride the same fixed window
+        # (a documented tail-only divergence — the sketch has no
+        # per-key timestamp to leak from). Estimates only over-count
+        # (conservative update + hash collisions), so refusal comes
+        # at-or-before the true budget: fail-closed.
+        sk_g = dropped_G
+        dur_pos = jnp.maximum(g_durQ, 1)
+        wid = now // dur_pos  # int32: engine now >= 0
+        window_end = (wid + 1) * dur_pos  # <= now + dur <= INT32_MAX
+        sk_est, sk_idx = _sketch_lookup(sketch, kh_G, wid)
+        est32 = jnp.minimum(sk_est, jnp.int64(_I32_MAX)).astype(
+            jnp.int32
+        )
+        # clamp the estimate into [0, max(limit, 0)] before the
+        # subtraction so R0 stays in int32 for any limit
+        est_c = jnp.minimum(est32, jnp.maximum(g_limQ, 0))
+        # sketch groups ride the "existing token window" machinery: no
+        # creation-leader special case, uniform cumulative charging
+        existing = existing | sk_g
+        eff_leaky = eff_leaky & ~sk_g
+        R0 = jnp.where(sk_g, jnp.maximum(g_limQ - est_c, 0), R0)
+        sticky0 = sticky0 & ~sk_g
+        g_exp = jnp.where(sk_g, window_end, g_exp)  # response reset
+        g_limS = jnp.where(sk_g, g_limQ, g_limS)  # params echo the
+        g_durS = jnp.where(sk_g, g_durQ, g_durS)  # request's
+
     # ---- bridge: group values needed per request, one stacked gather ------
     bridge = jnp.take(
         jnp.stack(
@@ -574,7 +764,10 @@ def decide_presorted(
                 g_durQ,
                 over_c.astype(jnp.int32),
                 leaky_zero.astype(jnp.int32),
-                (existing & ~stored_leaky).astype(jnp.int32),
+                # existing0, not existing: a sketch-served group is NOT
+                # a token replica — its gnp rows process as owned, the
+                # same contract as an exact-tier miss
+                (existing0 & ~stored_leaky).astype(jnp.int32),
                 charged_ldr.astype(jnp.int32),
                 g_hits,
             ],
@@ -671,6 +864,22 @@ def decide_presorted(
     )  # [G, 2]
     any_z = (ends[:, 4] - (z_lead[:, 0] - z_lead[:, 1])) > 0  # [G]
 
+    # ---- sketch conservative update at [G] --------------------------------
+    new_sketch = sketch
+    if sketch is not None:
+        # write max(counter, estimate + charged) into each row: only
+        # the counters that DEFINE the estimate grow (Count-Less-family
+        # discipline), so cross-key collision inflation is never
+        # compounded. Non-sketch and padding groups write 0, a no-op
+        # against non-negative counters. One narrow scatter-max per row.
+        upd = jnp.where(
+            sk_g, sk_est + total_charged.astype(jnp.int64), jnp.int64(0)
+        )
+        data_sk = sketch.data
+        for r in range(len(sk_idx)):
+            data_sk = data_sk.at[r, sk_idx[r]].max(upd)
+        new_sketch = Sketch(data=data_sk)
+
     # ---- responses --------------------------------------------------------
     st_cached = jnp.where(sticky_live, OVER, UNDER)
 
@@ -749,10 +958,9 @@ def decide_presorted(
     )
 
     # Groups served entirely from a replica write back identical values
-    # (harmless); invalid (padding / non-owned) and zero-guard groups skip
-    # the write.
-    w_mask = groups.valid & ~leaky_zero
-
+    # (harmless); invalid (padding / non-owned), zero-guard, and
+    # sketch-served groups skip the write (w_mask / the plan's dropped
+    # mask, computed above before the sketch overrides).
     new_vals = jnp.stack(
         [
             fp,
@@ -767,28 +975,12 @@ def decide_presorted(
         axis=-1,
     )  # [G, LANES]
 
-    # bucket segments over groups (>= 1 group each; groups sharing a
-    # bucket are adjacent because the order is bucket-major)
-    ar_G = jnp.arange(G, dtype=jnp.int32)
-    is_b_leader = jnp.concatenate(
-        [jnp.array([True]), bkt[1:] != bkt[:-1]]
-    )
-    b_end = _segment_ends(is_b_leader, ar_G)
-
-    # Delta-add writeback: each writing group adds (new - old) into its
-    # way's lanes; disjoint ways compose exactly and the store keeps its
-    # canonical shape (see _writeback_delta_add).
-    new_data, n_dropped, n_evicted = _writeback_delta_add(
-        store.data,
-        bkt,
-        w_mask,
-        found,
-        fway,
-        eway,
-        new_vals,
-        cand,
-        is_b_leader,
-        b_end,
+    # Delta-add writeback, phase 2 of the plan computed above: each
+    # writing group adds (new - old) into its way's lanes; disjoint
+    # ways compose exactly and the store keeps its canonical shape
+    # (see _writeback_delta_add).
+    new_data = _writeback_apply(
+        store.data, bkt, writer_G, way_G, new_vals, cand
     )
 
     resp = BatchResponse(
@@ -801,10 +993,13 @@ def decide_presorted(
         misses=jnp.sum(
             jnp.where(groups.valid & ~g_live, 1, 0)
         ).astype(jnp.int32),
-        dropped=n_dropped,
-        evictions=n_evicted,
+        # with the sketch tier on, `dropped` doubles as the
+        # sketch-served group count: every dropped create IS a
+        # sketch-tier decision (fail-closed), not silent over-admission
+        dropped=jnp.sum(dropped_G).astype(jnp.int32),
+        evictions=jnp.sum(evicted_G).astype(jnp.int32),
     )
-    return Store(data=new_data), resp, stats
+    return Store(data=new_data), new_sketch, resp, stats
 
 
 def decide(

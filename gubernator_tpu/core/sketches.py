@@ -1,4 +1,5 @@
-"""Traffic sketches: HyperLogLog cardinality + Space-Saving heavy hitters.
+"""Traffic sketches: HyperLogLog cardinality + Space-Saving heavy hitters +
+the device count-min tier (r13).
 
 The reference has no analogue — its LRU cache caps state at 50k entries and
 offers no visibility into key-space size or hot keys (reference
@@ -18,16 +19,152 @@ here:
   count > N/capacity is tracked, with overestimate bounded by `err`.
 
 Both feed /metrics gauges and the /v1/debug endpoints (serve/server.py).
+
+The sketch tier (r13)
+---------------------
+`SketchConfig` + the `Sketch` device state below back the approximate
+cold tier of the two-tier store (core/kernels.py decide_presorted's
+`sketch` argument): a count-min sketch of `rows` independent hash rows x
+`width` dense int64 counters living next to the slot store in device
+memory. The exact slot store remains the heavy-hitter tier; the sketch
+absorbs the long tail — every create the exact tier DROPS to way
+exhaustion is decided from the sketch's window-keyed estimate instead of
+being silently over-admitted (the pre-r13 contract).
+
+Design choices, mapped to PAPERS.md:
+
+- **Conservative update** ("Count-Less"-family discipline): an update
+  writes `max(counter, min_estimate + charged)` into each row instead of
+  incrementing all rows, so only the counters that define the estimate
+  grow — tail overestimates stay bounded without any per-update minimum
+  scan beyond the gather the estimate already pays. The per-batch shape
+  is exactly what this engine batches anyway: one [G]-gather per row +
+  one scatter-max per row at unique-key granularity.
+- **Window-keyed counting** (fixed-window approximation): the sketch
+  index mixes the key hash with `window_id = now // duration`, so
+  counts reset implicitly at window boundaries — no per-key reset state
+  anywhere. Tail keys therefore get FIXED-WINDOW token semantics with a
+  one-sided error: the estimate never under-counts the hits the sketch
+  was charged with (collisions only inflate), so refusal comes at-or-
+  before the true budget — fail-closed, matching the shed cache's
+  stance.
+- **int64 counters**: the store is int32 (TPU-native), but sketch
+  counters take collision inflation from the whole tail; int64 makes
+  overflow structurally impossible for the cost of one narrow gather +
+  scatter per row — noise next to the store's full-table writeback.
+
+`sketch_indices_np` is the host twin of the device indexing in
+core/kernels.py; the two MUST stay bit-identical (pinned by
+tests/test_sketch_tier.py) — the promoter and the error-bound property
+tests read estimates host-side for windows the device charged.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, List, Tuple
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 _ALPHA_INF = 0.721347520444482  # 1 / (2 ln 2)
+
+# -- the device sketch tier (r13) -------------------------------------------
+
+#: per-row index salts (splitmix64-style odd constants); supports up to
+#: 8 rows. Device and host indexing share these — see sketch_indices_np.
+SKETCH_SALTS = (
+    0x9AE16A3B2F90404F,
+    0xC2B2AE3D27D4EB4F,
+    0x165667B19E3779F9,
+    0x27D4EB2F165667C5,
+    0x85EBCA6B27D4EB4F,
+    0xFF51AFD7ED558CCD,
+    0xC4CEB9FE1A85EC53,
+    0x2545F4914F6CDD1D,
+)
+
+#: window-id mix multiplier: decorrelates the same key's indices across
+#: consecutive windows so a hot key's collision set rotates per window
+WINDOW_MIX = 0xD6E8FEB86659FD93
+
+SKETCH_BYTES_PER_COUNTER = 8  # dense int64 rows
+
+
+@dataclass(frozen=True)
+class SketchConfig:
+    """Count-min tier geometry: `rows` independent hash rows of `width`
+    int64 counters each. Error bound (classic CM, conservative update
+    only tightens it): with N charged sketch-tier hits in a window,
+    P[estimate - true > e*N/width] < e^-rows."""
+
+    rows: int = 4
+    width: int = 1 << 19  # 16 MiB at rows=4
+
+    def __post_init__(self):
+        assert 1 <= self.rows <= len(SKETCH_SALTS), (
+            f"sketch rows must be 1..{len(SKETCH_SALTS)}"
+        )
+        assert self.width > 0 and (self.width & (self.width - 1)) == 0, (
+            "sketch width must be a power of two"
+        )
+
+
+def sketch_footprint_bytes(config: SketchConfig) -> int:
+    return config.rows * config.width * SKETCH_BYTES_PER_COUNTER
+
+
+def derive_sketch_config(mib: int, rows: int = 4) -> SketchConfig:
+    """Largest power-of-two width whose rows x width x 8B footprint fits
+    in `mib` MiB — the sketch sibling of store.derive_store_config."""
+    if mib <= 0:
+        raise ValueError("sketch budget must be positive MiB")
+    counters = (mib << 20) // (rows * SKETCH_BYTES_PER_COUNTER)
+    if counters < 1:
+        raise ValueError(
+            f"sketch budget {mib} MiB holds no counters at {rows} rows"
+        )
+    width = 1 << (counters.bit_length() - 1)
+    return SketchConfig(rows=rows, width=width)
+
+
+def new_sketch(config: SketchConfig):
+    """Fresh zeroed device sketch (kernels.Sketch). Lazy jax import:
+    this module's host-side classes must stay importable without
+    touching the device runtime."""
+    import jax.numpy as jnp
+
+    from gubernator_tpu.core.kernels import Sketch
+
+    return Sketch(data=jnp.zeros((config.rows, config.width), jnp.int64))
+
+
+def window_id_np(engine_now: int, durations: np.ndarray) -> np.ndarray:
+    """Fixed-window id per request: engine-ms `now` // duration (floored
+    at 1ms so a zero/negative duration cannot divide by zero — such
+    requests never reach the sketch anyway)."""
+    d = np.maximum(np.asarray(durations, np.int64), 1)
+    return np.asarray(engine_now, np.int64) // d
+
+
+def sketch_indices_np(
+    key_hash: np.ndarray, window_id: np.ndarray, config: SketchConfig
+) -> np.ndarray:
+    """int64[rows, n] counter index per (key, window) — the host twin of
+    the device indexing in core/kernels.py (bit-identical, test-pinned).
+    One mix binds the window id into the key hash; per-row salts then
+    derive independent indices."""
+    from gubernator_tpu.core import hashing
+
+    kh = np.asarray(key_hash, np.uint64)
+    wid = np.asarray(window_id, np.uint64)
+    base = hashing.mix64(kh ^ (wid * np.uint64(WINDOW_MIX)))
+    out = np.empty((config.rows, kh.shape[0]), np.int64)
+    mask = np.uint64(config.width - 1)
+    for r in range(config.rows):
+        hr = hashing.mix64(base ^ np.uint64(SKETCH_SALTS[r]))
+        out[r] = (hr & mask).astype(np.int64)
+    return out
 
 
 def _popcount64(x: np.ndarray) -> np.ndarray:
@@ -120,6 +257,10 @@ class SpaceSaving:
         self.capacity = capacity
         self._counts: Dict[str, int] = {}
         self._errs: Dict[str, int] = {}
+        # optional per-key payload (the sketch promoter stores the
+        # candidate's last-seen (limit, duration) here); evicted with
+        # its key, so bounded by `capacity`
+        self._payload: Dict = {}
         self.total = 0
         self._lock = threading.Lock()
 
@@ -129,21 +270,94 @@ class SpaceSaving:
         agg: Dict[str, int] = {}
         for k in keys:
             agg[k] = agg.get(k, 0) + 1
+        self.observe_weighted(agg)
+
+    def observe_weighted(
+        self, agg: Dict, payloads: Optional[Dict] = None
+    ) -> None:
+        """Fold a pre-aggregated {key: weight} batch in (keys may be any
+        hashable — the sketch promoter uses uint64 key-hash ints).
+        `payloads` optionally records a per-key payload for keys that
+        end up tracked (last write wins).
+
+        Replacement runs as a HEAP cascade — one heapify per call plus
+        O(log capacity) per evicting insert — instead of the historical
+        O(capacity) min-scan per new key, which measured 10x of serving
+        throughput away once the r13 promoter hook started folding
+        dispatch-sized batches on the submit thread. Semantics are the
+        classic per-item cascade's: each new key replaces the CURRENT
+        minimum (which may be a key inserted earlier in this same
+        call) and inherits its count as the error floor, so an
+        established heavy hitter can never be displaced by a flood of
+        singletons — the floor only creeps up one weight at a time."""
+        if not agg:
+            return
         with self._lock:
-            self.total += len(keys)
+            self.total += sum(agg.values())
             counts, errs = self._counts, self._errs
+            new = []
             for k, w in agg.items():
                 if k in counts:
                     counts[k] += w
-                elif len(counts) < self.capacity:
-                    counts[k] = w
-                    errs[k] = 0
+                    if payloads is not None and k in payloads:
+                        self._payload[k] = payloads[k]
                 else:
-                    victim = min(counts, key=counts.__getitem__)
-                    floor = counts.pop(victim)
-                    errs.pop(victim, None)
+                    new.append((k, w))
+            i = 0
+            while i < len(new) and len(counts) < self.capacity:
+                k, w = new[i]
+                counts[k] = w
+                errs[k] = 0
+                if payloads is not None and k in payloads:
+                    self._payload[k] = payloads[k]
+                i += 1
+            if i < len(new):
+                import heapq
+
+                # counts are final for surviving keys at this point, so
+                # the heap has exactly one live entry per key; cascade
+                # insertions push their own entries back (they may be
+                # re-evicted by later new keys, exactly like the
+                # per-item original)
+                heap = [(c, k) for k, c in counts.items()]
+                heapq.heapify(heap)
+                for k, w in new[i:]:
+                    while True:
+                        floor, vk = heapq.heappop(heap)
+                        if counts.get(vk) == floor:
+                            break  # live entry (defensive: see above)
+                    del counts[vk]
+                    errs.pop(vk, None)
+                    self._payload.pop(vk, None)
                     counts[k] = floor + w
                     errs[k] = floor
+                    heapq.heappush(heap, (floor + w, k))
+                    if payloads is not None and k in payloads:
+                        self._payload[k] = payloads[k]
+
+    def payload(self, key):
+        with self._lock:
+            return self._payload.get(key)
+
+    def decay(self, shift: int = 1) -> None:
+        """Halve (>> shift) every tracked count/err — the streaming
+        demotion half of the promoter: without decay a formerly-hot key
+        rides its historical count forever and the top-K can never turn
+        over under churn. Keys decayed to zero are dropped entirely
+        (full demotion)."""
+        with self._lock:
+            dead = []
+            for k in self._counts:
+                c = self._counts[k] >> shift
+                if c <= 0:
+                    dead.append(k)
+                else:
+                    self._counts[k] = c
+                    self._errs[k] = self._errs.get(k, 0) >> shift
+            for k in dead:
+                del self._counts[k]
+                self._errs.pop(k, None)
+                self._payload.pop(k, None)
 
     def top(self, n: int = 20) -> List[Tuple[str, int, int]]:
         """[(key, count, err)] sorted hot-first. count-err is a lower
@@ -154,10 +368,23 @@ class SpaceSaving:
             )[:n]
             return [(k, c, self._errs.get(k, 0)) for k, c in items]
 
+    def top_with_payload(self, n: int = 20) -> List[Tuple]:
+        """[(key, count, err, payload)] sorted hot-first; payload is
+        None for keys observed without one."""
+        with self._lock:
+            items = sorted(
+                self._counts.items(), key=lambda kv: kv[1], reverse=True
+            )[:n]
+            return [
+                (k, c, self._errs.get(k, 0), self._payload.get(k))
+                for k, c in items
+            ]
+
     def reset(self) -> None:
         with self._lock:
             self._counts.clear()
             self._errs.clear()
+            self._payload.clear()
             self.total = 0
 
 

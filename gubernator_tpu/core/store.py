@@ -234,12 +234,19 @@ def derive_store_config(
     return StoreConfig(rows=rows, slots=slots)
 
 
-def check_store_budget(config: StoreConfig, target_keys: int) -> str:
+def check_store_budget(
+    config: StoreConfig, target_keys: int, cold_tier: bool = False
+) -> str:
     """Footprint-vs-key-budget lint for boot time. Returns '' when the
     provisioned shape suits `target_keys` live keys, else a one-line
     diagnosis (caller decides warn vs fail): oversized tables pay the
     footprint≍throughput law for nothing; undersized ones over-admit
-    under eviction pressure."""
+    under eviction pressure.
+
+    `cold_tier=True` (the r13 sketch tier is active): an "undersized"
+    exact tier is the DESIGN, not a misconfiguration — keys past the
+    eviction ceiling overflow to the count-min tier fail-closed instead
+    of over-admitting, so only the oversize lint fires."""
     if target_keys <= 0:
         return ""
     cap = store_capacity(config)
@@ -254,6 +261,8 @@ def check_store_budget(config: StoreConfig, target_keys: int) -> str:
             f"(~{derive_store_config(target_keys=target_keys, rows=config.rows).slots} slots) "
             f"or accept the throughput cost explicitly"
         )
+    if cold_tier:
+        return ""
     if target_keys > cap * MAX_LOAD:
         return (
             f"store is undersized for the key budget: {target_keys} live "
@@ -263,6 +272,32 @@ def check_store_budget(config: StoreConfig, target_keys: int) -> str:
             f"GUBER_STORE_MIB"
         )
     return ""
+
+
+def check_host_budget(budget_mib: int, parts: dict) -> str:
+    """Whole-host footprint lint (r13): does EVERYTHING the budget is
+    supposed to cover actually fit? `parts` maps tier name -> bytes
+    (exact store, sketch rows, shed cache, replication standby).
+    Returns '' when the sum fits `budget_mib`, else a one-line
+    diagnosis — "1 GiB budget" must mean the whole host's rate-limit
+    state, not just the exact tier (caller decides warn vs fail via
+    GUBER_STORE_SIZE_STRICT)."""
+    if budget_mib <= 0:
+        return ""
+    total = sum(parts.values())
+    if total <= (budget_mib << 20):
+        return ""
+    detail = " + ".join(
+        f"{k} {v / (1 << 20):.1f} MiB" for k, v in parts.items()
+    )
+    return (
+        f"declared GUBER_STORE_MIB={budget_mib} is exceeded by the "
+        f"full rate-limit-state footprint: {detail} = "
+        f"{total / (1 << 20):.1f} MiB — the budget covers exact tier "
+        f"+ sketch tier + shed cache + replication standby; shrink "
+        f"one (GUBER_SKETCH_MIB / GUBER_SHED_CACHE_KEYS / "
+        f"GUBER_REPLICATION_STANDBY_KEYS) or raise the budget"
+    )
 
 
 def new_store(config: StoreConfig = StoreConfig()) -> Store:

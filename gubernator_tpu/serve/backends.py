@@ -227,6 +227,34 @@ class _ArrayOps:
         a clock-jump store reset can never leave stale host verdicts."""
         return self.engine.reset_generation
 
+    # -- sketch cold tier (r13) ---------------------------------------------
+
+    @property
+    def sketch_enabled(self) -> bool:
+        """True when the engine carries the count-min cold tier — the
+        gate for the serve-tier promoter (serve/promoter.py)."""
+        return getattr(self.engine, "sketch", None) is not None
+
+    def set_hot_observer(self, fn) -> None:
+        """Attach the promoter's per-dispatch hot-key observer (called
+        with every numpy BatchRequest the engine dispatches; None
+        detaches)."""
+        self.engine.observe_hook = fn
+
+    def promote_hashes(self, key_hash, limits, durations, now=None):
+        """Migrate hot sketch-tier keys into exact buckets
+        (core/engine.py promote_from_sketch). MUST run on the batcher's
+        submit thread (DeviceBatcher.run_serialized): reads and upserts
+        the donated store."""
+        return self.engine.promote_from_sketch(
+            key_hash, limits, durations, now
+        )
+
+    def sketch_estimates(self, key_hash, durations, now=None):
+        """Current-window count-min estimates (non-mutating; submit-
+        thread contract like snapshot_read)."""
+        return self.engine.sketch_estimates(key_hash, durations, now)
+
 
 class TpuBackend(_ArrayOps):
     """Single-chip slot-store backend."""
@@ -235,8 +263,9 @@ class TpuBackend(_ArrayOps):
         self,
         store: StoreConfig = StoreConfig(),
         buckets: Sequence[int] = (64, 256, 1024, 4096),
+        sketch=None,
     ):
-        self.engine = TpuEngine(store, buckets=buckets)
+        self.engine = TpuEngine(store, buckets=buckets, sketch=sketch)
 
     def decide(self, reqs, gnp, now=None):
         return self.engine.get_rate_limits(reqs, now=now, gnp=list(gnp))
@@ -307,6 +336,11 @@ class MeshBackend(_ArrayOps):
             # Instance refuses GUBER_REPLICATION=1 on such backends at
             # boot instead of failing at the first flush
             self.snapshot_read = None
+
+    # the sharded engines don't carry the count-min cold tier (r13 scope
+    # limit: sketch rows sharded over mesh axes is ROADMAP item 2's
+    # follow-on) — the promoter stays off and GUBER_SKETCH is inert here
+    sketch_enabled = False
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now

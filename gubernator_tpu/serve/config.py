@@ -259,6 +259,33 @@ class ServerConfig:
     # at boot like the store sizing pass).
     shed_cache: bool = True
     shed_cache_keys: int = 1 << 16
+    # Sketch cold tier (r13, core/sketches.py + serve/promoter.py;
+    # GUBER_SKETCH, default ON): a window-keyed count-min sketch of
+    # dense int64 device rows absorbs every create the exact slot store
+    # DROPS to way exhaustion — the silent-over-admission case of the
+    # exact-only store becomes a fail-closed fixed-window decision with
+    # a one-sided (overestimate-only) error bound, which is what lets a
+    # fixed 1 GiB footprint serve ~100M-key cardinality (zipf100m
+    # bench, BENCH_SKETCH_r13.json). A streaming SpaceSaving promoter
+    # migrates hot sketch keys into exact buckets every
+    # GUBER_SKETCH_SYNC_WAIT_MS and feeds over-limit candidates to the
+    # r10 shed cache. tpu backend only (mesh/multihost: inert, a
+    # documented scope limit). With no exact-tier pressure (no dropped
+    # creates), ON is byte-identical to OFF (tests/test_sketch_tier.py).
+    sketch: bool = True
+    # Sketch footprint budget in MiB. 0 = auto: a quarter of
+    # GUBER_STORE_MIB (capped at 256) when the store budget is pinned —
+    # so "GUBER_STORE_MIB=1024" means 1 GiB for BOTH tiers — else
+    # 16 MiB. The exact tier's derivation subtracts this from
+    # GUBER_STORE_MIB (store_config()).
+    sketch_mib: int = 0
+    # Count-min rows (independent hash rows; error confidence
+    # ~1 - e^-rows at overestimate bound e*N/width per window).
+    sketch_rows: int = 4
+    # Promoter flush tick: candidate scan + promotion install cadence.
+    sketch_sync_wait: float = 0.2  # GUBER_SKETCH_SYNC_WAIT_MS
+    # Top-K candidates screened per tick (SpaceSaving tracks 4x this).
+    sketch_topk: int = 512
     # Bucket replication (r11, serve/replication.py; GUBER_REPLICATION=1
     # to enable, OFF by default): owned bucket windows are snapshot-read
     # (non-mutating) every replication_sync_wait and shipped to each
@@ -325,6 +352,32 @@ class ServerConfig:
     def resolved_advertise(self) -> str:
         return self.advertise_address or self.grpc_address
 
+    def sketch_config(self):
+        """Resolve the count-min cold-tier geometry (r13) — None when
+        the tier is off or the backend can't carry it (single-chip
+        `tpu` only; the sharded engines are a documented scope limit).
+        Auto sizing (GUBER_SKETCH_MIB=0): a quarter of GUBER_STORE_MIB
+        capped at 256 MiB when the store budget is pinned, else
+        16 MiB. A pinned budget too small to carve a quarter from
+        (< 4 MiB) auto-DISABLES the tier rather than failing the boot:
+        pre-r13 tiny-budget configs must keep booting, and the hard
+        "sketch consumes the whole budget" refusal is reserved for an
+        EXPLICIT GUBER_SKETCH_MIB (the operator's own oversubscription,
+        store_config())."""
+        if not self.sketch or self.backend != "tpu":
+            return None
+        from gubernator_tpu.core.sketches import derive_sketch_config
+
+        mib = self.sketch_mib
+        if mib <= 0:
+            if self.store_mib > 0:
+                mib = min(256, self.store_mib // 4)
+                if mib < 1:
+                    return None  # no room: exact-only, like pre-r13
+            else:
+                mib = 16
+        return derive_sketch_config(mib=mib, rows=self.sketch_rows)
+
     def store_config(self, logger=None):
         """Resolve the final slot-store geometry (core.store.StoreConfig)
         from the sizing knobs, and run the boot-time footprint lint when
@@ -336,7 +389,13 @@ class ServerConfig:
         shapes derived from target_keys alone (right-sized by
         construction); it fires when an explicit or MiB-pinned
         footprint disagrees with the declared key budget — warning by
-        default, hard failure under GUBER_STORE_SIZE_STRICT."""
+        default, hard failure under GUBER_STORE_SIZE_STRICT.
+
+        With the sketch tier active (r13), GUBER_STORE_MIB is the
+        budget for BOTH tiers: the sketch's resolved footprint is
+        carved out first and the exact tier derives from the
+        remainder, so "1 GiB" means 1 GiB of device state, not 1 GiB
+        plus a sketch."""
         from gubernator_tpu.core.store import (
             StoreConfig,
             check_store_budget,
@@ -351,10 +410,28 @@ class ServerConfig:
             != type(self).__dataclass_fields__["store_slots"].default
         )
         if self.store_mib > 0:
+            exact_mib = self.store_mib
+            skc = self.sketch_config()
+            if skc is not None:
+                from gubernator_tpu.core.sketches import (
+                    sketch_footprint_bytes,
+                )
+
+                sk_mib = -(-sketch_footprint_bytes(skc) // (1 << 20))
+                exact_mib = self.store_mib - sk_mib
+                if exact_mib <= 0:
+                    raise ValueError(
+                        f"GUBER_SKETCH_MIB ({sk_mib} MiB resolved) "
+                        f"consumes the whole GUBER_STORE_MIB="
+                        f"{self.store_mib} budget; leave room for the "
+                        f"exact tier or lower the sketch budget"
+                    )
             store = derive_store_config(
-                mib=self.store_mib, rows=self.store_rows
+                mib=exact_mib, rows=self.store_rows
             )
-            lint = check_store_budget(store, self.store_target_keys)
+            lint = check_store_budget(
+                store, self.store_target_keys, cold_tier=skc is not None
+            )
         elif self.store_target_keys > 0 and not slots_pinned:
             store = derive_store_config(
                 target_keys=self.store_target_keys, rows=self.store_rows
@@ -364,7 +441,11 @@ class ServerConfig:
             store = StoreConfig(
                 rows=self.store_rows, slots=self.store_slots
             )
-            lint = check_store_budget(store, self.store_target_keys)
+            lint = check_store_budget(
+                store,
+                self.store_target_keys,
+                cold_tier=self.sketch_config() is not None,
+            )
         if lint:
             if self.store_size_strict:
                 raise ValueError(f"GUBER_STORE_SIZE_STRICT: {lint}")
@@ -415,6 +496,14 @@ class ServerConfig:
             raise ValueError("GUBER_PREP_THREADS must be >= 0")
         if self.shed_cache_keys < 0:
             raise ValueError("GUBER_SHED_CACHE_KEYS must be >= 0")
+        if self.sketch_mib < 0:
+            raise ValueError("GUBER_SKETCH_MIB must be >= 0")
+        if not (1 <= self.sketch_rows <= 8):
+            raise ValueError("GUBER_SKETCH_ROWS must be in 1..8")
+        if self.sketch_sync_wait < 0:
+            raise ValueError("GUBER_SKETCH_SYNC_WAIT_MS must be >= 0")
+        if self.sketch_topk < 1:
+            raise ValueError("GUBER_SKETCH_TOPK must be >= 1")
         if self.replication_sync_wait < 0:
             raise ValueError("GUBER_REPLICATION_SYNC_WAIT_MS must be >= 0")
         if self.replication_standby_keys < 1 or self.replication_backlog < 1:
@@ -589,6 +678,14 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         shed_cache=_get(env, "GUBER_SHED_CACHE", "1").lower()
         not in ("0", "false", "no", "off"),
         shed_cache_keys=_get_int(env, "GUBER_SHED_CACHE_KEYS", 1 << 16),
+        sketch=_get(env, "GUBER_SKETCH", "1").lower()
+        not in ("0", "false", "no", "off"),
+        sketch_mib=_get_int(env, "GUBER_SKETCH_MIB", 0),
+        sketch_rows=_get_int(env, "GUBER_SKETCH_ROWS", 4),
+        sketch_sync_wait=_get_float_ms(
+            env, "GUBER_SKETCH_SYNC_WAIT_MS", 0.2
+        ),
+        sketch_topk=_get_int(env, "GUBER_SKETCH_TOPK", 512),
         replication=_get(env, "GUBER_REPLICATION") in ("1", "true", "yes"),
         replication_sync_wait=_get_float_ms(
             env, "GUBER_REPLICATION_SYNC_WAIT_MS", 0.1

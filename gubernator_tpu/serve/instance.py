@@ -107,14 +107,31 @@ class Instance:
             self.repl = ReplicationManager(conf, self)
         else:
             self.repl = None
+        # sketch-tier promoter (r13, serve/promoter.py): streaming
+        # SpaceSaving top-K over dispatched key hashes; hot sketch-tier
+        # keys migrate into exact buckets on a flush-tick cadence, and
+        # over-limit candidates seed the shed cache. Only constructed
+        # when the backend actually carries the count-min tier.
+        if getattr(conf, "sketch", False) and getattr(
+            backend, "sketch_enabled", False
+        ):
+            from gubernator_tpu.serve.promoter import SketchPromoter
+
+            self.promoter = SketchPromoter(conf, self)
+        else:
+            self.promoter = None
 
     def start(self) -> None:
         self.batcher.start()
         self.global_mgr.start()
         if self.repl is not None:
             self.repl.start()
+        if self.promoter is not None:
+            self.promoter.start()
 
     async def stop(self) -> None:
+        if self.promoter is not None:
+            await self.promoter.stop()
         if self.repl is not None:
             await self.repl.stop()
         await self.global_mgr.stop()

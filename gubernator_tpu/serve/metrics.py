@@ -232,6 +232,28 @@ REPLICATION_DROPPED = Counter(
     ["what"],
     registry=REGISTRY,
 )
+SKETCH_PROMOTIONS = Counter(
+    "sketch_promotions_total",
+    "Hot sketch-tier keys migrated into exact-tier buckets by the "
+    "streaming promoter (GUBER_SKETCH=1, serve/promoter.py): the "
+    "window continues from the count-min estimate instead of the tail "
+    "tier's approximate math",
+    registry=REGISTRY,
+)
+SKETCH_DEMOTIONS = Counter(
+    "sketch_demotions_total",
+    "Promoted keys released by the promoter (their installed window "
+    "expired, or their count decayed out of the top-K candidate set); "
+    "the key falls back to the sketch tier on its next window",
+    registry=REGISTRY,
+)
+SKETCH_SHED_SEEDS = Counter(
+    "sketch_shed_seeds_total",
+    "Over-limit hot candidates the promoter seeded straight into the "
+    "r10 shed cache (estimate >= limit at promotion time): their "
+    "refusals answer host-side without a device trip",
+    registry=REGISTRY,
+)
 DRAIN_DURATION = Gauge(
     "drain_duration_seconds",
     "Wall time of the last graceful drain (SIGTERM: deregister, refuse "

@@ -1,0 +1,264 @@
+"""Streaming heavy-hitter promoter/demoter for the sketch cold tier (r13).
+
+The two-tier store (core/kernels.py decide_presorted_sketch) splits keys
+by exact-tier residency: keys holding a slot decide exactly, dropped
+creates decide from the count-min estimate. Residency, though, is won by
+ARRIVAL ORDER (first keys into a bucket keep their ways until expiry) —
+not by heat. This module closes that loop, the top-K flow-detection
+design from PAPERS.md ("A streaming algorithm and hardware accelerator
+for top-K flow detection") mapped onto the serving tier:
+
+- **candidate source** — the shipped SpaceSaving top-K summary
+  (core/sketches.py), fed uint64 key hashes (not strings: the hot paths
+  — edge frames, GEB fast framing, the zipf benches — never materialize
+  key strings) from a rate-limited per-dispatch observer hook on the
+  engine's one dispatch funnel, so every door's traffic is seen. The
+  observed payload carries each candidate's last-seen (limit, duration),
+  the params a promotion needs.
+- **promotion** — on a flush-tick cadence (GUBER_SKETCH_SYNC_WAIT_MS),
+  top candidates not already exact-resident are migrated: the engine
+  reads their current-window sketch estimate and installs a token window
+  with remaining = max(limit - estimate, 0), reset = the window's end
+  (core/engine.py promote_from_sketch) — the key then decides EXACTLY
+  for the rest of its window and recreates exactly (byte-identical to a
+  fresh key) in the next. Installs ride DeviceBatcher.run_serialized,
+  the same submit-thread funnel replication's snapshot reads use, so
+  they can never race a store-donating dispatch.
+- **shed feed** — candidates promoted at estimate >= limit land in the
+  store as frozen over-limit windows; their verdicts are seeded straight
+  into the r10 shed cache (serve/shedcache.py seed), so the hottest
+  refused keys answer host-side without even the first device trip.
+- **demotion** — streaming and lazy: tracked promotions are released
+  when their installed window expires (the exact entry dies naturally
+  and the key's next window starts wherever it lands), and the
+  SpaceSaving counts DECAY geometrically every few ticks so a formerly
+  hot key cannot ride its history — under adversarial key churn the
+  candidate set turns over instead of ossifying, and the bounded
+  SpaceSaving capacity caps promoter memory regardless of key
+  cardinality.
+
+With no exact-tier pressure (no dropped creates) the promoter never
+fires — every candidate is already resident — which is what keeps
+GUBER_SKETCH=1 byte-identical to =0 on under-capacity stores
+(tests/test_sketch_tier.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Dict
+
+import numpy as np
+
+# NOTE: time is read through the module attribute (api.types
+# .millisecond_now), never a from-import: tests pin the serving clock
+# by patching that attribute, and a module-level from-import would
+# freeze whichever clock was live when this module first loaded
+from gubernator_tpu.api import types as api_types
+from gubernator_tpu.core.sketches import SpaceSaving
+from gubernator_tpu.serve import metrics
+
+log = logging.getLogger("gubernator_tpu.promoter")
+
+#: decay the SpaceSaving counts (halving) every this many flush ticks —
+#: the turnover half of demotion; small enough that a churned-away key
+#: falls out of the top-K within ~a dozen ticks
+DECAY_EVERY_TICKS = 8
+
+#: observer sampling floor: at most one SpaceSaving fold per this many
+#: seconds, so the per-dispatch hook costs one monotonic read in the
+#: steady state no matter how hot the submit thread runs
+OBSERVE_MIN_INTERVAL_S = 0.1
+
+#: heaviest distinct keys folded per sampled batch: the fold runs ON
+#: the submit thread, so its cost must stay bounded regardless of
+#: batch cardinality — and sampling the per-batch HEAD loses nothing,
+#: a heavy hitter that can't make a batch's top slice isn't one
+OBSERVE_TOP = 128
+
+
+class HotTracker:
+    """Rate-limited SpaceSaving front-end over dispatched batches.
+
+    observe() runs on the engine's submit thread (the dispatch funnel);
+    SpaceSaving is lock-protected, and the numpy pre-aggregation is one
+    np.unique over the batch's valid token rows — paid at most every
+    OBSERVE_MIN_INTERVAL_S."""
+
+    def __init__(self, capacity: int):
+        self.ss = SpaceSaving(capacity)
+        self._next = 0.0
+
+    def observe(self, req) -> None:
+        now = time.monotonic()
+        if now < self._next:
+            return
+        self._next = now + OBSERVE_MIN_INTERVAL_S
+        valid = np.asarray(req.valid, bool)
+        algo = np.asarray(req.algo)
+        hits = np.asarray(req.hits)
+        # token-bucket, hit-carrying rows only: promotion installs token
+        # windows (core/engine.py install_windows), and peeks say
+        # nothing about heat
+        mask = valid & (algo == 0) & (hits > 0)
+        if not mask.any():
+            return
+        kh = np.asarray(req.key_hash, np.uint64)[mask]
+        uk, first, counts = np.unique(
+            kh, return_index=True, return_counts=True
+        )
+        if uk.shape[0] > OBSERVE_TOP:
+            top = np.argpartition(counts, -OBSERVE_TOP)[-OBSERVE_TOP:]
+            uk, first, counts = uk[top], first[top], counts[top]
+        lim = np.asarray(req.limit, np.int64)[mask][first]
+        dur = np.asarray(req.duration, np.int64)[mask][first]
+        agg = {}
+        payloads = {}
+        for i in range(uk.shape[0]):
+            k = int(uk[i])
+            agg[k] = int(counts[i])
+            payloads[k] = (int(lim[i]), int(dur[i]))
+        self.ss.observe_weighted(agg, payloads)
+
+
+class SketchPromoter:
+    """Owns the promote/demote flush loop for one Instance."""
+
+    def __init__(self, conf, instance):
+        self.inst = instance
+        self.backend = instance.backend
+        self.tick = getattr(conf, "sketch_sync_wait", 0.2)
+        self.topk = max(1, getattr(conf, "sketch_topk", 512))
+        # track more candidates than we promote per tick so the top-K
+        # is stable under SpaceSaving's overestimate churn
+        self.tracker = HotTracker(capacity=4 * self.topk)
+        #: promoted key hash -> installed window's reset_time (unix ms);
+        #: released lazily at expiry (the demote half) and HARD-bounded
+        #: at 32x topk — long windows under churn would otherwise grow
+        #: this by up to topk per tick for the whole window (measured
+        #: 30k entries in one zipf100m bench run); past the cap the
+        #: earliest-reset entries release first (they were closest to
+        #: demotion anyway; a released-but-hot key simply re-screens)
+        self._promoted: Dict[int, int] = {}
+        self._promoted_cap = 32 * self.topk
+        self.promotions = 0
+        self.demotions = 0
+        self.shed_seeds = 0
+        self._tasks: list = []
+        self._ticks = 0
+
+    # -- lifecycle (the ReplicationManager shape) ---------------------------
+
+    def start(self) -> None:
+        if not self._tasks:
+            from gubernator_tpu.serve.global_mgr import supervise
+
+            self.backend.set_hot_observer(self.tracker.observe)
+            self._tasks = [
+                asyncio.ensure_future(
+                    supervise("sketch_promoter", self._run)
+                )
+            ]
+
+    async def stop(self) -> None:
+        self.backend.set_hot_observer(None)
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except asyncio.CancelledError:
+                pass
+        self._tasks = []
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.tick)
+            await self.flush_once()
+
+    # -- the flush tick ------------------------------------------------------
+
+    async def flush_once(self) -> None:
+        now = api_types.millisecond_now()
+        # demote: release promotions whose installed window expired —
+        # the exact entry is dead (lazy expiry) and the key's next
+        # window starts wherever the tiers put it
+        expired = [h for h, r in self._promoted.items() if now >= r]
+        for h in expired:
+            del self._promoted[h]
+        released = len(expired)
+        over = len(self._promoted) - self._promoted_cap
+        if over > 0:
+            import heapq
+
+            for h, _r in heapq.nsmallest(
+                over, self._promoted.items(), key=lambda kv: kv[1]
+            ):
+                del self._promoted[h]
+            released += over
+        if released:
+            self.demotions += released
+            try:
+                metrics.SKETCH_DEMOTIONS.inc(released)
+            except Exception:  # pragma: no cover - defensive
+                pass
+        self._ticks += 1
+        if self._ticks % DECAY_EVERY_TICKS == 0:
+            self.tracker.ss.decay()
+
+        cands = self.tracker.ss.top_with_payload(self.topk)
+        todo = [
+            (k, p[0], p[1])
+            for k, _c, _e, p in cands
+            if p is not None and k not in self._promoted
+        ]
+        if not todo:
+            return
+        kh = np.array([k for k, _, _ in todo], np.uint64)
+        lims = np.array([l for _, l, _ in todo], np.int64)
+        durs = np.array([d for _, _, d in todo], np.int64)
+        try:
+            installed, est, reset, over = (
+                await self.inst.batcher.run_serialized(
+                    self.backend.promote_hashes, kh, lims, durs, now
+                )
+            )
+        except Exception as e:
+            # batcher stopping / transient device failure: candidates
+            # stay tracked and the next tick retries
+            log.warning("sketch promotion tick failed: %s", e)
+            return
+        n_inst = int(np.asarray(installed).sum())
+        shed = self.inst.shed
+        seeded = 0
+        for i in range(kh.shape[0]):
+            # track EVERY candidate (installed or already-resident) so
+            # the tick doesn't re-screen residents until their window
+            # turns; reset==window end either way
+            self._promoted[int(kh[i])] = int(reset[i])
+            if installed[i] and over[i] and shed is not None:
+                shed.seed(
+                    int(kh[i]), int(lims[i]), int(durs[i]),
+                    int(reset[i]), now,
+                )
+                seeded += 1
+        self.promotions += n_inst
+        self.shed_seeds += seeded
+        try:
+            if n_inst:
+                metrics.SKETCH_PROMOTIONS.inc(n_inst)
+            if seeded:
+                metrics.SKETCH_SHED_SEEDS.inc(seeded)
+        except Exception:  # pragma: no cover - defensive
+            pass
+
+    def stats(self) -> dict:
+        return dict(
+            promotions=self.promotions,
+            demotions=self.demotions,
+            shed_seeds=self.shed_seeds,
+            tracked=len(self._promoted),
+            candidates=len(self.tracker.ss._counts),
+        )

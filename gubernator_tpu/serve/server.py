@@ -49,15 +49,72 @@ def make_backend(conf: ServerConfig):
     # per the measured footprint≍throughput law
     store = conf.store_config(logger=log)
     from gubernator_tpu.core.store import (
+        check_host_budget,
         store_capacity,
         store_footprint_bytes,
     )
 
+    # whole-host budget accounting (r13): the boot log reports the
+    # per-tier split, and the lint checks that GUBER_STORE_MIB covers
+    # exact + sketch + shed + replication standby — not just the exact
+    # tier (warning, or a hard failure under GUBER_STORE_SIZE_STRICT)
+    sketch = conf.sketch_config()
+    sketch_bytes = 0
+    if sketch is not None:
+        from gubernator_tpu.core.sketches import sketch_footprint_bytes
+
+        sketch_bytes = sketch_footprint_bytes(sketch)
+    from gubernator_tpu.serve.shedcache import ENTRY_BYTES as SHED_BYTES
+
+    shed_bytes = (
+        conf.shed_cache_keys * SHED_BYTES if conf.shed_cache else 0
+    )
+    # a standby snapshot is a small dataclass + dict node; ~160 B
+    # measured on CPython 3.10 (serve/replication.py)
+    standby_bytes = (
+        conf.replication_standby_keys * 160 if conf.replication else 0
+    )
     log.info(
-        "slot store: %d slots x %d ways = %d entries (%.0f MiB)",
+        "store tiers: exact %d slots x %d ways = %d entries (%.0f MiB)"
+        "%s + shed %.1f MiB + standby %.1f MiB",
         store.slots, store.rows, store_capacity(store),
         store_footprint_bytes(store) / (1 << 20),
+        (
+            f" + sketch {sketch.rows}x{sketch.width} int64 "
+            f"({sketch_bytes / (1 << 20):.0f} MiB)"
+            if sketch is not None
+            else " (sketch tier off)"
+        ),
+        shed_bytes / (1 << 20),
+        standby_bytes / (1 << 20),
     )
+    host_lint = check_host_budget(
+        conf.store_mib,
+        {
+            "exact store": store_footprint_bytes(store),
+            "sketch": sketch_bytes,
+            "shed cache": shed_bytes,
+            "replication standby": standby_bytes,
+        },
+    )
+    if host_lint:
+        # STRICT hard-fails only when a HOST-side part was explicitly
+        # sized (the operator oversubscribed on purpose): the device
+        # tiers always fit by the carve-out, but the DEFAULT shed
+        # cache (~12.5 MiB) overflows any tiny budget on its own, and
+        # failing a pre-r13 strict config whose knobs never changed
+        # would be a regression — those boots warn instead
+        fields = type(conf).__dataclass_fields__
+        host_explicit = (
+            conf.shed_cache_keys != fields["shed_cache_keys"].default
+        ) or (
+            conf.replication
+            and conf.replication_standby_keys
+            != fields["replication_standby_keys"].default
+        )
+        if conf.store_size_strict and host_explicit:
+            raise ValueError(f"GUBER_STORE_SIZE_STRICT: {host_lint}")
+        log.warning("%s", host_lint)
     from gubernator_tpu.core.engine import buckets_for_limit
 
     buckets = buckets_for_limit(conf.device_batch_limit)
@@ -68,7 +125,7 @@ def make_backend(conf: ServerConfig):
             conf.device_batch_limit, buckets,
         )
     if conf.backend == "tpu":
-        return TpuBackend(store, buckets=buckets)
+        return TpuBackend(store, buckets=buckets, sketch=sketch)
     if conf.backend == "mesh":
         return MeshBackend(store, buckets=buckets)
     if conf.backend == "multihost":

@@ -330,6 +330,32 @@ class ShedCache:
 
     # -- populate / invalidate ----------------------------------------------
 
+    def seed(
+        self,
+        h: int,
+        limit: int,
+        duration: int,
+        reset_time: int,
+        now: Optional[int] = None,
+    ) -> None:
+        """Promoter feed (r13, serve/promoter.py): install a frozen
+        verdict for a hot key whose PROMOTION just wrote an over-limit
+        token window (remaining=0, sticky over, this reset_time) into
+        the device store — the cached verdict matches store state by
+        construction, the same authority as observing the device's own
+        response. Expired seeds are ignored."""
+        if now is None:
+            now = self.now_fn()
+        if now >= reset_time:
+            return
+        entries = self._entries
+        if entries.get(h) != (limit, duration, reset_time):
+            self._snap = None
+        entries[int(h)] = (int(limit), int(duration), int(reset_time))
+        entries.move_to_end(int(h))
+        if len(entries) > self.capacity:
+            entries.popitem(last=False)
+
     def _observe_one(
         self,
         h: int,

@@ -43,14 +43,13 @@ S_DEFAULT = 2048  # steps fused per device call: amortizes the remote
 
 def _zipf_key_hashes(key_space, B, rng=None):
     """(zipf ids [R,B], key hashes [R,B]) — the one zipf key recipe every
-    scenario shares (bit-identical across scenarios for comparability)."""
-    rng = rng or np.random.default_rng(42)
-    zipf = rng.zipf(1.2, size=(R, B)) % key_space
-    key_hash = (
-        (zipf.astype(np.uint64) * np.uint64(0x9E3779B97F4A7C15))
-        ^ np.uint64(0xDEADBEEFCAFEF00D)
-    )
-    return zipf, key_hash
+    scenario shares, now factored to cli/keystreams.py (r13) so this
+    sweep and the serving benches cannot drift apart (bit-identical to
+    the historical inline recipe for any (key_space, size, seed))."""
+    from gubernator_tpu.cli import keystreams
+
+    zipf = keystreams.zipf_ids(key_space, (R, B), rng)
+    return zipf, keystreams.hash_ids(zipf)
 
 
 def _scenario_steps():

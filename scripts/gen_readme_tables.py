@@ -500,6 +500,42 @@ def table_frontdoor() -> str:
     return "\n".join(lines)
 
 
+def table_sketch() -> str:
+    """Sketch cold tier (r13), from BENCH_SKETCH_r13.json: 100M-key
+    zipf at the same fixed device budget as the exact-only 10M
+    baseline (both stacks resident, interleaved paired windows), plus
+    the measured one-sided tail-error bound."""
+    doc = json.loads((ROOT / "BENCH_SKETCH_r13.json").read_text())
+    rows = {r["metric"]: r for r in doc["rows"]}
+    base = rows["zipf10m_exact_baseline"]
+    sk = rows["zipf100m_sketch_tier"]
+    err = doc["tail_error"]
+    lines = [
+        "| phase | key space | decisions/s | dropped creates |",
+        "|---|---|---|---|",
+        f"| exact-only baseline (whole budget, zipf 10M) "
+        f"| 10,000,000 | {base['decisions_per_sec']:,.0f} "
+        f"| {base['dropped_creates']:,} (silent over-admission) |",
+        f"| two-tier (exact + sketch carve-out, zipf "
+        f"{doc['key_space'] / 1e6:.0f}M) | {doc['key_space']:,} "
+        f"| {sk['decisions_per_sec']:,.0f} "
+        f"| {sk['dropped_creates']:,} (sketch-served, fail-closed) |",
+        "",
+        f"(Both phases fit the same {doc['store_mib']} MiB device "
+        f"budget at depth {doc['depth']:,}; interleaved paired "
+        f"per-round ratio **{doc['sketch_over_exact_baseline']:.2f}x** "
+        f"the exact-only baseline at 10x the key cardinality. "
+        f"Measured tail error on "
+        f"a pinned zipf stream: max overestimate "
+        f"**{err['max_overestimate']}** of bound "
+        f"{err['documented_bound']} (e*N/width, N="
+        f"{err['charged_hits']:,} charged hits), under-counts "
+        f"**{err['under_counts']}** — one-sided, fail-closed. Scope "
+        f"and promoter stats in the artifact.)"
+    ]
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -512,6 +548,7 @@ TABLES = {
     "host-prep-table": table_host_prep,
     "shed-table": table_shed,
     "frontdoor-table": table_frontdoor,
+    "sketch-table": table_sketch,
 }
 
 

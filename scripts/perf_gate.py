@@ -66,6 +66,7 @@ GATED = (
     "shed_r10",
     "submit_r9",
     "stages_r7",
+    "sketch_r13",
     "frontdoor_geb_over_grpc",
     "frontdoor_http_over_grpc",
 )
@@ -122,13 +123,15 @@ def _loadgen(
     concurrency: int,
     batch: int,
     window: int = 0,
+    keyspace: int = 0,
 ) -> dict:
     """One out-of-process load window via the real CLI generator."""
     args = [
         sys.executable, "-m", "gubernator_tpu.cli.loadgen", address,
         "--protocol", protocol, "--duration", str(seconds),
         "--share", str(share), "--concurrency", str(concurrency),
-        "--batch", str(batch), "--window", str(window), "--json",
+        "--batch", str(batch), "--window", str(window),
+        "--keyspace", str(keyspace), "--json",
     ]
     out = subprocess.run(
         args,
@@ -194,6 +197,7 @@ def main() -> int:
 
     from gubernator_tpu.cluster import LocalCluster
     from gubernator_tpu.core.engine import buckets_for_limit
+    from gubernator_tpu.core.sketches import derive_sketch_config
     from gubernator_tpu.core.store import StoreConfig
     from gubernator_tpu.serve.backends import TpuBackend
     from gubernator_tpu.serve.faults import FAULTS
@@ -203,6 +207,10 @@ def main() -> int:
         backend_factory=lambda: TpuBackend(
             StoreConfig(rows=16, slots=1 << 12),
             buckets=buckets_for_limit(args.device_batch_limit),
+            # small cold tier so the sketch_r13 pair flips a real path
+            # (engine.sketch_on); the other workloads' key sets fit the
+            # exact tier, where ON is byte-identical to OFF
+            sketch=derive_sketch_config(mib=8),
         ),
         http_addresses=[HTTP_ADDR],
         device_batch_limit=args.device_batch_limit,
@@ -335,6 +343,39 @@ def main() -> int:
         )
         measured["stages_r7"], detail["stages_r7"] = m, rows
 
+        # -- sketch_r13: cold tier OFF vs ON, high-cardinality shape -
+        # cold keyspace ~5x the exact tier's entry capacity + a hot
+        # over-limit head: at deep batches multiple fresh keys land in
+        # one bucket, so creates drop and the pair exercises the
+        # sketch path (OFF: silent over-admission; ON: count-min
+        # decisions). Gates the sketch kernel's cost from decaying.
+        print("workload sketch_r13 (sketch OFF vs ON)...",
+              file=sys.stderr)
+        engine = instance.backend.engine
+
+        def flip_sketch(on: bool):
+            async def f():
+                engine.sketch_on = on
+
+            cluster.run(f())
+
+        def sketch_drive(s):
+            return _loadgen(
+                "geb", SOCK, s, 0.5, args.concurrency, args.batch,
+                keyspace=300_000,
+            )["decisions_per_sec"]
+
+        def sketch_off(s):
+            flip_sketch(False)
+            try:
+                return sketch_drive(s)
+            finally:
+                flip_sketch(True)
+
+        m, rows = paired("sketch_r13", sketch_off, sketch_drive,
+                         args.seconds, args.rounds)
+        measured["sketch_r13"], detail["sketch_r13"] = m, rows
+
         # -- front-door ladder: grpc vs geb vs http ------------------
         print("front-door ladder (grpc / geb / http)...", file=sys.stderr)
         doors = {
@@ -432,6 +473,12 @@ def main() -> int:
                     "pair": "credit window 1 (round-trip) vs full "
                             "window, saturation workload",
                     "committed": round(measured["stages_r7"], 4),
+                },
+                "sketch_r13": {
+                    "artifact": "BENCH_SKETCH_r13.json",
+                    "pair": "sketch cold tier OFF vs ON, share 0.5 "
+                            "keyspace-300k drop-heavy workload",
+                    "committed": round(measured["sketch_r13"], 4),
                 },
                 "frontdoor_geb_over_grpc": {
                     "artifact": "BENCH_FRONTDOOR_r12.json",

@@ -70,9 +70,10 @@ def test_baseline_manifest_guards_the_committed_artifacts():
     )
     assert manifest["schema"] == "perf_gate_baseline_r12"
     wl = manifest["workloads"]
-    # the three interior wins + the two public-door ratios are guarded
+    # the interior wins (incl. the r13 sketch pair) + the two
+    # public-door ratios are guarded
     for name in (
-        "shed_r10", "submit_r9", "stages_r7",
+        "shed_r10", "submit_r9", "stages_r7", "sketch_r13",
         "frontdoor_geb_over_grpc", "frontdoor_http_over_grpc",
     ):
         assert name in wl, f"workload {name} missing from the manifest"

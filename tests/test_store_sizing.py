@@ -76,12 +76,28 @@ def test_derived_shapes_hold_store_invariants():
 def test_boot_derivation_from_env_knobs():
     conf = config_from_env({"GUBER_STORE_TARGET_KEYS": "10000000"})
     assert conf.store_config() == StoreConfig(rows=16, slots=1 << 20)
-    conf = config_from_env({"GUBER_STORE_MIB": "1024"})
+    # exact-only (GUBER_SKETCH=0): the whole MiB budget is the exact
+    # tier, the historical derivation
+    conf = config_from_env(
+        {"GUBER_STORE_MIB": "1024", "GUBER_SKETCH": "0"}
+    )
     assert conf.store_config() == StoreConfig(rows=16, slots=1 << 21)
+    # with the sketch tier (r13, default on) the budget covers BOTH
+    # tiers: the sketch's resolved footprint (1024/4 = 256 MiB) is
+    # carved out and the exact tier derives from the remainder
+    conf = config_from_env({"GUBER_STORE_MIB": "1024"})
+    assert conf.store_config() == StoreConfig(rows=16, slots=1 << 20)
+    from gubernator_tpu.core.sketches import sketch_footprint_bytes
+
+    assert sketch_footprint_bytes(conf.sketch_config()) == 256 << 20
     # MIB wins over TARGET_KEYS for the footprint (the budget then only
     # lints); explicit slots remain the fallback
     conf = config_from_env(
-        {"GUBER_STORE_MIB": "512", "GUBER_STORE_TARGET_KEYS": "10000000"}
+        {
+            "GUBER_STORE_MIB": "512",
+            "GUBER_STORE_TARGET_KEYS": "10000000",
+            "GUBER_SKETCH": "0",
+        }
     )
     assert conf.store_config() == StoreConfig(rows=16, slots=1 << 20)
     assert config_from_env({}).store_config() == StoreConfig(
@@ -93,7 +109,11 @@ def test_oversized_footprint_warns_at_boot(caplog):
     """A 1 GiB table declared to serve 100k keys pays the full-table
     writeback for a ~0.3% load — the boot lint must say so."""
     conf = config_from_env(
-        {"GUBER_STORE_MIB": "1024", "GUBER_STORE_TARGET_KEYS": "100000"}
+        {
+            "GUBER_STORE_MIB": "1024",
+            "GUBER_STORE_TARGET_KEYS": "100000",
+            "GUBER_SKETCH": "0",
+        }
     )
     with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
         store = conf.store_config()
@@ -118,13 +138,30 @@ def test_oversized_footprint_fails_under_strict():
 
 def test_undersized_footprint_warns_over_admission(caplog):
     """Key budget past the eviction ceiling of an explicit footprint ->
-    over-admission warning."""
+    over-admission warning — with the exact-only store. With the r13
+    sketch tier on, undersized is the DESIGN (the tail overflows to the
+    sketch fail-closed), so the same shape boots silently."""
     conf = config_from_env(
-        {"GUBER_STORE_MIB": "16", "GUBER_STORE_TARGET_KEYS": "1000000"}
+        {
+            "GUBER_STORE_MIB": "16",
+            "GUBER_STORE_TARGET_KEYS": "1000000",
+            "GUBER_SKETCH": "0",
+        }
     )
     with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
         conf.store_config()
     assert any("undersized" in r.message for r in caplog.records)
+    caplog.clear()
+    conf = config_from_env(
+        {
+            "GUBER_STORE_MIB": "16",
+            "GUBER_STORE_TARGET_KEYS": "1000000",
+            "GUBER_SKETCH_MIB": "4",
+        }
+    )
+    with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
+        conf.store_config()
+    assert not any("undersized" in r.message for r in caplog.records)
 
 
 def test_right_sized_footprint_is_silent(caplog):
@@ -150,7 +187,11 @@ def test_explicit_slots_pin_is_linted_not_overridden(caplog):
     pinned geometry and lints it — deriving over a deliberate pin would
     silently change the HBM footprint the operator chose."""
     conf = config_from_env(
-        {"GUBER_STORE_SLOTS": "2048", "GUBER_STORE_TARGET_KEYS": "10000000"}
+        {
+            "GUBER_STORE_SLOTS": "2048",
+            "GUBER_STORE_TARGET_KEYS": "10000000",
+            "GUBER_SKETCH": "0",  # exact-only: the undersize lint fires
+        }
     )
     with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
         store = conf.store_config()
@@ -167,7 +208,9 @@ def test_directly_constructed_config_keeps_slot_pin(caplog):
     over."""
     from gubernator_tpu.serve.config import ServerConfig
 
-    conf = ServerConfig(store_slots=1 << 11, store_target_keys=10_000_000)
+    conf = ServerConfig(
+        store_slots=1 << 11, store_target_keys=10_000_000, sketch=False
+    )
     with caplog.at_level(logging.WARNING, "gubernator_tpu.config"):
         store = conf.store_config()
     assert store == StoreConfig(rows=16, slots=1 << 11)
