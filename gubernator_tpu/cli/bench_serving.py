@@ -433,6 +433,136 @@ def run_zipf10m(args) -> int:
     return 0
 
 
+def _run_shard_child(args) -> int:
+    """One shard-ladder row in THIS process (spawned by run_shard with
+    XLA_FLAGS/JAX_PLATFORMS pinned before jax ever initialized): boot
+    the shipped stack from GUBER_* env (backend tpu = the flat
+    degenerate policy, mesh = GUBER_SHARDS simulated devices) and
+    measure one zipf window through the batcher's array door."""
+    import asyncio
+
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.serve.config import config_from_env
+
+    _jax_cache()
+    conf = config_from_env()
+    n = int(args.shards.split(",")[0])
+    pool = keystreams.zipf_pool(args.keys, 1 << 18)
+    row = asyncio.run(
+        _drive_pool(
+            conf, pool, conf.device_batch_limit, args.seconds,
+            args.group, f"shard_{args.shard_child}_{n}",
+        )
+    )
+    row["shards"] = n
+    row["policy"] = args.shard_child
+    print(json.dumps(row))
+    return 0
+
+
+def run_shard(args) -> int:
+    """Shard-scaling ladder on SIMULATED host devices (r14): the same
+    partitioned engine under the flat policy (1 shard) and the mesh
+    policy at each --shards rung, every rung in its own subprocess so
+    XLA_FLAGS --xla_force_host_platform_device_count lands before jax
+    initializes (the tests/conftest.py mechanism). On a CPU box the
+    virtual devices SHARE the cores, so the ladder measures the
+    partitioned dispatch overhead (host shard routing + shard_map
+    program), not chip scaling — the scaling dividend this prices
+    exists on real meshes where each shard owns a chip; the artifact
+    records that scoping."""
+    import os
+    import subprocess
+
+    if args.shard_child:
+        return _run_shard_child(args)
+
+    ladder = [int(x) for x in args.shards.split(",") if x.strip()]
+    rows = []
+    configs = [("flat", 1)] + [("mesh", n) for n in ladder]
+    for policy, n in configs:
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env.update(
+            {
+                "JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": (
+                    f"--xla_force_host_platform_device_count={max(n, 1)}"
+                ),
+                "GUBER_BACKEND": "tpu" if policy == "flat" else "mesh",
+                "GUBER_DEVICE_BATCH_LIMIT": str(args.shard_depth),
+                "GUBER_STORE_SLOTS": str(args.shard_slots),
+                "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+            }
+        )
+        if policy == "mesh":
+            env["GUBER_SHARDS"] = str(n)
+        for k in ("GUBER_STORE_MIB", "GUBER_STORE_TARGET_KEYS",
+                  "GUBER_SHARDS" if policy == "flat" else ""):
+            env.pop(k, None) if k else None
+        cmd = [
+            sys.executable, "-m", "gubernator_tpu.cli.bench_serving",
+            "--scenario", "shard", "--shard-child", policy,
+            "--shards", str(n), "--seconds", str(args.seconds),
+            "--group", str(args.group), "--keys", str(args.keys),
+        ]
+        print(
+            f"shard ladder: {policy} x{n} "
+            f"(simulated devices = {max(n, 1)})...",
+            file=sys.stderr,
+        )
+        out = subprocess.run(
+            cmd, capture_output=True, text=True, env=env, timeout=1800
+        )
+        if out.returncode != 0:
+            print(out.stderr[-2000:], file=sys.stderr)
+            return 1
+        row = json.loads(out.stdout.strip().splitlines()[-1])
+        print(
+            f"  {policy} x{n}: {row['decisions_per_sec']:>12,.0f} dec/s"
+            f"  (mean device batch {row['mean_device_batch']:,.0f})",
+            file=sys.stderr,
+        )
+        rows.append(row)
+
+    flat_rate = rows[0]["decisions_per_sec"]
+    for r in rows:
+        r["vs_flat"] = round(r["decisions_per_sec"] / flat_rate, 4)
+    doc = dict(
+        scenario="shard_ladder_r14",
+        scope="cpu-simulated-devices",
+        host_cpus=os.cpu_count(),
+        shards_ladder=ladder,
+        served_via=(
+            "config_from_env -> make_backend (GUBER_BACKEND=tpu|mesh, "
+            "GUBER_SHARDS) -> Instance/DeviceBatcher array door; one "
+            "subprocess per rung with XLA_FLAGS "
+            "--xla_force_host_platform_device_count pinned pre-init"
+        ),
+        env_knobs={
+            "GUBER_DEVICE_BATCH_LIMIT": str(args.shard_depth),
+            "GUBER_STORE_SLOTS": str(args.shard_slots),
+            "GUBER_SHARDS": "<row shards>",
+        },
+        key_space=args.keys,
+        notes=(
+            "Simulated host devices share this box's cores, so rows "
+            "measure the PARTITIONED DISPATCH PRICE of the one r14 "
+            "engine (host owner-routing + shard_map program vs the "
+            "flat plain-jit degenerate policy) — not chip scaling. "
+            "On a real mesh each shard owns a chip and per-chip "
+            "decide work drops to ~B/n (tests/test_sharded.py "
+            "test_batch_is_sharded_not_replicated pins the sub-batch "
+            "economy); `make perf-gate` guards the flat-vs-mesh "
+            "paired ratio (shard_r14) against decay."
+        ),
+        rows=rows,
+    )
+    if args.json:
+        print(json.dumps(doc))
+    return 0
+
+
 def _filler_hashes(slots: int) -> "np.ndarray":
     """One uint64 key hash per store bucket (error-measurement rig):
     with every bucket's ways held by LIVE entries that are ALSO present
@@ -1003,7 +1133,10 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--scenario",
         default="cluster",
-        choices=["cluster", "zipf10m", "zipf100m", "key-churn", "shed"],
+        choices=[
+            "cluster", "zipf10m", "zipf100m", "key-churn", "shed",
+            "shard",
+        ],
         help="cluster = the reference benchmark suite over localhost "
         "gRPC; zipf10m = BASELINE config 4 through the shipped serving "
         "config (deep-batch ladder, GUBER_STORE_MIB-sized store); "
@@ -1019,6 +1152,26 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--rounds", type=int, default=3,
         help="zipf100m: interleaved paired baseline/sketch rounds",
+    )
+    parser.add_argument(
+        "--shards", default="1,2,4,8",
+        help="shard scenario: comma list of mesh shard counts, each "
+        "run on that many SIMULATED host devices in a fresh "
+        "subprocess (a flat 1-shard row is always included as the "
+        "degenerate-policy baseline)",
+    )
+    parser.add_argument(
+        "--shard-depth", type=int, default=8192,
+        help="shard scenario: GUBER_DEVICE_BATCH_LIMIT per rung",
+    )
+    parser.add_argument(
+        "--shard-slots", type=int, default=1 << 12,
+        help="shard scenario: GUBER_STORE_SLOTS per rung (per-shard "
+        "table geometry is identical across the ladder)",
+    )
+    parser.add_argument(
+        "--shard-child", default="",
+        help=argparse.SUPPRESS,  # internal: one ladder row in-process
     )
     parser.add_argument(
         "--shed-shares",
@@ -1137,6 +1290,13 @@ def main(argv=None) -> int:
         if args.depths == parser.get_default("depths"):
             args.depths = "32768"
         return run_churn(args)
+    if args.scenario == "shard":
+        if args.keys == parser.get_default("keys"):
+            # dispatch-price ladder: the key set must fit every rung's
+            # exact tier so tier behavior can't confound the topology
+            # comparison (per-shard tables multiply capacity with n)
+            args.keys = 50_000
+        return run_shard(args)
 
     backend_factory = None
     # device backends boot with the daemon's shipped co-batch depth

@@ -626,663 +626,17 @@ class EngineStats:
             )
 
 
-class TpuEngine:
-    """Owns the device-resident slot store for one shard/instance."""
+def __getattr__(name):
+    # TpuEngine is now the degenerate (single-device, flat) case of the
+    # ONE partitioned engine (r14): its implementation lives in
+    # parallel/sharded.py next to the mesh layout so decide/upsert/
+    # snapshot/sketch paths cannot drift between topologies. This lazy
+    # alias keeps every historical `from core.engine import TpuEngine`
+    # import site working without a core -> parallel import cycle.
+    if name == "TpuEngine":
+        from gubernator_tpu.parallel.sharded import TpuEngine
 
-    def __init__(
-        self,
-        config: StoreConfig = StoreConfig(),
-        buckets: Sequence[int] = DEFAULT_BUCKETS,
-        device: Optional[jax.Device] = None,
-        sketch=None,
-    ):
-        self.config = config
-        self.buckets = sorted(buckets)
-        self.device = device
-        self.clock = EpochClock()
-        store = new_store(config)
-        if device is not None:
-            store = jax.device_put(store, device)
-        self.store: Store = store
-        self.stats = EngineStats()
-        # bumped by every reset(): the store-wipe epoch the over-limit
-        # shed cache checks so a clock-jump reset (or warmup's cleanup)
-        # invalidates every cached verdict (serve/shedcache.py)
-        self.reset_generation = 0
-        # sketch cold tier (r13, core/sketches.SketchConfig or None):
-        # creates the exact tier DROPS to way exhaustion are decided
-        # from a window-keyed count-min estimate instead of being
-        # silently over-admitted. `sketch_on` is the runtime A/B flag
-        # (scripts/perf_gate.py flips it between paired rounds; both
-        # variants compile lazily).
-        self.sketch_config = sketch
-        self.sketch = None
-        self.sketch_on = sketch is not None
-        if sketch is not None:
-            self.sketch = self._new_sketch()
-        # serve-tier hot-key observer (serve/promoter.py): called with
-        # every dispatched BatchRequest (numpy, pre-device) so the
-        # streaming top-K candidate source sees all traffic regardless
-        # of which door it entered through. Must never raise into the
-        # dispatch path; the promoter's hook rate-limits itself.
-        self.observe_hook = None
-
-    def _new_sketch(self):
-        from gubernator_tpu.core.sketches import new_sketch
-
-        sk = new_sketch(self.sketch_config)
-        if self.device is not None:
-            sk = jax.device_put(sk, self.device)
-        return sk
-
-    # -- public API ---------------------------------------------------------
-
-    def get_rate_limits_submit(
-        self,
-        reqs: Sequence[RateLimitReq],
-        now: Optional[int] = None,
-        gnp: Optional[Sequence[bool]] = None,
-    ):
-        """Request-object sibling of decide_submit: convert + presort +
-        dispatch one batch without waiting. Returns an opaque handle for
-        get_rate_limits_wait, or None for an empty batch. Like
-        decide_submit, the store update is effective immediately, so the
-        caller may submit the next batch while the device computes this
-        one (the serving batcher's pipelining)."""
-        n = len(reqs)
-        if n == 0:
-            return None
-        if now is None:
-            now = millisecond_now()
-
-        keys = [r.hash_key() for r in reqs]
-        hashes = slot_hash_batch(keys)
-        hits = np.fromiter((r.hits for r in reqs), np.int64, n)
-        limit = np.fromiter((r.limit for r in reqs), np.int64, n)
-        duration = np.fromiter((r.duration for r in reqs), np.int64, n)
-        algo = np.fromiter((int(r.algorithm) for r in reqs), np.int32, n)
-        gnp_arr = (
-            np.asarray(gnp, bool) if gnp is not None else np.zeros(n, bool)
-        )
-        return self.decide_submit(
-            hashes, hits, limit, duration, algo, gnp_arr, now
-        )
-
-    def get_rate_limits_wait(self, handle) -> List[RateLimitResp]:
-        """Fetch + convert the responses for a get_rate_limits_submit
-        handle."""
-        if handle is None:
-            return []
-        return resps_from_columns(*self.decide_wait(handle))
-
-    def get_rate_limits(
-        self,
-        reqs: Sequence[RateLimitReq],
-        now: Optional[int] = None,
-        gnp: Optional[Sequence[bool]] = None,
-    ) -> List[RateLimitResp]:
-        """Decide a batch. `gnp[i]` marks GLOBAL non-owner replica reads."""
-        return self.get_rate_limits_wait(
-            self.get_rate_limits_submit(reqs, now=now, gnp=gnp)
-        )
-
-    def _engine_now(self, now: int) -> np.int32:
-        e, delta, reset_required = self.clock.advance(now)
-        if reset_required:
-            self.reset()
-        elif delta is not None:
-            self.store = rebase_jit(self.store, np.int32(delta))
-            if self.sketch is not None:
-                # sketch windows are keyed by engine-ms // duration, so
-                # a rebase shifts every window id: clear rather than
-                # carry counts into wrong windows. Rare (~12-day
-                # cadence) and one-sided-safe in the fail-open
-                # direction for at most one window per key — the same
-                # class of loss as the reference's restart contract.
-                self.sketch = self._new_sketch()
-        return e
-
-    def _dispatch(self, req, groups, e_now):
-        """The one jitted-dispatch funnel every submit path ends in:
-        feeds the serve-tier hot-key observer (numpy fields, pre-
-        device) and picks the exact-only or two-tier program."""
-        hook = self.observe_hook
-        if hook is not None:
-            try:
-                hook(req)
-            except Exception:  # pragma: no cover - defensive
-                pass  # observability must never fail a dispatch
-        if self.sketch is not None and self.sketch_on:
-            self.store, self.sketch, packed = _decide_packed_sketch_jit(
-                self.store, self.sketch, req, e_now, groups
-            )
-            return packed
-        self.store, packed = _decide_packed_jit(
-            self.store, req, e_now, groups
-        )
-        return packed
-
-    def decide_submit(
-        self,
-        key_hash: np.ndarray,
-        hits: np.ndarray,
-        limit: np.ndarray,
-        duration: np.ndarray,
-        algo: np.ndarray,
-        gnp: np.ndarray,
-        now: int,
-    ):
-        """Presort + dispatch one batch WITHOUT waiting for the result.
-
-        The store update is effective immediately (the jitted call threads
-        the donated store), so the next submit may follow at once; jax
-        dispatch is async, which lets the caller presort batch i+1 while
-        the device still computes batch i — the pipelining the serving
-        batcher and the e2e bench rely on. Returns an opaque handle for
-        decide_wait."""
-        n = key_hash.shape[0]
-        e_now = self._engine_now(now)
-        req, order, groups = pad_request_sorted(
-            self.buckets,
-            self.config.slots,
-            key_hash,
-            hits,
-            limit,
-            duration,
-            algo,
-            gnp,
-            with_groups=True,
-        )
-        packed = self._dispatch(req, groups, e_now)
-        # capture the epoch the batch was computed under: a later submit
-        # may rebase/reset the clock before this batch's wait, and the
-        # in-flight engine-ms outputs must convert against THEIR epoch
-        return (packed, order, n, req.key_hash.shape[0], self.clock.epoch)
-
-    def prep_run(self, fields: dict) -> dict:
-        """Arrival-time per-group prep (serve/batcher.py): see
-        prep_run_single."""
-        return prep_run_single(fields, self.config.slots)
-
-    def merge_prepped(self, runs):
-        """Merge the caller groups' pre-sorted runs into one dispatch-
-        ready batch (the submit thread's `merge` stage). With the
-        native lib this is ONE GIL-free fused pass — merge + field
-        materialization + padding + group stream (guber_merge_runs) —
-        leaving only build_groups' G-sized assembly in numpy; the
-        fallback is serve/prep.py's searchsorted merge plus the padded
-        build. Output feeds decide_submit_merged."""
-        n = int(sum(r["n"] for r in runs))
-        B = choose_bucket(self.buckets, n)
-        if _hn is not None and getattr(_hn, "_HAS_MERGE", False) and n:
-            m = _hn.merge_runs_native(runs, B, g_rungs=group_rungs(B))
-            req = BatchRequest(
-                key_hash=m["key_hash"], hits=m["hits"],
-                limit=m["limit"], duration=m["duration"],
-                algo=m["algo"], gnp=m["gnp"], valid=m["valid"],
-            )
-            groups = BatchGroups(
-                key_hash=m["group_key_hash"],
-                leader_pos=m["leader_pos"],
-                end_pos=m["group_end"],
-                valid=m["group_valid"],
-                group_id=m["group_id"],
-            )
-            return dict(
-                req=req, groups=groups, order=m["order"], n=n, B=B
-            )
-        from gubernator_tpu.serve.prep import merge_runs
-
-        m = merge_runs(runs)
-        req, groups, B = build_presorted_request(
-            self.buckets, m["fields"], m["skey"], n
-        )
-        order_p = np.empty(B, np.int32)
-        order_p[:n] = m["order"]
-        order_p[n:] = np.arange(n, B, dtype=np.int32)
-        return dict(req=req, groups=groups, order=order_p, n=n, B=B)
-
-    def decide_submit_merged(self, merged: dict, now: int):
-        """Dispatch a merge_prepped batch: epoch bookkeeping + the
-        jitted call, nothing else — the submit thread's `dispatch`
-        stage. Returns the standard decide_wait handle."""
-        e_now = self._engine_now(now)
-        packed = self._dispatch(merged["req"], merged["groups"], e_now)
-        return (
-            packed, merged["order"], merged["n"], merged["B"],
-            self.clock.epoch,
-        )
-
-    def decide_submit_presorted(
-        self,
-        fields: dict,
-        skey: np.ndarray,
-        order: Optional[np.ndarray],
-        counts: np.ndarray,
-        now: int,
-    ):
-        """Dispatch a batch whose host presort already happened
-        (arrival-time prep + merge combine): `fields` are device-dtype
-        request arrays in sorted (bucket, fingerprint) order, `skey`
-        the matching sorted composite keys, `order[k]` the caller index
-        of sorted row k (None = identity, for callers that discard the
-        handle). Pads + derives the duplicate-key group structure in
-        O(n) and dispatches — no argsort anywhere. Device fields are
-        byte-identical to decide_submit on the same unsorted batch
-        (tests/test_prep_pipeline.py); returns the same opaque handle
-        for decide_wait. `counts` is accepted for signature parity with
-        the mesh engine and unused here (one shard)."""
-        n = skey.shape[0]
-        if n == 0:
-            return None
-        e_now = self._engine_now(now)
-        req, groups, B = build_presorted_request(
-            self.buckets, fields, skey, n
-        )
-        order_p = np.empty(B, np.int32)
-        order_p[:n] = (
-            order if order is not None else np.arange(n, dtype=np.int32)
-        )
-        order_p[n:] = np.arange(n, B, dtype=np.int32)
-        packed = self._dispatch(req, groups, e_now)
-        return (packed, order_p, n, B, self.clock.epoch)
-
-    def decide_wait(
-        self, handle
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Fetch + unpermute the responses for a decide_submit handle."""
-        packed, order, n, B, epoch = handle
-        packed = np.asarray(jax.device_get(packed))
-        self.stats.add_batch(
-            int(packed[4 * B]),
-            int(packed[4 * B + 1]),
-            int(packed[4 * B + 2]),
-            int(packed[4 * B + 3]),
-        )
-        # responses come back in sorted order; one pass unpermutes (the
-        # [4, B] view of the packed transfer is zero-copy)
-        if _marshal is not None:
-            u = _marshal.unpermute_i32(
-                packed[: 4 * B].reshape(4, B), order, n
-            )
-            status, rlimit, remaining, reset = u[0], u[1], u[2], u[3]
-        else:
-            s_status, s_lim, s_rem, s_reset = unpack_outputs(packed, B)[:4]
-            status, rlimit, remaining, reset = unpermute_responses(
-                order, (s_status, s_lim, s_rem, s_reset)
-            )
-        # convert with the submit-time epoch (see decide_submit); 0 stays
-        # the 'no reset' sentinel
-        r = np.asarray(reset, np.int64)
-        reset = np.where(r == 0, 0, r + epoch)
-        return status[:n], rlimit[:n], remaining[:n], reset[:n]
-
-    def decide_arrays(
-        self,
-        key_hash: np.ndarray,
-        hits: np.ndarray,
-        limit: np.ndarray,
-        duration: np.ndarray,
-        algo: np.ndarray,
-        gnp: np.ndarray,
-        now: int,
-    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Array-level entry point (also used by the benchmark harness).
-        Times in/out are int64 unix-ms; conversion happens here."""
-        return self.decide_wait(
-            self.decide_submit(
-                key_hash, hits, limit, duration, algo, gnp, now
-            )
-        )
-
-    def snapshot_read(
-        self, key_hash: np.ndarray, now: Optional[int] = None
-    ) -> List[Optional[Tuple[int, int, int, int, bool]]]:
-        """NON-MUTATING host read of the store rows for these uint64 key
-        hashes: per key, (limit, duration, remaining, reset_time_unix,
-        over) for a live token window, or None (missing, expired, or
-        leaky — leaky state refills continuously and is out of the
-        replication scope, serve/replication.py). Nothing is written:
-        no eviction, no expiry deletion, no stats — which is what makes
-        bucket replication provably invisible to the decision stream
-        (replication ON == OFF byte-identical without failures).
-
-        Reads one gather of the addressed bucket rows, not the whole
-        table. Thread contract: call from the batcher's single submit
-        thread (DeviceBatcher.run_serialized) so the gather can never
-        race a store-donating dispatch."""
-        n = int(key_hash.shape[0])
-        if n == 0:
-            return []
-        if self.clock.epoch is None:
-            return [None] * n  # nothing ever decided
-        if now is None:
-            now = millisecond_now()
-        from gubernator_tpu.core.store import (
-            FLAG_ALGO_LEAKY,
-            FLAG_STICKY_OVER,
-            L_DURATION,
-            L_EXPIRE,
-            L_FLAGS,
-            L_LIMIT,
-            L_REMAINING,
-            L_TAG,
-            bucket_index,
-            fingerprints,
-        )
-
-        kh = jnp.asarray(np.ascontiguousarray(key_hash, dtype=np.uint64))
-        b = bucket_index(kh, self.config.slots)
-        fp = fingerprints(kh)
-        rows = jnp.take(self.store.entries, b, axis=0)  # [n, ways, LANES]
-        match = rows[..., L_TAG] == fp[:, None]
-        way = jnp.argmax(match, axis=1)
-        ent = jnp.take_along_axis(rows, way[:, None, None], axis=1)[:, 0, :]
-        found = np.asarray(match.any(axis=1))
-        ent = np.asarray(ent)
-        e_now = int(self.clock.to_engine(now))
-        out: List[Optional[Tuple[int, int, int, int, bool]]] = []
-        flags_col = ent[:, L_FLAGS]
-        for i in range(n):
-            if not found[i] or int(ent[i, L_EXPIRE]) < e_now:
-                out.append(None)  # miss, or entry past its reset
-                continue
-            flags = int(flags_col[i])
-            if flags & FLAG_ALGO_LEAKY:
-                out.append(None)
-                continue
-            remaining = int(ent[i, L_REMAINING])
-            reset_time = int(
-                self.clock.from_engine(np.int64(ent[i, L_EXPIRE]))
-            )
-            out.append((
-                int(ent[i, L_LIMIT]),
-                int(ent[i, L_DURATION]),
-                remaining,
-                reset_time,
-                bool(flags & FLAG_STICKY_OVER) or remaining == 0,
-            ))
-        return out
-
-    def update_globals(
-        self, updates: Sequence[Tuple[str, RateLimitResp]], now: Optional[int] = None
-    ) -> None:
-        """Install owner-broadcast GLOBAL statuses (UpdatePeerGlobals
-        receive path, reference gubernator.go:199-207)."""
-        n = len(updates)
-        if n == 0:
-            return
-        if now is None:
-            now = millisecond_now()
-        self._engine_now(now)  # pin/refresh the epoch
-        hashes, limit, remaining, reset, over, valid = pad_to_bucket(
-            self.buckets,
-            n,
-            (slot_hash_batch([k for k, _ in updates]), np.uint64),
-            (
-                _sat_i32(np.fromiter((s.limit for _, s in updates), np.int64, n)),
-                np.int32,
-            ),
-            (
-                _sat_i32(
-                    np.fromiter((s.remaining for _, s in updates), np.int64, n)
-                ),
-                np.int32,
-            ),
-            (
-                self.clock.to_engine(
-                    np.fromiter((s.reset_time for _, s in updates), np.int64, n)
-                ),
-                np.int32,
-            ),
-            (
-                np.fromiter(
-                    (s.status == Status.OVER_LIMIT for _, s in updates),
-                    bool,
-                    n,
-                ),
-                bool,
-            ),
-        )
-        self.store = upsert_globals_jit(
-            self.store, hashes, limit, remaining, reset, over, valid
-        )
-
-    def warmup(self, now: Optional[int] = None) -> None:
-        """Pre-compile all bucket sizes (first TPU jit is ~20-40s)."""
-        if now is None:
-            now = millisecond_now()
-        for b in self.buckets:
-            # one XLA program per (request rung, group rung) pair: craft
-            # batches whose unique-key count hits each group rung. Keys
-            # get distinct FINGERPRINTS (value << 32): small integer keys
-            # all share fp=1, which collapses same-bucket keys into one
-            # group and silently misses the top rung
-            for g in group_rungs(b):
-                k = np.resize(
-                    np.arange(1, g + 1, dtype=np.uint64) << np.uint64(32), b
-                )
-                ones = np.ones(b, np.int64)
-                self.decide_arrays(
-                    k, ones, ones * 10, ones * 1000,
-                    np.zeros(b, np.int32), np.zeros(b, bool), now,
-                )
-            # the GLOBAL replica-install path is a separate XLA program and
-            # must not pay jit time inside a broadcast RPC deadline either
-            self.update_globals(
-                [(f"warmup:{i}", RateLimitResp(limit=1)) for i in range(b)],
-                now=now,
-            )
-        if self.sketch is not None:
-            # promoter host-read surfaces (sketch_estimates/live_mask)
-            # run eagerly at power-of-two-padded shapes; compile the
-            # common rungs here so the first flush ticks don't pay
-            # ~0.5s of eager compiles on the serving submit thread
-            for B in (64, 128, 256, 512, 1024):
-                kh = np.arange(1, B + 1, dtype=np.uint64) << np.uint64(32)
-                durs = np.full(B, 1000, np.int64)
-                self.sketch_estimates(kh, durs, now)
-                self.live_mask(kh, now)
-        # reset state and counters dirtied by warmup traffic
-        self.reset()
-        self.stats = EngineStats()
-
-    def reset(self) -> None:
-        store = new_store(self.config)
-        if self.device is not None:
-            store = jax.device_put(store, self.device)
-        self.store = store
-        if self.sketch_config is not None:
-            self.sketch = self._new_sketch()
-        self.reset_generation += 1
-
-    # -- sketch cold tier surfaces (r13) ------------------------------------
-
-    @staticmethod
-    def _pad_keys_pow2(key_hash: np.ndarray, *cols):
-        """Pad key hashes (+ parallel int64 columns) to a power-of-two
-        length (floor 64) by repeating the last row. The promoter's
-        candidate count changes every tick, and un-jitted device ops
-        compile one eager kernel PER SHAPE — unpadded, each tick paid
-        ~500ms of recompiles on this box. Returns (kh, cols..., n)."""
-        n = int(key_hash.shape[0])
-        B = 1 << max(6, (n - 1).bit_length())
-        kh = np.empty(B, np.uint64)
-        kh[:n] = key_hash
-        kh[n:] = kh[n - 1] if n else 0
-        out = [kh]
-        for c in cols:
-            p = np.empty(B, np.int64)
-            p[:n] = c
-            p[n:] = p[n - 1] if n else 0
-            out.append(p)
-        out.append(n)
-        return tuple(out)
-
-    def _sketch_windows(self, durations: np.ndarray, now: int):
-        """(window_id int64[n], window_end_unix int64[n]) for the
-        current fixed windows of these durations."""
-        from gubernator_tpu.core.sketches import window_id_np
-
-        e_now = int(self.clock.to_engine(now))
-        wid = window_id_np(e_now, durations)
-        d = np.maximum(np.asarray(durations, np.int64), 1)
-        wend_engine = (wid + 1) * d
-        return wid, np.asarray(self.clock.from_engine(wend_engine))
-
-    def sketch_estimates(
-        self,
-        key_hash: np.ndarray,
-        durations: np.ndarray,
-        now: Optional[int] = None,
-    ) -> np.ndarray:
-        """NON-MUTATING current-window count-min estimates int64[n] for
-        these keys (0 when the tier is off or nothing was ever
-        decided). Reads only the addressed counters — a narrow device
-        gather, never the whole sketch. Thread contract: like
-        snapshot_read, call from the batcher's submit thread
-        (DeviceBatcher.run_serialized) so the gather can't race a
-        sketch-donating dispatch."""
-        n = int(key_hash.shape[0])
-        if self.sketch is None or self.clock.epoch is None or n == 0:
-            return np.zeros(n, np.int64)
-        if now is None:
-            now = millisecond_now()
-        from gubernator_tpu.core.sketches import sketch_indices_np
-
-        kh, dur, _n = self._pad_keys_pow2(
-            np.ascontiguousarray(key_hash, np.uint64),
-            np.asarray(durations, np.int64),
-        )
-        wid, _ = self._sketch_windows(dur, now)
-        idx = sketch_indices_np(kh, wid, self.sketch_config)
-        data = self.sketch.data
-        est = None
-        for r in range(idx.shape[0]):
-            c = jnp.take(data[r], jnp.asarray(idx[r]))
-            est = c if est is None else jnp.minimum(est, c)
-        return np.asarray(est, np.int64)[:n]
-
-    def install_windows(
-        self,
-        key_hash: np.ndarray,
-        limit: np.ndarray,
-        remaining: np.ndarray,
-        reset_time: np.ndarray,
-        is_over: np.ndarray,
-        now: Optional[int] = None,
-    ) -> None:
-        """Install token windows for pre-hashed keys — the array-level
-        sibling of update_globals (same upsert kernel, same replica-
-        style entry layout). The sketch promoter migrates a hot key's
-        sketch estimate into an exact bucket through this surface.
-        Batches larger than the bucket ladder's top rung are CHUNKED
-        (installs are per-key upserts, order-free across chunks) — the
-        promoter's candidate count is a config knob (GUBER_SKETCH_TOPK)
-        with no relation to the ladder, and a choose_bucket refusal
-        here would wedge every subsequent promotion tick."""
-        n = int(key_hash.shape[0])
-        if n == 0:
-            return
-        if now is None:
-            now = millisecond_now()
-        self._engine_now(now)  # pin/refresh the epoch
-        top = max(self.buckets)
-        kh = np.ascontiguousarray(key_hash, np.uint64)
-        limit = np.asarray(limit)
-        remaining = np.asarray(remaining)
-        reset_time = np.asarray(reset_time)
-        is_over = np.asarray(is_over, bool)
-        for s in range(0, n, top):
-            e = min(s + top, n)
-            hashes, lim, rem, reset, over, valid = pad_to_bucket(
-                self.buckets,
-                e - s,
-                (kh[s:e], np.uint64),
-                (_sat_i32(limit[s:e]), np.int32),
-                (_sat_i32(remaining[s:e]), np.int32),
-                (self.clock.to_engine(reset_time[s:e]), np.int32),
-                (is_over[s:e], bool),
-            )
-            self.store = upsert_globals_jit(
-                self.store, hashes, lim, rem, reset, over, valid
-            )
-
-    def live_mask(
-        self, key_hash: np.ndarray, now: Optional[int] = None
-    ) -> np.ndarray:
-        """bool[n]: key currently holds a LIVE exact-tier entry (tag
-        match, not expired). Non-mutating; same thread contract as
-        snapshot_read. The promoter screens candidates with this so an
-        install can never clobber live exact state."""
-        n = int(key_hash.shape[0])
-        if n == 0 or self.clock.epoch is None:
-            return np.zeros(n, bool)
-        if now is None:
-            now = millisecond_now()
-        from gubernator_tpu.core.store import (
-            L_EXPIRE,
-            L_TAG,
-            bucket_index,
-            fingerprints,
-        )
-
-        from gubernator_tpu.core.store import LANES
-
-        kh_p, _n = self._pad_keys_pow2(
-            np.ascontiguousarray(key_hash, np.uint64)
-        )
-        kh = jnp.asarray(kh_p)
-        b = bucket_index(kh, self.config.slots)
-        fp = fingerprints(kh)
-        # gather from the canonical [buckets, ways*LANES] shape and
-        # reshape only the gathered rows: the .entries view reshapes
-        # the WHOLE store, which eager mode materializes per call
-        rows = jnp.take(self.store.data, b, axis=0).reshape(
-            kh.shape[0], -1, LANES
-        )
-        match = rows[..., L_TAG] == fp[:, None]
-        e_now = int(self.clock.to_engine(now))
-        live = match & (rows[..., L_EXPIRE] >= e_now)
-        return np.asarray(live.any(axis=1))[:n]
-
-    def promote_from_sketch(
-        self,
-        key_hash: np.ndarray,
-        limits: np.ndarray,
-        durations: np.ndarray,
-        now: Optional[int] = None,
-    ):
-        """Migrate hot sketch-tier keys into exact buckets: read each
-        key's current-window estimate and install a token window with
-        remaining = max(limit - estimate, 0) and reset = the window's
-        end — the key then decides exactly for the rest of the window
-        and re-creates exactly (byte-identical to a fresh key) in the
-        next one. Keys already holding a LIVE exact entry are skipped
-        (their state is authoritative). Returns (installed bool[n],
-        estimate int64[n], reset_unix int64[n], over bool[n]). Thread
-        contract: submit-thread only (DeviceBatcher.run_serialized) —
-        this reads AND upserts the store."""
-        n = int(key_hash.shape[0])
-        if n == 0 or self.sketch is None:
-            z = np.zeros(n, np.int64)
-            return np.zeros(n, bool), z, z, np.zeros(n, bool)
-        if now is None:
-            now = millisecond_now()
-        self._engine_now(now)  # pin the epoch before window math
-        kh = np.ascontiguousarray(key_hash, np.uint64)
-        limits = np.asarray(limits, np.int64)
-        est = self.sketch_estimates(kh, durations, now)
-        _, reset_unix = self._sketch_windows(durations, now)
-        over = est >= limits
-        remaining = np.maximum(limits - est, 0)
-        todo = ~self.live_mask(kh, now)
-        if todo.any():
-            self.install_windows(
-                kh[todo], limits[todo], remaining[todo],
-                reset_unix[todo], over[todo], now,
-            )
-        return todo, est, reset_unix, over
-
-    def _bucket(self, n: int) -> int:
-        return choose_bucket(self.buckets, n)
+        return TpuEngine
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -99,6 +99,7 @@ from gubernator_tpu.core.store import (
     L_DURATION,
     L_EXPIRE,
     L_FLAGS,
+    L_KEYLOW,
     L_LIMIT,
     L_REMAINING,
     L_TAG,
@@ -276,6 +277,13 @@ def _use_sweep_writeback(buckets: int, W: int, B: int) -> bool:
 
     mode = os.environ.get("GUBER_WRITEBACK", "auto")
     if mode == "scatter":
+        return False
+    if mode != "sweep" and jax.default_backend() != "tpu":
+        # the sweep is a Mosaic TPU kernel: auto must never pick it on
+        # a CPU/GPU backend, where its non-interpret lowering cannot
+        # compile (the CPU mesh-serving stacks hit exactly this before
+        # r14 gated it). GUBER_WRITEBACK=sweep still forces the path
+        # (interpret-mode tests and TPU-bound benches).
         return False
     if mode != "sweep" and B < 4 * buckets:
         return False
@@ -693,6 +701,7 @@ def _decide_presorted(
 
     existing0 = existing  # pre-override: GLOBAL replica serving below
     sk_g = None
+    fold_G = None
     if sketch is not None:
         # Live-victim protection: with the cold tier on, a create whose
         # eviction victim is still LIVE goes to the sketch instead of
@@ -714,6 +723,52 @@ def _decide_presorted(
         sk_extra = evicted_G & victim_live
         dropped_G = dropped_G | sk_extra
         evicted_G = evicted_G & ~sk_extra
+
+        # Eviction->sketch migration (r14): the evictions that remain
+        # after live-victim protection RECYCLE a dead (lazy-expired)
+        # victim's way — and used to drop the victim's consumed count
+        # on the floor (the exact tier's historical state-loss
+        # contract). When the dead entry's own window still overlaps
+        # the victim key's CURRENT fixed window (an entry created in
+        # the previous fixed window whose tail crosses the boundary),
+        # fold its consumed count into the sketch at (victim key,
+        # current window) instead: if the victim returns to a full
+        # bucket it is sketch-served AT-LEAST-AS-RESTRICTIVELY as the
+        # unevicted oracle rather than with a phantom-fresh budget.
+        # The victim's full uint64 key hash reconstructs from
+        # L_TAG (high 32 bits) + L_KEYLOW (low 32, written below) —
+        # exact except for the fp==0 -> 1 substitution, whose
+        # mis-attributed fold only ever INFLATES some estimate
+        # (fail-closed). Sticky-over victims fold their whole limit
+        # (their refusal state is the thing worth preserving); leaky
+        # victims are skipped (no fixed window to fold into).
+        v_dur_pos = jnp.maximum(v_sel[:, L_DURATION], 1)
+        v_wid = now // v_dur_pos
+        v_overlap = v_sel[:, L_EXPIRE] > v_wid * v_dur_pos
+        v_token = (v_sel[:, L_FLAGS] & FLAG_ALGO_LEAKY) == 0
+        v_sticky = (v_sel[:, L_FLAGS] & FLAG_STICKY_OVER) != 0
+        v_consumed = jnp.clip(
+            jnp.where(
+                v_sticky,
+                v_sel[:, L_LIMIT],
+                v_sel[:, L_LIMIT] - v_sel[:, L_REMAINING],
+            ),
+            0,
+            None,
+        )
+        fold_G = evicted_G & v_overlap & v_token & (v_consumed > 0)
+        v_kh = (
+            lax.bitcast_convert_type(v_sel[:, L_TAG], jnp.uint32).astype(
+                jnp.uint64
+            )
+            << jnp.uint64(32)
+        ) | lax.bitcast_convert_type(
+            v_sel[:, L_KEYLOW], jnp.uint32
+        ).astype(jnp.uint64)
+        v_est, v_idx = _sketch_lookup(sketch, v_kh, v_wid)
+        v_upd = jnp.where(
+            fold_G, v_est + v_consumed.astype(jnp.int64), jnp.int64(0)
+        )
         writer_G = writer_G & ~sk_extra
 
         # Sketch-served groups = valid creates the exact tier refused
@@ -878,6 +933,15 @@ def _decide_presorted(
         data_sk = sketch.data
         for r in range(len(sk_idx)):
             data_sk = data_sk.at[r, sk_idx[r]].max(upd)
+        # eviction->sketch migration (computed above with the victim
+        # plan): fold recycled dead victims' consumed counts into
+        # their keys' current windows — scatter-max like the request
+        # update, so ordering between the two is immaterial. A key
+        # both folded and sketch-decided in this same batch reads its
+        # estimate from before the fold (one-batch lag, conservative
+        # thereafter).
+        for r in range(len(v_idx)):
+            data_sk = data_sk.at[r, v_idx[r]].max(v_upd)
         new_sketch = Sketch(data=data_sk)
 
     # ---- responses --------------------------------------------------------
@@ -970,7 +1034,14 @@ def _decide_presorted(
             new_limit,
             new_duration,
             new_flags,
-            jnp.zeros_like(fp),
+            # L_KEYLOW: the key hash's low 32 bits — with the tag this
+            # makes the entry's full hash reconstructable on device
+            # (eviction->sketch migration above). Written in BOTH
+            # modes so sketch on/off store bytes stay identical.
+            lax.bitcast_convert_type(
+                (kh_G & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+                jnp.int32,
+            ),
         ],
         axis=-1,
     )  # [G, LANES]
@@ -1114,8 +1185,15 @@ def upsert_globals(
     flags = jnp.where(stack[:, 3] != 0, FLAG_STICKY_OVER, 0).astype(
         jnp.int32
     )
+    # L_KEYLOW from the sorted key hashes (skey carries only bucket|fp,
+    # not the low bits): replica/promoter installs stay reconstructable
+    # for the eviction->sketch fold like decide-written entries
+    klow = lax.bitcast_convert_type(
+        (key_hash[order] & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+        jnp.int32,
+    )
     new_vals = jnp.stack(
-        [fp, stack[:, 2], stack[:, 1], zero, stack[:, 0], zero, flags, zero],
+        [fp, stack[:, 2], stack[:, 1], zero, stack[:, 0], zero, flags, klow],
         axis=-1,
     )
 

@@ -229,8 +229,16 @@ def _apply_inline(
     # The session runs with x64 enabled (uint64 key hashes); tracing this
     # kernel under x64 trips an astype recursion inside pallas (jax
     # v0.9.x). Every input here is int32, so trace the pallas_call with
-    # x64 locally disabled — numerics are identical.
-    with jax.enable_x64(False):
+    # x64 locally disabled — numerics are identical. (jax 0.4.x spells
+    # the context manager jax.experimental.disable_x64; 0.5+ promotes
+    # it to jax.enable_x64(False).)
+    if hasattr(jax, "enable_x64"):
+        ctx = jax.enable_x64(False)
+    else:
+        from jax.experimental import disable_x64
+
+        ctx = disable_x64()
+    with ctx:
         return _call(data, bounds, comb, ntiles, buckets, interpret)
 
 
@@ -260,11 +268,16 @@ def _call(data, bounds, comb, ntiles, buckets, interpret=False):
             pltpu.SemaphoreType.DMA((3,)),
         ],
     )
+    # jax 0.4.x names the params class TPUCompilerParams; 0.5+ renames
+    # it CompilerParams
+    _params_cls = getattr(pltpu, "CompilerParams", None) or getattr(
+        pltpu, "TPUCompilerParams"
+    )
     kwargs = (
         dict(interpret=True)
         if interpret
         else dict(
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=_params_cls(
                 dimension_semantics=("arbitrary",)
             )
         )

@@ -52,7 +52,14 @@ wide scatter per batch instead of one per field. Lane meanings:
   L_LIMIT     stored limit
   L_DURATION  stored duration ms
   L_FLAGS     FLAG_* bits
-  lane 7      reserved/padding (keeps the lane count a power of two)
+  L_KEYLOW    bitcast of the key hash's LOW 32 bits (r14; was padding).
+              With L_TAG (the high 32 bits) this makes every entry's
+              full uint64 key hash reconstructable on device, which is
+              what lets the sketch tier FOLD a recycled dead entry's
+              consumed count into the victim key's current count-min
+              window instead of dropping it (eviction->sketch
+              migration, core/kernels.py). Identity-valued: untouched
+              by rebase, ignored by every pre-r14 consumer.
 
 This is the "exact" sibling of a count-min sketch: same dense-array,
 gather/scatter compute shape, but tags make collisions explicit (evictions)
@@ -77,6 +84,7 @@ L_TS = 3
 L_LIMIT = 4
 L_DURATION = 5
 L_FLAGS = 6
+L_KEYLOW = 7
 LANES = 8
 
 # flags lane bits

@@ -282,9 +282,20 @@ def initialize_distributed(
     coordinator: str, num_processes: int, process_id: int
 ) -> None:
     """jax.distributed.initialize with the platform this image needs
-    forced first (the TPU tunnel pre-registers itself)."""
+    forced first (the TPU tunnel pre-registers itself). On the CPU
+    backend the cross-process collectives implementation must be
+    selected BEFORE the client initializes: without it this jaxlib's
+    CPU client refuses multi-process computations outright
+    ("Multiprocess computations aren't implemented on the CPU
+    backend") — the error that kept the multihost suite in the
+    permanent failure set. gloo-over-TCP is the CPU stand-in for DCN
+    (multi-process TPU/GPU backends ignore the knob)."""
     import jax
 
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # pragma: no cover - knob absent on newer jax
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator,
         num_processes=num_processes,
@@ -293,9 +304,13 @@ def initialize_distributed(
 
 
 class MultiHostMeshEngine:
-    """MeshEngine over the GLOBAL device mesh plus the leader-side step
-    pipe. Construct identically in every process; only the leader calls
-    the public decide/update/sync methods (followers run follower_loop).
+    """The ONE partitioned engine (parallel/sharded.PartitionedEngine,
+    r14) over the GLOBAL device mesh, plus the leader-side lockstep
+    step pipe — this wrapper owns only the multi-controller choreography
+    (broadcast each device call so every process issues the identical
+    program); every decide/upsert/sync code path is the shared engine's.
+    Construct identically in every process; only the leader calls the
+    public decide/update/sync methods (followers run follower_loop).
     """
 
     def __init__(

@@ -59,8 +59,16 @@ from gubernator_tpu.core.kernels import (
     pack_outputs,
     rebase_jit,
     upsert_globals,
+    upsert_globals_jit,
 )
 from gubernator_tpu.core.store import Store, StoreConfig, mix64, new_store
+from gubernator_tpu.parallel.policy import ShardingPolicy, shard_map_compat
+
+# wall-clock reads go through the api.types MODULE attribute: the test
+# suites pin the serving clock by patching millisecond_now there (and on
+# core.engine/core.oracle), and a from-import frozen at import time
+# would leak real time into fake-clock differential fuzzes
+from gubernator_tpu.api import types as api_types
 
 _SHARD_SALT = np.uint64(0xA24BAED4963EE407)
 
@@ -160,6 +168,32 @@ def _local_decide_gathered(store: Store, req: BatchRequest, groups, now,
     out = jax.lax.all_gather(out, axes[-1])
     out = jax.lax.all_gather(out, axes[0])
     return store, out.reshape((-1,) + out.shape[2:])
+
+
+def _local_decide_sketch(store: Store, sketch, req: BatchRequest, groups,
+                         now):
+    """Two-tier twin of _local_decide (r14): each shard carries its own
+    count-min SUB-SKETCH next to its store shard. The host routes every
+    key to its owner chip, so a key's sketch charges land only in its
+    owner's sub-sketch — the sketch identity is (shard, key, window),
+    and the per-key error bound is the CLASSIC bound over that shard's
+    charged total N_s <= N (sharding can only tighten it; see
+    docs/operations.md "Partitioned engine (r14)")."""
+    from gubernator_tpu.core.kernels import decide_presorted_sketch
+
+    store = jax.tree.map(lambda x: x[0], store)
+    sketch = jax.tree.map(lambda x: x[0], sketch)
+    req = jax.tree.map(lambda x: x[0], req)
+    groups = jax.tree.map(lambda x: x[0], groups)
+    new_store, new_sketch, resp, stats = decide_presorted_sketch(
+        store, sketch, req, now, groups
+    )
+    packed = pack_outputs(resp, stats)
+    return (
+        jax.tree.map(lambda x: x[None], new_store),
+        jax.tree.map(lambda x: x[None], new_sketch),
+        packed[None],
+    )
 
 
 def _np_presort_sharded(
@@ -579,6 +613,10 @@ def build_presorted_sharded(
 def _shard_sync_globals(
     store: Store,
     key_hash: jax.Array,  # uint64[B] global keys to broadcast
+    hits: jax.Array,  # int32[B] aggregated GLOBAL hits to charge on the
+    # owner shard BEFORE broadcasting (0 = pure peek, the classic
+    # sync_globals gossip step; nonzero = apply_global_hits, the
+    # in-mesh psum replacing the owner->replica gossip round trip)
     limit: jax.Array,  # int32[B] request limit (for owner-side peek of misses)
     duration: jax.Array,
     algo: jax.Array,  # int32[B]: must match the stored algorithm, or the
@@ -588,21 +626,21 @@ def _shard_sync_globals(
     n_shards: int,
     axes: tuple = ("shard",),
 ):
-    """Owner peeks authoritative status; psum replicates; others upsert.
-    On a 2-D ("host", "chip") mesh the replication is the hierarchical
-    ICI-then-DCN reduction of BASELINE config 5 (see _hier_psum)."""
+    """Owner charges+peeks authoritative status; psum replicates;
+    others upsert. On a 2-D ("host", "chip") mesh the replication is
+    the hierarchical ICI-then-DCN reduction of BASELINE config 5 (see
+    _hier_psum)."""
     me = _axis_me(axes)
     store = jax.tree.map(lambda x: x[0], store)
     mine = owner_of(key_hash, n_shards) == me
 
-    B = key_hash.shape[0]
     peek = BatchRequest(
         key_hash=key_hash,
-        hits=jnp.zeros(B, jnp.int32),
+        hits=hits,
         limit=limit,
         duration=duration,
         algo=algo,
-        gnp=jnp.zeros(B, bool),
+        gnp=jnp.zeros(key_hash.shape[0], bool),
         valid=valid & mine,
     )
     store2, resp, _ = decide_presorted(store, peek, now)
@@ -653,104 +691,134 @@ def _shard_upsert(
     return jax.tree.map(lambda x: x[None], out)
 
 
-class MeshEngine:
-    """Drop-in sibling of core.engine.TpuEngine, sharded over a mesh.
+class PartitionedEngine:
+    """ONE engine, every topology (r14): host glue + device programs
+    for the slot store (and the r13 sketch cold tier), parameterized by
+    a ShardingPolicy instead of being forked per topology.
 
-    decide_arrays() has the same contract; GLOBAL requests served on
-    non-owner shards never leave the mesh — replicas answer locally after
-    each sync_globals() collective.
+    The policy decides the layout; the engine's host-side surfaces are
+    layout-independent and SHARED, so decide/upsert/snapshot/sketch
+    paths cannot drift between topologies (the r9 stack_shard_groups
+    seam, finished):
+
+    - flat (ShardingPolicy.single, the degenerate case): batches are
+      flat [B] arrays, dispatch is a plain jit with the store donated —
+      byte-identical to the historical single-device TpuEngine,
+      including every padding and presort convention
+      (tests/test_prep_pipeline.py pins them).
+    - mesh (ShardingPolicy.over_mesh): the store (and sketch) gain a
+      leading shard axis laid out over the mesh; batches become
+      [n_shards, B_sub] per-shard sub-batches routed host-side by
+      `owner = mix64(key_hash) mod n` (the consistent-hash ring mapped
+      onto the mesh axis); dispatch is a jitted shard_map where each
+      chip runs the SAME single-device kernel on its own sub-batch —
+      no collective on the decide path. GLOBAL sync/upsert ride
+      collectives (psum / owner-masked upsert) whose structure the
+      policy picks (hierarchical ICI-then-DCN on 2-D meshes).
+
+    TpuEngine and MeshEngine below are thin constructor shims over
+    this class; parallel/multihost.py wraps it with the lockstep step
+    pipe for multi-controller SPMD.
     """
 
     def __init__(
         self,
         config: StoreConfig = StoreConfig(),
-        devices: Optional[Sequence[jax.Device]] = None,
+        policy: Optional[ShardingPolicy] = None,
         buckets: Sequence[int] = (64, 256, 1024, 4096),
-        mesh_shape: Optional[Tuple[int, int]] = None,
+        sketch=None,
     ):
-        if devices is None:
-            devices = jax.devices()
-        self.n = len(devices)
-        # a single-process mesh host can fetch every response shard
-        # directly; a multi-process mesh must all_gather them (the serving
-        # leader cannot address follower-process shards)
-        procs = {d.process_index for d in devices}
-        span = len(procs) > 1
-        if mesh_shape is None and span and self.n % len(procs) == 0:
-            # The auto 2-D shape assumes the device list is process-major
-            # with EQUAL per-process counts. Validate that before
-            # committing: with unequal contributions (n still divisible
-            # by len(procs)) the reshape would group chips of different
-            # hosts under one 'host' row — numerically correct, but the
-            # "ICI within a row, DCN across rows" staging would silently
-            # cross DCN inside a row. Fall back to the flat ('shard',)
-            # mesh when any row mixes processes (ADVICE r5 #1).
-            grid = np.asarray(devices).reshape(
-                len(procs), self.n // len(procs)
-            )
-            if all(
-                len({d.process_index for d in row}) == 1 for row in grid
-            ):
-                mesh_shape = (len(procs), self.n // len(procs))
-        if mesh_shape is not None:
-            # 2-D ("host", "chip") mesh: the GLOBAL-sync reduction runs
-            # hierarchically — chips combine within a host over ICI,
-            # then hosts combine over DCN (BASELINE config 5's
-            # "hierarchical psum"). Device order is process-major
-            # (host-major), so the reshape groups each host's chips and
-            # the flattened (host, chip) index equals the 1-D shard
-            # index — placement is layout-independent.
-            n_hosts, per_host = mesh_shape
-            if n_hosts * per_host != self.n:
-                raise ValueError(
-                    f"mesh_shape {mesh_shape} != {self.n} devices"
-                )
-            dev_grid = np.asarray(devices).reshape(n_hosts, per_host)
-            self.mesh = Mesh(dev_grid, ("host", "chip"))
-            self.axes: tuple = ("host", "chip")
-        else:
-            self.mesh = Mesh(np.asarray(devices), ("shard",))
-            self.axes = ("shard",)
+        self.policy = (
+            policy if policy is not None else ShardingPolicy.single()
+        )
+        self.flat = self.policy.flat
         self.config = config
         self.buckets = sorted(buckets)
-        self.sub_buckets = sub_batch_ladder(self.buckets)
+        self.device = self.policy.device
         self.clock = EpochClock()
         self.stats = EngineStats()
-        # store-wipe epoch for the over-limit shed cache (see
-        # core/engine.py reset_generation)
+        # bumped by every reset(): the store-wipe epoch the over-limit
+        # shed cache checks (serve/shedcache.py)
         self.reset_generation = 0
+        # serve-tier hot-key observer (serve/promoter.py): called with
+        # every dispatched BatchRequest (numpy, pre-device, flat [B] or
+        # sharded [n_shards, B_sub] — the observer masks by `valid`
+        # either way) so the streaming top-K candidate source sees all
+        # traffic regardless of door or topology. Must never raise into
+        # the dispatch path.
+        self.observe_hook = None
+        # sketch cold tier (r13; sharded over the mesh axis since r14):
+        # `sketch_on` is the runtime A/B flag (scripts/perf_gate.py
+        # flips it between paired rounds; both variants compile lazily)
+        self.sketch_config = sketch
+        self.sketch = None
+        self.sketch_on = sketch is not None
+        if sketch is not None and self.policy.spans_processes:
+            raise ValueError(
+                "the sketch tier needs host-side estimate gathers the "
+                "serving leader cannot issue against follower-process "
+                "shards (the promoter is not a lockstep participant); "
+                "run GUBER_SKETCH=0 on multihost deployments"
+            )
 
-        Ps = P(self.axes)  # leading dim over all mesh axes, host-major
-        sharding = NamedSharding(self.mesh, Ps)
-        self.store_sharding = sharding
-        self.store = self._fresh_store()
+        if self.flat:
+            self.n = 1
+            self.mesh = None
+            self.axes: tuple = ()
+        else:
+            self.n = self.policy.n_shards
+            self.mesh = self.policy.mesh
+            self.axes = self.policy.axes
+            self.sub_buckets = sub_batch_ladder(self.buckets)
+            self.store_sharding = self.policy.store_sharding()
+            self._build_mesh_programs()
+        self.store: Store = self._fresh_store()
+        if sketch is not None:
+            self.sketch = self._fresh_sketch()
 
+    # -- state construction -------------------------------------------------
+
+    def _build_mesh_programs(self) -> None:
+        Ps = self.policy.request_spec()
+        P0 = self.policy.replicated_spec()
+        span = self.policy.spans_processes
         step_fn = (
             functools.partial(_local_decide_gathered, axes=self.axes)
             if span
             else _local_decide
         )
         self._step = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 step_fn,
                 mesh=self.mesh,
-                in_specs=(Ps, Ps, Ps, P()),
-                out_specs=(Ps, P() if span else Ps),
+                in_specs=(Ps, Ps, Ps, P0),
+                out_specs=(Ps, P0 if span else Ps),
                 # the all_gather output IS replicated, but the static
                 # varying-axis check can't prove it — disable just there
-                check_vma=not span,
+                check=not span,
             ),
             donate_argnums=(0,),
         )
+        self._step_sketch = None
+        if self.sketch_config is not None:
+            self._step_sketch = jax.jit(
+                shard_map_compat(
+                    _local_decide_sketch,
+                    mesh=self.mesh,
+                    in_specs=(Ps, Ps, Ps, Ps, P0),
+                    out_specs=(Ps, Ps, Ps),
+                ),
+                donate_argnums=(0, 1),
+            )
         sync_fn = functools.partial(
             _shard_sync_globals, n_shards=self.n, axes=self.axes
         )
         self._sync = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 sync_fn,
                 mesh=self.mesh,
-                in_specs=(Ps, P(), P(), P(), P(), P(), P()),
-                out_specs=(Ps, P()),
+                in_specs=(Ps,) + (P0,) * 7,
+                out_specs=(Ps, P0),
             ),
             donate_argnums=(0,),
         )
@@ -758,26 +826,43 @@ class MeshEngine:
             _shard_upsert, n_shards=self.n, axes=self.axes
         )
         self._upsert = jax.jit(
-            jax.shard_map(
+            shard_map_compat(
                 upsert_fn,
                 mesh=self.mesh,
-                in_specs=(Ps,) + (P(),) * 6,
+                in_specs=(Ps,) + (P0,) * 6,
                 out_specs=Ps,
             ),
             donate_argnums=(0,),
         )
 
+    def _replicate(self, x):
+        """Stack a per-shard leaf to [n_shards, ...] laid over the
+        policy's store sharding."""
+        stacked = jnp.broadcast_to(x[None], (self.n,) + x.shape)
+        return jax.device_put(stacked, self.store_sharding)
+
     def _fresh_store(self) -> Store:
         base = new_store(self.config)
+        if self.flat:
+            if self.device is not None:
+                base = jax.device_put(base, self.device)
+            return base
+        return jax.tree.map(self._replicate, base)
 
-        def rep(x):
-            stacked = jnp.broadcast_to(x[None], (self.n,) + x.shape)
-            return jax.device_put(stacked, self.store_sharding)
+    def _fresh_sketch(self):
+        from gubernator_tpu.core.sketches import new_sketch
 
-        return jax.tree.map(rep, base)
+        sk = new_sketch(self.sketch_config)
+        if self.flat:
+            if self.device is not None:
+                sk = jax.device_put(sk, self.device)
+            return sk
+        return jax.tree.map(self._replicate, sk)
 
     def reset(self) -> None:
         self.store = self._fresh_store()
+        if self.sketch_config is not None:
+            self.sketch = self._fresh_sketch()
         self.reset_generation += 1
 
     def _engine_now(self, now: int) -> np.int32:
@@ -788,7 +873,107 @@ class MeshEngine:
             # rebase is elementwise, so it runs shard-local with the
             # store's sharding preserved — no collective needed
             self.store = rebase_jit(self.store, np.int32(delta))
+            if self.sketch is not None:
+                # sketch windows are keyed by engine-ms // duration, so
+                # a rebase shifts every window id: clear rather than
+                # carry counts into wrong windows. Rare (~12-day
+                # cadence) and one-sided-safe in the fail-open
+                # direction for at most one window per key — the same
+                # class of loss as the reference's restart contract.
+                self.sketch = self._fresh_sketch()
         return e
+
+    # -- the one dispatch funnel --------------------------------------------
+
+    def _dispatch(self, req, groups, e_now):
+        """Every submit path — flat or sharded, flush-prep, arrival-
+        prep or merged — ends here: feed the serve-tier hot-key
+        observer (numpy fields, pre-device) and pick the exact-only or
+        two-tier program for this engine's layout."""
+        hook = self.observe_hook
+        if hook is not None:
+            try:
+                hook(req)
+            except Exception:  # pragma: no cover - defensive
+                pass  # observability must never fail a dispatch
+        two_tier = self.sketch is not None and self.sketch_on
+        if self.flat:
+            from gubernator_tpu.core.engine import (
+                _decide_packed_jit,
+                _decide_packed_sketch_jit,
+            )
+
+            if two_tier:
+                self.store, self.sketch, packed = (
+                    _decide_packed_sketch_jit(
+                        self.store, self.sketch, req, e_now, groups
+                    )
+                )
+                return packed
+            self.store, packed = _decide_packed_jit(
+                self.store, req, e_now, groups
+            )
+            return packed
+        if two_tier:
+            self.store, self.sketch, packed = self._step_sketch(
+                self.store, self.sketch, req, groups, e_now
+            )
+            return packed
+        self.store, packed = self._step(self.store, req, groups, e_now)
+        return packed
+
+    # -- request-object API --------------------------------------------------
+
+    def get_rate_limits_submit(
+        self,
+        reqs: Sequence["RateLimitReq"],
+        now: Optional[int] = None,
+        gnp: Optional[Sequence[bool]] = None,
+    ):
+        """Request-object sibling of decide_submit: convert + presort +
+        dispatch one batch without waiting. Returns an opaque handle for
+        get_rate_limits_wait, or None for an empty batch."""
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        n = len(reqs)
+        if n == 0:
+            return None
+        if now is None:
+            now = api_types.millisecond_now()
+        keys = [r.hash_key() for r in reqs]
+        hashes = slot_hash_batch(keys)
+        hits = np.fromiter((r.hits for r in reqs), np.int64, n)
+        limit = np.fromiter((r.limit for r in reqs), np.int64, n)
+        duration = np.fromiter((r.duration for r in reqs), np.int64, n)
+        algo = np.fromiter((int(r.algorithm) for r in reqs), np.int32, n)
+        gnp_arr = (
+            np.asarray(gnp, bool) if gnp is not None else np.zeros(n, bool)
+        )
+        return self.decide_submit(
+            hashes, hits, limit, duration, algo, gnp_arr, now
+        )
+
+    def get_rate_limits_wait(self, handle):
+        """Fetch + convert the responses for a get_rate_limits_submit
+        handle."""
+        from gubernator_tpu.api.types import resps_from_columns
+
+        if handle is None:
+            return []
+        return resps_from_columns(*self.decide_wait(handle))
+
+    def get_rate_limits(
+        self,
+        reqs: Sequence["RateLimitReq"],
+        now: Optional[int] = None,
+        gnp: Optional[Sequence[bool]] = None,
+    ):
+        """Decide a batch. `gnp[i]` marks GLOBAL non-owner replica reads."""
+        return self.get_rate_limits_wait(
+            self.get_rate_limits_submit(reqs, now=now, gnp=gnp)
+        )
+
+    # -- array decide paths --------------------------------------------------
 
     def decide_submit(
         self,
@@ -800,14 +985,34 @@ class MeshEngine:
         gnp: np.ndarray,
         now: int,
     ):
-        """Presort/shard + dispatch one batch WITHOUT waiting — the mesh
-        sibling of TpuEngine.decide_submit. The store update threads
-        through the jitted step immediately, so the caller may prep the
-        next batch while every chip computes this one (the serving
-        batcher's pipelining; MeshBackend exposes this split). Returns
-        an opaque handle for decide_wait."""
+        """Presort(/shard) + dispatch one batch WITHOUT waiting.
+
+        The store update is effective immediately (the jitted call
+        threads the donated store), so the next submit may follow at
+        once; jax dispatch is async, which lets the caller presort
+        batch i+1 while the device computes batch i — the pipelining
+        the serving batcher relies on. Returns an opaque handle for
+        decide_wait; the handle captures the submit-time epoch so a
+        later rebase cannot skew an in-flight batch's reset_times."""
         n = key_hash.shape[0]
         e_now = self._engine_now(now)
+        if self.flat:
+            req, order, groups = pad_request_sorted(
+                self.buckets,
+                self.config.slots,
+                key_hash,
+                hits,
+                limit,
+                duration,
+                algo,
+                gnp,
+                with_groups=True,
+            )
+            packed = self._dispatch(req, groups, e_now)
+            return (
+                packed, order, None, n, req.key_hash.shape[0],
+                self.clock.epoch,
+            )
         req, order, take_idx, groups = pad_request_sharded(
             self.sub_buckets,
             self.config.slots,
@@ -821,36 +1026,74 @@ class MeshEngine:
             with_groups=True,
         )
         B_sub = req.key_hash.shape[1]
-        self.store, packed = self._step(self.store, req, groups, e_now)
+        packed = self._dispatch(req, groups, e_now)
         if _prep_native is not None:
             # the native prep returns order/take_idx as VIEWS into its
-            # reusable buffer ring. This handle outlives any fixed ring
-            # depth under the batcher's out-of-order fetch pipeline (a
-            # stalled fetch can be outrun by later submits without
-            # bound), so the handle keeps copies. The device-field views
-            # need no copy: dispatch commits host inputs before _step
-            # returns (verified by mutate-after-dispatch on the tunnel
-            # backend; jax never exposes numpy inputs to later writes).
+            # reusable buffer ring; this handle can outlive any fixed
+            # ring depth under the batcher's out-of-order fetch
+            # pipeline, so keep copies (device-field views need none:
+            # dispatch commits host inputs before the step returns)
             order = order.copy()
             take_idx = take_idx.copy()
-        # epoch captured at submit: a later submit may rebase before this
-        # batch's wait (same contract as TpuEngine.decide_submit)
         return (packed, order, take_idx, n, B_sub, self.clock.epoch)
 
     def prep_run(self, fields: dict) -> dict:
-        """Arrival-time per-group prep (serve/batcher.py): see
-        prep_run_sharded."""
+        """Arrival-time per-group prep (serve/batcher.py): one sorted,
+        device-dtype run the flush-time merge combine stitches. The
+        sort key is the policy's — (bucket, fp) flat, (owner, bucket,
+        fp) sharded — so runs merge without re-sorting either way."""
+        from gubernator_tpu.core.engine import prep_run_single
+
+        if self.flat:
+            return prep_run_single(fields, self.config.slots)
         return prep_run_sharded(fields, self.config.slots, self.n)
 
     def merge_prepped(self, runs):
         """Merge pre-sorted per-group runs into one dispatch-ready
-        sharded batch (the submit thread's `merge` stage): a flat
-        fused native merge when available (serve/prep.py dispatches to
-        guber_merge_runs), then the per-shard [n_shards, B_sub] layout
-        + group structure via build_presorted_sharded. Output feeds
-        decide_submit_merged."""
+        batch (the submit thread's `merge` stage)."""
         from gubernator_tpu.serve.prep import merge_runs
 
+        if self.flat:
+            from gubernator_tpu.core.engine import (
+                _hn as _ce_hn,
+                build_presorted_request,
+                choose_bucket,
+                group_rungs,
+            )
+
+            n = int(sum(r["n"] for r in runs))
+            B = choose_bucket(self.buckets, n)
+            if (
+                _ce_hn is not None
+                and getattr(_ce_hn, "_HAS_MERGE", False)
+                and n
+            ):
+                m = _ce_hn.merge_runs_native(
+                    runs, B, g_rungs=group_rungs(B)
+                )
+                req = BatchRequest(
+                    key_hash=m["key_hash"], hits=m["hits"],
+                    limit=m["limit"], duration=m["duration"],
+                    algo=m["algo"], gnp=m["gnp"], valid=m["valid"],
+                )
+                groups = BatchGroups(
+                    key_hash=m["group_key_hash"],
+                    leader_pos=m["leader_pos"],
+                    end_pos=m["group_end"],
+                    valid=m["group_valid"],
+                    group_id=m["group_id"],
+                )
+                return dict(
+                    req=req, groups=groups, order=m["order"], n=n, B=B
+                )
+            m = merge_runs(runs)
+            req, groups, B = build_presorted_request(
+                self.buckets, m["fields"], m["skey"], n
+            )
+            order_p = np.empty(B, np.int32)
+            order_p[:n] = m["order"]
+            order_p[n:] = np.arange(n, B, dtype=np.int32)
+            return dict(req=req, groups=groups, order=order_p, n=n, B=B)
         m = merge_runs(runs)
         req, take_idx, groups, B_sub = build_presorted_sharded(
             self.sub_buckets, self.config.slots, self.n, m["fields"],
@@ -862,13 +1105,15 @@ class MeshEngine:
         )
 
     def decide_submit_merged(self, merged: dict, now: int):
-        """Dispatch a merge_prepped batch (mesh): epoch bookkeeping +
-        the jitted shard_map call. Returns the standard decide_wait
-        handle."""
+        """Dispatch a merge_prepped batch: epoch bookkeeping + the one
+        jitted call — the submit thread's `dispatch` stage."""
         e_now = self._engine_now(now)
-        self.store, packed = self._step(
-            self.store, merged["req"], merged["groups"], e_now
-        )
+        packed = self._dispatch(merged["req"], merged["groups"], e_now)
+        if self.flat:
+            return (
+                packed, merged["order"], None, merged["n"], merged["B"],
+                self.clock.epoch,
+            )
         return (
             packed, merged["order"], merged["take_idx"], merged["n"],
             merged["B_sub"], self.clock.epoch,
@@ -882,49 +1127,88 @@ class MeshEngine:
         counts: np.ndarray,
         now: int,
     ):
-        """Mesh sibling of TpuEngine.decide_submit_presorted: dispatch a
-        batch whose (owner, bucket, fingerprint) presort already
-        happened at arrival time. Slices the merged sorted stream into
-        contiguous per-shard sub-batches ([n_shards, B_sub] repeat-pad,
-        identical to pad_request_sharded's layout), derives the
-        per-shard duplicate-key group structure from the sorted key
-        stream in O(n), and dispatches. `order` may be None (identity)
-        for callers that discard the handle — the lockstep follower
-        path. Returns the standard decide_wait handle."""
+        """Dispatch a batch whose host presort already happened
+        (arrival-time prep + merge combine): `fields` are device-dtype
+        request arrays in the policy's sorted order, `skey` the
+        matching sorted composite keys, `order[k]` the caller index of
+        sorted row k (None = identity, the lockstep-follower path),
+        `counts` the per-shard row counts ([n] on the flat policy).
+        Pads + derives the duplicate-key group structure in O(n) and
+        dispatches — no argsort anywhere."""
         n = skey.shape[0]
         if n == 0:
             return None
         e_now = self._engine_now(now)
+        if self.flat:
+            from gubernator_tpu.core.engine import build_presorted_request
+
+            req, groups, B = build_presorted_request(
+                self.buckets, fields, skey, n
+            )
+            order_p = np.empty(B, np.int32)
+            order_p[:n] = (
+                order
+                if order is not None
+                else np.arange(n, dtype=np.int32)
+            )
+            order_p[n:] = np.arange(n, B, dtype=np.int32)
+            packed = self._dispatch(req, groups, e_now)
+            return (packed, order_p, None, n, B, self.clock.epoch)
         req, take_idx, groups, B_sub = build_presorted_sharded(
             self.sub_buckets, self.config.slots, self.n, fields, skey,
             counts,
         )
         if order is None:
             order = np.arange(n, dtype=np.int32)
-        self.store, packed = self._step(self.store, req, groups, e_now)
+        packed = self._dispatch(req, groups, e_now)
         return (packed, order, take_idx, n, B_sub, self.clock.epoch)
 
     def decide_wait(
         self, handle
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
-        """Fetch + unflatten the responses for a decide_submit handle."""
-        packed, order, take_idx, n, B_sub, epoch = handle
-        # [n_shards, 4*B_sub+PACKED_STATS]
+        """Fetch + unpermute the responses for a decide_submit handle.
+        One handle format for every policy: (packed, order, take_idx,
+        n, B, epoch) with take_idx None on the flat layout."""
+        packed, order, take_idx, n, B, epoch = handle
         packed = np.asarray(jax.device_get(packed))
+        if take_idx is None:
+            from gubernator_tpu.core.engine import (
+                _marshal,
+                unpermute_responses,
+            )
+            from gubernator_tpu.core.kernels import unpack_outputs
+
+            self.stats.add_batch(
+                int(packed[4 * B]),
+                int(packed[4 * B + 1]),
+                int(packed[4 * B + 2]),
+                int(packed[4 * B + 3]),
+            )
+            if _marshal is not None:
+                u = _marshal.unpermute_i32(
+                    packed[: 4 * B].reshape(4, B), order, n
+                )
+                status, rlimit, remaining, reset = u[0], u[1], u[2], u[3]
+            else:
+                s_st, s_lim, s_rem, s_reset = unpack_outputs(packed, B)[:4]
+                status, rlimit, remaining, reset = unpermute_responses(
+                    order, (s_st, s_lim, s_rem, s_reset)
+                )
+            r = np.asarray(reset[:n], np.int64)
+            reset = np.where(r == 0, 0, r + epoch)
+            return status[:n], rlimit[:n], remaining[:n], reset
+        B_sub = B
+        # [n_shards, 4*B_sub+PACKED_STATS]
         self.stats.add_batch(
             int(packed[:, 4 * B_sub].sum()),
             int(packed[:, 4 * B_sub + 1].sum()),
             int(packed[:, 4 * B_sub + 2].sum()),
             int(packed[:, 4 * B_sub + 3].sum()),
         )
-
         if _prep_native is not None and n > 0:
             # native one-pass unflatten of all four response columns
             from gubernator_tpu.native.hashlib_native import unflatten_resp
 
-            # per-shard counts fall out of take_idx: it is strictly
-            # increasing and cell (s, j) flattens to s*B_sub + j, so
-            # shard boundaries are one binary search each
             bounds = np.searchsorted(
                 take_idx, np.arange(1, self.n + 1) * B_sub, side="left"
             )
@@ -960,13 +1244,167 @@ class MeshEngine:
         gnp: np.ndarray,
         now: int,
     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Array-level entry point (also the benchmark harness's).
+        Times in/out are int64 unix-ms; conversion happens here."""
         return self.decide_wait(
             self.decide_submit(
                 key_hash, hits, limit, duration, algo, gnp, now
             )
         )
 
-    def update_globals(
+    # -- shared host-side state reads ---------------------------------------
+
+    @staticmethod
+    def _pad_keys_pow2(key_hash: np.ndarray, *cols):
+        """Pad key hashes (+ parallel int64 columns) to a power-of-two
+        length (floor 64) by repeating the last row: un-jitted device
+        gathers compile one kernel PER SHAPE, and the promoter's
+        candidate count changes every tick (~500ms/tick of eager
+        recompiles unpadded). Returns (kh, cols..., n)."""
+        n = int(key_hash.shape[0])
+        B = 1 << max(6, (n - 1).bit_length())
+        kh = np.empty(B, np.uint64)
+        kh[:n] = key_hash
+        kh[n:] = kh[n - 1] if n else 0
+        out = [kh]
+        for c in cols:
+            p = np.empty(B, np.int64)
+            p[:n] = c
+            p[n:] = p[n - 1] if n else 0
+            out.append(p)
+        out.append(n)
+        return tuple(out)
+
+    def _gather_entries(self, kh_padded: np.ndarray) -> np.ndarray:
+        """Host np int32[B, ways, LANES]: each key's candidate bucket
+        row, gathered from the key's owning shard's store — THE one
+        lookup every non-mutating host read (snapshot_read, live_mask)
+        shares, so the addressed row can never drift between
+        topologies. Non-mutating; same thread contract as
+        snapshot_read."""
+        from gubernator_tpu.core.store import LANES, bucket_index
+
+        kh = jnp.asarray(kh_padded)
+        b = bucket_index(kh, self.config.slots)
+        if self.flat:
+            rows = _rows_flat(self.store.data, b)
+        else:
+            owner = jnp.asarray(owner_of_np(kh_padded, self.n))
+            rows = _rows_sharded(self.store.data, owner, b)
+        return np.asarray(rows).reshape(kh_padded.shape[0], -1, LANES)
+
+    def snapshot_read(
+        self, key_hash: np.ndarray, now: Optional[int] = None
+    ):
+        """NON-MUTATING host read of the store rows for these uint64
+        key hashes: per key, (limit, duration, remaining,
+        reset_time_unix, over) for a live token window, or None
+        (missing, expired, or leaky — leaky state refills continuously
+        and is out of the replication scope). Nothing is written: no
+        eviction, no expiry deletion, no stats — which is what makes
+        bucket replication provably invisible to the decision stream.
+
+        Thread contract: call from the batcher's single submit thread
+        (DeviceBatcher.run_serialized) so the gather can never race a
+        store-donating dispatch."""
+        from gubernator_tpu.core.store import (
+            FLAG_ALGO_LEAKY,
+            FLAG_STICKY_OVER,
+            L_DURATION,
+            L_EXPIRE,
+            L_FLAGS,
+            L_LIMIT,
+            L_REMAINING,
+            L_TAG,
+            fingerprints,
+        )
+        from gubernator_tpu.core import hashing
+
+        n = int(key_hash.shape[0])
+        if n == 0:
+            return []
+        if self.clock.epoch is None:
+            return [None] * n  # nothing ever decided
+        if now is None:
+            now = api_types.millisecond_now()
+        kh_p, _n = self._pad_keys_pow2(
+            np.ascontiguousarray(key_hash, dtype=np.uint64)
+        )
+        ent_rows = self._gather_entries(kh_p)[:n]
+        # fingerprint the PADDED pow2 shape and slice: eager per-n
+        # shapes would recompile every distinct snapshot batch size
+        fp = np.asarray(
+            jax.device_get(fingerprints(jnp.asarray(kh_p)))
+        )[:n]
+        match = ent_rows[:, :, L_TAG] == fp[:, None]
+        found = match.any(axis=1)
+        way = np.argmax(match, axis=1)
+        ent = ent_rows[np.arange(n), way]
+        e_now = int(self.clock.to_engine(now))
+        out = []
+        flags_col = ent[:, L_FLAGS]
+        for i in range(n):
+            if not found[i] or int(ent[i, L_EXPIRE]) < e_now:
+                out.append(None)  # miss, or entry past its reset
+                continue
+            flags = int(flags_col[i])
+            if flags & FLAG_ALGO_LEAKY:
+                out.append(None)
+                continue
+            remaining = int(ent[i, L_REMAINING])
+            reset_time = int(
+                self.clock.from_engine(np.int64(ent[i, L_EXPIRE]))
+            )
+            out.append((
+                int(ent[i, L_LIMIT]),
+                int(ent[i, L_DURATION]),
+                remaining,
+                reset_time,
+                bool(flags & FLAG_STICKY_OVER) or remaining == 0,
+            ))
+        return out
+
+    def live_mask(
+        self, key_hash: np.ndarray, now: Optional[int] = None
+    ) -> np.ndarray:
+        """bool[n]: key currently holds a LIVE exact-tier entry (tag
+        match, not expired) on its owning shard. Non-mutating; same
+        thread contract as snapshot_read. The promoter screens
+        candidates with this so an install can never clobber live
+        exact state."""
+        from gubernator_tpu.core.store import L_EXPIRE, L_TAG, fingerprints
+
+        n = int(key_hash.shape[0])
+        if n == 0 or self.clock.epoch is None:
+            return np.zeros(n, bool)
+        if now is None:
+            now = api_types.millisecond_now()
+        kh_p, _n = self._pad_keys_pow2(
+            np.ascontiguousarray(key_hash, np.uint64)
+        )
+        rows = self._gather_entries(kh_p)
+        fp = np.asarray(jax.device_get(fingerprints(jnp.asarray(kh_p))))
+        match = rows[:, :, L_TAG] == fp[:, None]
+        e_now = int(self.clock.to_engine(now))
+        live = match & (rows[:, :, L_EXPIRE] >= e_now)
+        return live.any(axis=1)[:n]
+
+    # -- GLOBAL install / sync ----------------------------------------------
+
+    def _upsert_padded(self, hashes, lim, rem, reset, over, valid):
+        """One padded replica-install call against this policy's
+        layout: flat = the donated single-store upsert jit; mesh = the
+        owner-masked shard_map upsert collective."""
+        if self.flat:
+            self.store = upsert_globals_jit(
+                self.store, hashes, lim, rem, reset, over, valid
+            )
+        else:
+            self.store = self._upsert(
+                self.store, hashes, lim, rem, reset, over, valid
+            )
+
+    def install_windows(
         self,
         key_hash: np.ndarray,
         limit: np.ndarray,
@@ -975,29 +1413,153 @@ class MeshEngine:
         is_over: np.ndarray,
         now: Optional[int] = None,
     ) -> None:
-        """Install broadcast GLOBAL statuses on their owning shards — the
-        receive side of UpdatePeerGlobals (reference gubernator.go:199-207)
-        for a mesh-backed host. reset_time is int64 unix-ms."""
-        n = key_hash.shape[0]
+        """Install token windows for pre-hashed keys — the array-level
+        GLOBAL replica install (UpdatePeerGlobals receive path) and the
+        sketch promoter's migration surface. Batches larger than the
+        bucket ladder's top rung are CHUNKED (installs are per-key
+        upserts; chunk order preserves last-wins for duplicates), so
+        callers never hit a choose_bucket refusal."""
+        kh = np.ascontiguousarray(key_hash, np.uint64)
+        n = int(kh.shape[0])
         if n == 0:
             return
-        from gubernator_tpu.api.types import millisecond_now
+        if now is None:
+            now = api_types.millisecond_now()
+        self._engine_now(now)  # pin/refresh the epoch
+        top = max(self.buckets)
+        limit = np.asarray(limit)
+        remaining = np.asarray(remaining)
+        reset_time = np.asarray(reset_time)
+        is_over = np.asarray(is_over, bool)
+        for s in range(0, n, top):
+            e = min(s + top, n)
+            hashes, lim, rem, reset, over, valid = pad_to_bucket(
+                self.buckets,
+                e - s,
+                (kh[s:e], np.uint64),
+                (_sat_i32(limit[s:e]), np.int32),
+                (_sat_i32(remaining[s:e]), np.int32),
+                (self.clock.to_engine(reset_time[s:e]), np.int32),
+                (is_over[s:e], bool),
+            )
+            self._upsert_padded(hashes, lim, rem, reset, over, valid)
 
-        self._engine_now(millisecond_now() if now is None else now)
+    def update_globals(self, *args, now: Optional[int] = None, **kw):
+        """Install owner-broadcast GLOBAL statuses (UpdatePeerGlobals
+        receive path). Two call forms, ONE install path (both funnel
+        into install_windows, so the replica-install semantics cannot
+        drift between the serving tiers):
+
+        - object form: update_globals([(key, RateLimitResp), ...])
+        - array form:  update_globals(key_hash=..., limit=...,
+          remaining=..., reset_time=..., is_over=...) — positional
+          ndarrays accepted for the historical MeshEngine signature.
+        """
+        updates_kw = kw.pop("updates", None)
+        if updates_kw is not None:
+            if args or kw:
+                raise TypeError(
+                    "update_globals(updates=...) excludes other args"
+                )
+            args = (updates_kw,)
+        if kw or len(args) > 1 or (
+            args and isinstance(args[0], np.ndarray)
+        ):
+            names = ("key_hash", "limit", "remaining", "reset_time",
+                     "is_over")
+            vals = dict(zip(names, args))
+            vals.update(kw)
+            return self.install_windows(
+                vals["key_hash"], vals["limit"], vals["remaining"],
+                vals["reset_time"], vals["is_over"], now=now,
+            )
+        from gubernator_tpu.api.types import Status
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        updates = list(args[0]) if args else []
+        n = len(updates)
+        if n == 0:
+            return
+        return self.install_windows(
+            slot_hash_batch([k for k, _ in updates]),
+            np.fromiter((s.limit for _, s in updates), np.int64, n),
+            np.fromiter((s.remaining for _, s in updates), np.int64, n),
+            np.fromiter((s.reset_time for _, s in updates), np.int64, n),
+            np.fromiter(
+                (s.status == Status.OVER_LIMIT for _, s in updates),
+                bool, n,
+            ),
+            now=now,
+        )
+
+    def _sync_padded(self, key_hash, hits, limit, duration, algo, now):
+        """One padded owner-charge + psum-replicate + replica-install
+        collective step; returns the padded sorted-order responses and
+        the pad order. Flat degenerate case: the owner leg IS the whole
+        mesh, so the same semantics are one local decide (identical
+        kernel; the replica-install leg is empty)."""
+        n = key_hash.shape[0]
+        if algo is None:
+            algo = np.zeros(n, np.int32)
+        e_now = self._engine_now(now)
+        if self.flat:
+            # gossip traffic must not heat the promoter's top-K or
+            # count as decide batches in EngineStats — the mesh
+            # branch's collective records neither, and the two
+            # policies may not drift (runs on the serialized submit
+            # thread, so the swap-out is not racy)
+            hook, self.observe_hook = self.observe_hook, None
+            stats, self.stats = self.stats, EngineStats()
+            try:
+                # sync batches are gossip accumulations with no upper
+                # bound; the flat ladder tops out at max(buckets), so
+                # chunk (like install_windows) rather than refuse —
+                # the mesh branch handles the same overflow by
+                # extending its ladder
+                top = max(self.buckets)
+                if n <= top:
+                    h = self.decide_submit(
+                        key_hash, hits, limit, duration, algo,
+                        np.zeros(n, bool), now,
+                    )
+                    return self.decide_wait(h), None
+                cols = ([], [], [], [])
+                for s in range(0, n, top):
+                    e = min(s + top, n)
+                    h = self.decide_submit(
+                        key_hash[s:e], hits[s:e], limit[s:e],
+                        duration[s:e], algo[s:e],
+                        np.zeros(e - s, bool), now,
+                    )
+                    for c, v in zip(cols, self.decide_wait(h)):
+                        c.append(v)
+                return tuple(np.concatenate(c) for c in cols), None
+            finally:
+                self.observe_hook = hook
+                self.stats = stats
         if n > max(self.buckets):
             _warn_ladder_overflow(max(self.buckets), n)
-        kh, lim, rem, rst, over, valid = pad_to_bucket(
+        req, order = pad_request_sorted(
             extend_ladder(self.buckets, n),
-            n,
-            (key_hash, np.uint64),
-            (_sat_i32(limit), np.int32),
-            (_sat_i32(remaining), np.int32),
-            (self.clock.to_engine(reset_time), np.int32),
-            (is_over, bool),
+            self.config.slots,
+            key_hash,
+            hits,
+            limit,
+            duration,
+            algo,
+            np.zeros(n, bool),
         )
-        self.store = self._upsert(
-            self.store, kh, lim, rem, rst, over, valid
+        self.store, resp = self._sync(
+            self.store,
+            req.key_hash,
+            req.hits,
+            req.limit,
+            req.duration,
+            req.algo,
+            req.valid,
+            e_now,
         )
+        return resp, order
 
     def sync_globals(
         self,
@@ -1007,32 +1569,338 @@ class MeshEngine:
         now: int,
         algo: Optional[np.ndarray] = None,
     ) -> None:
-        """One collective gossip step for the given GLOBAL keys. `algo`
+        """One collective gossip step for the given GLOBAL keys: owner
+        peeks authoritative status (hits=0), a psum replicates it
+        mesh-wide, every non-owner installs replica entries. `algo`
         must carry each key's algorithm (defaults to token bucket)."""
         n = key_hash.shape[0]
         if n == 0:
             return
-        if algo is None:
-            algo = np.zeros(n, np.int32)
-        e_now = self._engine_now(now)
-        if n > max(self.buckets):
-            _warn_ladder_overflow(max(self.buckets), n)
-        req, _order = pad_request_sorted(
-            extend_ladder(self.buckets, n),
-            self.config.slots,
-            key_hash,
-            np.zeros(n, np.int64),
-            limit,
-            duration,
-            algo,
-            np.zeros(n, bool),
+        self._sync_padded(
+            key_hash, np.zeros(n, np.int64), limit, duration, algo, now
         )
-        self.store, _resp = self._sync(
-            self.store,
-            req.key_hash,
-            req.limit,
-            req.duration,
-            req.algo,
-            req.valid,
-            e_now,
+
+    def apply_global_hits(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        now: int,
+        algo: Optional[np.ndarray] = None,
+    ):
+        """In-mesh GLOBAL hit aggregation (r14 prototype, the SNIPPETS
+        brief's psum): charge each key's aggregated GLOBAL hits on its
+        OWNER shard and replicate the post-charge status to every other
+        shard in ONE collective step — the owner->replica gossip loop
+        (queue hits -> owner applies -> broadcast -> replicas install)
+        collapsed into a single device program when the "peers" are
+        shards of one mesh. Returns (status, limit, remaining,
+        reset_time_unix) per key in caller order — the authoritative
+        post-charge windows, ready for a cross-NODE broadcast when the
+        mesh is one node of a wider ring."""
+        n = key_hash.shape[0]
+        if n == 0:
+            z = np.empty(0, np.int64)
+            return z, z, z, z
+        resp, order = self._sync_padded(
+            key_hash, hits, limit, duration, algo, now
+        )
+        if order is None:  # flat: decide_wait already unpermuted
+            return resp
+        epoch = self.clock.epoch
+
+        def unpad(a):
+            a = np.asarray(a)
+            out = np.empty(a.shape[0], a.dtype)
+            out[order] = a
+            return out[:n]
+
+        status = unpad(resp.status)
+        rlimit = unpad(resp.limit)
+        remaining = unpad(resp.remaining)
+        r = unpad(resp.reset_time).astype(np.int64)
+        reset = np.where(r == 0, 0, r + epoch)
+        return status, rlimit, remaining, reset
+
+    # -- sketch cold tier (r13, sharded r14) --------------------------------
+
+    def _sketch_windows(self, durations: np.ndarray, now: int):
+        """(window_id int64[n], window_end_unix int64[n]) for the
+        current fixed windows of these durations."""
+        from gubernator_tpu.core.sketches import window_id_np
+
+        e_now = int(self.clock.to_engine(now))
+        wid = window_id_np(e_now, durations)
+        d = np.maximum(np.asarray(durations, np.int64), 1)
+        wend_engine = (wid + 1) * d
+        return wid, np.asarray(self.clock.from_engine(wend_engine))
+
+    def sketch_estimates(
+        self,
+        key_hash: np.ndarray,
+        durations: np.ndarray,
+        now: Optional[int] = None,
+    ) -> np.ndarray:
+        """NON-MUTATING current-window count-min estimates int64[n]
+        for these keys (0 when the tier is off or nothing was ever
+        decided), read from each key's OWNING shard's sub-sketch —
+        the same addressing the decide kernel charges, so host and
+        device views cannot drift. Narrow gathers only; submit-thread
+        contract like snapshot_read."""
+        n = int(key_hash.shape[0])
+        if self.sketch is None or self.clock.epoch is None or n == 0:
+            return np.zeros(n, np.int64)
+        if now is None:
+            now = api_types.millisecond_now()
+        from gubernator_tpu.core.sketches import sketch_indices_np
+
+        kh, dur, _n = self._pad_keys_pow2(
+            np.ascontiguousarray(key_hash, np.uint64),
+            np.asarray(durations, np.int64),
+        )
+        wid, _ = self._sketch_windows(dur, now)
+        idx = sketch_indices_np(kh, wid, self.sketch_config)
+        if self.flat:
+            est = _sketch_min_flat(self.sketch.data, jnp.asarray(idx))
+        else:
+            owner = jnp.asarray(owner_of_np(kh, self.n))
+            est = _sketch_min_sharded(
+                self.sketch.data, owner, jnp.asarray(idx)
+            )
+        return np.asarray(est, np.int64)[:n]
+
+    def promote_from_sketch(
+        self,
+        key_hash: np.ndarray,
+        limits: np.ndarray,
+        durations: np.ndarray,
+        now: Optional[int] = None,
+    ):
+        """Migrate hot sketch-tier keys into exact buckets: read each
+        key's current-window estimate (an all-shards gather on the
+        mesh) and install a token window with remaining = max(limit -
+        estimate, 0) and reset = the window's end on the key's owning
+        shard — the key then decides exactly for the rest of the
+        window and re-creates exactly in the next one. Keys already
+        holding a LIVE exact entry are skipped (their state is
+        authoritative). Returns (installed bool[n], estimate int64[n],
+        reset_unix int64[n], over bool[n]). Thread contract: submit-
+        thread only (DeviceBatcher.run_serialized) — this reads AND
+        upserts the store."""
+        n = int(key_hash.shape[0])
+        if n == 0 or self.sketch is None:
+            z = np.zeros(n, np.int64)
+            return np.zeros(n, bool), z, z, np.zeros(n, bool)
+        if now is None:
+            now = api_types.millisecond_now()
+        self._engine_now(now)  # pin the epoch before window math
+        kh = np.ascontiguousarray(key_hash, np.uint64)
+        limits = np.asarray(limits, np.int64)
+        est = self.sketch_estimates(kh, durations, now)
+        _, reset_unix = self._sketch_windows(durations, now)
+        over = est >= limits
+        remaining = np.maximum(limits - est, 0)
+        todo = ~self.live_mask(kh, now)
+        if todo.any():
+            self.install_windows(
+                kh[todo], limits[todo], remaining[todo],
+                reset_unix[todo], over[todo], now,
+            )
+        return todo, est, reset_unix, over
+
+    # -- warmup --------------------------------------------------------------
+
+    def _warmup_sketch_reads(self, now: int) -> None:
+        """Compile the promoter's host-read surfaces at their pow2
+        rungs so the first flush ticks don't pay eager compiles on the
+        serving submit thread."""
+        if self.sketch is None:
+            return
+        for B in (64, 128, 256, 512, 1024):
+            kh = np.arange(1, B + 1, dtype=np.uint64) << np.uint64(32)
+            durs = np.full(B, 1000, np.int64)
+            self.sketch_estimates(kh, durs, now)
+            self.live_mask(kh, now)
+
+    def warmup(self, now: Optional[int] = None) -> None:
+        """Pre-compile every (batch rung, group rung) program plus the
+        GLOBAL install/sync programs (first TPU jit is ~20-40s; none of
+        it may land inside a serving RPC deadline), then reset the
+        state the warmup traffic dirtied. Mesh policies additionally
+        walk the per-shard sub-rung ladder with batches crafted so
+        every shard hits every rung. NOTE: this drives the engine's own
+        methods — the multihost lockstep wrapper must run its own
+        warmup through its broadcasting public surface
+        (parallel/multihost.py)."""
+        from gubernator_tpu.api.types import RateLimitResp
+
+        if now is None:
+            now = api_types.millisecond_now()
+        if self.flat:
+            from gubernator_tpu.core.engine import group_rungs
+
+            for b in self.buckets:
+                # one XLA program per (request rung, group rung) pair:
+                # craft batches whose unique-key count hits each group
+                # rung, with distinct FINGERPRINTS (value << 32)
+                for g in group_rungs(b):
+                    k = np.resize(
+                        np.arange(1, g + 1, dtype=np.uint64)
+                        << np.uint64(32),
+                        b,
+                    )
+                    ones = np.ones(b, np.int64)
+                    self.decide_arrays(
+                        k, ones, ones * 10, ones * 1000,
+                        np.zeros(b, np.int32), np.zeros(b, bool), now,
+                    )
+                # the GLOBAL replica-install path is a separate XLA
+                # program and must not pay jit time inside a broadcast
+                # RPC deadline either
+                self.update_globals(
+                    [
+                        (f"warmup:{i}", RateLimitResp(limit=1))
+                        for i in range(b)
+                    ],
+                    now=now,
+                )
+            self._warmup_sketch_reads(now)
+            self.reset()
+            self.stats = EngineStats()
+            return
+        warmup_public(self, now)
+
+
+def warmup_public(engine, now: Optional[int] = None) -> None:
+    """Mesh warmup through an engine-like object's PUBLIC surface
+    (decide_arrays / update_globals / sync_globals / reset): compiles
+    every (sub-batch rung, group rung) program plus the collective
+    GLOBAL programs. Driving only the public surface is what makes it
+    lockstep-safe for the multihost wrapper — every call broadcasts,
+    so followers replay the identical compile sequence. The ONE warmup
+    body for PartitionedEngine's mesh branch and the serving
+    MeshBackend/MultiHostBackend (serve/backends.py), so the compile
+    coverage cannot drift between the library and serving tiers."""
+    from gubernator_tpu.core.engine import group_rungs
+
+    if now is None:
+        now = api_types.millisecond_now()
+    n = engine.n
+    rungs = engine.sub_buckets
+    rng = np.random.default_rng(0xB007)
+    pool = rng.integers(1, 2**63, 4 * n * max(rungs), np.int64).astype(
+        np.uint64
+    )
+    owners = owner_of_np(pool, n)
+    per_shard = [pool[owners == s] for s in range(n)]
+    for r in rungs:
+        # one XLA program per (sub-batch rung, group rung) pair: craft
+        # per-shard batches whose unique-key count hits each group rung
+        # (g == r is the all-unique case)
+        for g in group_rungs(r):
+            k = np.concatenate([np.resize(p[:g], r) for p in per_shard])
+            ones = np.ones(k.shape[0], np.int64)
+            engine.decide_arrays(
+                key_hash=k, hits=ones, limit=ones * 10,
+                duration=ones * 1000,
+                algo=np.zeros(k.shape[0], np.int32),
+                gnp=np.zeros(k.shape[0], bool),
+                now=now,
+            )
+    # broadcast-receive + gossip collective programs per host rung
+    for b in engine.buckets:
+        k = np.arange(1, b + 1, dtype=np.uint64)
+        ones = np.ones(b, np.int64)
+        engine.update_globals(
+            key_hash=k,
+            limit=ones,
+            remaining=ones,
+            reset_time=ones * now,
+            is_over=np.zeros(b, bool),
+            now=now,
+        )
+        engine.sync_globals(k, ones, ones * 1000, now=now)
+    if getattr(engine, "sketch", None) is not None:
+        engine._warmup_sketch_reads(now)
+    # clear state and counters dirtied by warmup traffic (the stats
+    # object is shared through the multihost wrapper's property, so
+    # mutate in place rather than rebinding)
+    engine.reset()
+    engine.stats.__init__()
+
+
+# narrow jitted gathers shared by the host-side state reads: jit keeps
+# sharded-array indexing off the eager path (whole-array materialization)
+# and makes the per-shape compile explicit (warmup pre-pays the pow2
+# rungs the promoter/replication loops use)
+@jax.jit
+def _rows_flat(data, b):
+    return jnp.take(data, b, axis=0)
+
+
+@jax.jit
+def _rows_sharded(data, owner, b):
+    return data[owner, b]
+
+
+@jax.jit
+def _sketch_min_flat(data, idx):
+    est = None
+    for r in range(idx.shape[0]):
+        c = jnp.take(data[r], idx[r])
+        est = c if est is None else jnp.minimum(est, c)
+    return est
+
+
+@jax.jit
+def _sketch_min_sharded(data, owner, idx):
+    est = None
+    for r in range(idx.shape[0]):
+        c = data[owner, r, idx[r]]
+        est = c if est is None else jnp.minimum(est, c)
+    return est
+
+
+class TpuEngine(PartitionedEngine):
+    """Single-device engine: PartitionedEngine under the degenerate
+    flat policy (one shard, no mesh, plain-jit dispatch). The
+    historical name and constructor, kept because "one chip" remains
+    the most common deployment; every code path is the shared
+    partitioned implementation."""
+
+    def __init__(
+        self,
+        config: StoreConfig = StoreConfig(),
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+        device: Optional[jax.Device] = None,
+        sketch=None,
+    ):
+        super().__init__(
+            config,
+            policy=ShardingPolicy.single(device),
+            buckets=buckets,
+            sketch=sketch,
+        )
+
+
+class MeshEngine(PartitionedEngine):
+    """Mesh-sharded engine: PartitionedEngine over a device mesh
+    (key-space sharding with collective GLOBAL sync). The historical
+    name and constructor; see PartitionedEngine for the shared
+    implementation."""
+
+    def __init__(
+        self,
+        config: StoreConfig = StoreConfig(),
+        devices: Optional[Sequence[jax.Device]] = None,
+        buckets: Sequence[int] = (64, 256, 1024, 4096),
+        mesh_shape: Optional[Tuple[int, int]] = None,
+        sketch=None,
+    ):
+        super().__init__(
+            config,
+            policy=ShardingPolicy.over_mesh(devices, mesh_shape),
+            buckets=buckets,
+            sketch=sketch,
         )

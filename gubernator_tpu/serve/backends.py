@@ -293,7 +293,13 @@ class TpuBackend(_ArrayOps):
 
 
 class MeshBackend(_ArrayOps):
-    """Mesh-sharded slot-store backend (all local devices by default)."""
+    """Mesh-sharded slot-store backend (all local devices by default).
+
+    Since r14 this is the same PartitionedEngine as TpuBackend's under
+    a mesh ShardingPolicy, so it carries the full surface: the sketch
+    cold tier (sub-sketches sharded over the mesh axis), the
+    replication snapshot reads, and the arrival-prep pipeline — none
+    of which the pre-r14 MeshEngine fork had."""
 
     def __init__(
         self,
@@ -301,6 +307,7 @@ class MeshBackend(_ArrayOps):
         devices=None,
         buckets: Sequence[int] = (64, 256, 1024, 4096),
         engine=None,
+        sketch=None,
     ):
         import numpy as np
 
@@ -311,7 +318,9 @@ class MeshBackend(_ArrayOps):
         if engine is None:
             from gubernator_tpu.parallel.sharded import MeshEngine
 
-            engine = MeshEngine(store, devices=devices, buckets=buckets)
+            engine = MeshEngine(
+                store, devices=devices, buckets=buckets, sketch=sketch
+            )
         self.engine = engine
         if not hasattr(engine, "decide_submit"):
             # an engine without the submit/wait split (none in-tree since
@@ -336,11 +345,6 @@ class MeshBackend(_ArrayOps):
             # Instance refuses GUBER_REPLICATION=1 on such backends at
             # boot instead of failing at the first flush
             self.snapshot_read = None
-
-    # the sharded engines don't carry the count-min cold tier (r13 scope
-    # limit: sketch rows sharded over mesh axes is ROADMAP item 2's
-    # follow-on) — the promoter stays off and GUBER_SKETCH is inert here
-    sketch_enabled = False
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now
@@ -398,63 +402,16 @@ class MeshBackend(_ArrayOps):
         )
 
     def warmup(self) -> None:
-        np = self._np
-        from gubernator_tpu.api.types import millisecond_now
-        from gubernator_tpu.parallel.sharded import owner_of_np
+        # The decide path pads PER-SHARD sub-batches to the dense
+        # sub-rung ladder (sharded.sub_batch_ladder); warmup_public
+        # compiles each rung through the PUBLIC decide_arrays, which
+        # keeps it lockstep-safe for the multi-host wrapper (followers
+        # replay every call). One real wall-clock now threads through
+        # all of it: mixing clock domains would trip the EpochClock's
+        # large-jump reset path.
+        from gubernator_tpu.parallel.sharded import warmup_public
 
-        # One real wall-clock now threads through every warmup call: mixing
-        # clock domains would trip the EpochClock's large-jump reset path
-        # and leave the epoch pinned at a synthetic time.
-        now = millisecond_now()
-        # The decide path pads PER-SHARD sub-batches to the dense sub-rung
-        # ladder (sharded.sub_batch_ladder); compile each rung by crafting
-        # a batch with exactly `r` keys owned by every shard. Driving the
-        # public decide_arrays keeps this lockstep-safe for the multi-host
-        # engine (followers replay the same call).
-        from gubernator_tpu.core.engine import group_rungs
-
-        n = self.engine.n
-        rungs = self.engine.sub_buckets
-        rng = np.random.default_rng(0xB007)
-        pool = rng.integers(1, 2**63, 4 * n * max(rungs), np.int64).astype(
-            np.uint64
-        )
-        owners = owner_of_np(pool, n)
-        per_shard = [pool[owners == s] for s in range(n)]
-        for r in rungs:
-            # one XLA program per (sub-batch rung, group rung) pair:
-            # craft per-shard batches whose unique-key count hits each
-            # group rung (g == r is the all-unique case)
-            for g in group_rungs(r):
-                k = np.concatenate(
-                    [np.resize(p[:g], r) for p in per_shard]
-                )
-                ones = np.ones(k.shape[0], np.int64)
-                self.engine.decide_arrays(
-                    key_hash=k, hits=ones, limit=ones * 10,
-                    duration=ones * 1000,
-                    algo=np.zeros(k.shape[0], np.int32),
-                    gnp=np.zeros(k.shape[0], bool),
-                    now=now,
-                )
-        # broadcast-receive + gossip collective programs per host rung
-        for b in self.engine.buckets:
-            k = np.arange(1, b + 1, dtype=np.uint64)
-            ones = np.ones(b, np.int64)
-            self.engine.update_globals(
-                key_hash=k,
-                limit=ones,
-                remaining=ones,
-                reset_time=ones * now,
-                is_over=np.zeros(b, bool),
-                now=now,
-            )
-            self.engine.sync_globals(k, ones, ones * 1000, now=now)
-        # clear state and counters dirtied by warmup traffic (the stats
-        # object is shared through the multihost wrapper's property, so
-        # mutate in place rather than rebinding)
-        self.engine.reset()
-        self.engine.stats.__init__()
+        warmup_public(self.engine)
 
     def stats(self) -> dict:
         return self.engine.stats.snapshot()

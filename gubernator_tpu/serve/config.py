@@ -111,6 +111,15 @@ class ServerConfig:
     behaviors: BehaviorConfig = field(default_factory=BehaviorConfig)
 
     backend: str = "tpu"  # tpu | exact | mesh | multihost
+    # Shard count for the mesh backend (GUBER_SHARDS, r14): how many
+    # local devices the partitioned engine's sharding policy takes, in
+    # jax.devices() order. 0 = all local devices (the historical mesh
+    # default). On a TPU slice this is the chip count; in CI the
+    # simulated-device flag (XLA_FLAGS
+    # --xla_force_host_platform_device_count=N) makes the same sharded
+    # paths run on N virtual CPU devices — how the sharded scale-out
+    # suite runs in tier-1 (tests/conftest.py).
+    shards: int = 0
     cache_size: int = 50_000  # exact backend capacity
     store_rows: int = 16  # slot-store geometry (tpu/mesh backends);
     # 16 ways = 128-lane bucket rows, the fast TPU layout (core.store).
@@ -354,17 +363,19 @@ class ServerConfig:
 
     def sketch_config(self):
         """Resolve the count-min cold-tier geometry (r13) — None when
-        the tier is off or the backend can't carry it (single-chip
-        `tpu` only; the sharded engines are a documented scope limit).
-        Auto sizing (GUBER_SKETCH_MIB=0): a quarter of GUBER_STORE_MIB
-        capped at 256 MiB when the store budget is pinned, else
-        16 MiB. A pinned budget too small to carve a quarter from
-        (< 4 MiB) auto-DISABLES the tier rather than failing the boot:
-        pre-r13 tiny-budget configs must keep booting, and the hard
-        "sketch consumes the whole budget" refusal is reserved for an
-        EXPLICIT GUBER_SKETCH_MIB (the operator's own oversubscription,
+        the tier is off or the backend can't carry it (`tpu` and, since
+        r14, `mesh` — whose sub-sketches shard over the mesh axis;
+        multihost stays a documented scope limit: the promoter's host
+        reads are not lockstep participants). Auto sizing
+        (GUBER_SKETCH_MIB=0): a quarter of GUBER_STORE_MIB capped at
+        256 MiB when the store budget is pinned, else 16 MiB. A pinned
+        budget too small to carve a quarter from (< 4 MiB)
+        auto-DISABLES the tier rather than failing the boot: pre-r13
+        tiny-budget configs must keep booting, and the hard "sketch
+        consumes the whole budget" refusal is reserved for an EXPLICIT
+        GUBER_SKETCH_MIB (the operator's own oversubscription,
         store_config())."""
-        if not self.sketch or self.backend != "tpu":
+        if not self.sketch or self.backend not in ("tpu", "mesh"):
             return None
         from gubernator_tpu.core.sketches import derive_sketch_config
 
@@ -494,6 +505,14 @@ class ServerConfig:
             )
         if self.prep_threads < 0:
             raise ValueError("GUBER_PREP_THREADS must be >= 0")
+        if self.shards < 0:
+            raise ValueError("GUBER_SHARDS must be >= 0 (0 = all devices)")
+        if self.shards and self.backend != "mesh":
+            raise ValueError(
+                "GUBER_SHARDS selects devices for the mesh sharding "
+                "policy; set GUBER_BACKEND=mesh to use it (multihost "
+                "always spans the full distributed mesh)"
+            )
         if self.shed_cache_keys < 0:
             raise ValueError("GUBER_SHED_CACHE_KEYS must be >= 0")
         if self.sketch_mib < 0:
@@ -638,6 +657,7 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         advertise_address=_get(env, "GUBER_ADVERTISE_ADDRESS"),
         behaviors=b,
         backend=_get(env, "GUBER_BACKEND", "tpu"),
+        shards=_get_int(env, "GUBER_SHARDS", 0),
         cache_size=_get_int(env, "GUBER_CACHE_SIZE", 50_000),
         store_rows=_get_int(env, "GUBER_STORE_ROWS", 16),
         store_slots=_get_int(env, "GUBER_STORE_SLOTS", 1 << 15),

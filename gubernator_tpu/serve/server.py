@@ -127,7 +127,26 @@ def make_backend(conf: ServerConfig):
     if conf.backend == "tpu":
         return TpuBackend(store, buckets=buckets, sketch=sketch)
     if conf.backend == "mesh":
-        return MeshBackend(store, buckets=buckets)
+        devices = None
+        if conf.shards:
+            import jax
+
+            avail = jax.devices()
+            if conf.shards > len(avail):
+                raise ValueError(
+                    f"GUBER_SHARDS={conf.shards} exceeds the "
+                    f"{len(avail)} visible devices; on CPU, raise "
+                    "XLA_FLAGS --xla_force_host_platform_device_count"
+                )
+            devices = avail[: conf.shards]
+        backend = MeshBackend(
+            store, devices=devices, buckets=buckets, sketch=sketch
+        )
+        # the operator's confirmation that GUBER_SHARDS took effect
+        log.info(
+            "partitioned engine: %s", backend.engine.policy.describe()
+        )
+        return backend
     if conf.backend == "multihost":
         from gubernator_tpu.serve.backends import MultiHostBackend
 

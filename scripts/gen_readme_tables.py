@@ -536,6 +536,39 @@ def table_sketch() -> str:
     return "\n".join(lines)
 
 
+def table_shard() -> str:
+    """Partitioned-engine shard ladder (r14), from
+    BENCH_SHARD_r14.json: the flat degenerate policy vs N-shard mesh
+    policies on simulated host devices — the partitioned dispatch
+    price the perf gate (shard_r14) guards."""
+    doc = json.loads((ROOT / "BENCH_SHARD_r14.json").read_text())
+    lines = [
+        "| policy | shards | decisions/s | vs flat |",
+        "|---|---|---|---|",
+    ]
+    for r in doc["rows"]:
+        label = (
+            "flat (degenerate)" if r["policy"] == "flat"
+            else "mesh (shard_map)"
+        )
+        lines.append(
+            f"| {label} | {r['shards']} "
+            f"| {r['decisions_per_sec']:,.0f} "
+            f"| {r['vs_flat']:.2f}x |"
+        )
+    lines += [
+        "",
+        f"(One engine, one kernel — only the ShardingPolicy differs; "
+        f"simulated devices share this box's {doc['host_cpus']} "
+        f"CPU core(s), so sub-1.0 ratios are the partitioned DISPATCH "
+        f"price (host owner-routing + shard_map program), not chip "
+        f"scaling: on a real mesh each shard owns a chip and per-chip "
+        f"work drops to ~B/n. `make perf-gate` (shard_r14) fails if "
+        f"this price decays >10%.)",
+    ]
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -549,6 +582,7 @@ TABLES = {
     "shed-table": table_shed,
     "frontdoor-table": table_frontdoor,
     "sketch-table": table_sketch,
+    "shard-table": table_shard,
 }
 
 

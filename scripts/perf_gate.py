@@ -59,14 +59,24 @@ sys.path.insert(0, str(ROOT))
 
 HTTP_ADDR = "127.0.0.1:29881"
 GRPC_ADDR = "127.0.0.1:29880"
+GRPC_ADDR_MESH = "127.0.0.1:29883"
 GEB_PORT = 29882
 SOCK = "/tmp/guber-perf-gate.sock"
+SOCK_MESH = "/tmp/guber-perf-gate-mesh.sock"
+
+# simulated host devices for the shard_r14 pair (r14): the same
+# XLA_FLAGS mechanism tests/conftest.py uses — the N-shard partitioned
+# engine runs on N virtual CPU devices, so the gate prices the
+# partitioned dispatch overhead (host shard routing + shard_map
+# program) against the flat single-device policy on identical hardware.
+SHARDS = 4
 
 GATED = (
     "shed_r10",
     "submit_r9",
     "stages_r7",
     "sketch_r13",
+    "shard_r14",
     "frontdoor_geb_over_grpc",
     "frontdoor_http_over_grpc",
 )
@@ -188,6 +198,24 @@ def main() -> int:
     ap.add_argument("--batch", type=int, default=1000)
     args = ap.parse_args()
 
+    # simulated devices for the N-shard side, BEFORE any jax client
+    # initializes (XLA_FLAGS is read lazily at CPU-client init — the
+    # tests/conftest.py pattern). The flat side keeps using the default
+    # (first) device, so both sides of every pair share the box. An
+    # inherited device-count flag (e.g. from a test-suite env) is
+    # OVERRIDDEN, not kept: the committed shard_r14 baseline says
+    # "{SHARDS}-shard mesh" and must measure exactly that.
+    import re
+
+    _flags = re.sub(
+        r"--xla_force_host_platform_device_count=\d+",
+        "",
+        os.environ.get("XLA_FLAGS", ""),
+    )
+    os.environ["XLA_FLAGS"] = (
+        _flags + f" --xla_force_host_platform_device_count={SHARDS}"
+    ).strip()
+
     import jax
 
     jax.config.update(
@@ -220,6 +248,34 @@ def main() -> int:
           file=sys.stderr)
     cluster.start(timeout=600)
 
+    # second resident stack for the shard_r14 pair: the SAME
+    # partitioned engine under an N-shard mesh policy on the simulated
+    # devices (store geometry and ladder identical to the flat side, so
+    # the paired ratio isolates the partitioned dispatch overhead)
+    from gubernator_tpu.serve.backends import MeshBackend
+
+    if len(jax.devices()) < SHARDS:
+        raise SystemExit(
+            f"perf-gate: only {len(jax.devices())} devices visible but "
+            f"the shard_r14 pair is committed as {SHARDS}-shard — a jax "
+            "client initialized before the XLA_FLAGS override landed"
+        )
+    mesh_cluster = LocalCluster(
+        [GRPC_ADDR_MESH],
+        backend_factory=lambda: MeshBackend(
+            StoreConfig(rows=16, slots=1 << 12),
+            devices=jax.devices()[:SHARDS],
+            buckets=buckets_for_limit(args.device_batch_limit),
+            sketch=derive_sketch_config(mib=8),
+        ),
+        device_batch_limit=args.device_batch_limit,
+    )
+    print(
+        f"perf-gate: starting {SHARDS}-shard mesh stack "
+        "(sub-rung warmup)...",
+        file=sys.stderr,
+    )
+
     async def attach(server, sock):
         from gubernator_tpu.serve.edge_bridge import EdgeBridge
 
@@ -227,8 +283,23 @@ def main() -> int:
         await bridge.start()
         return bridge
 
-    pathlib.Path(SOCK).unlink(missing_ok=True)
-    bridge = cluster.run(attach(cluster.servers[0], SOCK))
+    # the flat stack is already serving: a mesh boot/attach failure
+    # must tear it down rather than leak its threads and sockets
+    try:
+        mesh_cluster.start(timeout=600)
+        pathlib.Path(SOCK).unlink(missing_ok=True)
+        pathlib.Path(SOCK_MESH).unlink(missing_ok=True)
+        bridge = cluster.run(attach(cluster.servers[0], SOCK))
+        mesh_bridge = mesh_cluster.run(
+            attach(mesh_cluster.servers[0], SOCK_MESH)
+        )
+    except BaseException:
+        for c in (cluster, mesh_cluster):
+            try:
+                c.stop()
+            except Exception:
+                pass
+        raise
     instance = cluster.servers[0].instance
     shed_obj = instance.shed
     assert shed_obj is not None, "gate expects the shipped defaults"
@@ -376,6 +447,34 @@ def main() -> int:
                          args.seconds, args.rounds)
         measured["sketch_r13"], detail["sketch_r13"] = m, rows
 
+        # -- shard_r14: 1-shard flat vs N-shard mesh, zipf keyspace --
+        # Same GEB workload against two RESIDENT stacks (identical
+        # store geometry/ladder/sketch): A = the flat single-device
+        # policy, B = the N-shard partitioned policy on simulated
+        # devices. On one CPU the mesh buys no parallelism, so the
+        # ratio IS the partitioned dispatch price (host shard routing
+        # + shard_map program) the unification must not let decay —
+        # its value (per-chip scaling) only exists on real meshes.
+        print(
+            f"workload shard_r14 (flat vs {SHARDS}-shard mesh)...",
+            file=sys.stderr,
+        )
+
+        def shard_drive(sock):
+            def d(seconds):
+                return _loadgen(
+                    "geb", sock, seconds, 0.0, args.concurrency,
+                    args.batch, keyspace=30_000,
+                )["decisions_per_sec"]
+
+            return d
+
+        m, rows = paired(
+            "shard_r14", shard_drive(SOCK), shard_drive(SOCK_MESH),
+            args.seconds, args.rounds,
+        )
+        measured["shard_r14"], detail["shard_r14"] = m, rows
+
         # -- front-door ladder: grpc vs geb vs http ------------------
         print("front-door ladder (grpc / geb / http)...", file=sys.stderr)
         doors = {
@@ -431,8 +530,16 @@ def main() -> int:
             cluster.run(bridge.stop())
         except Exception:
             pass
-        cluster.stop()
+        try:
+            mesh_cluster.run(mesh_bridge.stop())
+        except Exception:
+            pass
+        try:
+            cluster.stop()
+        finally:
+            mesh_cluster.stop()
         pathlib.Path(SOCK).unlink(missing_ok=True)
+        pathlib.Path(SOCK_MESH).unlink(missing_ok=True)
 
     for k, v in measured.items():
         print(f"measured {k}: {v:.3f}", file=sys.stderr)
@@ -479,6 +586,13 @@ def main() -> int:
                     "pair": "sketch cold tier OFF vs ON, share 0.5 "
                             "keyspace-300k drop-heavy workload",
                     "committed": round(measured["sketch_r13"], 4),
+                },
+                "shard_r14": {
+                    "artifact": "BENCH_SHARD_r14.json",
+                    "pair": f"flat 1-shard vs {SHARDS}-shard "
+                            "simulated-device mesh, keyspace-30k zipf "
+                            "shape (partitioned dispatch price)",
+                    "committed": round(measured["shard_r14"], 4),
                 },
                 "frontdoor_geb_over_grpc": {
                     "artifact": "BENCH_FRONTDOOR_r12.json",

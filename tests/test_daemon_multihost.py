@@ -92,17 +92,25 @@ def test_follower_requires_step_listen():
     assert "GUBER_DIST_STEP_LISTEN" in out, out[-500:]
 
 
+@pytest.mark.slow
 def test_two_daemon_multihost_e2e():
     """Leader daemon + follower daemon as REAL processes: gRPC serving
-    over a 2-process jax.distributed mesh with the lockstep pipe, tiny
-    bucket ladder (GUBER_DEVICE_BATCH_LIMIT=64) so CPU warmup stays
-    fast. Asserts decisions, health, and graceful SIGTERM shutdown."""
+    over a 2-process jax.distributed mesh with the lockstep pipe, the
+    smallest ladder the serving tier's cross-validation allows
+    (GUBER_DEVICE_BATCH_LIMIT=1024 >= the 1000-item per-RPC cap).
+    Asserts decisions, health, and graceful SIGTERM shutdown.
+
+    Marked slow (the chaos-soak convention): the lockstep warmup
+    compiles the whole sub-rung ladder through 2-process gloo
+    collectives, ~8-10 minutes on a 2-core box — the ENGINE-level
+    multihost suite (tests/test_multihost.py) covers the global-mesh
+    collectives in tier-1."""
     coord_port, step_port, grpc_port = free_ports(3)
     base = _clean_env(
         GUBER_JAX_PLATFORM="cpu",
         GUBER_DIST_COORDINATOR=f"127.0.0.1:{coord_port}",
         GUBER_DIST_NUM_PROCESSES="2",
-        GUBER_DEVICE_BATCH_LIMIT="64",
+        GUBER_DEVICE_BATCH_LIMIT="1024",
         GUBER_STORE_SLOTS="256",
     )
     # daemon logs go to files, not pipes: an undrained pipe filling its
@@ -161,7 +169,8 @@ def test_two_daemon_multihost_e2e():
 
         chan = grpc.insecure_channel(f"127.0.0.1:{grpc_port}")
         stub = V1Stub(chan)
-        deadline = time.monotonic() + 240  # warmup compiles the ladder
+        deadline = time.monotonic() + 900  # lockstep warmup compiles
+        # the sub-rung ladder over 2-process gloo (minutes on CPU)
         hc = None
         while time.monotonic() < deadline:
             if leader.poll() is not None or follower.poll() is not None:

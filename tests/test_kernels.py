@@ -455,9 +455,15 @@ def test_writeback_auto_selection(monkeypatch):
     its measured winning regime (B >= 4x bucket count, see
     scripts/bench_sweep_regime.py) and the scatter elsewhere; explicit
     values force a path."""
+    import jax as jax_mod
+
     from gubernator_tpu.core.kernels import _use_sweep_writeback
 
     monkeypatch.delenv("GUBER_WRITEBACK", raising=False)
+    # auto never picks the Mosaic TPU kernel on a non-TPU backend
+    assert not _use_sweep_writeback(2048, 128, 16384)
+    # ... the regime assertions below model a TPU host
+    monkeypatch.setattr(jax_mod, "default_backend", lambda: "tpu")
     # flagship store (32k buckets, 32k batch): density 1 -> scatter
     assert not _use_sweep_writeback(1 << 15, 128, 1 << 15)
     # dense small-store regime: density >= 4 -> sweep

@@ -379,10 +379,13 @@ def test_hierarchical_sync_stages_collectives():
     def sync_hlo(eng):
         B = 64
         s = jax.ShapeDtypeStruct
+        # (store, key_hash, hits, limit, duration, algo, valid, now) —
+        # hits is the r14 in-mesh GLOBAL aggregation leg (zeros = the
+        # classic peek-only gossip step)
         return eng._sync.lower(
             eng.store, s((B,), np.uint64), s((B,), np.int32),
-            s((B,), np.int32), s((B,), np.int32), s((B,), bool),
-            s((), np.int32),
+            s((B,), np.int32), s((B,), np.int32), s((B,), np.int32),
+            s((B,), bool), s((), np.int32),
         ).as_text()
 
     def groups(txt):

@@ -139,11 +139,17 @@ def test_store_mib_carve_out_and_host_budget():
     ).store_config()
     assert store_footprint_bytes(carved) <= (1024 - 256) << 20
     assert store_footprint_bytes(carved) < store_footprint_bytes(full)
-    # non-tpu backends carry no sketch: the full budget stays exact
+    # mesh carries the sharded sketch since r14: same carve-out as tpu;
+    # multihost stays sketch-free (documented scope limit) so its full
+    # budget remains exact
     mesh = ServerConfig(
-        backend="mesh", store_mib=1024, sketch=True
+        backend="mesh", store_mib=1024, sketch=True, sketch_mib=256
     ).store_config()
-    assert store_footprint_bytes(mesh) == store_footprint_bytes(full)
+    assert store_footprint_bytes(mesh) == store_footprint_bytes(carved)
+    mh = ServerConfig(
+        backend="multihost", store_mib=1024, sketch=True
+    ).store_config()
+    assert store_footprint_bytes(mh) == store_footprint_bytes(full)
     with pytest.raises(ValueError):
         ServerConfig(
             backend="tpu", store_mib=16, sketch=True, sketch_mib=16
@@ -497,6 +503,135 @@ def test_tail_error_bound_and_no_undercount():
     assert err["under_counts"] == 0, err
     assert err["within_bound"], err
     assert err["charged_hits"] > 0 and err["distinct_keys"] > 100
+
+
+# -- eviction -> sketch migration (r14) -------------------------------------
+
+
+def _same_bucket_keys(slots: int, n: int, start: int = 1):
+    """n distinct-fingerprint uint64 hashes all mapping to bucket 0."""
+    from gubernator_tpu.core import hashing
+    from gubernator_tpu.core.store import _BUCKET_SALT
+
+    out = []
+    v = start
+    while len(out) < n:
+        kh = np.uint64(v << 32) | np.uint64(5)
+        b = int(
+            hashing.mix64(np.asarray([kh], np.uint64) ^ _BUCKET_SALT)[0]
+            & np.uint64(slots - 1)
+        )
+        if b == 0:
+            out.append(kh)
+        v += 1
+    return np.asarray(out, np.uint64)
+
+
+def test_evicted_dead_entry_folds_into_sketch(monkeypatch):
+    """A create recycling a DEAD victim's way folds the victim's
+    consumed count into the victim key's current fixed window: the
+    evicted-then-recreated key decides at-least-as-restrictively as
+    the unevicted oracle, and the sketch estimate actually carries
+    the folded count (without the fold it would be 0). Exactly
+    window-aligned dead entries (no overlap with the current window)
+    fold nothing."""
+
+    def mk():
+        eng = TpuEngine(
+            StoreConfig(rows=1, slots=16), buckets=(64,),
+            sketch=SketchConfig(rows=4, width=1 << 12),
+        )
+        # pin the epoch at T0 (engine-ms 0)
+        z = np.zeros(1, np.int64)
+        eng.decide_arrays(
+            _keys(1, tag=250), z, z + 1, z + 1000,
+            np.zeros(1, np.int32), np.zeros(1, bool), T0,
+        )
+        return eng
+
+    D, LIM = 1000, 10
+    K, L = _same_bucket_keys(16, 2)
+    kk = np.asarray([K], np.uint64)
+    ll = np.asarray([L], np.uint64)
+
+    def drive(eng, kh, hits, t):
+        one = np.ones(1, np.int64)
+        return eng.decide_arrays(
+            kh, np.asarray([hits], np.int64), one * LIM, one * D,
+            np.zeros(1, np.int32), np.zeros(1, bool), T0 + t,
+        )
+
+    evicted = mk()
+    oracle = mk()
+    for eng in (evicted, oracle):
+        # K created mid-window-1 (engine 1500): window [1500, 2500)
+        # consumes 6 of 10 — its tail crosses into fixed window 2
+        drive(eng, kk, 6, 1500)
+    # at engine 2600 K is dead (2500 < 2600); L's create recycles K's
+    # way on `evicted` only — the fold moment
+    drive(evicted, ll, 1, 2600)
+    assert evicted.stats.snapshot()["evictions"] == 1
+    est = evicted.sketch_estimates(kk, np.asarray([D], np.int64), T0 + 2600)
+    assert est[0] >= 6, f"fold did not land: estimate {est[0]}"
+
+    # K returns at 2700: bucket full with LIVE L -> sketch-served from
+    # the folded estimate; the unevicted oracle recreates exactly
+    s_e, _, r_e, t_e = drive(evicted, kk, 1, 2700)
+    s_o, _, r_o, t_o = drive(oracle, kk, 1, 2700)
+    assert s_o[0] == int(Status.UNDER_LIMIT) and r_o[0] == LIM - 1
+    assert s_e[0] >= s_o[0] and r_e[0] <= r_o[0], (
+        "evicted-then-recreated key went fail-open vs the unevicted "
+        f"oracle: {(s_e[0], r_e[0])} vs {(s_o[0], r_o[0])}"
+    )
+    # the folded 6 plus this charge: remaining = (10 - 6) - 1
+    assert r_e[0] == LIM - 6 - 1
+    # sketch window reset = window 2's end (engine 3000)
+    assert t_e[0] == T0 + 3000
+
+    # exact alignment: an entry whose expiry == the window boundary
+    # has NO overlap with the current window -> nothing folds
+    aligned = mk()
+    K2, L2 = _same_bucket_keys(16, 2, start=500)
+    drive(aligned, np.asarray([K2], np.uint64), 6, 1000)  # [1000, 2000)
+    drive(aligned, np.asarray([L2], np.uint64), 1, 2100)  # recycles
+    est2 = aligned.sketch_estimates(
+        np.asarray([K2], np.uint64), np.asarray([D], np.int64), T0 + 2100
+    )
+    assert est2[0] == 0, est2
+
+
+def test_sticky_over_victim_folds_whole_limit(monkeypatch):
+    """A recycled sticky-over victim folds its LIMIT: the key stays
+    refused for the remainder of its current fixed window when it
+    returns sketch-served."""
+    eng = TpuEngine(
+        StoreConfig(rows=1, slots=16), buckets=(64,),
+        sketch=SketchConfig(rows=4, width=1 << 12),
+    )
+    z = np.zeros(1, np.int64)
+    eng.decide_arrays(
+        _keys(1, tag=251), z, z + 1, z + 1000,
+        np.zeros(1, np.int32), np.zeros(1, bool), T0,
+    )
+    D, LIM = 1000, 4
+    K, L = _same_bucket_keys(16, 2, start=900)
+
+    def drive(kh, hits, t):
+        one = np.ones(1, np.int64)
+        return eng.decide_arrays(
+            np.asarray([kh], np.uint64), np.asarray([hits], np.int64),
+            one * LIM, one * D, np.zeros(1, np.int32),
+            np.zeros(1, bool), T0 + t,
+        )
+
+    # drain K to 0 then over: sticky flag set, remaining 0
+    drive(K, 4, 1500)
+    s, _, r, _ = drive(K, 1, 1600)
+    assert s[0] == int(Status.OVER_LIMIT)
+    # dead at 2600; L recycles the way; K returns sketch-served
+    drive(L, 1, 2600)
+    s2, _, r2, _ = drive(K, 1, 2700)
+    assert s2[0] == int(Status.OVER_LIMIT) and r2[0] == 0, (s2, r2)
 
 
 # -- promotion / demotion ---------------------------------------------------
