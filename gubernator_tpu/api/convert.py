@@ -6,6 +6,7 @@ from gubernator_tpu.api.proto.gen import gubernator_pb2
 from gubernator_tpu.api.types import (
     Algorithm,
     Behavior,
+    ChainLevel,
     RateLimitReq,
     RateLimitResp,
     Status,
@@ -21,11 +22,19 @@ def req_from_pb(pb) -> RateLimitReq:
         duration=pb.duration,
         algorithm=Algorithm(pb.algorithm),
         behavior=Behavior(pb.behavior),
+        chain=[
+            ChainLevel(
+                unique_key=lv.unique_key,
+                limit=lv.limit,
+                duration=lv.duration,
+            )
+            for lv in pb.chain
+        ],
     )
 
 
 def req_to_pb(r: RateLimitReq):
-    return gubernator_pb2.RateLimitReq(
+    pb = gubernator_pb2.RateLimitReq(
         name=r.name,
         unique_key=r.unique_key,
         hits=r.hits,
@@ -34,6 +43,13 @@ def req_to_pb(r: RateLimitReq):
         algorithm=int(r.algorithm),
         behavior=int(r.behavior),
     )
+    for lv in r.chain:
+        pb.chain.add(
+            unique_key=lv.unique_key,
+            limit=lv.limit,
+            duration=lv.duration,
+        )
+    return pb
 
 
 def resp_from_pb(pb) -> RateLimitResp:

@@ -21,10 +21,13 @@ HOUR = 60 * MINUTE
 
 
 class Algorithm(enum.IntEnum):
-    """Rate-limit algorithm (reference proto/gubernator.proto:57-62)."""
+    """Rate-limit algorithm (reference proto/gubernator.proto:57-62;
+    SLIDING_WINDOW/GCRA are the r15 suite — core/algorithms.py)."""
 
     TOKEN_BUCKET = 0
     LEAKY_BUCKET = 1
+    SLIDING_WINDOW = 2
+    GCRA = 3
 
 
 class Behavior(enum.IntEnum):
@@ -50,10 +53,39 @@ class Status(enum.IntEnum):
 
 
 @dataclass
+class ChainLevel:
+    """One ancestor level of a hierarchical quota chain (r15).
+
+    A chained request debits `chain[0] -> chain[1] -> ... -> leaf`
+    (shallow to deep: global first, the request's own key last) in ONE
+    device pass with most-restrictive-wins semantics and the
+    no-partial-debit contract (a refused level consumes quota at no
+    other level). Each level is a real counter under the request's
+    `name` namespace, shared by every chain with the same HEAD (and by
+    plain requests for the head's own key): the tenant level IS the
+    tenant's limit. Ancestor levels always decide as TOKEN buckets —
+    only the leaf uses the request's algorithm — so one hierarchy
+    serves callers with different leaf algorithms without the shared
+    counters mismatch-recreating. On sharded topologies a chain's levels live on the
+    head's owner shard (the consolidation contract,
+    parallel/sharded.py pad_request_chained), so well-formed
+    hierarchies keep one head per subtree. `duration=0` inherits the
+    request's duration."""
+
+    unique_key: str = ""
+    limit: int = 0
+    duration: int = 0
+
+
+@dataclass
 class RateLimitReq:
     """One rate-limit request (reference proto/gubernator.proto:97-123).
 
     duration is in milliseconds. hits == 0 is a read-only peek.
+    `chain` (r15) lists ancestor quota levels, shallow to deep; empty =
+    a plain single-level request. Chained requests are routed by the
+    chain HEAD's key so one owner debits the whole chain atomically,
+    and are incompatible with Behavior.GLOBAL (validated serving-side).
     """
 
     name: str = ""
@@ -63,9 +95,18 @@ class RateLimitReq:
     duration: int = 0
     algorithm: Algorithm = Algorithm.TOKEN_BUCKET
     behavior: Behavior = Behavior.BATCHING
+    chain: List["ChainLevel"] = field(default_factory=list)
 
     def hash_key(self) -> str:
         return hash_key(self.name, self.unique_key)
+
+    def routing_key(self) -> str:
+        """The ring/ownership key: the chain head's for chained
+        requests (the whole chain lands on one owner), else the
+        request's own."""
+        if self.chain:
+            return hash_key(self.name, self.chain[0].unique_key)
+        return self.hash_key()
 
 
 @dataclass

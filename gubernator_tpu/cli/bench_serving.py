@@ -1124,6 +1124,348 @@ def run_shed(args) -> int:
     return 0
 
 
+def _algo_env(args):
+    """GUBER_* env for the r15 algorithm scenarios: the shipped boot
+    path on the device backend, moderate store, shed cache OFF so the
+    token arm of an A/B pays the same host path as the non-sheddable
+    algorithms."""
+    import os
+
+    depth = int(args.depths.split(",")[0])
+    env = dict(os.environ)
+    env.update(
+        {
+            "GUBER_BACKEND": "tpu",
+            "GUBER_DEVICE_BATCH_LIMIT": str(depth),
+            "GUBER_DEVICE_DEEP_BATCH": "1",
+            "GUBER_STORE_SLOTS": str(1 << 14),
+            "GUBER_SHED_CACHE": "0",
+            "GUBER_GRPC_ADDRESS": "127.0.0.1:0",
+        }
+    )
+    env.pop("GUBER_STORE_MIB", None)
+    env.pop("GUBER_STORE_TARGET_KEYS", None)
+    return env, depth
+
+
+def run_flash_crowd(args) -> int:
+    """Flash-crowd scenario (r15): `--algorithm` under a suddenly-hot
+    key set that rotates every phase (cli/keystreams.flash_crowd_pool)
+    over the zipf background. The algorithm-suite shape: a fixed
+    window admits ~2x limit around each boundary of a crowd this
+    bursty; the sliding blend and GCRA's emission spacing do not.
+    Reports dec/s plus the over-limit share the algorithm enforced."""
+    import asyncio
+
+    import numpy as np
+
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.core.algorithms import ALGO_NAMES
+    from gubernator_tpu.serve.config import config_from_env
+
+    _jax_cache()
+    env, depth = _algo_env(args)
+    conf = config_from_env(env)
+    algo_id = ALGO_NAMES[args.algorithm]
+    group = min(args.group, depth)
+    limit, duration = 200, 1000
+
+    async def run():
+        inst, backend, warm_s = await _boot_stack(
+            conf, f"flash_crowd_{args.algorithm}", depth
+        )
+        try:
+            stop_at = time.monotonic() + args.seconds
+            done = 0
+            over = 0
+            t0 = time.monotonic()
+
+            async def worker(w: int):
+                nonlocal done, over
+                ones = np.ones(group, np.int64)
+                algo = np.full(group, algo_id, np.int32)
+                passes = 0
+                while time.monotonic() < stop_at:
+                    # the crowd rotates every ~500ms: a fresh flash
+                    phase = int((time.monotonic() - t0) * 2)
+                    passes += 1
+                    kh = keystreams.flash_crowd_pool(
+                        1 << 20, group, phase,
+                        rng=np.random.default_rng(
+                            phase * 1000 + passes * 17 + w
+                        ),
+                    )
+                    status, _l, _r, _t = (
+                        await inst.batcher.decide_arrays(
+                            dict(
+                                key_hash=kh, hits=ones,
+                                limit=ones * limit,
+                                duration=ones * duration,
+                                algo=algo,
+                            )
+                        )
+                    )
+                    done += group
+                    over += int(np.sum(np.asarray(status) != 0))
+
+            workers = max(8, 2 * depth // group)
+            await asyncio.gather(*[worker(w) for w in range(workers)])
+            elapsed = time.monotonic() - t0
+            return dict(
+                metric=f"flash_crowd_{args.algorithm}",
+                algorithm=args.algorithm,
+                depth=depth,
+                decisions_per_sec=round(done / elapsed, 1),
+                over_limit_share=round(over / max(done, 1), 4),
+                limit=limit,
+                duration_ms=duration,
+                seconds=round(elapsed, 3),
+                workers=workers,
+                group_rows=group,
+                warmup_seconds=round(warm_s, 1),
+            )
+        finally:
+            await inst.stop()
+
+    row = asyncio.run(run())
+    print(
+        f"flash-crowd[{args.algorithm}]: "
+        f"{row['decisions_per_sec']:>12,.0f} dec/s  "
+        f"over-limit {row['over_limit_share']:.1%}",
+        file=sys.stderr,
+    )
+    if args.json:
+        import jax as _jax
+
+        print(json.dumps(dict(
+            scenario="flash_crowd",
+            scope=_jax.devices()[0].platform,
+            rows=[row],
+        )))
+    return 0
+
+
+def run_mixed_tenant_zipf(args) -> int:
+    """Mixed-tenant quota-chain scenario (r15): every request names a
+    global -> (region ->) tenant chain (depth = --chain-depth) over a
+    zipf tenant draw (keystreams.tenant_zipf_ids) — the multi-tenant
+    front door quota chains exist for. Drives the batcher's dedicated
+    chain lane (object path, one coalesced chain-coupled kernel pass
+    per flush); reports chains/s, device rows/s (the expansion
+    factor), refusal share, and which level refused."""
+    import asyncio
+    import collections
+
+    import numpy as np
+
+    from gubernator_tpu.api.types import ChainLevel, RateLimitReq
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.serve.config import config_from_env
+
+    _jax_cache()
+    env, depth = _algo_env(args)
+    conf = config_from_env(env)
+    d = max(1, min(int(args.chain_depth), 3))
+    tenants = 64
+    chain_group = 256
+    # ancestors, shallow to deep; truncated to depth KEEPING the head
+    # (the consolidation contract routes every chain by chain[0])
+    # tenant limit sized so the zipf head tenant (~18% of traffic at
+    # a=1.2) exhausts its quota inside a few bench seconds — the
+    # most-restrictive-wins refusals are the scenario's point
+    lv_limits = {"global": 1 << 30, "region": 1 << 24, "tenant": 1200}
+
+    async def run():
+        inst, backend, warm_s = await _boot_stack(
+            conf, f"tenant_chain_d{d}", depth
+        )
+        try:
+            stop_at = time.monotonic() + args.seconds
+            done = 0
+            refused = 0
+            level_hist = collections.Counter()
+            t0 = time.monotonic()
+
+            async def worker(w: int):
+                nonlocal done, refused
+                rng = np.random.default_rng(100 + w)
+                passes = 0
+                while time.monotonic() < stop_at:
+                    passes += 1
+                    ts = keystreams.tenant_zipf_ids(
+                        tenants, chain_group, rng
+                    )
+                    reqs = []
+                    for j, t in enumerate(ts):
+                        chain = [
+                            ChainLevel("global", lv_limits["global"], 0),
+                            ChainLevel(
+                                f"region:{int(t) % 4}",
+                                lv_limits["region"], 0,
+                            ),
+                            ChainLevel(
+                                f"tenant:{int(t)}",
+                                lv_limits["tenant"], 0,
+                            ),
+                        ][-d:]
+                        # keep ONE head per hierarchy: depth-truncated
+                        # chains still start at the deepest kept level
+                        reqs.append(RateLimitReq(
+                            name="mtz",
+                            unique_key=(
+                                f"k:{int(t)}:"
+                                f"{int(rng.integers(1 << 14))}"
+                            ),
+                            hits=1,
+                            limit=1 << 20,
+                            duration=60_000,
+                            chain=chain,
+                        ))
+                    resps = await inst.batcher.decide_chain(reqs)
+                    done += len(resps)
+                    for r in resps:
+                        if int(r.status) != 0:
+                            refused += 1
+                            level_hist[
+                                r.metadata.get("chain_level", "leaf")
+                            ] += 1
+
+            await asyncio.gather(*[worker(w) for w in range(8)])
+            elapsed = time.monotonic() - t0
+            return dict(
+                metric=f"tenant_chain_depth{d}",
+                chain_depth=d,
+                tenants=tenants,
+                chains_per_sec=round(done / elapsed, 1),
+                device_rows_per_sec=round(done * (d + 1) / elapsed, 1),
+                refusal_share=round(refused / max(done, 1), 4),
+                refusing_level=dict(level_hist),
+                seconds=round(elapsed, 3),
+                warmup_seconds=round(warm_s, 1),
+            )
+        finally:
+            await inst.stop()
+
+    row = asyncio.run(run())
+    print(
+        f"mixed-tenant-zipf[d{d}]: {row['chains_per_sec']:>10,.0f} "
+        f"chains/s ({row['device_rows_per_sec']:,.0f} rows/s, "
+        f"refused {row['refusal_share']:.1%})",
+        file=sys.stderr,
+    )
+    if args.json:
+        import jax as _jax
+
+        print(json.dumps(dict(
+            scenario="mixed_tenant_zipf",
+            scope=_jax.devices()[0].platform,
+            rows=[row],
+        )))
+    return 0
+
+
+def run_gcra_vs_token(args) -> int:
+    """GCRA-vs-token fairness A/B (r15): one hot key under demand far
+    above its limit, token bucket then GCRA on fresh stacks. The
+    token window admits its whole budget at the window start and
+    refuses the rest (bursty admission: long refusal runs, high
+    inter-admission-gap variance); GCRA's emission interval spaces
+    the SAME average admission rate evenly. Reported per arm:
+    admitted/s, max refusal run, and the coefficient of variation of
+    inter-admission gaps — the fairness number (lower = smoother)."""
+    import asyncio
+
+    import numpy as np
+
+    from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.core.algorithms import ALGO_NAMES
+    from gubernator_tpu.serve.config import config_from_env
+
+    _jax_cache()
+    env, depth = _algo_env(args)
+    limit, duration = 50, 2000
+
+    async def one_arm(algo_name: str) -> dict:
+        conf = config_from_env(env)
+        inst, backend, warm_s = await _boot_stack(
+            conf, f"gcra_vs_token_{algo_name}", depth
+        )
+        try:
+            algo_id = ALGO_NAMES[algo_name]
+            kh = keystreams.hash_ids(np.array([7], np.uint64))
+            one = np.ones(1, np.int64)
+            algo = np.full(1, algo_id, np.int32)
+            stop_at = time.monotonic() + args.seconds
+            admits = []
+            statuses = []
+            while time.monotonic() < stop_at:
+                status, _l, _r, _t = (
+                    await inst.batcher.decide_arrays(
+                        dict(
+                            key_hash=kh, hits=one,
+                            limit=one * limit,
+                            duration=one * duration, algo=algo,
+                        )
+                    )
+                )
+                ok = int(np.asarray(status)[0]) == 0
+                statuses.append(ok)
+                if ok:
+                    admits.append(time.monotonic())
+            gaps = np.diff(np.asarray(admits))
+            run_len = max_run = 0
+            for ok in statuses:
+                run_len = 0 if ok else run_len + 1
+                max_run = max(max_run, run_len)
+            cv = (
+                float(np.std(gaps) / np.mean(gaps))
+                if gaps.size > 1 and np.mean(gaps) > 0
+                else 0.0
+            )
+            return dict(
+                algorithm=algo_name,
+                requests=len(statuses),
+                admitted=len(admits),
+                admitted_per_sec=round(
+                    len(admits) / args.seconds, 1
+                ),
+                max_refusal_run=max_run,
+                admission_gap_cv=round(cv, 3),
+                limit=limit,
+                duration_ms=duration,
+                warmup_seconds=round(warm_s, 1),
+            )
+        finally:
+            await inst.stop()
+
+    rows = []
+    for name in ("token", "gcra"):
+        r = asyncio.run(one_arm(name))
+        rows.append(r)
+        print(
+            f"gcra-vs-token[{name}]: {r['admitted']} admitted "
+            f"of {r['requests']}  gap-CV {r['admission_gap_cv']} "
+            f"max-refusal-run {r['max_refusal_run']}",
+            file=sys.stderr,
+        )
+    if args.json:
+        import jax as _jax
+
+        print(json.dumps(dict(
+            scenario="gcra_vs_token",
+            scope=_jax.devices()[0].platform,
+            note=(
+                "same demand, same average admission rate; GCRA's "
+                "emission interval spreads admissions evenly where "
+                "the token window grants its whole budget at the "
+                "window start — compare admission_gap_cv and "
+                "max_refusal_run, not admitted_per_sec"
+            ),
+            rows=rows,
+        )))
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description="serving benchmarks")
     parser.add_argument("--backend", default="exact")
@@ -1135,7 +1477,8 @@ def main(argv=None) -> int:
         default="cluster",
         choices=[
             "cluster", "zipf10m", "zipf100m", "key-churn", "shed",
-            "shard",
+            "shard", "flash-crowd", "mixed-tenant-zipf",
+            "gcra-vs-token",
         ],
         help="cluster = the reference benchmark suite over localhost "
         "gRPC; zipf10m = BASELINE config 4 through the shipped serving "
@@ -1147,7 +1490,25 @@ def main(argv=None) -> int:
         "every-pass stream (tier thrash worst case, ROADMAP item 4); "
         "shed = over-limit-heavy skew ladder through the shipped boot "
         "path (the r10 shed cache's workload; GUBER_SHED_CACHE "
-        "honored and recorded, over-limit share reported per round)",
+        "honored and recorded, over-limit share reported per round); "
+        "flash-crowd = suddenly-hot rotating key set under "
+        "--algorithm (r15 suite); mixed-tenant-zipf = quota chains "
+        "over a zipf tenant draw at --chain-depth; gcra-vs-token = "
+        "single-hot-key admission-fairness A/B",
+    )
+    parser.add_argument(
+        "--algorithm",
+        default="sliding",
+        choices=["token", "leaky", "sliding", "gcra"],
+        help="flash-crowd: the rate-limit algorithm under test "
+        "(core/algorithms.py registry names)",
+    )
+    parser.add_argument(
+        "--chain-depth",
+        type=int,
+        default=3,
+        help="mixed-tenant-zipf: ancestor levels per request (1-3; "
+        "3 = global -> region -> tenant above the leaf)",
     )
     parser.add_argument(
         "--rounds", type=int, default=3,
@@ -1257,6 +1618,12 @@ def main(argv=None) -> int:
         import os
 
         os.environ["GUBER_PREP_AT_ARRIVAL"] = args.prep_at_arrival
+    if args.scenario == "flash-crowd":
+        return run_flash_crowd(args)
+    if args.scenario == "mixed-tenant-zipf":
+        return run_mixed_tenant_zipf(args)
+    if args.scenario == "gcra-vs-token":
+        return run_gcra_vs_token(args)
     if args.scenario == "shed":
         if args.backend == "exact":
             print(

@@ -35,7 +35,7 @@ ZIPF_A = 1.2
 MIX_MUL = 0x9E3779B97F4A7C15
 MIX_XOR = 0xDEADBEEFCAFEF00D
 
-STREAMS = ("zipf", "key-churn")
+STREAMS = ("zipf", "key-churn", "flash-crowd")
 
 
 def hash_ids(ids: np.ndarray) -> np.ndarray:
@@ -78,6 +78,45 @@ def churn_pool(key_space: int, size: int, phase: int = 0) -> np.ndarray:
     return hash_ids(ids)
 
 
+def flash_crowd_pool(
+    key_space: int,
+    size: int,
+    phase: int = 0,
+    crowd: int = 64,
+    share: float = 0.8,
+    rng: Optional[np.random.Generator] = None,
+) -> np.ndarray:
+    """Flash-crowd stream (r15): `share` of the traffic hammers a
+    `crowd`-sized set of SUDDENLY-hot keys — fresh every phase, so no
+    earlier window, shed entry, or promoter rank exists for them when
+    the crowd arrives — over the canonical zipf background. The shape
+    the sliding-window blend is for: a fixed window admits 2x limit
+    around each boundary under this stream, the blend does not."""
+    rng = rng or np.random.default_rng(1000 + phase)
+    out = zipf_ids(key_space, size, rng)
+    is_crowd = rng.random(size) < share
+    # crowd ids live in a reserved stripe far above the zipf head and
+    # advance by `crowd` each phase: disjoint from the background and
+    # from every earlier phase's crowd until the space wraps
+    stripe = (1 << 40) + phase * crowd
+    out = np.where(
+        is_crowd, stripe + rng.integers(0, crowd, size), out
+    )
+    return hash_ids(out)
+
+
+def tenant_zipf_ids(
+    tenants: int, size: int, rng: Optional[np.random.Generator] = None
+) -> np.ndarray:
+    """Tenant draw for the mixed-tenant-zipf chain scenario (r15):
+    zipf over `tenants` — a few tenants dominate the front door, the
+    long tail trickles, the multi-tenant shape quota chains exist
+    for. Returns int64 tenant ids (not hashed: they become chain
+    LEVEL keys, strings, serving-side)."""
+    rng = rng or np.random.default_rng(43)
+    return (rng.zipf(ZIPF_A, size=size) % tenants).astype(np.int64)
+
+
 def stream_pool(
     name: str,
     key_space: int,
@@ -90,6 +129,8 @@ def stream_pool(
         return zipf_pool(key_space, size, rng)
     if name == "key-churn":
         return churn_pool(key_space, size, phase)
+    if name == "flash-crowd":
+        return flash_crowd_pool(key_space, size, phase)
     raise ValueError(
         f"unknown key stream {name!r} (choose from {STREAMS})"
     )

@@ -28,14 +28,49 @@ import json
 import sys
 import time
 
-from gubernator_tpu.api.types import Algorithm, Behavior, RateLimitReq, Status
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    ChainLevel,
+    RateLimitReq,
+    Status,
+)
 from gubernator_tpu.client import AsyncV1Client, random_string
 
 HOT_KEYS = 512
 COLD_KEYS = 4096
 
+#: r15 algorithm suite names (core/algorithms.py registry names, kept
+#: as a local literal so the generator stays jax-free)
+ALGOS = {
+    "token": Algorithm.TOKEN_BUCKET,
+    "leaky": Algorithm.LEAKY_BUCKET,
+    "sliding": Algorithm.SLIDING_WINDOW,
+    "gcra": Algorithm.GCRA,
+}
 
-def _shed_pool(share: float, batch: int, keyspace: int = 0):
+
+def _chain_levels(depth: int, tenant: int):
+    """Ancestor levels for --chain-depth: ONE shared head (the
+    consolidation contract routes every chain by chain[0], so one
+    hierarchy = one head), generous limits (the gate measures the
+    chain lane's dispatch price, not refusals)."""
+    if depth <= 0:
+        return []
+    return [
+        ChainLevel("cg:global", 1 << 30, 0),
+        ChainLevel(f"cg:region:{tenant % 4}", 1 << 28, 0),
+        ChainLevel(f"cg:tenant:{tenant % 64}", 1 << 26, 0),
+    ][:depth]
+
+
+def _shed_pool(
+    share: float,
+    batch: int,
+    keyspace: int = 0,
+    algorithm: Algorithm = Algorithm.TOKEN_BUCKET,
+    chain_depth: int = 0,
+):
     """Pre-built batch rotation in the shed-r10 workload shape: the
     first `share` of each batch hits hot limit-1 keys (over limit
     after their first touch), the rest never-over keys. `keyspace=0`
@@ -70,8 +105,9 @@ def _shed_pool(share: float, batch: int, keyspace: int = 0):
                     hits=1,
                     limit=limit,
                     duration=600_000,
-                    algorithm=Algorithm.TOKEN_BUCKET,
+                    algorithm=algorithm,
                     behavior=Behavior.BATCHING,
+                    chain=_chain_levels(chain_depth, i * batch + j),
                 )
             )
         pools.append(reqs)
@@ -118,10 +154,13 @@ async def run(
     quiet: bool = False,
     json_out: bool = False,
     keyspace: int = 0,
+    algorithm: str = "token",
+    chain_depth: int = 0,
 ) -> dict:
     client = _make_client(protocol, address, window, mode)
+    algo = ALGOS[algorithm]
     if share >= 0.0:
-        batches = _shed_pool(share, batch, keyspace)
+        batches = _shed_pool(share, batch, keyspace, algo, chain_depth)
     else:
         pool = [
             RateLimitReq(
@@ -130,8 +169,9 @@ async def run(
                 hits=1,
                 limit=(i % 100) + 1,
                 duration=((i % 50) + 1) * 1000,
-                algorithm=Algorithm.TOKEN_BUCKET,
+                algorithm=algo,
                 behavior=Behavior.BATCHING,
+                chain=_chain_levels(chain_depth, i),
             )
             for i in range(keys)
         ]
@@ -236,6 +276,17 @@ def main(argv=None) -> int:
         help="geb/http framing: pre-hashed fast records vs string "
         "items (auto negotiates via the hello)",
     )
+    parser.add_argument(
+        "--algorithm", choices=sorted(ALGOS), default="token",
+        help="rate-limit algorithm for every generated request "
+        "(r15 suite: token, leaky, sliding, gcra)",
+    )
+    parser.add_argument(
+        "--chain-depth", type=int, default=0,
+        help="ancestor quota-chain levels per request (r15; 0 = "
+        "plain). Chained items ride string GEBC frames / the proto "
+        "chain field; fast framing is bypassed by contract",
+    )
     parser.add_argument("--quiet", action="store_true",
                         help="don't print each OVER_LIMIT response")
     parser.add_argument("--json", action="store_true",
@@ -256,6 +307,8 @@ def main(argv=None) -> int:
             quiet=args.quiet or args.json,
             json_out=args.json,
             keyspace=args.keyspace,
+            algorithm=args.algorithm,
+            chain_depth=args.chain_depth,
         )
     )
     return 0

@@ -90,10 +90,12 @@ MAGIC_WREQ = 0x32424547  # 'GEB2'
 MAGIC_WRESP = 0x34424547  # 'GEB4'
 MAGIC_WFAST_REQ = 0x37424547  # 'GEB7'
 MAGIC_WFAST_RESP = 0x38424547  # 'GEB8'
+MAGIC_WCHAIN = 0x43424547  # 'GEBC' — chain-extended string req (r15)
 
 HELLO_FAST = 1
 HELLO_WINDOWED = 2
 HELLO_XXH64 = 4
+HELLO_CHAIN = 8  # server accepts GEBC chain-extended frames (r15)
 
 DRAIN_FRAME_ID = 0xFFFFFFFF
 
@@ -234,6 +236,10 @@ class Hello:
         return bool(self.flags & HELLO_XXH64)
 
     @property
+    def chain(self) -> bool:
+        return bool(self.flags & HELLO_CHAIN)
+
+    @property
     def window(self) -> int:
         return max(1, self.flags >> 16) if self.windowed else 1
 
@@ -293,10 +299,15 @@ def parse_hello_bytes(buf: bytes) -> Hello:
 
 def _fast_eligible(reqs: Sequence[RateLimitReq]) -> bool:
     """Fast records carry (hash, hits, limit, duration, algo) only: no
-    behavior, no validation-error channel. GLOBAL/NO_BATCHING items
-    and empty names/keys must ride string frames."""
+    behavior, no validation-error channel, no quota-chain levels.
+    GLOBAL/NO_BATCHING items, empty names/keys, and chained requests
+    (r15 — the 33-byte record has no varlen room) must ride string
+    frames."""
     return all(
-        r.behavior == Behavior.BATCHING and r.name and r.unique_key
+        r.behavior == Behavior.BATCHING
+        and r.name
+        and r.unique_key
+        and not r.chain
         for r in reqs
     )
 
@@ -346,6 +357,44 @@ def encode_string_payload(reqs: Sequence[RateLimitReq]) -> bytes:
                 int(r.behavior),
             )
         )
+    return b"".join(parts)
+
+
+def encode_chain_payload(reqs: Sequence[RateLimitReq]) -> bytes:
+    """GEBC chain-extended items (r15): each string item followed by a
+    u8 level count and that many (u16 key_len | key | i64 limit |
+    i64 duration) ancestor levels, shallow to deep. Plain items ride
+    with a 0 count, so one mixed batch stays one frame."""
+    parts = []
+    for r in reqs:
+        name = r.name.encode()
+        key = r.unique_key.encode()
+        if len(name) > 0xFFFF or len(key) > 0xFFFF:
+            raise GebError("name/unique_key exceed 65535 bytes")
+        chain = getattr(r, "chain", None) or []
+        if len(chain) > 0xFF:
+            raise GebError("chain exceeds 255 levels")
+        parts.append(_U16.pack(len(name)))
+        parts.append(name)
+        parts.append(_U16.pack(len(key)))
+        parts.append(key)
+        parts.append(
+            _ITEM_FIX.pack(
+                r.hits,
+                r.limit,
+                r.duration,
+                int(r.algorithm),
+                int(r.behavior),
+            )
+        )
+        parts.append(struct.pack("<B", len(chain)))
+        for lv in chain:
+            lk = lv.unique_key.encode()
+            if len(lk) > 0xFFFF:
+                raise GebError("chain level key exceeds 65535 bytes")
+            parts.append(_U16.pack(len(lk)))
+            parts.append(lk)
+            parts.append(struct.pack("<qq", lv.limit, lv.duration))
     return b"".join(parts)
 
 
@@ -429,10 +478,18 @@ def build_frame(
             f"batch of {len(reqs)} exceeds the {MAX_FRAME_ITEMS}-item "
             f"frame bound; split it"
         )
-    use_fast = fast and _fast_eligible(reqs)
+    chained = any(getattr(r, "chain", None) for r in reqs)
+    if chained and not windowed:
+        raise GebError(
+            "quota chains need the windowed GEBC framing; this server "
+            "negotiated the legacy single-frame protocol (pre-r7)"
+        )
+    use_fast = fast and not chained and _fast_eligible(reqs)
     payload = (
         encode_fast_payload(reqs)
         if use_fast
+        else encode_chain_payload(reqs)
+        if chained
         else encode_string_payload(reqs)
     )
     if len(payload) > MAX_FRAME_PAYLOAD:
@@ -455,9 +512,9 @@ def build_frame(
             )
         return hdr + _U32.pack(len(payload)) + payload, True
     if windowed:
-        hdr = _HDR.pack(MAGIC_WREQ, len(reqs)) + _WREQ_HDR.pack(
-            frame_id, t_sent_us
-        )
+        hdr = _HDR.pack(
+            MAGIC_WCHAIN if chained else MAGIC_WREQ, len(reqs)
+        ) + _WREQ_HDR.pack(frame_id, t_sent_us)
     else:
         hdr = _HDR.pack(MAGIC_REQ, len(reqs))
     return hdr + _U32.pack(len(payload)) + payload, use_fast
@@ -630,6 +687,16 @@ class AsyncGebClient:
         pipeline up to the credit window; responses match by frame id
         regardless of completion order."""
         await self.connect()
+        if (
+            any(getattr(r, "chain", None) for r in reqs)
+            and not self.hello.chain
+        ):
+            # sending GEBC at a pre-r15 server would poison the
+            # connection (bad magic) — refuse client-side instead
+            raise GebError(
+                "server does not accept quota-chain frames "
+                "(no HELLO_CHAIN capability; pre-r15?)"
+            )
         if not self._windowed:
             return await self._legacy_roundtrip(reqs, timeout)
         loop = asyncio.get_running_loop()
@@ -944,10 +1011,18 @@ class AsyncHttpGebClient:
         self, reqs: Sequence[RateLimitReq], _retried: bool = False
     ) -> List[RateLimitResp]:
         await self._ensure()
+        chained = any(getattr(r, "chain", None) for r in reqs)
+        if chained and not self.hello.chain:
+            raise GebError(
+                "gateway does not accept quota-chain frames "
+                "(no HELLO_CHAIN capability; pre-r15?)"
+            )
+        # chains need the GEBC framing, which is windowed-shaped; the
+        # gateway echoes the frame id without pipelining semantics
         frame, is_fast = build_frame(
             reqs,
             fast=self._use_fast,
-            windowed=False,
+            windowed=chained,
             ring_hash=self.hello.ring_hash,
         )
         async with self._session.post(
@@ -979,6 +1054,12 @@ class AsyncHttpGebClient:
             if magic != MAGIC_FAST_RESP:
                 raise GebError(f"bad response magic {magic:#x}")
             out = decode_fast_body(body[8:], n)
+        elif chained:
+            # GEBC is answered with a GEB4 frame: u32 frame_id (echoed,
+            # meaningless over HTTP) precedes the items
+            if magic != MAGIC_WRESP:
+                raise GebError(f"bad response magic {magic:#x}")
+            out = decode_string_body(body[12:], n)
         else:
             if magic != MAGIC_RESP:
                 raise GebError(f"bad response magic {magic:#x}")

@@ -1,0 +1,232 @@
+"""Algorithm suite v2 (r15): the rate-limit algorithm subsystem.
+
+The engine served exactly the reference's two algorithms (token bucket /
+leaky bucket) through r14; the scalable-rate-limiting survey (PAPERS.md,
+arXiv 2602.11741) names sliding-window counters and GCRA as the
+production-standard alternatives. This module is the single source of
+truth for the expanded family: the algorithm registry (ids, flag bits,
+serving-tier eligibility), and the integer decision math each algorithm
+shares between its DEVICE branch (core/kernels.py decide_presorted) and
+its HOST oracle twin (core/oracle.py) — the two are fuzz-pinned
+byte-identical, so the conventions live in one place.
+
+Per-algorithm state in the 8-lane bucket-row layout (core/store.py):
+
+  token bucket (FLAG bits 0, the pre-r15 encoding, unchanged):
+    L_EXPIRE window end | L_REMAINING budget | L_TS creation time
+  leaky bucket (FLAG_ALGO_LEAKY):
+    L_EXPIRE cache expiry | L_REMAINING budget | L_TS last-leak time
+  sliding window (FLAG_ALGO_SLIDING):
+    L_EXPIRE = window_start + 2*duration (the entry stays live through
+      the FOLLOWING window so its count can serve as the "previous
+      window" of the blend; window_start reconstructs as expire - 2d)
+    L_REMAINING = hits consumed in the CURRENT subwindow (a count)
+    L_TS = hits consumed in the PREVIOUS subwindow (a COUNT, not a
+      time — store.rebase is flag-aware and skips it)
+    Subwindows are PER-KEY ANCHORED at creation time (boundaries at
+    ws0 + k*duration), not epoch-aligned: every decision then depends
+    only on time DIFFERENCES, which makes the state invariant under the
+    engine's epoch rebase — the property that lets sliding windows ride
+    the int32 engine-ms envelope with no special rebase handling.
+  GCRA (FLAG_ALGO_GCRA):
+    L_EXPIRE = the theoretical arrival time (TAT). Computed in int64
+      in-kernel and clamped into the int32 engine-ms lane; TAT < now
+      means the bucket has fully drained, which is exactly the store's
+      lazy-expiry miss condition — a drained GCRA bucket and a fresh
+      one are indistinguishable by design, so expiry needs no extra
+      state. L_REMAINING/L_TS carry the last response's budget and
+      touch time for observability only.
+
+Integer conventions (identical on device and host):
+
+  sliding blend   used = cur + floor(prev * (d - elapsed) / d)
+                  budget = max(limit - used, 0)
+  GCRA            T   = max(duration // max(limit, 1), 1)   (emission)
+                  tau = min(T * limit, INT32_MAX)           (burst)
+                  tat0 = max(TAT, now)
+                  budget = clamp((now + tau - tat0) // T, 0, limit)
+                  charge of n admitted hits: TAT' = tat0 + n*T
+
+Mismatch rule: a request finding live state of another algorithm
+recreates the window. The reference's token/leaky pair recreates as a
+fresh TOKEN bucket in both directions (algorithms.go:33-38,100-105) and
+that behavior is kept verbatim; a sliding or GCRA request recreates as
+a fresh window of ITS OWN algorithm (the reference has no rule here,
+and "the algorithm you asked for" is the only defensible extension).
+
+Serving-tier eligibility (the r15 interplay audit):
+
+- shed cache (serve/shedcache.py): token only, as before. Sliding and
+  GCRA verdicts change every millisecond (the blend weight decays; TAT
+  drains), so a cached refusal is never provably current — the same
+  reason leaky was excluded on day one. SHEDDABLE_ALGOS is the gate.
+- sketch cold tier (core/sketches.py, kernels sketch branch): token
+  and leaky only. The sketch serves dropped creates with FIXED-WINDOW
+  token math over a window-keyed estimate; for sliding that math can
+  UNDER-count at window boundaries (the previous-window weight is
+  invisible to the sketch) and for GCRA the TAT has no window at all —
+  both would break the tier's one-sided fail-closed contract, so their
+  dropped creates keep the exact-only store's historical behavior
+  (counted in BatchStats.dropped, briefly over-admitting).
+  SKETCH_SERVABLE_ALGOS is the gate.
+- GLOBAL replica serving and bucket replication stay token-scoped
+  (unchanged): sliding/GCRA GLOBAL misses process locally exactly like
+  leaky always has, and snapshot_read skips every non-token entry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+import numpy as np
+
+from gubernator_tpu.core.store import (
+    FLAG_ALGO_GCRA,
+    FLAG_ALGO_LEAKY,
+    FLAG_ALGO_SLIDING,
+)
+
+# algorithm ids — the wire enum (api.types.Algorithm) and the kernel's
+# BatchRequest.algo column use these values
+ALGO_TOKEN = 0
+ALGO_LEAKY = 1
+ALGO_SLIDING = 2
+ALGO_GCRA = 3
+
+_I32_MAX = (1 << 31) - 1
+
+#: Sliding-window duration cap: HALF the generic MAX_DURATION_MS
+#: envelope (~6.2 days vs token's ~12.4). The expire lane encodes
+#: window_start + 2*duration (the entry must outlive TWO windows so
+#: its count can serve as the next window's "previous"), and with
+#: engine now <= 2^30 the anchor ws + 2*d only stays inside int32 for
+#: d <= 2^29 - 1. Applied IDENTICALLY on device and host — both sides
+#: derive the effective duration from the stored/request value via
+#: sliding_dur(), so the byte-identity holds for any requested
+#: duration; beyond the cap a sliding window simply rotates on the
+#: capped period.
+SLIDING_MAX_DURATION_MS = (1 << 29) - 1
+
+
+def sliding_dur(duration: int) -> int:
+    """The EFFECTIVE sliding-window period for a stored/requested
+    duration (host twin of the kernel's clip; see
+    SLIDING_MAX_DURATION_MS)."""
+    return max(min(duration, SLIDING_MAX_DURATION_MS), 1)
+
+
+@dataclass(frozen=True)
+class AlgoSpec:
+    """Registry row: everything the serving tiers need to know about
+    one algorithm without reading kernel code."""
+
+    algo: int  # BatchRequest.algo / api.types.Algorithm value
+    name: str  # CLI/README name
+    flag: int  # FLAG_ALGO_* store bit (0 = token, the all-zero default)
+    sheddable: bool  # frozen OVER verdicts host-cacheable (shed cache)
+    sketch_servable: bool  # dropped creates servable by the cold tier
+    state: str  # one-line state-layout summary (README table)
+
+
+ALGORITHMS: Dict[int, AlgoSpec] = {
+    ALGO_TOKEN: AlgoSpec(
+        ALGO_TOKEN, "token", 0, True, True,
+        "window end + remaining budget (sticky-over flag)",
+    ),
+    ALGO_LEAKY: AlgoSpec(
+        ALGO_LEAKY, "leaky", FLAG_ALGO_LEAKY, False, True,
+        "budget + last-leak timestamp (continuous refill)",
+    ),
+    ALGO_SLIDING: AlgoSpec(
+        ALGO_SLIDING, "sliding", FLAG_ALGO_SLIDING, False, False,
+        "current + previous subwindow counts, per-key anchored",
+    ),
+    ALGO_GCRA: AlgoSpec(
+        ALGO_GCRA, "gcra", FLAG_ALGO_GCRA, False, False,
+        "one theoretical-arrival-time (int64 math, int32 lane)",
+    ),
+}
+
+ALGO_NAMES = {spec.name: a for a, spec in ALGORITHMS.items()}
+
+#: shed-cache gate (serve/shedcache.py): algorithms whose over-limit
+#: verdict is frozen for the rest of the window
+SHEDDABLE_ALGOS = frozenset(
+    a for a, s in ALGORITHMS.items() if s.sheddable
+)
+
+#: sketch-tier gate (kernels sketch branch + serve/promoter.py):
+#: algorithms whose dropped creates the count-min tier may serve
+SKETCH_SERVABLE_ALGOS = frozenset(
+    a for a, s in ALGORITHMS.items() if s.sketch_servable
+)
+
+
+def sheddable(algo: int) -> bool:
+    return algo in SHEDDABLE_ALGOS
+
+
+def sketch_servable(algo: int) -> bool:
+    return algo in SKETCH_SERVABLE_ALGOS
+
+
+# -- shared integer math (host twins; the kernel inlines the same
+# -- expressions in jnp — changing one side without the other breaks the
+# -- byte-identity fuzz in tests/test_algorithms.py) ------------------------
+
+
+def gcra_params(limit: int, duration: int) -> Tuple[int, int]:
+    """(emission interval T, burst tolerance tau) — both ms. T uses the
+    leaky bucket's division guard (divergence-2 style: max(.., 1));
+    tau saturates at int32 max so limit >> duration (sub-ms emission)
+    cannot overflow the engine envelope."""
+    T = max(duration // max(limit, 1), 1)
+    tau = min(T * max(limit, 0), _I32_MAX)
+    return T, tau
+
+
+def gcra_budget(tat: int, now: int, limit: int, duration: int) -> int:
+    """Visible budget at `now` for a stored theoretical arrival time."""
+    T, tau = gcra_params(limit, duration)
+    tat0 = max(tat, now)
+    return max(min((now + tau - tat0) // T, max(limit, 0)), 0)
+
+
+def sliding_rotate(
+    expire: int, duration: int, now: int, cur: int, prev: int
+) -> Tuple[int, int, int]:
+    """(window_start', cur', prev') after advancing a stored sliding
+    entry to `now`. `expire` is the stored L_EXPIRE (= ws + 2d, d the
+    EFFECTIVE capped duration); one whole-window advance shifts cur
+    into prev; two or more clear both (the previous window recorded
+    nothing)."""
+    d = sliding_dur(duration)
+    ws0 = expire - 2 * d
+    k = max((now - ws0) // d, 0)
+    if k == 0:
+        return ws0, cur, prev
+    if k == 1:
+        return ws0 + d, 0, cur
+    return ws0 + k * d, 0, 0
+
+
+def sliding_used(
+    ws: int, duration: int, now: int, cur: int, prev: int
+) -> int:
+    """Weighted consumed total: current count plus the previous
+    subwindow's count scaled by its remaining overlap (floor)."""
+    d = sliding_dur(duration)
+    wrem = d - (now - ws)
+    return cur + (prev * wrem) // d
+
+
+def stored_algo_np(flags: np.ndarray) -> np.ndarray:
+    """Decode FLAG_ALGO_* bits to algo ids (numpy; the device twin is
+    inlined in kernels.decide_presorted)."""
+    f = np.asarray(flags)
+    return (
+        ((f & FLAG_ALGO_LEAKY) != 0) * ALGO_LEAKY
+        + ((f & FLAG_ALGO_SLIDING) != 0) * ALGO_SLIDING
+        + ((f & FLAG_ALGO_GCRA) != 0) * ALGO_GCRA
+    ).astype(np.int32)

@@ -101,6 +101,22 @@ class LRUCache:
             return None, False
         return rec.value, True
 
+    def snapshot(self, key: Hashable):
+        """(deep-copied value, expire_at) of the RAW record — expired
+        or not — or None when absent. No recency move, no accounting,
+        no deletion. With add()/remove(), lets a caller run an
+        advisory decision pass and restore pristine state afterwards:
+        the r15 chain peek uses this so a leaky level's peek-persisted
+        leak credit (the reference's quirk) is not applied twice by
+        the peek-then-debit two-phase (serve/backends.py
+        ExactBackend.decide_chain)."""
+        import copy
+
+        rec = self._data.get(key)
+        if rec is None:
+            return None
+        return copy.deepcopy(rec.value), rec.expire_at
+
     def remove(self, key: Hashable) -> None:
         self._data.pop(key, None)
 

@@ -76,6 +76,20 @@ def _decide_packed_jit(store, req, now, groups=None):
     return store, pack_outputs(resp, stats)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _decide_packed_chain_jit(store, req, now, groups, chain_id):
+    """Quota-chain twin of _decide_packed_jit (r15): one jitted pass
+    with chain-coupled rows (kernels.decide_presorted_chain). Chain
+    batches run exact-only — the sketch tier is never consulted
+    (core/algorithms.py eligibility)."""
+    from gubernator_tpu.core.kernels import decide_presorted_chain
+
+    store, resp, stats = decide_presorted_chain(
+        store, req, now, chain_id, groups
+    )
+    return store, pack_outputs(resp, stats)
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _decide_packed_sketch_jit(store, sketch, req, now, groups=None):
     """Two-tier twin of _decide_packed_jit (r13): store AND sketch
@@ -240,17 +254,25 @@ class EpochClock:
         self.epoch: Optional[int] = None
 
     def advance(self, now: int) -> Tuple[np.int32, Optional[int], bool]:
-        """Returns (engine_now, rebase_delta, reset_required)."""
+        """Returns (engine_now, rebase_delta, reset_required).
+
+        The epoch pins ONE MILLISECOND before the first observed time,
+        so live engine-ms values are always >= 1: engine-ms 0 is the
+        wire's "no reset" sentinel (from_engine passes it through), and
+        since r15 a real timestamp can land there — a GCRA peek at the
+        pinning instant reports reset_time = its TAT = the current
+        time, which a 0-based epoch would silently map to "no reset"."""
         now = int(now)
         if self.epoch is None:
-            self.epoch = now
+            self.epoch = now - 1
         e = now - self.epoch
         if 0 <= e <= REBASE_AT:
             return np.int32(e), None, False
-        self.epoch = now
+        self.epoch = now - 1
+        e -= 1
         if -REBASE_AT < e <= _I32_SAT:
-            return np.int32(0), e, False
-        return np.int32(0), None, True
+            return np.int32(1), e, False
+        return np.int32(1), None, True
 
     def to_engine(self, t) -> np.ndarray:
         """int64 unix-ms (vector) -> int32 engine-ms, clamped."""

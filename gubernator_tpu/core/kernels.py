@@ -94,7 +94,10 @@ import jax.numpy as jnp
 from jax import lax
 
 from gubernator_tpu.core.store import (
+    FLAG_ALGO_GCRA,
     FLAG_ALGO_LEAKY,
+    FLAG_ALGO_MASK,
+    FLAG_ALGO_SLIDING,
     FLAG_STICKY_OVER,
     L_DURATION,
     L_EXPIRE,
@@ -483,6 +486,43 @@ def decide_presorted(
     return store, resp, stats
 
 
+def decide_presorted_chain(
+    store: Store,
+    req: BatchRequest,
+    now: jax.Array,
+    chain_id: jax.Array,
+    groups: BatchGroups | None = None,
+) -> Tuple[Store, BatchResponse, BatchStats]:
+    """Quota-chain decide (r15): evaluate a presorted batch whose rows
+    are COUPLED into chains — `chain_id` int32[B] gives each row its
+    chain slot (rows of one hierarchical request share an id; plain
+    rows carry a unique id each), ids need not be contiguous in the
+    sorted order (chain levels hash to different buckets by design).
+
+    Semantics: each row decides exactly as decide_presorted would
+    (optimistically), then a chain whose ANY member row reports
+    OVER_LIMIT has EVERY member's state charge rolled back before the
+    single writeback — the no-partial-debit contract: a level's
+    refusal never consumes quota at any other level, all inside one
+    device pass. Responses stay the per-level optimistic verdicts; the
+    serving tier collapses them most-restrictive-wins
+    (serve/instance.py). In-batch conservatism: a later chain sharing
+    a level with an earlier ROLLED-BACK chain still sees the
+    optimistic prefix, so it can only be refused where sequential
+    processing might have admitted it — at-least-as-restrictive, the
+    same direction as the kernel's cumulative-attempt rule. The sketch
+    cold tier is not consulted on this path (chain batches run
+    exact-only; see core/algorithms.py).
+
+    With every chain a singleton (chain_id all-distinct), decisions
+    and the written store are byte-identical to decide_presorted —
+    the depth-1 identity pinned by tests/test_chains.py."""
+    store, _sketch, resp, stats = _decide_presorted(
+        store, req, now, groups, None, chain_id=chain_id
+    )
+    return store, resp, stats
+
+
 def decide_presorted_sketch(
     store: Store,
     sketch: Sketch,
@@ -520,6 +560,7 @@ def _decide_presorted(
     now: jax.Array,
     groups: BatchGroups | None,
     sketch: Sketch | None,
+    chain_id: jax.Array | None = None,
 ) -> Tuple[Store, Sketch | None, BatchResponse, BatchStats]:
     """Evaluate one PRESORTED padded batch; responses come back in the
     same (sorted) order. `now` is int32 engine-ms. Pure; jit with
@@ -644,18 +685,31 @@ def _decide_presorted(
         axis=0,
         indices_are_sorted=True,
     )
-    g_algo = lead_req[:, 0]
+    g_algo = jnp.clip(lead_req[:, 0], 0, 3)
     g_hits = lead_req[:, 1]
     g_limQ = lead_req[:, 2]
     g_durQ = lead_req[:, 3]
 
+    # stored algorithm from the entry's flag bits (core/algorithms.py:
+    # token is the all-zero encoding, so pre-r15 entries decode as 0)
     stored_leaky = (g_flg & FLAG_ALGO_LEAKY) != 0
+    stored_sld = (g_flg & FLAG_ALGO_SLIDING) != 0
+    stored_gcra = (g_flg & FLAG_ALGO_GCRA) != 0
+    stored_algo = (
+        stored_leaky * 1 + stored_sld * 2 + stored_gcra * 3
+    ).astype(jnp.int32)
     req_leaky = g_algo == 1
-    # Algorithm switch recreates as a fresh *token* bucket in both
-    # directions (reference algorithms.go:33-38,100-105).
-    mismatch = g_live & (stored_leaky != req_leaky)
+    # Algorithm switch recreates the window. The token/leaky pair
+    # recreates as a fresh *token* bucket in both directions (reference
+    # algorithms.go:33-38,100-105, kept verbatim); sliding/GCRA
+    # requests recreate as their OWN algorithm (core/algorithms.py).
+    mismatch = g_live & (stored_algo != g_algo)
     existing = g_live & ~mismatch
-    eff_leaky = jnp.where(existing, stored_leaky, ~mismatch & req_leaky)
+    create_algo = jnp.where(mismatch & req_leaky, 0, g_algo)
+    eff_algo = jnp.where(existing, stored_algo, create_algo)
+    eff_leaky = eff_algo == 1
+    eff_sld = eff_algo == 2
+    eff_gcra = eff_algo == 3
 
     # leaky guard (documented divergence: reference div-by-zero,
     # algorithms.go:107): existing leaky group with request limit <= 0
@@ -669,8 +723,61 @@ def _decide_presorted(
     # overflow-free min(g_rem + leak, g_limS): stored remaining <= limit
     leaky_R0 = g_rem + jnp.minimum(leak, jnp.maximum(g_limS - g_rem, 0))
 
+    now64 = now.astype(jnp.int64)
+
+    # sliding window (r15, core/algorithms.py conventions): rotate the
+    # stored subwindow pair to `now` — the entry's L_REMAINING lane is
+    # the CURRENT subwindow's consumed count, L_TS the PREVIOUS one's,
+    # and the window start reconstructs as expire - 2d. All in int64:
+    # the blend multiply (count * ms) overflows int32 by design. The
+    # EFFECTIVE period caps at SLIDING_MAX_DURATION_MS = 2^29-1 (half
+    # the token envelope: the ws + 2d expire anchor must stay inside
+    # int32 with now <= 2^30) — algorithms.sliding_dur is the host
+    # twin, so the byte-identity holds for any requested duration.
+    _SLD_DMAX = (1 << 29) - 1
+    d_sld = jnp.clip(g_durS.astype(jnp.int64), 1, _SLD_DMAX)
+    sld_ws0 = g_exp.astype(jnp.int64) - 2 * d_sld
+    sld_k = jnp.maximum((now64 - sld_ws0) // d_sld, 0)
+    sld_ws = sld_ws0 + sld_k * d_sld  # current subwindow start
+    sld_cur0 = jnp.where(sld_k == 0, g_rem, 0)
+    sld_prev0 = jnp.where(
+        sld_k == 0, g_ts, jnp.where(sld_k == 1, g_rem, 0)
+    )
+    sld_wrem = d_sld - (now64 - sld_ws)  # in (0, d]
+    sld_used = sld_cur0.astype(jnp.int64) + (
+        sld_prev0.astype(jnp.int64) * sld_wrem
+    ) // d_sld
+    lim_s64 = g_limS.astype(jnp.int64)
+    R0_sld = (
+        jnp.clip(lim_s64 - sld_used, 0, jnp.maximum(lim_s64, 0))
+        .astype(jnp.int32)
+    )
+
+    # GCRA (r15): the stored L_EXPIRE lane IS the theoretical arrival
+    # time; budget = clamp((now + tau - max(TAT, now)) // T, 0, limit)
+    # with T/tau from the STORED params for existing entries (creation
+    # uses the request's params via the generic creation machinery and
+    # the effective-params columns below). int64 throughout: tau =
+    # T*limit can exceed int32 for limit >> duration.
+    T_stored = jnp.maximum(
+        g_durS.astype(jnp.int64)
+        // jnp.maximum(g_limS.astype(jnp.int64), 1),
+        1,
+    )
+    tau_stored = jnp.minimum(
+        T_stored * jnp.maximum(lim_s64, 0), jnp.int64(_I32_MAX)
+    )
+    tat0_stored = jnp.maximum(g_exp.astype(jnp.int64), now64)
+    R0_gcra = jnp.clip(
+        (now64 + tau_stored - tat0_stored) // T_stored,
+        0,
+        jnp.maximum(lim_s64, 0),
+    ).astype(jnp.int32)
+
     # group budget at batch start
     R0_exist = jnp.where(eff_leaky, leaky_R0, g_rem)
+    R0_exist = jnp.where(eff_sld, R0_sld, R0_exist)
+    R0_exist = jnp.where(eff_gcra, R0_gcra, R0_exist)
 
     # creation by the group leader (reference algorithms.go:68-84,161-186)
     over_c = g_hits > g_limQ
@@ -678,12 +785,38 @@ def _decide_presorted(
     R0_create = g_limQ - jnp.where(charged_ldr, g_hits, 0)
     # token creation with hits > limit stores remaining = limit ("sticky
     # over", algorithms.go:78-81); leaky stores an empty bucket (:180).
+    # Sliding/GCRA creation refusals store an untouched fresh window
+    # (their status is recomputed every call, nothing to persist).
     R0_create = jnp.where(over_c & eff_leaky, 0, R0_create)
 
     R0 = jnp.where(existing, R0_exist, R0_create)
+    # sticky-over is a token-bucket-only mutation; sliding/GCRA
+    # recompute their status from state every call
     sticky0 = jnp.where(
-        existing, (g_flg & FLAG_STICKY_OVER) != 0, ~eff_leaky & over_c
+        existing,
+        (g_flg & FLAG_STICKY_OVER) != 0,
+        (eff_algo == 0) & over_c,
     )
+
+    # effective GCRA params per group (stored for existing, request's
+    # for creations) — the response resets and the TAT writeback below
+    # share these
+    eff_lim64 = jnp.where(existing, lim_s64, g_limQ.astype(jnp.int64))
+    eff_dur64 = jnp.where(
+        existing, g_durS.astype(jnp.int64), g_durQ.astype(jnp.int64)
+    )
+    gcra_T = jnp.maximum(eff_dur64 // jnp.maximum(eff_lim64, 1), 1)
+    gcra_tau = jnp.minimum(
+        gcra_T * jnp.maximum(eff_lim64, 0), jnp.int64(_I32_MAX)
+    )
+    gcra_tat0 = jnp.where(existing, tat0_stored, now64)
+    # sliding response reset: the current subwindow's end (existing) or
+    # the creation window's end
+    sld_reset_G = jnp.where(
+        existing & eff_sld,
+        jnp.clip(sld_ws + d_sld, _I32_MIN, _I32_MAX),
+        (now + g_durQ).astype(jnp.int64),
+    ).astype(jnp.int32)
 
     # ---- writeback plan + sketch cold tier (r13) --------------------------
     # The writer/way/drop plan runs BEFORE response math so the sketch
@@ -720,7 +853,19 @@ def _decide_presorted(
         victim_live = (v_sel[:, L_TAG] != 0) & (
             v_sel[:, L_EXPIRE] >= now
         )
-        sk_extra = evicted_G & victim_live
+        # sketch-servable gate (r15, core/algorithms.py): only token
+        # and leaky creates may be diverted to the count-min tier —
+        # the sketch decides with FIXED-WINDOW token math, which
+        # under-counts a sliding window's previous-window weight and
+        # has no analogue of a GCRA TAT, so serving those there would
+        # break the tier's one-sided fail-closed contract. Their
+        # dropped creates keep the exact-only store's historical
+        # behavior (BatchStats.dropped, brief over-admission), and
+        # live-victim protection does not engage for them (an
+        # unservable create diverted to nowhere would be over-
+        # admission with an evicted victim spared — strictly worse).
+        sk_able = eff_algo <= 1
+        sk_extra = evicted_G & victim_live & sk_able
         dropped_G = dropped_G | sk_extra
         evicted_G = evicted_G & ~sk_extra
 
@@ -745,7 +890,10 @@ def _decide_presorted(
         v_dur_pos = jnp.maximum(v_sel[:, L_DURATION], 1)
         v_wid = now // v_dur_pos
         v_overlap = v_sel[:, L_EXPIRE] > v_wid * v_dur_pos
-        v_token = (v_sel[:, L_FLAGS] & FLAG_ALGO_LEAKY) == 0
+        # token victims only: leaky has no fixed window to fold into,
+        # and sliding/GCRA lanes don't hold a (limit - remaining)
+        # consumed count (r15: the mask covers all three)
+        v_token = (v_sel[:, L_FLAGS] & FLAG_ALGO_MASK) == 0
         v_sticky = (v_sel[:, L_FLAGS] & FLAG_STICKY_OVER) != 0
         v_consumed = jnp.clip(
             jnp.where(
@@ -780,8 +928,10 @@ def _decide_presorted(
         # (a documented tail-only divergence — the sketch has no
         # per-key timestamp to leak from). Estimates only over-count
         # (conservative update + hash collisions), so refusal comes
-        # at-or-before the true budget: fail-closed.
-        sk_g = dropped_G
+        # at-or-before the true budget: fail-closed. Sliding/GCRA
+        # drops are NOT sketch-served (sk_able above): they keep the
+        # exact-only contract.
+        sk_g = dropped_G & sk_able
         dur_pos = jnp.maximum(g_durQ, 1)
         wid = now // dur_pos  # int32: engine now >= 0
         window_end = (wid + 1) * dur_pos  # <= now + dur <= INT32_MAX
@@ -796,6 +946,7 @@ def _decide_presorted(
         # creation-leader special case, uniform cumulative charging
         existing = existing | sk_g
         eff_leaky = eff_leaky & ~sk_g
+        eff_algo = jnp.where(sk_g, 0, eff_algo)
         R0 = jnp.where(sk_g, jnp.maximum(g_limQ - est_c, 0), R0)
         sticky0 = sticky0 & ~sk_g
         g_exp = jnp.where(sk_g, window_end, g_exp)  # response reset
@@ -822,9 +973,14 @@ def _decide_presorted(
                 # existing0, not existing: a sketch-served group is NOT
                 # a token replica — its gnp rows process as owned, the
                 # same contract as an exact-tier miss
-                (existing0 & ~stored_leaky).astype(jnp.int32),
+                (existing0 & (stored_algo == 0)).astype(jnp.int32),
                 charged_ldr.astype(jnp.int32),
                 g_hits,
+                eff_algo,
+                sld_reset_G,
+                gcra_T.astype(jnp.int32),  # T <= duration: fits int32
+                gcra_tau.astype(jnp.int32),  # clamped to I32_MAX above
+                gcra_tat0.astype(jnp.int32),  # <= I32_MAX by envelope
             ],
             axis=-1,
         ),
@@ -845,9 +1001,16 @@ def _decide_presorted(
     g_durQ_r = bridge[:, 10]
     over_c_r = bridge[:, 11] != 0
     leaky_zero_r = bridge[:, 12] != 0
-    tok_replica_r = bridge[:, 13] != 0  # existing & ~stored_leaky
+    tok_replica_r = bridge[:, 13] != 0  # existing & stored token
     charged_ldr_r = bridge[:, 14] != 0
     g_hits_r = bridge[:, 15]
+    eff_algo_r = bridge[:, 16]
+    eff_sld_r = eff_algo_r == 2
+    eff_gcra_r = eff_algo_r == 3
+    sld_reset_r = bridge[:, 17]
+    gcra_T_r = bridge[:, 18].astype(jnp.int64)
+    gcra_tau_r = bridge[:, 19].astype(jnp.int64)
+    gcra_tat0_r = bridge[:, 20].astype(jnp.int64)
 
     # GLOBAL non-owner replica read: answer straight from the live entry,
     # no mutation (reference gubernator.go:178-187). On a miss the request
@@ -895,7 +1058,12 @@ def _decide_presorted(
     S_chg = prefix2[:, 0]
     rem_vis = jnp.maximum(R0_r - S_chg, 0)  # true budget visible to j
 
-    z = viable & ~eff_leaky_r & (R0_r - S_chg == 0) & ~is_creation_leader
+    # token-only sticky flip: sliding/GCRA statuses are recomputed from
+    # state every call, like leaky (r15)
+    z = (
+        viable & (eff_algo_r == 0) & (R0_r - S_chg == 0)
+        & ~is_creation_leader
+    )
     c3 = jnp.cumsum(z.astype(jnp.int32))
     sticky_live = sticky0_r | (same_prev & _shift1(z, False))
 
@@ -973,12 +1141,54 @@ def _decide_presorted(
     remaining = jnp.where(eff_leaky_r, lk_remaining, tok_remaining)
     reset = jnp.where(eff_leaky_r, lk_reset, tok_reset)
 
+    # sliding / GCRA, existing-style position (r15): no persisted
+    # status — OVER iff the visible budget is gone or this hit-carrying
+    # request was refused (the leaky status shape, minus its quirks)
+    sg = eff_sld_r | eff_gcra_r
+    sg_over = (rem_vis == 0) | (~charged & (h != 0))
+    sg_status = jnp.where(sg_over, OVER, UNDER)
+    sg_remaining = jnp.where(
+        rem_vis == 0, 0, jnp.where(charged, rem_vis - h, rem_vis)
+    )
+    # GCRA per-row reset: the row's own theoretical arrival time after
+    # every charge earlier in its group (S_eff adds a creation leader's
+    # charge for follower rows) plus its own n*T; a refused hit-
+    # carrying row instead reports the earliest instant the same
+    # request could succeed (TAT + n*T - tau). Matches sequential
+    # application of core/oracle.gcra by construction.
+    S_eff = S_chg + jnp.where(
+        ~existing_r & charged_ldr_r & ~is_creation_leader, g_hits_r, 0
+    )
+    tat_row = gcra_tat0_r + S_eff.astype(jnp.int64) * gcra_T_r
+    g_reset64 = (
+        tat_row
+        + h.astype(jnp.int64) * gcra_T_r
+        - jnp.where(sg_over & (h != 0), gcra_tau_r, 0)
+    )
+    gcra_reset_r = jnp.clip(g_reset64, _I32_MIN, _I32_MAX).astype(
+        jnp.int32
+    )
+    status = jnp.where(sg, sg_status, status)
+    remaining = jnp.where(sg, sg_remaining, remaining)
+    reset = jnp.where(eff_sld_r, sld_reset_r, reset)
+    reset = jnp.where(eff_gcra_r, gcra_reset_r, reset)
+
     # creation leader overrides (the branchy creation responses)
     cl_status = jnp.where(over_c_r, OVER, UNDER)
     cl_remaining = jnp.where(
         over_c_r, jnp.where(eff_leaky_r, 0, g_limQ_r), g_limQ_r - g_hits_r
     )
     cl_reset = jnp.where(eff_leaky_r, 0, now + g_durQ_r)
+    # GCRA creation: reset is the fresh TAT after the leader's own
+    # charge (now + n*T); sliding keeps the token-shaped window end
+    gcra_cl = jnp.clip(
+        gcra_tat0_r
+        + jnp.where(charged_ldr_r, g_hits_r, 0).astype(jnp.int64)
+        * gcra_T_r,
+        _I32_MIN,
+        _I32_MAX,
+    ).astype(jnp.int32)
+    cl_reset = jnp.where(eff_gcra_r, gcra_cl, cl_reset)
     status = jnp.where(is_creation_leader, cl_status, status)
     remaining = jnp.where(is_creation_leader, cl_remaining, remaining)
     reset = jnp.where(is_creation_leader, cl_reset, reset)
@@ -996,10 +1206,81 @@ def _decide_presorted(
     reset = jnp.where(leaky_zero_r, now + g_durS_r, reset)
     resp_limit = jnp.where(leaky_zero_r, lim_q, g_lim_resp)
 
-    # ---- state writeback at [G]: merged whole-bucket-row scatter ----------
-    rem_final = R0 - total_charged
+    # ---- quota-chain no-partial-debit (r15) -------------------------------
+    # With chain coupling, a chain ANY of whose member rows reports
+    # OVER_LIMIT has every member's charge rolled back before the
+    # writeback: recompute the group aggregates the writeback consumes
+    # with refused-chain rows masked out (one extra scan pair, traced
+    # only into the chain program — the plain program's aggregates are
+    # untouched). Responses stay the per-level optimistic verdicts;
+    # the serving tier collapses them most-restrictive-wins.
+    if chain_id is not None:
+        row_over = (status == OVER) & valid
+        over_i = row_over.astype(jnp.int32)
+        bad_cnt = jnp.zeros((B,), jnp.int32).at[chain_id].add(over_i)
+        # A row is rolled back iff ANOTHER member of its chain refused.
+        # The refusing level's own refusal is its own decision: its
+        # bookkeeping (token sticky flip at exhaustion, leaky touch)
+        # keeps plain-kernel semantics — which is exactly what makes
+        # the all-singleton program byte-identical to decide_presorted
+        # (a refused row never charged, so quota rollback is moot for
+        # it; masking it anyway was dropping the plain path's sticky
+        # and timestamp writebacks).
+        m_ok = (jnp.take(bad_cnt, chain_id) - over_i) == 0
+        inc_chg_w = jnp.where(charged & ~is_creation_leader & m_ok, h, 0)
+        incl_w = _seg_scan(
+            is_leader,
+            jnp.stack(
+                [
+                    inc_chg_w,
+                    (decr & m_ok).astype(jnp.int32),
+                    (viable & (h != 0) & m_ok).astype(jnp.int32),
+                ],
+                axis=-1,
+            ),
+        )
+        z_w = z & m_ok
+        c3_w = jnp.cumsum(z_w.astype(jnp.int32))
+        ends_w = jnp.take(
+            jnp.concatenate([incl_w, c3_w[:, None]], axis=1),
+            end_pos_G,
+            axis=0,
+            indices_are_sorted=True,
+        )
+        total_charged_w = ends_w[:, 0]
+        any_decr_w = ends_w[:, 1] > 0
+        any_hits_w = ends_w[:, 2] > 0
+        z_lead_w = jnp.take(
+            jnp.stack([c3_w, z_w.astype(jnp.int32)], axis=-1),
+            lead_clip,
+            axis=0,
+            indices_are_sorted=True,
+        )
+        any_z_w = (ends_w[:, 3] - (z_lead_w[:, 0] - z_lead_w[:, 1])) > 0
+        ldr_ok_G = jnp.take(
+            m_ok, lead_clip, axis=0, indices_are_sorted=True
+        )
+        ldr_chg_w = jnp.where(
+            ~existing & charged_ldr & ldr_ok_G, g_hits, 0
+        )
+    else:
+        total_charged_w = total_charged
+        any_decr_w = any_decr
+        any_hits_w = any_hits
+        any_z_w = any_z
+        ldr_chg_w = jnp.where(~existing & charged_ldr, g_hits, 0)
 
-    sticky_final = sticky0 | any_z
+    # ---- state writeback at [G]: merged whole-bucket-row scatter ----------
+    # chg_all: every hit actually charged to the group this batch,
+    # INCLUDING a creation leader's (the historical rem_final folded
+    # the leader's charge into R0_create; chains need it explicit so a
+    # rolled-back leader restores the full budget). Without chains the
+    # arithmetic is identical to the pre-r15 R0 - total_charged.
+    chg_all = total_charged_w + ldr_chg_w
+    R0C = R0 + jnp.where(~existing & charged_ldr, g_hits, 0)
+    rem_final = R0C - chg_all
+
+    sticky_final = sticky0 | any_z_w
 
     w_leaky = eff_leaky
     g_expire_new = jnp.where(existing, g_exp, now + g_durQ)
@@ -1007,19 +1288,54 @@ def _decide_presorted(
         w_leaky,
         jnp.where(
             existing,
-            jnp.where(any_decr, now + g_durS, g_exp),
+            jnp.where(any_decr_w, now + g_durS, g_exp),
             now + g_durQ,
         ),
         g_expire_new,
     )
-    new_ts = jnp.where(existing & w_leaky & ~any_hits, g_ts, now)
+    # sliding (r15): the rotated subwindow pair persists — expire pins
+    # the current window start (ws + 2d), L_REMAINING the current
+    # count, L_TS the previous count (store.rebase skips it there)
+    d_eff64 = jnp.where(
+        existing,
+        d_sld,
+        jnp.clip(g_durQ.astype(jnp.int64), 1, _SLD_DMAX),
+    )
+    ws_eff64 = jnp.where(existing, sld_ws, now64)
+    sld_exp_new = jnp.clip(
+        ws_eff64 + 2 * d_eff64, _I32_MIN, _I32_MAX
+    ).astype(jnp.int32)
+    new_expire = jnp.where(eff_sld, sld_exp_new, new_expire)
+    # GCRA (r15): the stored entry IS one theoretical arrival time —
+    # TAT' = max(TAT, now) + charged * T, int64 math clamped into the
+    # int32 expiry lane; TAT < now on a later batch lazy-expires the
+    # entry, which is exactly "fully drained == fresh"
+    gcra_tat_new = jnp.clip(
+        gcra_tat0 + chg_all.astype(jnp.int64) * gcra_T,
+        _I32_MIN,
+        _I32_MAX,
+    ).astype(jnp.int32)
+    new_expire = jnp.where(eff_gcra, gcra_tat_new, new_expire)
+
+    new_rem = jnp.where(
+        eff_sld,
+        jnp.where(existing, sld_cur0, 0) + chg_all,
+        rem_final,
+    )
+    new_ts = jnp.where(existing & w_leaky & ~any_hits_w, g_ts, now)
+    new_ts = jnp.where(
+        eff_sld, jnp.where(existing, sld_prev0, 0), new_ts
+    )
     new_limit = jnp.where(existing, g_limS, g_limQ)
     new_duration = jnp.where(existing, g_durS, g_durQ)
-    new_flags = jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0).astype(jnp.int32) | (
-        jnp.where(~w_leaky & sticky_final, FLAG_STICKY_OVER, 0).astype(
-            jnp.int32
+    new_flags = (
+        jnp.where(w_leaky, FLAG_ALGO_LEAKY, 0)
+        | jnp.where(eff_sld, FLAG_ALGO_SLIDING, 0)
+        | jnp.where(eff_gcra, FLAG_ALGO_GCRA, 0)
+        | jnp.where(
+            (eff_algo == 0) & sticky_final, FLAG_STICKY_OVER, 0
         )
-    )
+    ).astype(jnp.int32)
 
     # Groups served entirely from a replica write back identical values
     # (harmless); invalid (padding / non-owned), zero-guard, and
@@ -1029,7 +1345,7 @@ def _decide_presorted(
         [
             fp,
             new_expire,
-            rem_final,
+            new_rem,
             new_ts,
             new_limit,
             new_duration,

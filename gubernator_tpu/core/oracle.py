@@ -44,6 +44,12 @@ from gubernator_tpu.api.types import (
     RateLimitResp,
     Status,
 )
+from gubernator_tpu.core.algorithms import (
+    gcra_params,
+    sliding_dur,
+    sliding_rotate,
+    sliding_used,
+)
 from gubernator_tpu.core.cache import LRUCache, millisecond_now
 
 
@@ -53,6 +59,33 @@ class _LeakyState:
     duration: int
     remaining: int
     timestamp: int
+
+
+@dataclass
+class _SlidingState:
+    """Sliding-window counter state (r15, core/algorithms.py): per-key
+    anchored subwindows — `ws` is the current subwindow's start, `cur`
+    its consumed count, `prev` the previous subwindow's. Cache expiry
+    is ws + 2*duration (the entry stays useful through the following
+    window as its "previous")."""
+
+    limit: int
+    duration: int
+    ws: int
+    cur: int
+    prev: int
+
+
+@dataclass
+class _GcraState:
+    """GCRA state (r15): one theoretical arrival time. Cache expiry IS
+    the TAT — a fully-drained bucket (tat < now) lazily expires, which
+    is indistinguishable from fresh state by construction (the same
+    contract the device kernel gets from the store's expiry lane)."""
+
+    limit: int
+    duration: int
+    tat: int
 
 
 def token_bucket(
@@ -189,14 +222,146 @@ def leaky_bucket(
     return rl
 
 
+def sliding_window(
+    cache: LRUCache, r: RateLimitReq, now: Optional[int] = None
+) -> RateLimitResp:
+    """Sliding-window counter (r15): previous-window weighted blend
+    over per-key anchored subwindows. Host twin of the kernel's
+    FLAG_ALGO_SLIDING branch — byte-identical through the engine's
+    epoch conversion (tests/test_algorithms.py); the shared integer
+    conventions live in core/algorithms.py."""
+    if now is None:
+        now = millisecond_now()
+
+    key = r.hash_key()
+    item, ok = cache.get(key, now)
+    if ok:
+        if not isinstance(item, _SlidingState):
+            # Algorithm switched: a sliding request recreates as a
+            # fresh SLIDING window (core/algorithms.py mismatch rule —
+            # the token/leaky pair keeps the reference's token-recreate
+            # behavior; the new algorithms recreate as themselves).
+            cache.remove(key)
+            return sliding_window(cache, r, now)
+
+        s = item
+        d = sliding_dur(s.duration)  # capped period (int32 envelope)
+        expire = s.ws + 2 * d
+        s.ws, s.cur, s.prev = sliding_rotate(
+            expire, s.duration, now, s.cur, s.prev
+        )
+        used = sliding_used(s.ws, s.duration, now, s.cur, s.prev)
+        budget = max(min(s.limit - used, max(s.limit, 0)), 0)
+
+        rl = RateLimitResp(
+            status=Status.UNDER_LIMIT,
+            limit=s.limit,
+            remaining=budget,
+            reset_time=s.ws + d,  # current subwindow's end
+        )
+        if 0 < r.hits <= budget:
+            s.cur += r.hits
+            rl.remaining = budget - r.hits
+        elif r.hits != 0 or budget == 0:
+            rl.status = Status.OVER_LIMIT
+        # rotation may have advanced the window: persist the new
+        # expiry (the kernel re-writes the expire lane every touch)
+        cache.update_expiration(key, s.ws + 2 * d)
+        return rl
+
+    s = _SlidingState(
+        limit=r.limit, duration=r.duration, ws=now, cur=0, prev=0
+    )
+    rl = RateLimitResp(
+        status=Status.UNDER_LIMIT,
+        limit=r.limit,
+        remaining=r.limit - r.hits,
+        reset_time=now + r.duration,
+    )
+    if r.hits > r.limit:
+        # refused creation stores an untouched fresh window (no
+        # sticky-over: sliding status is recomputed every call)
+        rl.status = Status.OVER_LIMIT
+        rl.remaining = r.limit
+    elif r.hits > 0:
+        s.cur = r.hits
+    cache.add(key, s, now + 2 * sliding_dur(r.duration))
+    return rl
+
+
+def gcra(
+    cache: LRUCache, r: RateLimitReq, now: Optional[int] = None
+) -> RateLimitResp:
+    """GCRA (r15): one theoretical arrival time per key, emission
+    interval T = duration/limit, burst tolerance tau = T*limit. Host
+    twin of the kernel's FLAG_ALGO_GCRA branch (byte-identical;
+    conventions in core/algorithms.py). Every touch advances the
+    stored TAT to at least `now` (draining is a re-expression of time
+    passage, not a mutation of consumed quota)."""
+    if now is None:
+        now = millisecond_now()
+
+    key = r.hash_key()
+    item, ok = cache.get(key, now)
+    if ok:
+        if not isinstance(item, _GcraState):
+            # mismatch rule: GCRA recreates as itself
+            cache.remove(key)
+            return gcra(cache, r, now)
+
+        s = item
+        T, tau = gcra_params(s.limit, s.duration)
+        tat0 = max(s.tat, now)
+        budget = max(min((now + tau - tat0) // T, max(s.limit, 0)), 0)
+
+        rl = RateLimitResp(
+            status=Status.UNDER_LIMIT, limit=s.limit, remaining=budget
+        )
+        if 0 < r.hits <= budget:
+            s.tat = tat0 + r.hits * T
+            rl.remaining = budget - r.hits
+            rl.reset_time = s.tat
+        elif r.hits == 0:
+            s.tat = tat0
+            if budget == 0:
+                rl.status = Status.OVER_LIMIT
+            rl.reset_time = tat0
+        else:
+            # refused: no charge; report the earliest instant this
+            # same request could succeed
+            s.tat = tat0
+            rl.status = Status.OVER_LIMIT
+            rl.reset_time = tat0 + r.hits * T - tau
+        cache.update_expiration(key, s.tat)
+        return rl
+
+    T, _tau = gcra_params(r.limit, r.duration)
+    over_c = r.hits > r.limit
+    charged = not over_c and r.hits > 0
+    tat = now + (r.hits * T if charged else 0)
+    rl = RateLimitResp(
+        status=Status.OVER_LIMIT if over_c else Status.UNDER_LIMIT,
+        limit=r.limit,
+        remaining=r.limit if over_c else r.limit - r.hits,
+        reset_time=tat,
+    )
+    cache.add(key, _GcraState(limit=r.limit, duration=r.duration, tat=tat), tat)
+    return rl
+
+
 def get_rate_limit(
     cache: LRUCache, r: RateLimitReq, now: Optional[int] = None
 ) -> RateLimitResp:
-    """Dispatch on algorithm (reference gubernator.go:244-250)."""
+    """Dispatch on algorithm (reference gubernator.go:244-250; the r15
+    suite extends the family — core/algorithms.py)."""
     if r.algorithm == Algorithm.TOKEN_BUCKET:
         return token_bucket(cache, r, now)
     if r.algorithm == Algorithm.LEAKY_BUCKET:
         return leaky_bucket(cache, r, now)
+    if r.algorithm == Algorithm.SLIDING_WINDOW:
+        return sliding_window(cache, r, now)
+    if r.algorithm == Algorithm.GCRA:
+        return gcra(cache, r, now)
     raise ValueError(f"invalid rate limit algorithm '{r.algorithm}'")
 
 

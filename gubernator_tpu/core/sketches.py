@@ -67,6 +67,27 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gubernator_tpu.core.algorithms import (
+    ALGO_LEAKY,
+    ALGO_TOKEN,
+    SKETCH_SERVABLE_ALGOS,
+)
+
+# r15 interplay audit: the sketch serves dropped creates with
+# FIXED-WINDOW token math over a window-keyed estimate. That math is a
+# documented tail-only approximation for token AND leaky (r13), but it
+# would UNDER-count a sliding window at boundaries (the previous
+# window's weight is invisible to a window-keyed counter) and a GCRA
+# TAT has no window at all — both would break the tier's one-sided
+# fail-closed contract. The kernel's serve gate (core/kernels.py
+# sk_able = eff_algo <= 1) hardcodes the same pair; this pin fails the
+# import, not production, if the registry and the kernel drift.
+assert SKETCH_SERVABLE_ALGOS == {ALGO_TOKEN, ALGO_LEAKY}, (
+    "the sketch tier's fixed-window math only covers token/leaky; "
+    "update core/kernels.py sk_able and this pin together with "
+    "core/algorithms.py SKETCH_SERVABLE_ALGOS"
+)
+
 _ALPHA_INF = 0.721347520444482  # 1 / (2 ln 2)
 
 # -- the device sketch tier (r13) -------------------------------------------

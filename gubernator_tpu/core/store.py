@@ -90,6 +90,12 @@ LANES = 8
 # flags lane bits
 FLAG_STICKY_OVER = 1  # token window created over-limit: status persists OVER
 FLAG_ALGO_LEAKY = 2  # slot holds leaky-bucket state (else token bucket)
+# r15 algorithm suite v2 (core/algorithms.py): one flag bit per stored
+# algorithm, mutually exclusive with FLAG_ALGO_LEAKY. Token bucket stays
+# the all-zero encoding so every pre-r15 entry decodes unchanged.
+FLAG_ALGO_SLIDING = 4  # sliding-window counter (per-key anchored windows)
+FLAG_ALGO_GCRA = 8  # GCRA: L_EXPIRE holds the theoretical arrival time
+FLAG_ALGO_MASK = FLAG_ALGO_LEAKY | FLAG_ALGO_SLIDING | FLAG_ALGO_GCRA
 
 # Engine-time envelope. `now` stays in [0, REBASE_AT]; stored times stay in
 # [TIME_FLOOR, INT32_MAX]; durations are clamped to MAX_DURATION_MS so
@@ -318,9 +324,31 @@ def rebase(store: Store, delta: jax.Array) -> Store:
     """Shift all stored times by -delta (the host moved the epoch forward
     by `delta` ms). One elementwise pass over the store; runs every ~12
     days of engine uptime (see EpochClock), so the int64 widening here is
-    free in practice."""
+    free in practice.
+
+    Flag-aware since r15: the L_TS lane is a TIME for token (creation
+    time), leaky (last-leak timestamp) and GCRA (last-touch time)
+    entries, but a COUNT for sliding-window entries (the previous
+    subwindow's consumed total, core/algorithms.py) — shifting it there
+    would corrupt the blend. Each entry's own L_FLAGS lane decides; the
+    per-entry broadcast is one extra elementwise select in a pass that
+    runs twice a month."""
     lane = jnp.arange(store.data.shape[-1]) % LANES
-    is_time = (lane == L_EXPIRE) | (lane == L_TS)
+    is_expire = lane == L_EXPIRE
+    is_ts = lane == L_TS
+    # broadcast each entry's flags across its 8 lanes so the L_TS
+    # decision can read them elementwise (entries are LANES-aligned;
+    # shape-generic over any leading axes — sharded stores carry one)
+    lead = store.data.shape[:-1]
+    W = store.data.shape[-1]
+    flags = store.data.reshape(*lead, W // LANES, LANES)[
+        ..., L_FLAGS : L_FLAGS + 1
+    ]
+    flags = jnp.broadcast_to(flags, (*lead, W // LANES, LANES)).reshape(
+        *lead, W
+    )
+    ts_is_count = (flags & FLAG_ALGO_SLIDING) != 0
+    is_time = is_expire | (is_ts & ~ts_is_count)
     shifted = jnp.clip(
         store.data.astype(jnp.int64) - jnp.where(is_time, delta, 0),
         TIME_FLOOR,

@@ -610,6 +610,124 @@ def build_presorted_sharded(
     return req, take_idx, groups, B_sub
 
 
+def _local_decide_chain(store: Store, req: BatchRequest, groups, chain_id,
+                        now):
+    """Per-device chain decide under shard_map (r15): the host routed
+    every CHAIN whole to its head-key owner shard (pad_request_chained),
+    so the chain AND-reduce runs entirely shard-local — the decide path
+    keeps its no-collective property even with coupled rows."""
+    from gubernator_tpu.core.kernels import decide_presorted_chain
+
+    store = jax.tree.map(lambda x: x[0], store)
+    req = jax.tree.map(lambda x: x[0], req)
+    groups = jax.tree.map(lambda x: x[0], groups)
+    chain_id = chain_id[0]
+    new_store, resp, stats = decide_presorted_chain(
+        store, req, now, chain_id, groups
+    )
+    packed = pack_outputs(resp, stats)
+    return jax.tree.map(lambda x: x[None], new_store), packed[None]
+
+
+def pad_request_chained(
+    buckets: Sequence[int],
+    store_buckets: int,
+    n_shards: int,
+    key_hash: np.ndarray,
+    hits: np.ndarray,
+    limit: np.ndarray,
+    duration: np.ndarray,
+    algo: np.ndarray,
+    chain_ids: np.ndarray,
+    route_hash: np.ndarray,
+):
+    """Presort + pad one CHAINED batch (r15): rows whose `chain_ids`
+    match are one hierarchical request's levels and must decide in the
+    same kernel invocation (the no-partial-debit AND-reduce is
+    shard-local). Ownership therefore follows `route_hash` — the chain
+    HEAD's key hash, identical for every row of a chain — while bucket
+    addressing keeps each row's OWN key hash, so a chain's levels land
+    whole on one shard yet store state in their own buckets. numpy-only
+    (the native prep has no chain column; chain batches ride a
+    dedicated lane, serve/batcher.py).
+
+    Returns (req, order, take_idx, groups, chain_local) where
+    chain_local carries kernel-ready per-shard-local chain slots
+    (int32, values < the sub-batch rung; padding rows are singleton
+    chains). take_idx is None on the flat (n_shards == 1) layout.
+
+    Consolidation contract: a level key shared by chains with
+    DIFFERENT heads lands on each head's owner shard separately, so
+    its quota would be tracked per shard. Well-formed hierarchies
+    (every child under one parent) never do this; the serving tier
+    routes by chain head for the same reason (serve/instance.py).
+    """
+    from gubernator_tpu.core.engine import (
+        _gather_clip_sorted,
+        build_presorted_request,
+    )
+    from gubernator_tpu.core.store import group_sort_key_np
+
+    kh = np.ascontiguousarray(key_hash, np.uint64)
+    n = kh.shape[0]
+    skey = group_sort_key_np(kh, store_buckets)
+    if n_shards > 1:
+        owner = owner_of_np(
+            np.ascontiguousarray(route_hash, np.uint64), n_shards
+        )
+        bucket_bits = max(int(store_buckets).bit_length() - 1, 1)
+        comp = (
+            owner.astype(np.uint64) << np.uint64(32 + bucket_bits)
+        ) | skey
+    else:
+        owner = np.zeros(n, np.int32)
+        comp = skey
+    order = np.argsort(comp, kind="stable").astype(np.int32)
+    s = comp[order]
+    sorted_fields = _gather_clip_sorted(
+        dict(
+            key_hash=kh, hits=hits, limit=limit, duration=duration,
+            algo=algo, gnp=np.zeros(n, bool),
+        ),
+        order,
+        n,
+    )
+    chain_sorted = np.asarray(chain_ids, np.int64)[order]
+    # pad/group/take_idx machinery is the merge-combine twins' —
+    # delegated so the owner bit-packing, ladder-overflow, and
+    # clamp-pad invariants cannot drift between the chain and plain
+    # sharded paths; only the chain-slot localization is chain-specific
+
+    if n_shards == 1:
+        req, groups, B = build_presorted_request(
+            buckets, sorted_fields, s, n
+        )
+        chain_local = np.arange(B, dtype=np.int32)
+        if n:
+            _, inv = np.unique(chain_sorted, return_inverse=True)
+            chain_local[:n] = inv  # values < n <= B
+        return req, order, None, groups, chain_local
+
+    counts = np.bincount(owner, minlength=n_shards).astype(np.int64)
+    req, take_idx, groups, B_sub = build_presorted_sharded(
+        buckets, store_buckets, n_shards, sorted_fields, s, counts
+    )
+    starts = np.zeros(n_shards + 1, np.int64)
+    np.cumsum(counts, out=starts[1:])
+    chain_local = np.broadcast_to(
+        np.arange(B_sub, dtype=np.int32), (n_shards, B_sub)
+    ).copy()
+    for sh in range(n_shards):
+        c = int(counts[sh])
+        if c:
+            _, inv = np.unique(
+                chain_sorted[starts[sh] : starts[sh] + c],
+                return_inverse=True,
+            )
+            chain_local[sh, :c] = inv  # values < c <= B_sub
+    return req, order, take_idx, groups, chain_local
+
+
 def _shard_sync_globals(
     store: Store,
     key_hash: jax.Array,  # uint64[B] global keys to broadcast
@@ -799,6 +917,23 @@ class PartitionedEngine:
             ),
             donate_argnums=(0,),
         )
+        # quota-chain program (r15): chain-coupled rows, shard-local
+        # AND-reduce (chains are routed whole to their head's owner).
+        # jit is lazy, so deployments that never see a chain pay only
+        # this wrapper construction. Multi-process meshes don't carry
+        # it: the lockstep step pipe has no chain message (documented
+        # scope limit; decide_chain_submit refuses loudly).
+        self._step_chain = None
+        if not span:
+            self._step_chain = jax.jit(
+                shard_map_compat(
+                    _local_decide_chain,
+                    mesh=self.mesh,
+                    in_specs=(Ps, Ps, Ps, Ps, P0),
+                    out_specs=(Ps, Ps),
+                ),
+                donate_argnums=(0,),
+            )
         self._step_sketch = None
         if self.sketch_config is not None:
             self._step_sketch = jax.jit(
@@ -1036,6 +1171,88 @@ class PartitionedEngine:
             order = order.copy()
             take_idx = take_idx.copy()
         return (packed, order, take_idx, n, B_sub, self.clock.epoch)
+
+    def decide_chain_submit(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        algo: np.ndarray,
+        chain_ids: np.ndarray,
+        route_hash: np.ndarray,
+        now: int,
+    ):
+        """Dispatch one CHAINED batch (r15) without waiting: rows
+        sharing a `chain_ids` value are one hierarchical request's
+        levels, decided atomically under the no-partial-debit contract
+        (kernels.decide_presorted_chain); `route_hash` (the chain
+        head's key hash per row) picks the owning shard so chains stay
+        whole. Handle format is decide_wait's. Chain batches run
+        exact-only (no sketch tier) and take the numpy prep path — a
+        dedicated lane, not the native-prep pipeline."""
+        if self.policy.spans_processes:
+            raise ValueError(
+                "quota chains are not supported on the multihost "
+                "lockstep engine (no chain step message); route chains "
+                "to single-host backends"
+            )
+        n = key_hash.shape[0]
+        e_now = self._engine_now(now)
+        req, order, take_idx, groups, chain_local = pad_request_chained(
+            self.buckets if self.flat else self.sub_buckets,
+            self.config.slots,
+            self.n,
+            key_hash,
+            hits,
+            limit,
+            duration,
+            algo,
+            chain_ids,
+            route_hash,
+        )
+        hook = self.observe_hook
+        if hook is not None:
+            try:
+                hook(req)
+            except Exception:  # pragma: no cover - defensive
+                pass
+        if self.flat:
+            from gubernator_tpu.core.engine import _decide_packed_chain_jit
+
+            B = req.key_hash.shape[0]
+            self.store, packed = _decide_packed_chain_jit(
+                self.store, req, e_now, groups, chain_local
+            )
+            order_p = np.empty(B, np.int32)
+            order_p[:n] = order
+            order_p[n:] = np.arange(n, B, dtype=np.int32)
+            return (packed, order_p, None, n, B, self.clock.epoch)
+        B_sub = req.key_hash.shape[1]
+        self.store, packed = self._step_chain(
+            self.store, req, groups, chain_local, e_now
+        )
+        return (packed, order, take_idx, n, B_sub, self.clock.epoch)
+
+    def decide_chain_arrays(
+        self,
+        key_hash: np.ndarray,
+        hits: np.ndarray,
+        limit: np.ndarray,
+        duration: np.ndarray,
+        algo: np.ndarray,
+        chain_ids: np.ndarray,
+        route_hash: np.ndarray,
+        now: int,
+    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+        """Array-level chained decide: submit + wait (times int64
+        unix-ms in/out, like decide_arrays)."""
+        return self.decide_wait(
+            self.decide_chain_submit(
+                key_hash, hits, limit, duration, algo, chain_ids,
+                route_hash, now,
+            )
+        )
 
     def prep_run(self, fields: dict) -> dict:
         """Arrival-time per-group prep (serve/batcher.py): one sorted,

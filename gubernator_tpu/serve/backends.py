@@ -26,18 +26,53 @@ instead serializes on a cache mutex, gubernator.go:237).
 from __future__ import annotations
 
 from dataclasses import replace
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from gubernator_tpu.api.types import (
+    Algorithm,
     RateLimitReq,
     RateLimitResp,
     Status,
+    hash_key,
     resps_from_columns,
 )
 from gubernator_tpu.core.cache import LRUCache
 from gubernator_tpu.core.engine import TpuEngine
 from gubernator_tpu.core.oracle import get_rate_limit
 from gubernator_tpu.core.store import StoreConfig
+
+
+def chain_level_keys(r: RateLimitReq):
+    """(cache_key, limit, duration) per level of a chained request,
+    shallow -> deep, the request's own key last. A level duration of 0
+    inherits the request's. Shared by every backend's chain expansion
+    so level addressing can never drift between the exact and device
+    tiers."""
+    rows = [
+        (hash_key(r.name, lv.unique_key), lv.limit,
+         lv.duration or r.duration)
+        for lv in r.chain
+    ]
+    rows.append((r.hash_key(), r.limit, r.duration))
+    return rows
+
+
+def collapse_chain_responses(resps):
+    """Most-restrictive-wins collapse (r15): the FIRST (shallowest)
+    OVER_LIMIT level's response answers the whole chained request —
+    a global refusal dominates a tenant's dominates the leaf's — else
+    the leaf's response (every level admitted and was debited).
+    `metadata["chain_level"]` names the refusing level's index when it
+    is not the leaf."""
+    pick = len(resps) - 1
+    for j, resp in enumerate(resps):
+        if resp.status == Status.OVER_LIMIT or resp.error:
+            pick = j
+            break
+    out = resps[pick]
+    if pick != len(resps) - 1:
+        out.metadata["chain_level"] = str(pick)
+    return out
 
 
 class ExactBackend:
@@ -124,6 +159,112 @@ class ExactBackend:
         never wholesale-resets, so cached verdicts only die by their
         own expiry/purge rules."""
         return 0
+
+    def decide_chain(
+        self, reqs: Sequence[RateLimitReq], now=None
+    ) -> List[RateLimitResp]:
+        """Hierarchical quota chains on the host backend (r15):
+        two-phase per request — peek every level, debit every level
+        only if all admit, else answer from the first refusing level
+        (most-restrictive-wins; no level consumed anything). Matches
+        the device kernel's no-partial-debit CONTRACT; byte-level
+        parity is pinned only for the device path (the kernel is
+        authoritative — this is the small-deployment convenience)."""
+        from dataclasses import replace as _replace
+
+        out = []
+        for r in reqs:
+            rows = chain_level_keys(r)
+            # ancestor levels always decide as TOKEN buckets; only the
+            # LEAF uses the request's algorithm. A shared ancestor
+            # (one hierarchy, many tenants) would otherwise flip its
+            # stored algorithm with each caller's choice and the
+            # mismatch rule would recreate it every flip — erasing the
+            # parent quota (review finding). Same convention as the
+            # device path (decide_chain below / _ArrayOps).
+            levels = [
+                _replace(
+                    r, unique_key="", name="", chain=[], hits=r.hits,
+                    limit=lim, duration=dur,
+                    algorithm=(
+                        r.algorithm
+                        if j == len(rows) - 1
+                        else Algorithm.TOKEN_BUCKET
+                    ),
+                )
+                for j, (_k, lim, dur) in enumerate(rows)
+            ]
+            # peek pass, made NON-mutating by snapshot/restore: a
+            # plain reference peek is not side-effect free — a leaky
+            # peek PERSISTS its elapsed leak credit without advancing
+            # the timestamp (the reference's quirk, kept faithful in
+            # the oracle) — so an advisory peek followed by the real
+            # debit would credit the same elapsed leak TWICE (review
+            # finding: chained leaky leaves refilled at ~2x the
+            # configured rate). Restoring pristine state makes the
+            # debit pass byte-equal to a single sequential pass, and
+            # a refused chain leaves no trace at all.
+            # `planned` accumulates the charges earlier levels of THIS
+            # chain would make per cache key, so a chain naming the
+            # same key twice (ancestor == leaf, duplicated ancestors)
+            # is judged against the post-charge budget — without it
+            # the debit pass would charge the first occurrence and
+            # refuse the second, a partial debit (the device kernel
+            # gets this from cumulative same-group charging + chain
+            # rollback; this keeps the host twin on the contract)
+            refuse = None
+            planned: Dict[str, int] = {}
+            saved = {
+                key: self.cache.snapshot(key) for key, _l, _d in rows
+            }
+            for j, ((key, lim, dur), lv) in enumerate(zip(rows, levels)):
+                peek = self._decide_key(key, _replace(lv, hits=0), now)
+                already = planned.get(key, 0)
+                if (
+                    peek.status == Status.OVER_LIMIT
+                    or r.hits + already > peek.remaining
+                    or r.hits > lim
+                ):
+                    refuse = (j, peek, already)
+                    break
+                if r.hits > 0:
+                    planned[key] = already + r.hits
+            for key, snap in saved.items():
+                if snap is None:
+                    self.cache.remove(key)
+                else:
+                    self.cache.add(key, snap[0], snap[1])
+            if refuse is not None:
+                j, peek, already = refuse
+                resp = RateLimitResp(
+                    status=Status.OVER_LIMIT,
+                    limit=peek.limit,
+                    remaining=max(peek.remaining - already, 0),
+                    reset_time=peek.reset_time,
+                )
+                if j != len(rows) - 1:
+                    resp.metadata["chain_level"] = str(j)
+                out.append(resp)
+                continue
+            resps = [
+                self._decide_key(key, lv, now)
+                for (key, _lim, _dur), lv in zip(rows, levels)
+            ]
+            out.append(collapse_chain_responses(resps))
+        return out
+
+    def _decide_key(self, key: str, r: RateLimitReq, now=None):
+        """get_rate_limit against an explicit cache key (chain levels
+        address level keys directly; the oracle hashes name/unique_key,
+        so wrap with a pre-keyed request)."""
+        from dataclasses import replace as _replace
+
+        # oracle keys on name + "_" + unique_key; split the precomputed
+        # key back so hash_key() reproduces it exactly
+        name, _, uk = key.partition("_")
+        return get_rate_limit(
+            self.cache, _replace(r, name=name, unique_key=uk), now
+        )
 
 
 class _ArrayOps:
@@ -255,6 +396,73 @@ class _ArrayOps:
         thread contract like snapshot_read)."""
         return self.engine.sketch_estimates(key_hash, durations, now)
 
+    # -- hierarchical quota chains (r15) -------------------------------------
+
+    def decide_chain(
+        self, reqs: Sequence[RateLimitReq], now=None
+    ) -> List[RateLimitResp]:
+        """Chained decide on the device engine: expand every request
+        into per-level rows (shallow -> deep, leaf last; the request's
+        hits charge EVERY level), run ONE chain-coupled kernel pass
+        (engine.decide_chain_arrays — all levels debit atomically
+        under the no-partial-debit contract), and collapse each
+        request's level responses most-restrictive-wins. MUST run on
+        the batcher's single submit thread (DeviceBatcher routes the
+        chain lane there): this submits AND waits against the donated
+        store."""
+        import numpy as np
+
+        from gubernator_tpu.api.types import millisecond_now
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        if not reqs:
+            return []
+        if now is None:
+            now = millisecond_now()
+        keys, lims, durs, spans, routes = [], [], [], [], []
+        hits_l, algo_l, cids = [], [], []
+        for i, r in enumerate(reqs):
+            rows = chain_level_keys(r)
+            route = r.routing_key()
+            for j, (key, lim, dur) in enumerate(rows):
+                keys.append(key)
+                lims.append(lim)
+                durs.append(dur)
+                routes.append(route)
+                hits_l.append(r.hits)
+                # ancestors are TOKEN counters; only the leaf carries
+                # the request's algorithm (see ExactBackend.decide_chain
+                # — a shared ancestor must not mismatch-recreate under
+                # callers with different leaf algorithms)
+                algo_l.append(
+                    int(r.algorithm) if j == len(rows) - 1 else 0
+                )
+                cids.append(i)
+            spans.append(len(rows))
+        m = len(keys)
+        status, limit, remaining, reset = self.engine.decide_chain_arrays(
+            slot_hash_batch(keys),
+            np.asarray(hits_l, np.int64),
+            np.asarray(lims, np.int64),
+            np.asarray(durs, np.int64),
+            np.asarray(algo_l, np.int32),
+            np.asarray(cids, np.int64),
+            slot_hash_batch(routes),
+            now,
+        )
+        out = []
+        k = 0
+        for r, span in zip(reqs, spans):
+            resps = resps_from_columns(
+                status[k : k + span],
+                limit[k : k + span],
+                remaining[k : k + span],
+                reset[k : k + span],
+            )
+            k += span
+            out.append(collapse_chain_responses(resps))
+        return out
+
 
 class TpuBackend(_ArrayOps):
     """Single-chip slot-store backend."""
@@ -345,6 +553,12 @@ class MeshBackend(_ArrayOps):
             # Instance refuses GUBER_REPLICATION=1 on such backends at
             # boot instead of failing at the first flush
             self.snapshot_read = None
+        if not hasattr(engine, "decide_chain_arrays"):
+            # quota chains (r15) need the engine's chain-coupled
+            # kernel pass; the multihost lockstep wrapper has no chain
+            # step message (documented scope limit) — the batcher's
+            # chain lane then fails chained callers with a clear error
+            self.decide_chain = None
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now

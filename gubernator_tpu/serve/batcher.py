@@ -83,6 +83,12 @@ def _item_weight(item) -> int:
     requests/updates, not queue entries."""
     if item[0] == "decide_arrays":
         return max(1, item[1]["key_hash"].shape[0])
+    if item[0] == "chain":
+        # a chained request expands to one device row per level
+        return max(
+            1,
+            sum(1 + len(getattr(r, "chain", ()) or ()) for r in item[1]),
+        )
     return max(1, len(item[1]))
 
 
@@ -345,6 +351,30 @@ class DeviceBatcher:
         )
         return await fut
 
+    async def decide_chain(self, reqs: Sequence[RateLimitReq]):
+        """Hierarchical quota chains (r15): a dedicated, coalescing
+        lane — chained caller groups in one flush window merge into ONE
+        backend.decide_chain call, which expands levels and runs the
+        chain-coupled kernel pass. The call runs on the single submit
+        thread (it submits AND waits against the donated store), so a
+        chain batch serializes with — never races — the pipelined
+        plain-batch submits; plain traffic keeps its full pipeline."""
+        if not reqs:
+            return []
+        if self._closed:
+            raise RuntimeError("DeviceBatcher is stopped")
+        if getattr(self.backend, "decide_chain", None) is None:
+            raise RuntimeError(
+                "backend does not support quota chains (r15): the "
+                "multihost lockstep engine has no chain step message"
+            )
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._queue.put_nowait(
+            ("chain", list(reqs), time.monotonic(), fut)
+        )
+        return await fut
+
     async def run_serialized(self, fn, *args):
         """Run `fn(*args)` on the single submit thread, serialized with
         every device dispatch. Bucket replication's snapshot reads
@@ -428,6 +458,7 @@ class DeviceBatcher:
             b for b in batch if b[0] in ("decide", "decide_arrays")
         ]
         global_items = [b for b in batch if b[0] == "globals"]
+        chain_items = [b for b in batch if b[0] == "chain"]
         # batch_queue stage: enqueue -> collect, per frame-flagged
         # caller group (enqueue stamp None = unattributed traffic)
         t_collect = time.monotonic()
@@ -467,6 +498,48 @@ class DeviceBatcher:
                         fut.set_result(None)
             # a cancel mid-call propagates to _run's handler, which fails
             # this and every remaining item in the batch
+
+        if chain_items:
+            # coalesced chain lane (r15): ONE backend call per flush
+            # window; responses slice back per caller. Runs on the
+            # single submit thread (never the shared to_thread pool):
+            # decide_chain submits AND waits against the donated store,
+            # and only the one-wide submit pool serializes that with
+            # the pipelined plain submits. Inline (host) backends run
+            # on the loop like their plain decide.
+            all_chain = [
+                r for _, reqs, _t_enq, _fut in chain_items for r in reqs
+            ]
+            t0c = time.monotonic()
+            try:
+                if inline:
+                    resps = self.backend.decide_chain(all_chain)
+                else:
+                    loop = asyncio.get_running_loop()
+                    resps = await loop.run_in_executor(
+                        self._submit_pool,
+                        self.backend.decide_chain,
+                        all_chain,
+                    )
+            except Exception as e:
+                for _, _reqs, _t_enq, fut in chain_items:
+                    if not fut.done():
+                        fut.set_exception(e)
+            else:
+                k = 0
+                for _, reqs_c, _t_enq, fut in chain_items:
+                    span = resps[k : k + len(reqs_c)]
+                    k += len(reqs_c)
+                    if not fut.done():
+                        fut.set_result(span)
+                try:
+                    metrics.DEVICE_BATCH_SIZE.observe(len(resps))
+                    metrics.DEVICE_LAUNCH_MS.observe(
+                        (time.monotonic() - t0c) * 1e3
+                    )
+                    self._observe_cache_stats()
+                except Exception:  # pragma: no cover - defensive
+                    pass
 
         if not decide_items:
             return

@@ -295,6 +295,20 @@ class ServerConfig:
     sketch_sync_wait: float = 0.2  # GUBER_SKETCH_SYNC_WAIT_MS
     # Top-K candidates screened per tick (SpaceSaving tracks 4x this).
     sketch_topk: int = 512
+    # Hierarchical quota chains (r15, core/algorithms.py +
+    # serve/instance.py; GUBER_CHAINS, default ON): a request may name
+    # ancestor quota levels (global -> tenant -> key); the whole chain
+    # routes to the chain HEAD's owner and debits every level in ONE
+    # device pass with most-restrictive-wins semantics and the
+    # no-partial-debit contract (a refused level consumes quota
+    # nowhere). GUBER_CHAINS=0 refuses chained requests with a per-item
+    # error (operational kill switch).
+    chains: bool = True
+    # Maximum ANCESTOR levels per request (the leaf is free): bounds
+    # the per-request device-row expansion factor a hostile caller can
+    # demand. Depth-3 (global -> region -> tenant above the leaf)
+    # covers the multi-tenant front-door shape the bench pins.
+    chain_max_depth: int = 3
     # Bucket replication (r11, serve/replication.py; GUBER_REPLICATION=1
     # to enable, OFF by default): owned bucket windows are snapshot-read
     # (non-mutating) every replication_sync_wait and shipped to each
@@ -515,6 +529,8 @@ class ServerConfig:
             )
         if self.shed_cache_keys < 0:
             raise ValueError("GUBER_SHED_CACHE_KEYS must be >= 0")
+        if self.chain_max_depth < 0:
+            raise ValueError("GUBER_CHAIN_MAX_DEPTH must be >= 0")
         if self.sketch_mib < 0:
             raise ValueError("GUBER_SKETCH_MIB must be >= 0")
         if not (1 <= self.sketch_rows <= 8):
@@ -698,6 +714,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         shed_cache=_get(env, "GUBER_SHED_CACHE", "1").lower()
         not in ("0", "false", "no", "off"),
         shed_cache_keys=_get_int(env, "GUBER_SHED_CACHE_KEYS", 1 << 16),
+        chains=_get(env, "GUBER_CHAINS", "1").lower()
+        not in ("0", "false", "no", "off"),
+        chain_max_depth=_get_int(env, "GUBER_CHAIN_MAX_DEPTH", 3),
         sketch=_get(env, "GUBER_SKETCH", "1").lower()
         not in ("0", "false", "no", "off"),
         sketch_mib=_get_int(env, "GUBER_SKETCH_MIB", 0),

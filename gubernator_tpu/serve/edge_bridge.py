@@ -75,6 +75,13 @@ Frame protocol (little-endian, lengths in bytes):
   windowed string response (r7):
                    u32 magic 'GEB4' | u32 n | u32 frame_id |
                    items as GEB3
+  windowed chain request (r15):
+                   u32 magic 'GEBC' | u32 n | u32 frame_id |
+                   u64 t_sent_us | u32 payload_len | payload
+      item: as GEB1 item | u8 n_levels | n_levels x level
+      level: u16 key_len | key | i64 limit | i64 duration
+      (hierarchical quota chains; answered with a plain GEB4 frame —
+      the chain collapses most-restrictive-wins server-side)
   windowed fast request (r7):
                    u32 magic 'GEB7' | u32 n | u32 frame_id |
                    u32 ring_hash | u64 t_sent_us | u32 payload_len |
@@ -150,6 +157,13 @@ MAGIC_WREQ = 0x32424547  # 'GEB2' — windowed string request (r7)
 MAGIC_WRESP = 0x34424547  # 'GEB4' — windowed string response (r7)
 MAGIC_WFAST_REQ = 0x37424547  # 'GEB7' — windowed pre-hashed request (r7)
 MAGIC_WFAST_RESP = 0x38424547  # 'GEB8' — windowed pre-hashed response
+MAGIC_WCHAIN = 0x43424547  # 'GEBC' — windowed chain-extended string
+# request (r15): header as GEB2; items as GEB1 plus a u8 level count
+# and that many (u16 key_len | key | i64 limit | i64 duration) chain
+# levels. Responses come back as plain GEB4 (the chain collapses
+# most-restrictive-wins server-side). String framing only: the 33-byte
+# fast records have no varlen room — a chained item is never
+# fast-eligible (documented scope limit, client_geb._fast_eligible).
 
 HELLO_FAST = 1  # hello flags bit 0
 HELLO_WINDOWED = 2  # hello flags bit 1; window size = flags >> 16
@@ -161,6 +175,11 @@ HELLO_WINDOWED = 2  # hello flags bit 1; window size = flags >> 16
 # splitting buckets. Pre-r12 edges ignore unknown bits (the compiled
 # edge always hashes XXH64 and ships with the native build).
 HELLO_XXH64 = 4
+# hello flags bit 3 (r15): this bridge accepts GEBC chain-extended
+# string frames (hierarchical quota chains). The compiled edge's JSON
+# door does not speak chains — chained callers use the GEB client or
+# the daemon's HTTP/gRPC doors (documented scope limit).
+HELLO_CHAIN = 8
 
 DEFAULT_WINDOW = 32
 MAX_WINDOW = 1024
@@ -327,7 +346,7 @@ def decode_request_frame(
                 hits=hits,
                 limit=limit,
                 duration=duration,
-                algorithm=Algorithm(algo) if algo in (0, 1)
+                algorithm=Algorithm(algo) if 0 <= algo <= 3
                 else Algorithm.TOKEN_BUCKET,
                 behavior=Behavior(behavior) if behavior in (0, 1, 2)
                 else Behavior.BATCHING,
@@ -335,6 +354,74 @@ def decode_request_frame(
         )
     if off != len(payload):
         raise ValueError("trailing bytes in request frame")
+    return items
+
+
+def decode_chain_request_frame(
+    payload: bytes, n: int
+) -> List[Optional[RateLimitReq]]:
+    """Decode one GEBC chain-extended string frame (r15): each item is
+    a GEB1 item plus a u8 level count and that many
+    (u16 key_len | key | i64 limit | i64 duration) ancestor levels,
+    shallow to deep. Non-UTF-8 item bytes decode to None exactly like
+    decode_request_frame; chain depth/behavior validation happens
+    serving-side (instance.chain_error), per item."""
+    from gubernator_tpu.api.types import ChainLevel
+
+    items: List[Optional[RateLimitReq]] = []
+    off = 0
+    for _ in range(n):
+        (name_len,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        raw_name = payload[off : off + name_len]
+        off += name_len
+        (key_len,) = struct.unpack_from("<H", payload, off)
+        off += 2
+        raw_key = payload[off : off + key_len]
+        off += key_len
+        hits, limit, duration, algo, behavior = _ITEM_FIX.unpack_from(
+            payload, off
+        )
+        off += _ITEM_FIX.size
+        (n_levels,) = struct.unpack_from("<B", payload, off)
+        off += 1
+        raw_levels = []
+        for _lv in range(n_levels):
+            (lk_len,) = struct.unpack_from("<H", payload, off)
+            off += 2
+            raw_lk = payload[off : off + lk_len]
+            off += lk_len
+            lv_limit, lv_duration = struct.unpack_from("<qq", payload, off)
+            off += 16
+            raw_levels.append((raw_lk, lv_limit, lv_duration))
+        try:
+            name = raw_name.decode()
+            key = raw_key.decode()
+            chain = [
+                ChainLevel(
+                    unique_key=raw_lk.decode(), limit=li, duration=d
+                )
+                for raw_lk, li, d in raw_levels
+            ]
+        except UnicodeDecodeError:
+            items.append(None)
+            continue
+        items.append(
+            RateLimitReq(
+                name=name,
+                unique_key=key,
+                hits=hits,
+                limit=limit,
+                duration=duration,
+                algorithm=Algorithm(algo) if 0 <= algo <= 3
+                else Algorithm.TOKEN_BUCKET,
+                behavior=Behavior(behavior) if behavior in (0, 1, 2)
+                else Behavior.BATCHING,
+                chain=chain,
+            )
+        )
+    if off != len(payload):
+        raise ValueError("trailing bytes in chain request frame")
     return items
 
 
@@ -579,6 +666,12 @@ class FrameService:
                 peers = []
         bridge_port = self._bridge_advert_port()
         flags = HELLO_WINDOWED | (self.window << 16)
+        if getattr(getattr(self.instance, "conf", None), "chains", True):
+            # advertise GEBC only when chains are actually served —
+            # with the GUBER_CHAINS=0 kill switch on, the client's
+            # capability check fails fast instead of shipping frames
+            # that would only be refused per-item
+            flags |= HELLO_CHAIN
         if self._fast_ok():
             flags |= HELLO_FAST
             from gubernator_tpu.core.hashing import using_native_hash
@@ -742,12 +835,15 @@ class FrameService:
         STAGES.add("encode", time.monotonic() - t_enc)
         return raw
 
-    async def _decide_string(self, payload: bytes, n: int):
+    async def _decide_string(
+        self, payload: bytes, n: int, decoder=decode_request_frame
+    ):
         """Decode one string-item payload and serve it through the full
         instance (validation, routing, forwarding). Returns the
-        response list, one per item, in order."""
+        response list, one per item, in order. `decoder` swaps in the
+        GEBC chain-item decoder for chain-extended frames (r15)."""
         t_dec = time.monotonic()
-        decoded = decode_request_frame(payload, n)
+        decoded = decoder(payload, n)
         STAGES.add("bridge_decode", time.monotonic() - t_dec)
         good = [r for r in decoded if r is not None]
         # the edge caps frames at its batch limit, but two large
@@ -855,7 +951,7 @@ class FrameService:
             duration=duration,
             # unknown algorithm bytes clamp to the default, matching
             # decode_request_frame and the JSON gateway
-            algo=np.where(algo <= 1, algo, 0).astype(np.int32),
+            algo=np.where(algo <= 3, algo, 0).astype(np.int32),
         )
         return full, fields
 
@@ -986,6 +1082,16 @@ class FrameService:
                     + struct.pack("<I", frame_id)
                     + raw
                 )
+            elif magic == MAGIC_WCHAIN:
+                # chain-extended string frame (r15): always the object
+                # path — chains need the instance's routing/validation
+                # and are never foldable (coupled multi-key decides)
+                resps = await self._decide_string(
+                    payload, n, decoder=decode_chain_request_frame
+                )
+                frame = encode_response_frame(
+                    resps, magic=MAGIC_WRESP, frame_id=frame_id
+                )
             else:
                 frame = await self._decide_string_frame(
                     payload, n, magic=MAGIC_WRESP, frame_id=frame_id
@@ -1042,7 +1148,7 @@ class FrameService:
                 hdr = await reader.readexactly(_HDR.size)
                 t_frame0 = time.monotonic()
                 magic, n = _HDR.unpack(hdr)
-                if magic in (MAGIC_WFAST_REQ, MAGIC_WREQ):
+                if magic in (MAGIC_WFAST_REQ, MAGIC_WREQ, MAGIC_WCHAIN):
                     if magic == MAGIC_WFAST_REQ:
                         frame_id, frame_ring, t_sent = _WFAST_HDR.unpack(
                             await reader.readexactly(_WFAST_HDR.size)
@@ -1189,10 +1295,11 @@ class FrameService:
         """Serve ONE complete request frame carried as a byte string
         and return the complete encoded response frame — the body-per-
         request shape of the HTTP gateway's protobuf-free POST /v1/geb
-        door (serve/server.py). All four request framings are accepted
-        (GEB1/GEB6 legacy, GEB2/GEB7 windowed — the windowed frame ids
-        are echoed but carry no pipelining here: HTTP gives each frame
-        its own request/response exchange). Malformed input raises
+        door (serve/server.py). All request framings are accepted
+        (GEB1/GEB6 legacy, GEB2/GEB7 windowed, GEBC chain-extended —
+        the windowed frame ids are echoed but carry no pipelining
+        here: HTTP gives each frame its own request/response
+        exchange). Malformed input raises
         ValueError (the gateway answers 400); a stale-ring fast frame
         or a draining node returns a GEBR frame, exactly as on the
         socket doors. Runs the same shed screen, stage clock, and
@@ -1211,9 +1318,9 @@ class FrameService:
                 data, off
             )
             off += _WFAST_HDR.size
-        elif magic == MAGIC_WREQ:
+        elif magic in (MAGIC_WREQ, MAGIC_WCHAIN):
             if len(data) < off + _WREQ_HDR.size + 4:
-                raise ValueError("short GEB2 header")
+                raise ValueError("short GEB2/GEBC header")
             frame_id, _t_sent = _WREQ_HDR.unpack_from(data, off)
             off += _WREQ_HDR.size
         elif magic == MAGIC_FAST_REQ:
@@ -1266,6 +1373,14 @@ class FrameService:
                     )
                 else:
                     frame = _HDR.pack(MAGIC_FAST_RESP, n) + raw
+            elif magic == MAGIC_WCHAIN:
+                # chain-extended items (r15): object path only
+                resps = await self._decide_string(
+                    payload, n, decoder=decode_chain_request_frame
+                )
+                frame = encode_response_frame(
+                    resps, magic=MAGIC_WRESP, frame_id=frame_id
+                )
             elif magic == MAGIC_WREQ:
                 frame = await self._decide_string_frame(
                     payload, n, magic=MAGIC_WRESP, frame_id=frame_id

@@ -56,6 +56,27 @@ class BatchTooLargeError(ValueError):
     pass
 
 
+def chain_error(r: RateLimitReq, conf: ServerConfig) -> str:
+    """Validation for hierarchical quota chains (r15). Returns '' when
+    the chain is acceptable, else the per-item error string."""
+    if not getattr(conf, "chains", True):
+        return "quota chains are disabled (GUBER_CHAINS=0)"
+    max_depth = getattr(conf, "chain_max_depth", 3)
+    if len(r.chain) > max_depth:
+        return (
+            f"chain has {len(r.chain)} ancestor levels; "
+            f"GUBER_CHAIN_MAX_DEPTH allows {max_depth}"
+        )
+    if r.behavior == Behavior.GLOBAL:
+        # GLOBAL's replica/broadcast machinery is per-key; a chain must
+        # debit all levels atomically on one owner — incompatible
+        return "behavior GLOBAL is incompatible with a quota chain"
+    for lv in r.chain:
+        if not lv.unique_key:
+            return "chain level 'unique_key' cannot be empty"
+    return ""
+
+
 class Instance:
     def __init__(self, conf: ServerConfig, backend):
         self.conf = conf
@@ -177,6 +198,11 @@ class Instance:
                     error="field 'namespace' cannot be empty"
                 )
                 continue
+            if r.chain:
+                err = chain_error(r, self.conf)
+                if err:
+                    out[i] = RateLimitResp(error=err)
+                    continue
             valid.append((i, r, r.hash_key()))
 
         hashes = (
@@ -191,11 +217,16 @@ class Instance:
         seeds: List[Tuple[int, str, object]] = []
         fps = {}
 
+        chain_local: List[Tuple[int, RateLimitReq]] = []
         for j, (i, r, key) in enumerate(valid):
             h = int(hashes[j])
             fps[i] = h
             try:
-                peer = self.get_peer(key)
+                # chained requests route by the chain HEAD's key so one
+                # owner debits the whole chain atomically (r15)
+                peer = self.get_peer(
+                    r.routing_key() if r.chain else key
+                )
             except Exception as e:
                 out[i] = RateLimitResp(
                     error=(
@@ -203,6 +234,17 @@ class Instance:
                         f"'{key}' - '{e}'"
                     )
                 )
+                continue
+            if r.chain:
+                # shed cache bypassed for chains (r15 audit): a cached
+                # LEAF verdict cannot speak for parent levels, and a
+                # collapsed chain response must never populate a
+                # leaf-fingerprint entry (observe calls below are
+                # likewise chain-gated)
+                if peer.is_owner:
+                    chain_local.append((i, r))
+                else:
+                    forwards.append((i, r, peer))
                 continue
             # over-limit shed screen (serve/shedcache.py): a cached
             # frozen refusal answers here — no batcher, no forward RPC.
@@ -256,7 +298,7 @@ class Instance:
             try:
                 resp = await peer.get_peer_rate_limit(r)
                 resp.metadata["owner"] = peer.host
-                if shed is not None:
+                if shed is not None and not r.chain:
                     shed.observe_resps([fps[i]], [r], [resp])
             except Exception as e:
                 taken = await self._takeover_fallback([(i, r)], peer, e)
@@ -289,11 +331,18 @@ class Instance:
                     resp.metadata["owner"] = peer.host
                     out[i] = resp
                 if shed is not None:
-                    shed.observe_resps(
-                        [fps[i] for i, _ in items],
-                        [r for _, r in items],
-                        resps,
-                    )
+                    plain = [
+                        (i, r, resp)
+                        for (i, r), resp in zip(items, resps)
+                        if not r.chain  # collapsed chain responses
+                        # must never seed leaf-fingerprint entries
+                    ]
+                    if plain:
+                        shed.observe_resps(
+                            [fps[i] for i, _, _ in plain],
+                            [r for _, r, _ in plain],
+                            [resp for _, _, resp in plain],
+                        )
             except Exception as e:
                 taken = await self._takeover_fallback(items, peer, e)
                 if taken is not None:
@@ -332,6 +381,29 @@ class Instance:
             asyncio.ensure_future(forward_group(p, items))
             for p, items in grouped.items()
         ]
+
+        if chain_local:
+            # owned chains ride the batcher's dedicated chain lane,
+            # overlapped with the plain local batch below
+            async def chain_decide(items):
+                try:
+                    resps = await self.batcher.decide_chain(
+                        [r for _, r in items]
+                    )
+                    for (i, _), resp in zip(items, resps):
+                        out[i] = resp
+                except Exception as e:
+                    for i, r in items:
+                        out[i] = RateLimitResp(
+                            error=(
+                                f"while applying chained rate limit "
+                                f"for '{r.hash_key()}' - '{e}'"
+                            )
+                        )
+
+            tasks.append(
+                asyncio.ensure_future(chain_decide(chain_local))
+            )
 
         seeded_idx: List[int] = []
         if seeds:
@@ -432,6 +504,19 @@ class Instance:
         out: List[Optional[RateLimitResp]] = [None] * len(items)
         by_succ: dict = {}
         for j, (_, r) in enumerate(items):
+            if r.chain:
+                # chains are outside the replication scope (documented
+                # r15 limit): no standby snapshot holds level state,
+                # and deciding only the leaf here would silently skip
+                # every ancestor quota — refuse honestly instead
+                out[j] = RateLimitResp(
+                    error=(
+                        f"owner '{peer.host}' unreachable and chained "
+                        f"requests are outside the takeover scope "
+                        f"(chain levels are not replicated) - '{exc}'"
+                    )
+                )
+                continue
             try:
                 succ = self.picker.get_successor(r.hash_key())
             except Exception:
@@ -470,9 +555,32 @@ class Instance:
         if not getattr(self.conf, "degraded_local", False):
             return None
         try:
-            resps = await self.decide_local(
-                [r for _, r in items], [False] * len(items)
-            )
+            # chained items keep FULL chain semantics against the
+            # local store (every level consulted, no-partial-debit)
+            # via the chain lane — degrading a chain to a leaf-only
+            # decide would silently skip its ancestor quotas (r15)
+            chained = [j for j, (_, r) in enumerate(items) if r.chain]
+            if chained:
+                resps = [None] * len(items)
+                cresps = await self.batcher.decide_chain(
+                    [items[j][1] for j in chained]
+                )
+                for j, resp in zip(chained, cresps):
+                    resps[j] = resp
+                plain = [
+                    j for j, (_, r) in enumerate(items) if not r.chain
+                ]
+                if plain:
+                    presps = await self.decide_local(
+                        [items[j][1] for j in plain],
+                        [False] * len(plain),
+                    )
+                    for j, resp in zip(plain, presps):
+                        resps[j] = resp
+            else:
+                resps = await self.decide_local(
+                    [r for _, r in items], [False] * len(items)
+                )
         except Exception:
             return None
         for resp in resps:
@@ -518,6 +626,55 @@ class Instance:
                 await FAULTS.inject("peer_serve")
             if self.repl is not None:
                 await self._peer_serve_replication(reqs)
+            chained_idx = [i for i, r in enumerate(reqs) if r.chain]
+            if chained_idx:
+                # forwarded chains decide on THIS node's chain lane
+                # (the forwarder routed them here by the chain head);
+                # shed screen and population are chain-bypassed.
+                # Validation runs with the RECEIVING node's config —
+                # the forwarder validated too, but the kill switch,
+                # the depth bound (the device-row expansion cap a
+                # hostile peer could otherwise demand: the proto
+                # repeated field has no wire-level limit), and the
+                # GLOBAL check must hold at every door
+                out_c: List[Optional[RateLimitResp]] = [None] * len(reqs)
+                ok_idx = []
+                for i in chained_idx:
+                    err = chain_error(reqs[i], self.conf)
+                    if err:
+                        out_c[i] = RateLimitResp(error=err)
+                    else:
+                        ok_idx.append(i)
+                if ok_idx:
+                    cresps = await self.batcher.decide_chain(
+                        [reqs[i] for i in ok_idx]
+                    )
+                    for i, resp in zip(ok_idx, cresps):
+                        out_c[i] = resp
+                plain = [
+                    (i, r) for i, r in enumerate(reqs) if not r.chain
+                ]
+                if plain:
+                    presps = await self._peer_serve_plain(
+                        [r for _, r in plain]
+                    )
+                    for (i, _), resp in zip(plain, presps):
+                        out_c[i] = resp
+                return [
+                    o if o is not None else RateLimitResp()
+                    for o in out_c
+                ]
+            return await self._peer_serve_plain(reqs)
+        except Exception as e:
+            return [RateLimitResp(error=str(e)) for _ in reqs]
+
+    async def _peer_serve_plain(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> List[RateLimitResp]:
+        """The owner-side decide for PLAIN (non-chained) forwarded
+        batches: shed screen + device decide (the pre-r15
+        get_peer_rate_limits interior)."""
+        try:
             shed = self.shed
             if shed is None:
                 return await self.decide_local(reqs, [False] * len(reqs))
@@ -566,6 +723,11 @@ class Instance:
         repl = self.repl
         seeds = []
         for r in reqs:
+            if r.chain:
+                # chain levels are outside the replication scope (r15
+                # documented limit, like leaky): level keys are owned
+                # by the chain head's ring position, not their own
+                continue
             key = r.hash_key()
             try:
                 own = self.get_peer(key).is_owner

@@ -654,6 +654,16 @@ class Server:
                         gubernator_pb2.Behavior, item.get("behavior", 0)
                     ),
                 )
+                # hierarchical quota chain (r15): ancestor levels,
+                # shallow to deep; depth/behavior validation happens
+                # serving-side (instance.chain_error)
+                for lv in item.get("chain", []) or []:
+                    pb.chain.add(
+                        unique_key=str(lv.get("uniqueKey",
+                                              lv.get("unique_key", ""))),
+                        limit=int(lv.get("limit", 0)),
+                        duration=int(lv.get("duration", 0)),
+                    )
                 reqs.append(convert.req_from_pb(pb))
         except (AttributeError, TypeError, ValueError) as e:
             # non-object items, non-numeric int64 fields, bad enum names

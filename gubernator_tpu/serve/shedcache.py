@@ -75,6 +75,23 @@ from gubernator_tpu.api.types import (
     millisecond_now,
     over_limit_resp,
 )
+from gubernator_tpu.core.algorithms import ALGO_TOKEN, SHEDDABLE_ALGOS
+
+# r15 interplay audit: every consult and populate path below is gated
+# on Algorithm.TOKEN_BUCKET because the frozen-verdict fixed point this
+# cache serves exists ONLY there — a leaky reset_time refills
+# continuously, a sliding blend's weight decays every millisecond, and
+# a GCRA TAT drains every millisecond, so none of their OVER verdicts
+# is provably current after the response that produced it. This pin
+# keeps the registry (core/algorithms.py SHEDDABLE_ALGOS) and this
+# module from drifting apart: marking a new algorithm sheddable there
+# without teaching lookup/screen_fields/_observe_one its fixed point
+# fails at import, not silently in production.
+assert SHEDDABLE_ALGOS == {ALGO_TOKEN}, (
+    "shed cache only understands the token bucket's frozen verdicts; "
+    "extend serve/shedcache.py before marking another algorithm "
+    "sheddable in core/algorithms.py"
+)
 
 #: default LRU bound (GUBER_SHED_CACHE_KEYS): sized to the hot head a
 #: Zipf workload can keep over limit at once, not the whole key space

@@ -1,0 +1,205 @@
+"""Regenerate gubernator_tpu/api/proto/gen/gubernator_pb2.py WITHOUT protoc.
+
+The sibling of scripts/gen_peers_pb2.py (see its docstring for why:
+protoc/grpc_tools are not in this image). Rebuilds the serialized
+FileDescriptorProto for gubernator.proto with the descriptor API and
+emits the standard generated-file shape. The message/field set below
+must be kept in lockstep with gubernator.proto — the proto file stays
+the wire-contract source of truth, this script is its protoc stand-in.
+
+r15 additions over the historical protoc output: Algorithm gains
+SLIDING_WINDOW=2 / GCRA=3, and RateLimitReq gains the hierarchical
+quota-chain field (`repeated ChainLevel chain = 8`). Field/enum
+numbering is append-only, so reference clients remain wire-compatible.
+
+Usage: python scripts/gen_gubernator_pb2.py   # rewrites gen/gubernator_pb2.py
+"""
+
+from __future__ import annotations
+
+import pathlib
+
+from google.protobuf import descriptor_pb2 as dpb
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+OUT = ROOT / "gubernator_tpu" / "api" / "proto" / "gen" / "gubernator_pb2.py"
+
+T = dpb.FieldDescriptorProto
+
+REPEATED = ("requests", "responses", "chain")
+
+
+def _msg(name, fields):
+    m = dpb.DescriptorProto(name=name)
+    for fname, num, ftype, type_name in fields:
+        f = m.field.add(
+            name=fname,
+            number=num,
+            type=ftype,
+            label=(
+                T.LABEL_REPEATED
+                if fname in REPEATED
+                else T.LABEL_OPTIONAL
+            ),
+        )
+        if type_name:
+            f.type_name = type_name
+    return m
+
+
+def build_file() -> dpb.FileDescriptorProto:
+    f = dpb.FileDescriptorProto(
+        name="gubernator.proto",
+        package="pb.gubernator",
+        syntax="proto3",
+    )
+    f.options.cc_generic_services = True
+
+    f.message_type.append(_msg("GetRateLimitsReq", [
+        ("requests", 1, T.TYPE_MESSAGE, ".pb.gubernator.RateLimitReq"),
+    ]))
+    f.message_type.append(_msg("GetRateLimitsResp", [
+        ("responses", 1, T.TYPE_MESSAGE, ".pb.gubernator.RateLimitResp"),
+    ]))
+    f.message_type.append(_msg("ChainLevel", [
+        ("unique_key", 1, T.TYPE_STRING, None),
+        ("limit", 2, T.TYPE_INT64, None),
+        ("duration", 3, T.TYPE_INT64, None),
+    ]))
+    f.message_type.append(_msg("RateLimitReq", [
+        ("name", 1, T.TYPE_STRING, None),
+        ("unique_key", 2, T.TYPE_STRING, None),
+        ("hits", 3, T.TYPE_INT64, None),
+        ("limit", 4, T.TYPE_INT64, None),
+        ("duration", 5, T.TYPE_INT64, None),
+        ("algorithm", 6, T.TYPE_ENUM, ".pb.gubernator.Algorithm"),
+        ("behavior", 7, T.TYPE_ENUM, ".pb.gubernator.Behavior"),
+        ("chain", 8, T.TYPE_MESSAGE, ".pb.gubernator.ChainLevel"),
+    ]))
+    resp = _msg("RateLimitResp", [
+        ("status", 1, T.TYPE_ENUM, ".pb.gubernator.Status"),
+        ("limit", 2, T.TYPE_INT64, None),
+        ("remaining", 3, T.TYPE_INT64, None),
+        ("reset_time", 4, T.TYPE_INT64, None),
+        ("error", 5, T.TYPE_STRING, None),
+    ])
+    meta = resp.nested_type.add(name="MetadataEntry")
+    meta.field.add(name="key", number=1, type=T.TYPE_STRING,
+                   label=T.LABEL_OPTIONAL)
+    meta.field.add(name="value", number=2, type=T.TYPE_STRING,
+                   label=T.LABEL_OPTIONAL)
+    meta.options.map_entry = True
+    mf = resp.field.add(
+        name="metadata", number=6, type=T.TYPE_MESSAGE,
+        label=T.LABEL_REPEATED,
+    )
+    mf.type_name = ".pb.gubernator.RateLimitResp.MetadataEntry"
+    f.message_type.append(resp)
+    f.message_type.append(_msg("HealthCheckReq", []))
+    hc = _msg("HealthCheckResp", [
+        ("status", 1, T.TYPE_STRING, None),
+        ("message", 2, T.TYPE_STRING, None),
+    ])
+    hc.field.add(name="peer_count", number=3, type=T.TYPE_INT32,
+                 label=T.LABEL_OPTIONAL)
+    f.message_type.append(hc)
+
+    algo = f.enum_type.add(name="Algorithm")
+    for vname, num in [
+        ("TOKEN_BUCKET", 0), ("LEAKY_BUCKET", 1),
+        ("SLIDING_WINDOW", 2), ("GCRA", 3),
+    ]:
+        algo.value.add(name=vname, number=num)
+    beh = f.enum_type.add(name="Behavior")
+    for vname, num in [("BATCHING", 0), ("NO_BATCHING", 1), ("GLOBAL", 2)]:
+        beh.value.add(name=vname, number=num)
+    st = f.enum_type.add(name="Status")
+    for vname, num in [("UNDER_LIMIT", 0), ("OVER_LIMIT", 1)]:
+        st.value.add(name=vname, number=num)
+
+    svc = f.service.add(name="V1")
+    for meth, req_t, resp_t in [
+        ("GetRateLimits", "GetRateLimitsReq", "GetRateLimitsResp"),
+        ("HealthCheck", "HealthCheckReq", "HealthCheckResp"),
+    ]:
+        svc.method.add(
+            name=meth,
+            input_type=f".pb.gubernator.{req_t}",
+            output_type=f".pb.gubernator.{resp_t}",
+        )
+    return f
+
+
+def main() -> int:
+    f = build_file()
+    blob = f.SerializeToString()
+
+    # _serialized_start/end offsets located by serialized-subsequence
+    # search (see gen_peers_pb2.py)
+    offsets = []
+    for m in f.message_type:
+        sub = m.SerializeToString()
+        start = blob.find(sub)
+        assert start >= 0, f"descriptor bytes for {m.name} not found"
+        offsets.append((f"_{m.name.upper()}", start, start + len(sub)))
+        for nested in m.nested_type:
+            nsub = nested.SerializeToString()
+            nstart = blob.find(nsub)
+            assert nstart >= 0
+            offsets.append((
+                f"_{m.name.upper()}_{nested.name.upper()}",
+                nstart, nstart + len(nsub),
+            ))
+    for e in f.enum_type:
+        sub = e.SerializeToString()
+        start = blob.find(sub)
+        assert start >= 0
+        offsets.append((f"_{e.name.upper()}", start, start + len(sub)))
+    svc = f.service[0]
+    sub = svc.SerializeToString()
+    start = blob.find(sub)
+    assert start >= 0
+    offsets.append((f"_{svc.name.upper()}", start, start + len(sub)))
+
+    lines = [
+        "# -*- coding: utf-8 -*-",
+        "# Generated by scripts/gen_gubernator_pb2.py (protoc stand-in;",
+        "# see that script's docstring).  DO NOT EDIT!",
+        "# source: gubernator.proto",
+        '"""Generated protocol buffer code."""',
+        "from google.protobuf.internal import builder as _builder",
+        "from google.protobuf import descriptor as _descriptor",
+        "from google.protobuf import descriptor_pool as _descriptor_pool",
+        "from google.protobuf import symbol_database as _symbol_database",
+        "# @@protoc_insertion_point(imports)",
+        "",
+        "_sym_db = _symbol_database.Default()",
+        "",
+        "",
+        "",
+        "",
+        "DESCRIPTOR = _descriptor_pool.Default().AddSerializedFile("
+        + repr(blob)
+        + ")",
+        "",
+        "_builder.BuildMessageAndEnumDescriptors(DESCRIPTOR, globals())",
+        "_builder.BuildTopDescriptorsAndMessages(DESCRIPTOR,"
+        " 'gubernator_pb2', globals())",
+        "if _descriptor._USE_C_DESCRIPTORS == False:",
+        "",
+        "  DESCRIPTOR._options = None",
+        "  DESCRIPTOR._serialized_options = b'\\200\\001\\001'",
+        "  _RATELIMITRESP_METADATAENTRY._options = None",
+        "  _RATELIMITRESP_METADATAENTRY._serialized_options = b'8\\001'",
+    ]
+    for name, s, e in offsets:
+        lines.append(f"  {name}._serialized_start={s}")
+        lines.append(f"  {name}._serialized_end={e}")
+    lines.append("# @@protoc_insertion_point(module_scope)")
+    OUT.write_text("\n".join(lines) + "\n")
+    print(f"wrote {OUT} ({len(blob)} descriptor bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -569,6 +569,66 @@ def table_shard() -> str:
     return "\n".join(lines)
 
 
+def table_algorithms() -> str:
+    """Algorithm suite v2 (r15): the registry table straight from
+    core/algorithms.py (ids, per-entry state layout, serving-tier
+    eligibility — the same rows the import-time gate pins in
+    serve/shedcache.py and core/sketches.py), plus the committed
+    fairness headline from BENCH_ALGO_r15.json."""
+    import sys
+
+    sys.path.insert(0, str(ROOT))
+    from gubernator_tpu.core.algorithms import ALGORITHMS
+
+    lines = [
+        "| algorithm | wire id | per-key state (8-lane bucket row) "
+        "| shed cache | sketch tier |",
+        "|---|---|---|---|---|",
+    ]
+    for a in sorted(ALGORITHMS):
+        s = ALGORITHMS[a]
+        lines.append(
+            f"| {s.name} | {a} | {s.state} "
+            f"| {'yes' if s.sheddable else 'no'} "
+            f"| {'yes' if s.sketch_servable else 'no'} |"
+        )
+    doc = json.loads((ROOT / "BENCH_ALGO_r15.json").read_text())
+    by_scenario = {d["scenario"]: d for d in doc["scenarios"]}
+    fair = {
+        r["algorithm"]: r
+        for r in by_scenario["gcra_vs_token"]["rows"]
+    }
+    tok, gc = fair["token"], fair["gcra"]
+    crowd = [
+        r
+        for d in doc["scenarios"]
+        if d["scenario"] == "flash_crowd"
+        for r in d["rows"]
+    ]
+    crowd_s = ", ".join(
+        f"{r['algorithm']} {r['decisions_per_sec']:,.0f} dec/s"
+        for r in crowd
+    )
+    chain = by_scenario["mixed_tenant_zipf"]["rows"][0]
+    lines += [
+        "",
+        f"(Fairness A/B, one hot key under ~{tok['requests']}-request "
+        f"demand, committed in `BENCH_ALGO_r15.json`: the token "
+        f"window admits in bursts — inter-admission gap CV "
+        f"**{tok['admission_gap_cv']}**, max refusal run "
+        f"**{tok['max_refusal_run']}** — where GCRA's emission "
+        f"interval spaces the same average rate at CV "
+        f"**{gc['admission_gap_cv']}**, max run "
+        f"**{gc['max_refusal_run']}**. Flash-crowd scenario: "
+        f"{crowd_s}. Depth-{chain['chain_depth']} quota chains: "
+        f"{chain['chains_per_sec']:,.0f} chains/s "
+        f"({chain['device_rows_per_sec']:,.0f} device rows/s) through "
+        f"the batcher's chain lane; `make perf-gate` (chain_r15) "
+        f"guards the expansion price.)",
+    ]
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -583,6 +643,7 @@ TABLES = {
     "frontdoor-table": table_frontdoor,
     "sketch-table": table_sketch,
     "shard-table": table_shard,
+    "algorithms-table": table_algorithms,
 }
 
 

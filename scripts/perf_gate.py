@@ -77,6 +77,7 @@ GATED = (
     "stages_r7",
     "sketch_r13",
     "shard_r14",
+    "chain_r15",
     "frontdoor_geb_over_grpc",
     "frontdoor_http_over_grpc",
 )
@@ -134,6 +135,7 @@ def _loadgen(
     batch: int,
     window: int = 0,
     keyspace: int = 0,
+    chain_depth: int = 0,
 ) -> dict:
     """One out-of-process load window via the real CLI generator."""
     args = [
@@ -141,7 +143,8 @@ def _loadgen(
         "--protocol", protocol, "--duration", str(seconds),
         "--share", str(share), "--concurrency", str(concurrency),
         "--batch", str(batch), "--window", str(window),
-        "--keyspace", str(keyspace), "--json",
+        "--keyspace", str(keyspace),
+        "--chain-depth", str(chain_depth), "--json",
     ]
     out = subprocess.run(
         args,
@@ -475,6 +478,34 @@ def main() -> int:
         )
         measured["shard_r14"], detail["shard_r14"] = m, rows
 
+        # -- chain_r15: plain vs depth-3 quota chains, zipf shape ----
+        # Same GEB workload against the flat stack, A = plain items
+        # (fold/fast path), B = every item carrying a depth-3 chain
+        # (GEBC string frames -> the batcher's dedicated chain lane ->
+        # one chain-coupled kernel pass per flush, 4x the device rows).
+        # The ratio IS the chain expansion price the r15 subsystem
+        # must not let decay; generous level limits keep refusals out
+        # of the measured quantity.
+        print(
+            "workload chain_r15 (plain vs depth-3 chains)...",
+            file=sys.stderr,
+        )
+
+        def chain_drive(depth):
+            def d(seconds):
+                return _loadgen(
+                    "geb", SOCK, seconds, 0.0, args.concurrency,
+                    args.batch, keyspace=30_000, chain_depth=depth,
+                )["decisions_per_sec"]
+
+            return d
+
+        m, rows = paired(
+            "chain_r15", chain_drive(0), chain_drive(3),
+            args.seconds, args.rounds,
+        )
+        measured["chain_r15"], detail["chain_r15"] = m, rows
+
         # -- front-door ladder: grpc vs geb vs http ------------------
         print("front-door ladder (grpc / geb / http)...", file=sys.stderr)
         doors = {
@@ -593,6 +624,13 @@ def main() -> int:
                             "simulated-device mesh, keyspace-30k zipf "
                             "shape (partitioned dispatch price)",
                     "committed": round(measured["shard_r14"], 4),
+                },
+                "chain_r15": {
+                    "artifact": "BENCH_ALGO_r15.json",
+                    "pair": "plain items vs depth-3 quota chains, "
+                            "keyspace-30k zipf shape (chain-lane "
+                            "expansion price)",
+                    "committed": round(measured["chain_r15"], 4),
                 },
                 "frontdoor_geb_over_grpc": {
                     "artifact": "BENCH_FRONTDOOR_r12.json",

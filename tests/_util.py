@@ -1,8 +1,57 @@
 """Shared test helpers."""
 
+import json
 import os
 import pathlib
 import socket
+import time
+import urllib.error
+import urllib.request
+
+
+def post_json(url, body, timeout=30.0, retries=8, backoff=0.25):
+    """POST a JSON body and decode the JSON response, with BOUNDED
+    retry on transient 503s (r15 deflake of the r14 note: the
+    edge-cluster suites could 503-flake under full-suite load on one
+    core while passing in isolation).
+
+    Retrying a 503 is safe by protocol contract: the edge/daemon doors
+    answer 503 only for frames REFUSED un-served (lane down, shard
+    connect failure, conn cap — the HTTP face of the GEBR refusal,
+    whose client contract is explicitly retry-safe), so no hit can be
+    double-charged. Connection-refused/reset during setup is equally
+    un-served and retried. TIMEOUTS ARE NOT RETRIED — an expired
+    in-flight request's delivery is unknown and a retry could double
+    charge; a wedged fixture should fail loudly, not double-count.
+    """
+    data = json.dumps(body).encode()
+    last = None
+    for attempt in range(retries + 1):
+        try:
+            return json.loads(
+                urllib.request.urlopen(
+                    urllib.request.Request(
+                        url,
+                        data=data,
+                        headers={"Content-Type": "application/json"},
+                    ),
+                    timeout=timeout,
+                ).read()
+            )
+        except urllib.error.HTTPError as e:
+            if e.code != 503:
+                raise
+            last = e
+        except urllib.error.URLError as e:
+            if not isinstance(
+                e.reason, (ConnectionRefusedError, ConnectionResetError)
+            ):
+                raise
+            last = e
+        except (ConnectionRefusedError, ConnectionResetError) as e:
+            last = e
+        time.sleep(backoff * (attempt + 1))
+    raise last
 
 
 def edge_binary() -> "pathlib.Path":

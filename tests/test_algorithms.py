@@ -1,0 +1,364 @@
+"""Algorithm suite v2 (r15): sliding-window + GCRA correctness pins.
+
+Three layers, mirroring the r10/r13 rigs:
+
+- directed host-oracle semantics (the blend's decay, GCRA's emission
+  arithmetic, creation corners, the mismatch rule);
+- engine-vs-oracle coverage rides tests/test_fuzz_differential.py
+  (the per-key algorithm draw spans all four ids since r15);
+- the acceptance pin: fuzzed BYTE-IDENTITY between the device serving
+  pipeline (instance -> batcher -> arrival prep -> merged submit ->
+  kernel) and the host-oracle instance (ExactBackend) under the fake
+  clock, with clock jumps crossing subwindow, window, and multi-window
+  boundaries — on BOTH the flat single-chip backend and the simulated
+  8-device mesh policy (conftest pins 8 CPU devices).
+
+Duplicate-key discipline follows test_fuzz_differential.py: one hits
+draw per (key, batch) and at most one peek, so the kernel's cumulative
+rule and the oracle's sequential loop provably coincide.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    PeerInfo,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.core.algorithms import (
+    ALGO_GCRA,
+    ALGO_SLIDING,
+    ALGORITHMS,
+    gcra_budget,
+    gcra_params,
+    sliding_rotate,
+    sliding_used,
+)
+from gubernator_tpu.core.cache import LRUCache
+from gubernator_tpu.core.oracle import gcra, get_rate_limit, sliding_window
+from gubernator_tpu.core.store import StoreConfig
+from gubernator_tpu.serve.backends import (
+    ExactBackend,
+    MeshBackend,
+    TpuBackend,
+)
+from gubernator_tpu.serve.config import ServerConfig
+from gubernator_tpu.serve.instance import Instance
+
+T0 = 1_700_000_000_000
+ADDR = "127.0.0.1:7975"
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self) -> int:
+        return self.t
+
+
+# -- shared integer conventions (core/algorithms.py) ------------------------
+
+
+def test_gcra_params_guards():
+    # emission interval floors at 1ms even when limit >> duration
+    assert gcra_params(1000, 10) == (1, 1000)
+    # limit 0: T = duration (div guard), tau = 0 -> budget always 0
+    T, tau = gcra_params(0, 5000)
+    assert (T, tau) == (5000, 0)
+    assert gcra_budget(0, T0, 0, 5000) == 0
+    # tau saturates at int32 max instead of overflowing the envelope
+    _, tau = gcra_params(1 << 40, 1)
+    assert tau == (1 << 31) - 1
+
+
+def test_sliding_rotate_and_blend():
+    d = 1000
+    ws = 10_000
+    expire = ws + 2 * d
+    # same window: untouched
+    assert sliding_rotate(expire, d, ws + 500, 3, 7) == (ws, 3, 7)
+    # one window later: cur shifts into prev
+    assert sliding_rotate(expire, d, ws + d + 1, 3, 7) == (ws + d, 0, 3)
+    # two+ windows later: both clear
+    assert sliding_rotate(expire, d, ws + 2 * d, 3, 7) == (
+        ws + 2 * d, 0, 0,
+    )
+    # blend weight decays linearly (floor): at 25% into the window 75%
+    # of prev still counts
+    assert sliding_used(ws, d, ws + 250, 2, 8) == 2 + 6
+    assert sliding_used(ws, d, ws + 999, 2, 8) == 2  # 8*1//1000 == 0
+
+
+# -- directed oracle semantics ----------------------------------------------
+
+
+def test_oracle_sliding_window_blend_over_boundary():
+    cache = LRUCache()
+    r = RateLimitReq(name="s", unique_key="k", hits=1, limit=10,
+                     duration=1000, algorithm=Algorithm.SLIDING_WINDOW)
+    # consume 6 in the creation window
+    now = T0
+    for i in range(6):
+        rl = sliding_window(cache, r, now + i)
+        assert rl.status == Status.UNDER_LIMIT
+    # 40% into the NEXT window: used = floor(6 * 0.6) = 3 -> budget 7
+    peek = RateLimitReq(name="s", unique_key="k", hits=0, limit=10,
+                        duration=1000,
+                        algorithm=Algorithm.SLIDING_WINDOW)
+    rl = sliding_window(cache, peek, T0 + 1400)
+    assert rl.remaining == 10 - (6 * 600) // 1000
+    # two windows later the old counts are gone entirely
+    rl = sliding_window(cache, peek, T0 + 3100)
+    assert rl.remaining == 10
+
+
+def test_oracle_sliding_refused_hits_do_not_debit():
+    cache = LRUCache()
+    r = RateLimitReq(name="s", unique_key="nr", hits=4, limit=5,
+                     duration=1000, algorithm=Algorithm.SLIDING_WINDOW)
+    assert sliding_window(cache, r, T0).status == Status.UNDER_LIMIT
+    # 4 consumed, budget 1: a 4-hit request is refused and consumes
+    # nothing (remaining stays 1)
+    rl = sliding_window(cache, r, T0 + 10)
+    assert rl.status == Status.OVER_LIMIT
+    peek = RateLimitReq(name="s", unique_key="nr", hits=0, limit=5,
+                        duration=1000,
+                        algorithm=Algorithm.SLIDING_WINDOW)
+    assert sliding_window(cache, peek, T0 + 20).remaining == 1
+
+
+def test_oracle_gcra_emission_and_burst():
+    cache = LRUCache()
+    # limit 10 per 1000ms -> T=100ms, tau=1000ms: a full burst of 10
+    # admits at once, then one token re-emerges every 100ms
+    r = RateLimitReq(name="g", unique_key="k", hits=10, limit=10,
+                     duration=1000, algorithm=Algorithm.GCRA)
+    rl = gcra(cache, r, T0)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+    assert rl.reset_time == T0 + 10 * 100  # the fresh TAT
+    one = RateLimitReq(name="g", unique_key="k", hits=1, limit=10,
+                       duration=1000, algorithm=Algorithm.GCRA)
+    assert gcra(cache, one, T0 + 50).status == Status.OVER_LIMIT
+    # 100ms after the burst exactly one token has re-emerged
+    rl = gcra(cache, one, T0 + 100)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 0)
+    # a refused request reports the earliest instant it could succeed
+    r3 = RateLimitReq(name="g", unique_key="k", hits=3, limit=10,
+                      duration=1000, algorithm=Algorithm.GCRA)
+    rl = gcra(cache, r3, T0 + 150)
+    assert rl.status == Status.OVER_LIMIT
+    T, tau = gcra_params(10, 1000)
+    # stored TAT after the admits above: T0+1000 (burst) + 100 (one)
+    assert rl.reset_time == (T0 + 1100) + 3 * T - tau
+
+
+def test_oracle_gcra_drained_equals_fresh():
+    cache = LRUCache()
+    one = RateLimitReq(name="g", unique_key="d", hits=1, limit=5,
+                       duration=500, algorithm=Algorithm.GCRA)
+    a = gcra(cache, one, T0)
+    # after > duration idle the bucket has fully drained: the entry
+    # lazily expired (cache expiry IS the TAT) and a fresh decision is
+    # indistinguishable from a first-contact one
+    b = gcra(cache, one, T0 + 10_000)
+    assert (a.status, a.limit, a.remaining) == (
+        b.status, b.limit, b.remaining,
+    )
+    assert b.remaining == 4
+
+
+def test_sliding_long_duration_caps_inside_envelope():
+    """10-day sliding windows (a legal duration, above the 2^29-1
+    sliding cap): the effective period caps IDENTICALLY on device and
+    host (algorithms.sliding_dur vs the kernel clip), so the
+    ws + 2*duration expire anchor stays inside int32 even for windows
+    created late in the engine epoch — pre-fix the clamped anchor
+    silently corrupted the rotation and broke kernel/oracle identity
+    (review finding)."""
+    from gubernator_tpu.core.algorithms import SLIDING_MAX_DURATION_MS
+    from gubernator_tpu.core.engine import TpuEngine
+
+    engine = TpuEngine(StoreConfig(rows=16, slots=1 << 8), buckets=(16,))
+    cache = LRUCache()
+    DAY = 86_400_000
+    pin = RateLimitReq(name="sl", unique_key="pin", hits=1, limit=1,
+                       duration=1000)
+    engine.get_rate_limits([pin], now=T0)
+    get_rate_limit(cache, pin, now=T0)
+    r = RateLimitReq(
+        name="sl", unique_key="long", hits=1, limit=10,
+        duration=10 * DAY,  # effective period caps at ~6.2 days
+        algorithm=Algorithm.SLIDING_WINDOW,
+    )
+    offsets = (
+        6 * DAY,  # creation: ws + 2*10d would be ~2.2e9 uncapped
+        6 * DAY + 1,
+        6 * DAY + SLIDING_MAX_DURATION_MS // 2,
+        6 * DAY + SLIDING_MAX_DURATION_MS + 5,  # capped-window rotate
+        12 * DAY,  # near the top of the epoch envelope
+    )
+    for dt in offsets:
+        g = engine.get_rate_limits([r], now=T0 + dt)[0]
+        w = get_rate_limit(cache, r, now=T0 + dt)
+        assert (
+            g.status, g.limit, g.remaining, g.reset_time
+        ) == (w.status, w.limit, w.remaining, w.reset_time), (dt, g, w)
+
+
+def test_oracle_mismatch_rule():
+    """Token/leaky keep the reference's recreate-as-token behavior;
+    sliding/GCRA recreate as THEMSELVES (core/algorithms.py)."""
+    cache = LRUCache()
+    tok = RateLimitReq(name="m", unique_key="k", hits=1, limit=10,
+                       duration=60_000)
+    for _ in range(3):
+        get_rate_limit(cache, tok, T0)
+    # a sliding request over live token state recreates a sliding
+    # window with full budget
+    sld = RateLimitReq(name="m", unique_key="k", hits=1, limit=10,
+                       duration=60_000,
+                       algorithm=Algorithm.SLIDING_WINDOW)
+    rl = get_rate_limit(cache, sld, T0 + 10)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 9)
+    # and a GCRA request over the sliding state recreates GCRA
+    g = RateLimitReq(name="m", unique_key="k", hits=1, limit=10,
+                     duration=60_000, algorithm=Algorithm.GCRA)
+    rl = get_rate_limit(cache, g, T0 + 20)
+    assert (rl.status, rl.remaining) == (Status.UNDER_LIMIT, 9)
+
+
+# -- serving-pipeline identity fuzz (the acceptance pin) --------------------
+
+
+def _pin_clock(monkeypatch, clock):
+    import gubernator_tpu.api.types as types_mod
+    import gubernator_tpu.core.engine as engine_mod
+    import gubernator_tpu.core.oracle as oracle_mod
+
+    monkeypatch.setattr(types_mod, "millisecond_now", clock)
+    monkeypatch.setattr(engine_mod, "millisecond_now", clock)
+    monkeypatch.setattr(oracle_mod, "millisecond_now", clock)
+
+
+async def _mk_instance(backend) -> Instance:
+    conf = ServerConfig(grpc_address=ADDR, advertise_address=ADDR)
+    inst = Instance(conf, backend)
+    inst.start()
+    await inst.set_peers([PeerInfo(address=ADDR, is_owner=True)])
+    return inst
+
+
+def _algo_stream(rng, keys, steps, algos):
+    """Batches with duplicate keys, peeks, oversized hits, mid-window
+    param changes; hits/params drawn once per (key, batch) and the
+    algorithm pinned per KEY (test_fuzz_differential discipline).
+    Clock jumps cross subwindow (1..150ms), reset (500..2500ms) and
+    multi-window (60s) boundaries."""
+    for step in range(steps):
+        n = int(rng.integers(1, 9))
+        picked = rng.choice(len(keys), size=n)
+        per_key = {}
+        batch = []
+        for k in picked:
+            if k not in per_key:
+                per_key[k] = (
+                    int(rng.choice([0, 1, 1, 1, 2, 5, 40])),
+                    int(rng.choice([1, 3, 8, 30])),
+                    int(rng.choice([400, 1000, 60_000])),
+                )
+            elif per_key[k][0] == 0:
+                continue
+            hits, limit, duration = per_key[k]
+            batch.append(
+                RateLimitReq(
+                    name="algofuzz",
+                    unique_key=keys[k],
+                    hits=hits,
+                    limit=limit,
+                    duration=duration,
+                    algorithm=Algorithm(algos[k % len(algos)]),
+                )
+            )
+        dt = int(rng.choice([0, 1, 7, 50, 150, 500, 2500, 60_000]))
+        yield step, batch, dt
+
+
+def _assert_same(a, b, ctx):
+    assert (
+        a.status, a.limit, a.remaining, a.reset_time, a.error
+    ) == (
+        b.status, b.limit, b.remaining, b.reset_time, b.error
+    ), (ctx, a, b)
+
+
+def _run_pipeline_identity(monkeypatch, device_backend, seed, steps):
+    """Byte-identity between the device serving pipeline and the
+    host-oracle (ExactBackend) instance under one fake clock."""
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    async def run():
+        dev = await _mk_instance(device_backend)
+        host = await _mk_instance(ExactBackend(10_000))
+        try:
+            rng = np.random.default_rng(seed)
+            keys = [f"a{i}" for i in range(16)]
+            # sliding and GCRA keys interleaved with token/leaky ones:
+            # the cross-algorithm store-coexistence pin rides the same
+            # fuzz (every batch mixes all four algorithms in one
+            # kernel pass over one store)
+            algos = (0, 2, 3, 2, 1, 3)
+            for step, batch, dt in _algo_stream(
+                rng, keys, steps, algos
+            ):
+                clock.t += dt
+                a = await dev.get_rate_limits(batch)
+                b = await host.get_rate_limits(batch)
+                for x, y, r in zip(a, b, batch):
+                    _assert_same(x, y, (seed, step, r))
+        finally:
+            await dev.stop()
+            await host.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_pipeline_identity_flat(monkeypatch, seed):
+    """Flat single-chip policy: instance -> batcher -> arrival prep ->
+    merged submit -> kernel, vs the host oracle."""
+    _run_pipeline_identity(
+        monkeypatch,
+        TpuBackend(StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)),
+        seed,
+        steps=140,
+    )
+
+
+def test_pipeline_identity_mesh(monkeypatch):
+    """Simulated 8-device mesh policy (conftest pins 8 CPU devices):
+    the same byte-identity through the sharded engine."""
+    import jax
+
+    assert len(jax.devices()) == 8, "conftest should provide 8 devices"
+    _run_pipeline_identity(
+        monkeypatch,
+        MeshBackend(StoreConfig(rows=16, slots=256), buckets=(64,)),
+        7,
+        steps=80,
+    )
+
+
+def test_registry_covers_wire_enum():
+    """Every api.types.Algorithm value has a registry row and the two
+    id spaces agree (the CLI/bench name map rides the registry)."""
+    for a in Algorithm:
+        assert int(a) in ALGORITHMS
+        assert ALGORITHMS[int(a)].algo == int(a)
+    assert ALGORITHMS[ALGO_SLIDING].name == "sliding"
+    assert ALGORITHMS[ALGO_GCRA].name == "gcra"

@@ -1,0 +1,531 @@
+"""Hierarchical quota chains (r15): expansion identity, no-partial-
+debit, most-restrictive-wins, routing, and the serving surface.
+
+The contracts under test (ISSUE 11 / core/kernels.py
+decide_presorted_chain):
+
+- depth-1 identity: a chain-coupled pass where every chain is a
+  singleton is BYTE-identical to the plain kernel — responses and the
+  written store — and a single-level decide_chain request matches the
+  plain decide for the same traffic;
+- no-partial-debit: a chain refused at ANY level consumes quota at NO
+  level, in one device pass, on the flat and the simulated 8-device
+  mesh policies;
+- most-restrictive-wins: the shallowest refusing level answers the
+  whole request (metadata["chain_level"] names it);
+- level counters are REAL counters under the request's name namespace:
+  a plain request for (name, level_key) shares the level's state;
+- cross-algorithm coexistence: chained token/sliding/GCRA requests
+  interleave with plain keys of all four algorithms in one batch;
+- the serving tier: instance-level validation (depth bound, GLOBAL
+  incompatibility, GUBER_CHAINS=0 kill switch) and the batcher's
+  dedicated chain lane end-to-end.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.types import (
+    Algorithm,
+    Behavior,
+    ChainLevel,
+    PeerInfo,
+    RateLimitReq,
+    Status,
+)
+from gubernator_tpu.core.store import StoreConfig
+from gubernator_tpu.serve.backends import (
+    ExactBackend,
+    MeshBackend,
+    TpuBackend,
+)
+from gubernator_tpu.serve.config import ServerConfig
+from gubernator_tpu.serve.instance import Instance
+
+T0 = 1_700_000_000_000
+ADDR = "127.0.0.1:7977"
+
+
+def _chain_req(key, hits=1, limit=50, chain=(), algo=Algorithm.TOKEN_BUCKET,
+               duration=60_000):
+    return RateLimitReq(
+        name="chain", unique_key=key, hits=hits, limit=limit,
+        duration=duration, algorithm=algo,
+        chain=[ChainLevel(*lv) for lv in chain],
+    )
+
+
+def _peek(backend, key, limit=50, duration=60_000, now=None):
+    """Plain read of a level counter's remaining budget."""
+    return backend.decide(
+        [RateLimitReq(name="chain", unique_key=key, hits=0, limit=limit,
+                      duration=duration)],
+        [False],
+        now=now,
+    )[0]
+
+
+# -- kernel-level depth-1 identity ------------------------------------------
+
+
+def test_kernel_singleton_chain_identity():
+    """The chain-coupled path with every chain a singleton is byte-
+    identical to the plain path — responses AND the written store —
+    over randomized mixed-algorithm batches with duplicate keys and
+    clock advances (decide_chain_arrays vs decide_arrays on twin flat
+    engines; this also covers the dedicated chain prep,
+    pad_request_chained)."""
+    from gubernator_tpu.core.engine import TpuEngine
+
+    rng = np.random.default_rng(5)
+    cfg = StoreConfig(rows=16, slots=1 << 8)
+    plain = TpuEngine(cfg, buckets=(64,))
+    chained = TpuEngine(cfg, buckets=(64,))
+    pool = (rng.integers(1, 1 << 60, 24)).astype(np.uint64)
+    now = T0
+    for step in range(30):
+        now += int(rng.choice([0, 1, 40, 700]))
+        n = int(rng.integers(1, 32))
+        kh = pool[rng.integers(0, pool.shape[0], n)]
+        hits = rng.choice([0, 1, 2, 9], n).astype(np.int64)
+        limit = rng.choice([3, 8, 30], n).astype(np.int64)
+        dur = rng.choice([400, 1000, 60_000], n).astype(np.int64)
+        algo = rng.integers(0, 4, n).astype(np.int32)
+        gnp = np.zeros(n, bool)
+        a = plain.decide_arrays(kh, hits, limit, dur, algo, gnp, now)
+        b = chained.decide_chain_arrays(
+            kh, hits, limit, dur, algo,
+            np.arange(n, dtype=np.int64),  # every chain a singleton
+            kh,  # route by own key, like a plain row
+            now,
+        )
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(
+                np.asarray(x), np.asarray(y), err_msg=f"step {step}"
+            )
+    np.testing.assert_array_equal(
+        np.asarray(plain.store.data), np.asarray(chained.store.data)
+    )
+
+
+# -- backend-level contracts ------------------------------------------------
+
+
+def _flat_backend():
+    return TpuBackend(StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64))
+
+
+def _mesh_backend():
+    import jax
+
+    assert len(jax.devices()) == 8
+    return MeshBackend(StoreConfig(rows=16, slots=256), buckets=(64,))
+
+
+@pytest.mark.parametrize(
+    "mk", [_flat_backend, _mesh_backend], ids=["flat", "mesh8"]
+)
+def test_single_level_chain_matches_plain(mk):
+    """A decide_chain request with NO ancestor levels is byte-identical
+    to the plain decide for the same stream (the serving-tier face of
+    the depth-1 identity)."""
+    a, b = mk(), mk()
+    rng = np.random.default_rng(3)
+    now = T0
+    for step in range(25):
+        now += int(rng.choice([0, 1, 40, 700]))
+        key = f"sl{rng.integers(6)}"
+        hits = int(rng.choice([0, 1, 2, 9]))
+        algo = Algorithm(int(rng.integers(0, 4)))
+        r = _chain_req(key, hits=hits, limit=5, algo=algo, duration=2000)
+        ra = a.decide_chain([r], now=now)[0]
+        rb = b.decide([r], [False], now=now)[0]
+        assert (
+            ra.status, ra.limit, ra.remaining, ra.reset_time
+        ) == (rb.status, rb.limit, rb.remaining, rb.reset_time), (
+            step, r, ra, rb,
+        )
+
+
+@pytest.mark.parametrize(
+    "mk",
+    [_flat_backend, _mesh_backend, lambda: ExactBackend(10_000)],
+    ids=["flat", "mesh8", "exact"],
+)
+def test_depth3_no_partial_debit(mk):
+    """global -> tenant -> leaf: the tenant exhausts first; the refusal
+    must consume quota at NEITHER the global nor the leaf level, and
+    the shallowest refusing level is named in metadata. Level state is
+    read back through chain-head-routed peeks: on the sharded policy a
+    chain's levels live on the HEAD's owner shard (the consolidation
+    contract, parallel/sharded.py pad_request_chained), so a plain
+    probe of a non-head level would address a different shard's
+    (empty) counter."""
+    be = mk()
+    chain = (("global", 100, 0), ("tenant", 2, 0))
+    now = T0
+    for i in range(2):
+        rl = be.decide_chain(
+            [_chain_req("leaf", chain=chain)], now=now + i
+        )[0]
+        assert rl.status == Status.UNDER_LIMIT, (i, rl)
+    # tenant (limit 2) is now exhausted: refusal, no debit anywhere
+    for i in range(3):
+        rl = be.decide_chain(
+            [_chain_req("leaf", chain=chain)], now=now + 2 + i
+        )[0]
+        assert rl.status == Status.OVER_LIMIT
+        assert rl.metadata.get("chain_level") == "1"
+        assert rl.limit == 2  # the refusing level answers
+    # level counters are real counters under the name namespace,
+    # shared with any traffic routed by the same chain head: global
+    # consumed exactly 2 (the head reads plainly — it routes to its
+    # own shard), the leaf exactly 2, the tenant pinned at 0
+    assert _peek(be, "global", limit=100, now=now + 9).remaining == 98
+    leaf_read = be.decide_chain(
+        [_chain_req("leaf", hits=0,
+                    chain=(("global", 100, 0),))],
+        now=now + 9,
+    )[0]
+    assert leaf_read.remaining == 48, leaf_read
+    tenant_read = be.decide_chain(
+        [_chain_req("tenant", hits=0, limit=2,
+                    chain=(("global", 100, 0),))],
+        now=now + 9,
+    )[0]
+    assert tenant_read.remaining == 0, tenant_read
+
+
+def test_depth3_single_device_pass():
+    """All levels of a chained batch debit in ONE engine dispatch."""
+    be = _flat_backend()
+    calls = []
+    orig = be.engine.decide_chain_arrays
+
+    def counting(*a, **kw):
+        calls.append(len(a[0]))
+        return orig(*a, **kw)
+
+    be.engine.decide_chain_arrays = counting
+    chain = (("g", 100, 0), ("t", 50, 0), ("r", 25, 0))
+    resps = be.decide_chain(
+        [_chain_req("leaf1", chain=chain),
+         _chain_req("leaf2", chain=chain)],
+        now=T0,
+    )
+    assert len(resps) == 2
+    assert calls == [8], "expected one device pass over all 8 rows"
+
+
+def test_shallowest_refusal_wins():
+    """When several levels would refuse, the SHALLOWEST one answers
+    (a global refusal dominates a tenant's)."""
+    be = _flat_backend()
+    chain = (("G", 1, 0), ("T", 1, 0))
+    assert be.decide_chain(
+        [_chain_req("L", chain=chain)], now=T0
+    )[0].status == Status.UNDER_LIMIT
+    rl = be.decide_chain([_chain_req("L", chain=chain)], now=T0 + 1)[0]
+    assert rl.status == Status.OVER_LIMIT
+    assert rl.metadata.get("chain_level") == "0"
+    assert rl.limit == 1
+
+
+@pytest.mark.parametrize(
+    "mk", [_flat_backend, lambda: ExactBackend(10_000)],
+    ids=["flat", "exact"],
+)
+def test_shared_ancestor_survives_mixed_leaf_algorithms(mk):
+    """Ancestor levels always decide as TOKEN buckets regardless of
+    the request's leaf algorithm (review finding): tenants using GCRA
+    and token leaves under ONE shared ancestor must accumulate against
+    the same parent counter — per-leaf-algorithm ancestors would flip
+    the stored flag every batch, mismatch-recreate, and never reach
+    the parent limit."""
+    be = mk()
+    algos = [Algorithm.GCRA, Algorithm.TOKEN_BUCKET,
+             Algorithm.SLIDING_WINDOW]
+    for i in range(3):
+        rl = be.decide_chain(
+            [_chain_req(f"ml{i}", chain=(("shared", 3, 0),),
+                        algo=algos[i], limit=10, duration=60_000)],
+            now=T0 + i,
+        )[0]
+        assert rl.status == Status.UNDER_LIMIT, (i, rl)
+    # the shared ancestor (limit 3) is now exhausted for EVERY tenant
+    rl = be.decide_chain(
+        [_chain_req("ml9", chain=(("shared", 3, 0),),
+                    algo=Algorithm.GCRA, limit=10, duration=60_000)],
+        now=T0 + 5,
+    )[0]
+    assert rl.status == Status.OVER_LIMIT, rl
+    assert rl.metadata.get("chain_level") == "0"
+
+
+def test_chain_algorithms_coexist_with_plain_traffic():
+    """Chained GCRA/sliding requests share one batch with plain keys
+    of all four algorithms; the chain's levels store the CHAIN's
+    algorithm and plain traffic is unaffected."""
+    be = _flat_backend()
+    now = T0
+    chain = (("cg", 20, 0),)
+    for algo in (Algorithm.GCRA, Algorithm.SLIDING_WINDOW):
+        rl = be.decide_chain(
+            [_chain_req(f"cl-{int(algo)}", chain=chain, algo=algo,
+                        limit=10, duration=1000)],
+            now=now,
+        )[0]
+        assert rl.status == Status.UNDER_LIMIT, (algo, rl)
+    plain = [
+        RateLimitReq(name="chain", unique_key=f"p{a}", hits=1, limit=5,
+                     duration=1000, algorithm=Algorithm(a))
+        for a in range(4)
+    ]
+    for rl in be.decide(plain, [False] * 4, now=now + 1):
+        assert rl.status == Status.UNDER_LIMIT
+        assert rl.remaining == 4
+
+
+# -- serving tier -----------------------------------------------------------
+
+
+async def _mk_instance(conf_kw=None):
+    conf = ServerConfig(
+        grpc_address=ADDR, advertise_address=ADDR, **(conf_kw or {})
+    )
+    inst = Instance(
+        conf, TpuBackend(StoreConfig(rows=16, slots=1 << 8), buckets=(16,))
+    )
+    inst.start()
+    await inst.set_peers([PeerInfo(address=ADDR, is_owner=True)])
+    return inst
+
+
+def test_instance_chain_lane_and_validation():
+    async def run():
+        inst = await _mk_instance()
+        try:
+            chain = (("ig", 100, 0), ("it", 2, 0))
+            r1, r2 = await inst.get_rate_limits(
+                [_chain_req("il", chain=chain),
+                 _chain_req("il", chain=chain)]
+            )
+            assert r1.status == Status.UNDER_LIMIT
+            assert r2.status == Status.UNDER_LIMIT
+            (r3,) = await inst.get_rate_limits(
+                [_chain_req("il", chain=chain)]
+            )
+            assert r3.status == Status.OVER_LIMIT
+            assert r3.metadata.get("chain_level") == "1"
+
+            # depth bound (GUBER_CHAIN_MAX_DEPTH defaults to 3 ancestors)
+            deep = _chain_req(
+                "il", chain=[("a", 1, 0), ("b", 1, 0), ("c", 1, 0),
+                             ("d", 1, 0)]
+            )
+            (rd,) = await inst.get_rate_limits([deep])
+            assert "GUBER_CHAIN_MAX_DEPTH" in rd.error
+
+            # GLOBAL behavior is incompatible with a chain
+            g = _chain_req("il", chain=chain)
+            g.behavior = Behavior.GLOBAL
+            (rg,) = await inst.get_rate_limits([g])
+            assert "GLOBAL" in rg.error
+
+            # empty level key refused per item
+            (re_,) = await inst.get_rate_limits(
+                [_chain_req("il", chain=(("", 1, 0),))]
+            )
+            assert "unique_key" in re_.error
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_peer_door_validates_chains():
+    """get_peer_rate_limits enforces chain validation with the
+    RECEIVING node's config (review finding): the depth bound and the
+    GUBER_CHAINS kill switch hold at the peer door, not only the
+    client door — a hostile peer cannot demand unbounded device-row
+    expansion."""
+
+    async def run():
+        inst = await _mk_instance()
+        try:
+            deep = _chain_req(
+                "pd", chain=[("a", 1, 0), ("b", 1, 0), ("c", 1, 0),
+                             ("d", 1, 0)]
+            )
+            ok = _chain_req("pd", chain=(("pg", 5, 0),))
+            rd, rok = await inst.get_peer_rate_limits([deep, ok])
+            assert "GUBER_CHAIN_MAX_DEPTH" in rd.error
+            assert rok.status == Status.UNDER_LIMIT and not rok.error
+        finally:
+            await inst.stop()
+
+    async def run_off():
+        inst = await _mk_instance({"chains": False})
+        try:
+            (r,) = await inst.get_peer_rate_limits(
+                [_chain_req("pd2", chain=(("pg", 5, 0),))]
+            )
+            assert "GUBER_CHAINS" in r.error
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+    asyncio.run(run_off())
+
+
+def test_instance_chain_kill_switch():
+    async def run():
+        inst = await _mk_instance({"chains": False})
+        try:
+            (r,) = await inst.get_rate_limits(
+                [_chain_req("ks", chain=(("g", 5, 0),))]
+            )
+            assert "GUBER_CHAINS" in r.error
+            # plain traffic unaffected
+            (p,) = await inst.get_rate_limits([_chain_req("ks2")])
+            assert p.status == Status.UNDER_LIMIT
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+@pytest.mark.parametrize(
+    "mk", [_flat_backend, lambda: ExactBackend(10_000)],
+    ids=["flat", "exact"],
+)
+def test_duplicate_level_key_no_partial_debit(mk):
+    """A chain naming the SAME counter twice (ancestor == leaf) judges
+    the request against the cumulative charge: 6+6 > 10 refuses the
+    whole chain and consumes NOTHING (the review-found ExactBackend
+    hole: its peek pass saw the pre-charge budget twice, charged the
+    first occurrence, refused the second — a partial debit)."""
+    be = mk()
+    r = RateLimitReq(
+        name="chain", unique_key="dup", hits=6, limit=10,
+        duration=60_000, chain=[ChainLevel("dup", 10, 0)],
+    )
+    rl = be.decide_chain([r], now=T0)[0]
+    assert rl.status == Status.OVER_LIMIT, rl
+    assert _peek(be, "dup", limit=10, now=T0 + 1).remaining == 10
+    # and a fitting duplicate chain charges BOTH occurrences
+    r2 = RateLimitReq(
+        name="chain", unique_key="dup", hits=3, limit=10,
+        duration=60_000, chain=[ChainLevel("dup", 10, 0)],
+    )
+    rl2 = be.decide_chain([r2], now=T0 + 2)[0]
+    assert rl2.status == Status.UNDER_LIMIT, rl2
+    assert _peek(be, "dup", limit=10, now=T0 + 3).remaining == 4
+
+
+def test_chain_peek_pass_is_non_mutating_for_leaky():
+    """The exact backend's advisory peek pass must not double-apply
+    the leaky peek's persisted leak credit (review finding): one
+    chained request on a drained leaky leaf sees the SAME budget a
+    single sequential pass would — pre-fix the peek credited the
+    elapsed leak and the debit pass credited it again, refilling
+    chained leaky leaves at ~2x the configured rate."""
+    be = ExactBackend(10_000)
+    drain = RateLimitReq(
+        name="chain", unique_key="lk", hits=10, limit=10,
+        duration=10_000, algorithm=Algorithm.LEAKY_BUCKET,
+        chain=[ChainLevel("lg", 100, 0)],
+    )
+    assert be.decide_chain([drain], now=T0)[0].status == (
+        Status.UNDER_LIMIT
+    )
+    # 1s later the leak has refilled exactly 1 (rate = 10/10s)
+    peek = RateLimitReq(
+        name="chain", unique_key="lk", hits=0, limit=10,
+        duration=10_000, algorithm=Algorithm.LEAKY_BUCKET,
+        chain=[ChainLevel("lg", 100, 0)],
+    )
+    rl = be.decide_chain([peek], now=T0 + 1000)[0]
+    assert rl.remaining == 1, rl  # pre-fix: 2
+    # and a refused chain leaves no trace (no leak-clock advance)
+    over = RateLimitReq(
+        name="chain", unique_key="lk", hits=5, limit=10,
+        duration=10_000, algorithm=Algorithm.LEAKY_BUCKET,
+        chain=[ChainLevel("lg", 100, 0)],
+    )
+    rl2 = be.decide_chain([over], now=T0 + 1000)[0]
+    assert rl2.status == Status.OVER_LIMIT, rl2
+    # a SECOND peek at the same instant reads 2: the reference's own
+    # repeated-peek quirk (a leaky peek persists its credit without
+    # advancing the timestamp, so each peek re-credits the same
+    # elapsed leak) — one credit per request, exactly like sequential
+    # plain peeks, NOT the intra-request double credit under test
+    rl3 = be.decide_chain([peek], now=T0 + 1000)[0]
+    assert rl3.remaining == 2, rl3
+
+
+def test_fallbacks_never_decide_chains_as_plain():
+    """Owner-unreachable fallbacks must not silently strip a chain to
+    its leaf (the review finding): takeover refuses chained items
+    per-item (chain levels are not replicated), and degraded mode
+    serves them through the LOCAL chain lane with full most-
+    restrictive-wins semantics."""
+
+    class FakePeer:
+        host = "10.0.0.9:81"
+
+    async def run():
+        inst = await _mk_instance({"degraded_local": True})
+        try:
+            chained = _chain_req("fb", chain=(("fbt", 1, 0),))
+            # takeover: repl present, all-chained items -> per-item
+            # refusal, never a leaf-only decide
+            inst.repl = object()
+            taken = await inst._takeover_fallback(
+                [(0, chained)], FakePeer(), RuntimeError("down")
+            )
+            inst.repl = None
+            assert taken is not None
+            assert "takeover scope" in taken[0].error
+
+            # degraded: full chain semantics against the local store
+            d1 = await inst._degraded_fallback(
+                [(0, chained)], FakePeer(), RuntimeError("down")
+            )
+            assert d1[0].status == Status.UNDER_LIMIT
+            assert d1[0].metadata.get("degraded") == "true"
+            d2 = await inst._degraded_fallback(
+                [(0, chained)], FakePeer(), RuntimeError("down")
+            )
+            # the tenant level (limit 1) is exhausted: the chain is
+            # refused at level 0 — a leaf-only decide (limit 50)
+            # would have admitted it
+            assert d2[0].status == Status.OVER_LIMIT
+            assert d2[0].metadata.get("chain_level") == "0"
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_level_duration_inheritance():
+    """A level duration of 0 inherits the request's; an explicit level
+    duration stands on its own."""
+    be = _flat_backend()
+    rl = be.decide_chain(
+        [_chain_req("dl", duration=1000,
+                    chain=(("dg", 5, 0), ("dt", 5, 30_000)))],
+        now=T0,
+    )[0]
+    assert rl.status == Status.UNDER_LIMIT
+    # the inheriting level's window ends with the request's duration
+    assert _peek(be, "dg", limit=5, duration=1000,
+                 now=T0 + 1).remaining == 4
+    # ...and is gone after it (token window expired -> fresh peek)
+    assert _peek(be, "dg", limit=5, duration=1000,
+                 now=T0 + 1500).remaining == 5
+    # the explicit 30s level still holds its consumed hit
+    assert _peek(be, "dt", limit=5, duration=30_000,
+                 now=T0 + 1500).remaining == 4

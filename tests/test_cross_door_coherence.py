@@ -93,19 +93,13 @@ def _call_door(kind, port, key, hits, limit=LIMIT):
             timeout=30,
         ).responses[0]
         return int(r.status), int(r.remaining)
-    body = json.dumps(
+    # bounded 503 retry (r15 deflake; see tests/_util.post_json)
+    from _util import post_json
+
+    out = post_json(
+        f"http://127.0.0.1:{port}/v1/GetRateLimits",
         {"requests": [{"name": "coh", "uniqueKey": key, "hits": hits,
-                       "limit": limit, "duration": 600000}]}
-    ).encode()
-    out = json.loads(
-        urllib.request.urlopen(
-            urllib.request.Request(
-                f"http://127.0.0.1:{port}/v1/GetRateLimits",
-                data=body,
-                headers={"Content-Type": "application/json"},
-            ),
-            timeout=30,
-        ).read()
+                       "limit": limit, "duration": 600000}]},
     )["responses"][0]
     return (1 if out["status"] == "OVER_LIMIT" else 0,
             int(out["remaining"]))

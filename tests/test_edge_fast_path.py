@@ -79,15 +79,11 @@ def _grpc_req(key, hits=1, limit=5, behavior=0):
 
 
 def _daemon_http(body: dict) -> dict:
-    return json.loads(
-        urllib.request.urlopen(
-            urllib.request.Request(
-                f"http://127.0.0.1:{DAEMON_HTTP}/v1/GetRateLimits",
-                data=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
-            ),
-            timeout=30,
-        ).read()
+    # bounded 503 retry (r15 deflake; see tests/_util.post_json)
+    from _util import post_json
+
+    return post_json(
+        f"http://127.0.0.1:{DAEMON_HTTP}/v1/GetRateLimits", body
     )
 
 
@@ -109,18 +105,12 @@ def test_fast_path_shares_state_with_direct_traffic(stack):
     assert out["responses"][0]["remaining"] == "3"
 
     # and back through the edge HTTP door (also fast-path eligible)
-    body = json.dumps(
+    from _util import post_json
+
+    out2 = post_json(
+        f"http://127.0.0.1:{EDGE_HTTP}/v1/GetRateLimits",
         {"requests": [{"name": "fp", "uniqueKey": "parity", "hits": 1,
-                       "limit": 5, "duration": 60000}]}
-    ).encode()
-    out2 = json.loads(
-        urllib.request.urlopen(
-            urllib.request.Request(
-                f"http://127.0.0.1:{EDGE_HTTP}/v1/GetRateLimits",
-                data=body, headers={"Content-Type": "application/json"},
-            ),
-            timeout=30,
-        ).read()
+                       "limit": 5, "duration": 60000}]},
     )
     assert out2["responses"][0]["remaining"] == "2"
 

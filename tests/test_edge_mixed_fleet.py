@@ -127,15 +127,11 @@ def fleet():
 
 
 def _post(port, body):
-    return json.loads(
-        urllib.request.urlopen(
-            urllib.request.Request(
-                f"http://127.0.0.1:{port}/v1/GetRateLimits",
-                data=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
-            ),
-            timeout=30,
-        ).read()
+    # bounded 503 retry (r15 deflake; see tests/_util.post_json)
+    from _util import post_json
+
+    return post_json(
+        f"http://127.0.0.1:{port}/v1/GetRateLimits", body
     )
 
 

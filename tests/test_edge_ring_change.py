@@ -100,23 +100,22 @@ class CountingInstance:
 
 
 def _post(port, n_keys, tag):
-    body = json.dumps(
+    # bounded 503 retry (r15 deflake; see tests/_util.post_json): a
+    # just-(re)spawned edge can refuse the first frame un-served
+    # under full-suite load
+    from tests._util import post_json
+
+    return post_json(
+        f"http://127.0.0.1:{port}/v1/GetRateLimits",
         {
             "requests": [
                 {"name": "rc", "uniqueKey": f"{tag}-{i}", "hits": 1,
                  "limit": 7, "duration": 60000}
                 for i in range(n_keys)
             ]
-        }
-    ).encode()
-    resp = urllib.request.urlopen(
-        urllib.request.Request(
-            f"http://127.0.0.1:{port}/v1/GetRateLimits", data=body,
-            headers={"Content-Type": "application/json"},
-        ),
+        },
         timeout=15,
     )
-    return json.loads(resp.read())
 
 
 def test_membership_change_refuses_then_reroutes():

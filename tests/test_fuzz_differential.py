@@ -67,7 +67,9 @@ def test_fuzz_vs_oracle(seed):
                     int(rng.choice([0, 1, 1, 2, 5, 40])),
                     int(rng.choice([1, 3, 8, 30])),
                     int(rng.choice([100, 1000, 60_000])),
-                    Algorithm(int(k) % 2),
+                    # all four algorithms of the r15 suite, pinned per
+                    # key for the run (see module docstring)
+                    Algorithm(int(k) % 4),
                 )
             elif per_key[k][0] == 0:
                 continue

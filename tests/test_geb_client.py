@@ -217,6 +217,92 @@ def test_string_mode_roundtrip_and_negotiation():
     assert inst.calls == [["k0", "k1", "k2", "k3"]]
 
 
+def test_chain_frames_roundtrip_and_capability_gate():
+    """Quota chains over the socket door (r15): a mixed plain/chained
+    batch rides ONE GEBC frame — the chain levels arrive intact at the
+    instance, fast framing is bypassed, responses come back in order —
+    and a server hello without HELLO_CHAIN is refused client-side
+    before anything hits the wire."""
+    from gubernator_tpu.api.types import ChainLevel
+
+    inst = _ObjectInstance()
+    seen_chains = []
+    orig = inst.get_rate_limits
+
+    async def capture(reqs, stage_frame=False):
+        seen_chains.append(
+            [[(lv.unique_key, lv.limit, lv.duration) for lv in r.chain]
+             for r in reqs]
+        )
+        return await orig(reqs, stage_frame)
+
+    inst.get_rate_limits = capture
+
+    async def go(port, lst):
+        from gubernator_tpu.client_geb import (
+            HELLO_CHAIN,
+            AsyncGebClient,
+            GebError,
+        )
+
+        async with AsyncGebClient(f"127.0.0.1:{port}") as c:
+            assert c.hello.chain, hex(c.hello.flags)
+            reqs = _reqs(3)
+            reqs[1].chain = [
+                ChainLevel("global", 100, 0),
+                ChainLevel("tenant:a", 10, 2000),
+            ]
+            out = await c.get_rate_limits(reqs)
+            assert len(out) == 3
+            # a pre-r15 hello (no chain capability) refuses client-side
+            c.hello.flags &= ~HELLO_CHAIN
+            try:
+                await c.get_rate_limits(reqs)
+            except GebError as e:
+                assert "HELLO_CHAIN" in str(e)
+            else:
+                raise AssertionError("expected GebError")
+        return out
+
+    _with_listener(inst, go)
+    assert seen_chains == [[
+        [],
+        [("global", 100, 0), ("tenant:a", 10, 2000)],
+        [],
+    ]]
+
+
+def test_hello_chain_bit_follows_kill_switch():
+    """With GUBER_CHAINS=0 the hello must NOT advertise HELLO_CHAIN,
+    so a chained caller fails fast client-side instead of shipping
+    GEBC frames destined for per-item refusal (review finding)."""
+    from types import SimpleNamespace
+
+    from gubernator_tpu.api.types import ChainLevel
+
+    inst = _ObjectInstance()
+    inst.conf = SimpleNamespace(chains=False)
+
+    async def go(port, lst):
+        from gubernator_tpu.client_geb import AsyncGebClient, GebError
+
+        async with AsyncGebClient(f"127.0.0.1:{port}") as c:
+            assert not c.hello.chain, hex(c.hello.flags)
+            reqs = _reqs(1)
+            reqs[0].chain = [ChainLevel("g", 5, 0)]
+            try:
+                await c.get_rate_limits(reqs)
+            except GebError as e:
+                assert "HELLO_CHAIN" in str(e)
+            else:
+                raise AssertionError("expected GebError")
+            # plain traffic is unaffected
+            out = await c.get_rate_limits(_reqs(2))
+            assert len(out) == 2
+
+    _with_listener(inst, go)
+
+
 def test_auto_mode_uses_fast_on_single_node():
     """In-process, the client and 'store' share a hash tier, the ring
     is single-node, and the fake backend takes arrays — auto must pick

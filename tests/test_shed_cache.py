@@ -91,6 +91,55 @@ def test_lookup_gates_and_expiry():
     assert len(c) == 0
 
 
+def test_r15_algorithms_never_shed():
+    """r15 interplay audit (core/algorithms.py SHEDDABLE_ALGOS): a
+    sliding-window blend decays and a GCRA TAT drains every
+    millisecond, so their OVER verdicts are never provably current —
+    they must neither consult nor populate the shed cache, and a
+    stale token entry must never answer one of their requests."""
+    from gubernator_tpu.core.algorithms import sheddable
+
+    assert not sheddable(int(Algorithm.SLIDING_WINDOW))
+    assert not sheddable(int(Algorithm.GCRA))
+
+    clock = FakeClock()
+    c = ShedCache(8, now_fn=clock)
+    c._observe_one(42, 1, 10, 1000, 0, int(Status.OVER_LIMIT), 10, 0,
+                   clock.t + 500, clock.t)
+    assert len(c) == 1
+    # a cached token verdict never answers a sliding/GCRA request for
+    # the same fingerprint (not even a lookup)
+    lk = c.lookups
+    for algo in (Algorithm.SLIDING_WINDOW, Algorithm.GCRA):
+        assert c.lookup_resp(
+            42, RateLimitReq(name="n", unique_key="k", hits=1,
+                             limit=10, duration=1000, algorithm=algo)
+        ) is None
+    assert c.lookups == lk
+    # the bridge array screen is equally gated: a GCRA row over a
+    # cached fingerprint does not shed
+    for algo in (2, 3):
+        fields = dict(
+            key_hash=np.array([42], np.uint64),
+            hits=np.array([1], np.int64),
+            limit=np.array([10], np.int64),
+            duration=np.array([1000], np.int64),
+            algo=np.array([algo], np.int32),
+        )
+        assert c.screen_fields(fields, clock.t) is None
+    # observing a sliding/GCRA response DROPS the stale token entry
+    # (algorithm switch recreates the window, like leaky)...
+    c._observe_one(42, 1, 10, 1000, int(Algorithm.GCRA),
+                   int(Status.OVER_LIMIT), 10, 0, clock.t + 500,
+                   clock.t)
+    assert 42 not in c._entries
+    # ...and never populates one of its own
+    for algo in (2, 3):
+        c._observe_one(7, 1, 10, 1000, algo, int(Status.OVER_LIMIT),
+                       10, 0, clock.t + 500, clock.t)
+        assert 7 not in c._entries
+
+
 def test_lru_bound_and_observe_drop():
     clock = FakeClock()
     c = ShedCache(4, now_fn=clock)

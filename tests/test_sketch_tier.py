@@ -286,8 +286,11 @@ def test_sketch_tier_fixed_window_semantics():
             np.zeros(nf + 1, np.int32), np.zeros(nf + 1, bool),
             T0 + i,
         )
-        e_now = (T0 + i) - T0  # engine-ms (epoch pinned at T0)
-        window_end_unix = T0 + ((e_now // DUR) + 1) * DUR
+        # engine-ms: the epoch pins ONE ms before first contact (r15,
+        # core/engine.py EpochClock — engine 0 is the wire's no-reset
+        # sentinel), so the fixed-window grid anchors at T0 - 1
+        e_now = (T0 + i) - (T0 - 1)
+        window_end_unix = (T0 - 1) + ((e_now // DUR) + 1) * DUR
         if i < LIM:
             assert s[-1] == int(Status.UNDER_LIMIT)
             assert r[-1] == LIM - (i + 1)
@@ -328,9 +331,12 @@ def test_reset_and_rebase_clear_sketch():
 def _twin_arrays(seed, slots, rows, steps=60, keyspace=24,
                  hit_pool=(0, 1, 1, 2), limit_pool=(5, 8, 50),
                  dur_pool=(400, 2000, 60_000),
-                 dt_pool=(0, 1, 7, 500, 2500), token_only=False):
+                 dt_pool=(0, 1, 7, 500, 2500), token_only=False,
+                 algo_pool=None):
     """Drive identical random array batches through sketch-ON and
-    sketch-OFF engines; returns the per-step response pairs."""
+    sketch-OFF engines; returns the per-step response pairs.
+    `algo_pool` pins the algorithm draw (r15 suite ids); default is
+    the historical token/leaky mix (or token-only)."""
     rng = np.random.default_rng(seed)
     cfg = StoreConfig(rows=rows, slots=slots)
     on = TpuEngine(cfg, buckets=(64, 256),
@@ -345,11 +351,12 @@ def _twin_arrays(seed, slots, rows, steps=60, keyspace=24,
         hits = rng.choice(hit_pool, n).astype(np.int64)
         limit = rng.choice(limit_pool, n).astype(np.int64)
         dur = rng.choice(dur_pool, n).astype(np.int64)
-        algo = (
-            np.zeros(n, np.int32)
-            if token_only
-            else rng.integers(0, 2, n).astype(np.int32)
-        )
+        if algo_pool is not None:
+            algo = rng.choice(algo_pool, n).astype(np.int32)
+        elif token_only:
+            algo = np.zeros(n, np.int32)
+        else:
+            algo = rng.integers(0, 2, n).astype(np.int32)
         gnp = np.zeros(n, bool)
         t += int(rng.choice(dt_pool))
         a = on.decide_arrays(kh, hits, limit, dur, algo, gnp, t)
@@ -417,6 +424,36 @@ def test_on_off_pressure_is_fail_closed():
     assert s_on["dropped"] > 0
     # live-victim protection: resident windows survive the tail storm
     assert s_on["evictions"] < s_off["evictions"]
+
+
+@pytest.mark.parametrize("algo", [2, 3], ids=["sliding", "gcra"])
+def test_r15_algorithms_bypass_sketch_under_pressure(algo):
+    """r15 interplay audit (core/algorithms.py SKETCH_SERVABLE_ALGOS):
+    sliding-window and GCRA creates dropped to way exhaustion are
+    never served from the count-min tier — its fixed-window token math
+    would under-count a sliding blend's previous-window weight and has
+    no GCRA-TAT analogue, breaking the fail-closed contract. Under the
+    same tier pressure that makes the token stream diverge
+    (test_on_off_pressure_is_fail_closed), a sliding/GCRA-only stream
+    is byte-identical sketch-ON vs OFF: drops surface in
+    BatchStats.dropped on BOTH engines, store contents match, and the
+    ON engine's sketch counters never get charged."""
+    on, off, steps = _twin_arrays(
+        11, slots=16, rows=1, steps=80, keyspace=64,
+        hit_pool=(0, 1, 1, 1), limit_pool=(50,),
+        dur_pool=(600_000,), dt_pool=(0, 1, 7, 150),
+        algo_pool=(algo,),
+    )
+    for step, a, b in steps:
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y, err_msg=f"step {step}")
+    s_on, s_off = on.stats.snapshot(), off.stats.snapshot()
+    assert s_on["dropped"] > 0, "pressure fuzz never dropped a create"
+    assert s_on["dropped"] == s_off["dropped"]
+    np.testing.assert_array_equal(
+        np.asarray(on.store.data), np.asarray(off.store.data)
+    )
+    assert int(np.asarray(on.sketch.data).sum()) == 0
 
 
 def test_on_off_identity_serving_device(monkeypatch):
@@ -585,14 +622,17 @@ def test_evicted_dead_entry_folds_into_sketch(monkeypatch):
     )
     # the folded 6 plus this charge: remaining = (10 - 6) - 1
     assert r_e[0] == LIM - 6 - 1
-    # sketch window reset = window 2's end (engine 3000)
-    assert t_e[0] == T0 + 3000
+    # sketch window reset = window 2's end (engine 3000; the epoch
+    # pins 1ms before first contact since r15, so unix = T0-1+3000)
+    assert t_e[0] == (T0 - 1) + 3000
 
     # exact alignment: an entry whose expiry == the window boundary
     # has NO overlap with the current window -> nothing folds
     aligned = mk()
     K2, L2 = _same_bucket_keys(16, 2, start=500)
-    drive(aligned, np.asarray([K2], np.uint64), 6, 1000)  # [1000, 2000)
+    # unix T0+999 = ENGINE 1000 (epoch at T0-1): window [1000, 2000)
+    # ends exactly on the fixed-window boundary
+    drive(aligned, np.asarray([K2], np.uint64), 6, 999)
     drive(aligned, np.asarray([L2], np.uint64), 1, 2100)  # recycles
     est2 = aligned.sketch_estimates(
         np.asarray([K2], np.uint64), np.asarray([D], np.int64), T0 + 2100
@@ -659,7 +699,8 @@ def test_promote_migrates_estimate_and_skips_live():
         key, np.array([LIM]), np.array([DUR]), T0 + 5
     )
     assert inst[0] and est[0] == 3 and not over[0]
-    assert reset[0] == T0 + DUR  # window end (epoch pinned at T0)
+    # window end; the epoch pins 1ms before first contact (r15)
+    assert reset[0] == (T0 - 1) + DUR
     assert eng.live_mask(key, T0 + 6)[0]
     # the window CONTINUES: next hit decides exactly at remaining 6
     kh = np.concatenate([fillers, key])
