@@ -1,5 +1,5 @@
 .PHONY: all native proto test bench readme readme-check profile-stages \
-	profile-submit profile-shed chaos perf-gate clean
+	profile-submit profile-shed profile-trace chaos perf-gate clean
 
 all: native proto
 
@@ -60,6 +60,20 @@ profile-shed: native
 	python scripts/profile_shed.py --seconds $(SHED_SECONDS) \
 	  --rounds $(SHED_ROUNDS) --shares $(SHED_SHARES) \
 	  --json $(SHED_OUT)
+
+# distributed-tracing overhead A/B (r16): the keyspace-30k zipf GEB
+# workload with the tracer flipped between interleaved rounds (off vs
+# GUBER_TRACE_SAMPLE=0.01); the paired median seeds the trace_r16
+# perf-gate pair. Overridable:
+# make profile-trace TRACE_SECONDS=5 TRACE_ROUNDS=8 TRACE_OUT=x.json
+TRACE_SECONDS ?= 3
+TRACE_ROUNDS ?= 6
+TRACE_SAMPLE ?= 0.01
+TRACE_OUT ?= BENCH_TRACE_r16.json
+profile-trace:
+	python scripts/profile_trace.py --seconds $(TRACE_SECONDS) \
+	  --rounds $(TRACE_ROUNDS) --sample $(TRACE_SAMPLE) \
+	  --json $(TRACE_OUT)
 
 # continuous front-door perf gate (r12): replays the committed workload
 # shapes (stages r7, submit r9, shed r10) with interleaved paired A/B

@@ -91,11 +91,13 @@ MAGIC_WRESP = 0x34424547  # 'GEB4'
 MAGIC_WFAST_REQ = 0x37424547  # 'GEB7'
 MAGIC_WFAST_RESP = 0x38424547  # 'GEB8'
 MAGIC_WCHAIN = 0x43424547  # 'GEBC' — chain-extended string req (r15)
+MAGIC_WTRACE = 0x54424547  # 'GEBT' — trace-extended string req (r16)
 
 HELLO_FAST = 1
 HELLO_WINDOWED = 2
 HELLO_XXH64 = 4
 HELLO_CHAIN = 8  # server accepts GEBC chain-extended frames (r15)
+HELLO_TRACE = 16  # server accepts GEBT trace-extended frames (r16)
 
 DRAIN_FRAME_ID = 0xFFFFFFFF
 
@@ -104,6 +106,9 @@ _ITEM_FIX = struct.Struct("<qqqBB")
 _RESP_FIX = struct.Struct("<Bqqq")
 _WFAST_HDR = struct.Struct("<IIQ")  # frame_id | ring_hash | t_sent_us
 _WREQ_HDR = struct.Struct("<IQ")  # frame_id | t_sent_us
+# GEBT trace extension after _WREQ_HDR (r16): 16B big-endian trace id,
+# u64 span id, u8 flags (bit 0 = sampled)
+_WTRACE_EXT = struct.Struct("<16sQB")
 _U16 = struct.Struct("<H")
 _U32 = struct.Struct("<I")
 _FAST_REQ = struct.Struct("<QqqqB")  # key_hash|hits|limit|duration|algo
@@ -238,6 +243,10 @@ class Hello:
     @property
     def chain(self) -> bool:
         return bool(self.flags & HELLO_CHAIN)
+
+    @property
+    def trace(self) -> bool:
+        return bool(self.flags & HELLO_TRACE)
 
     @property
     def window(self) -> int:
@@ -469,8 +478,16 @@ def build_frame(
     frame_id: int = 0,
     ring_hash: int = 0,
     t_sent_us: int = 0,
+    trace_ctx=None,
 ) -> Tuple[bytes, bool]:
-    """Encode one request frame; returns (bytes, is_fast)."""
+    """Encode one request frame; returns (bytes, is_fast).
+
+    `trace_ctx` (r16, a serve/tracing.TraceContext) emits the GEBT
+    trace-extended framing — windowed string frames only. It is
+    silently dropped for fast frames (the 33-byte records are
+    trace-free by design; the server head-samples those bridge-side)
+    and for chained frames (GEBC has no trace slot — documented scope
+    limit)."""
     if not reqs:
         raise GebError("empty request batch")
     if len(reqs) > MAX_FRAME_ITEMS:
@@ -512,9 +529,22 @@ def build_frame(
             )
         return hdr + _U32.pack(len(payload)) + payload, True
     if windowed:
-        hdr = _HDR.pack(
-            MAGIC_WCHAIN if chained else MAGIC_WREQ, len(reqs)
-        ) + _WREQ_HDR.pack(frame_id, t_sent_us)
+        if trace_ctx is not None and not chained:
+            hdr = (
+                _HDR.pack(MAGIC_WTRACE, len(reqs))
+                + _WREQ_HDR.pack(frame_id, t_sent_us)
+                + _WTRACE_EXT.pack(
+                    (trace_ctx.trace_id & ((1 << 128) - 1)).to_bytes(
+                        16, "big"
+                    ),
+                    trace_ctx.span_id & ((1 << 64) - 1),
+                    1 if trace_ctx.sampled else 0,
+                )
+            )
+        else:
+            hdr = _HDR.pack(
+                MAGIC_WCHAIN if chained else MAGIC_WREQ, len(reqs)
+            ) + _WREQ_HDR.pack(frame_id, t_sent_us)
     else:
         hdr = _HDR.pack(MAGIC_REQ, len(reqs))
     return hdr + _U32.pack(len(payload)) + payload, use_fast
@@ -682,10 +712,18 @@ class AsyncGebClient:
         self,
         reqs: Sequence[RateLimitReq],
         timeout: Optional[float] = None,
+        trace=None,
     ) -> List[RateLimitResp]:
         """Serve one batch as one frame. Under concurrency, calls
         pipeline up to the credit window; responses match by frame id
-        regardless of completion order."""
+        regardless of completion order.
+
+        `trace` (r16): a serve/tracing.TraceContext to carry in-band
+        over the GEBT framing — or, by default, the caller's active
+        SAMPLED trace context (serve.tracing, stdlib-only) when the
+        server advertises HELLO_TRACE. Fast and chained frames drop
+        the context (trace-free by design / no GEBC slot); pre-r16
+        servers never see GEBT."""
         await self.connect()
         if (
             any(getattr(r, "chain", None) for r in reqs)
@@ -697,6 +735,16 @@ class AsyncGebClient:
                 "server does not accept quota-chain frames "
                 "(no HELLO_CHAIN capability; pre-r15?)"
             )
+        trace_ctx = None
+        if self.hello.trace and self._windowed:
+            if trace is not None:
+                trace_ctx = trace
+            else:
+                from gubernator_tpu.serve import tracing as _tracing
+
+                tr = _tracing.active()
+                if tr is not None and tr.sampled:
+                    trace_ctx = tr.context()
         if not self._windowed:
             return await self._legacy_roundtrip(reqs, timeout)
         loop = asyncio.get_running_loop()
@@ -709,6 +757,7 @@ class AsyncGebClient:
             frame_id=fid,
             ring_hash=self.hello.ring_hash,
             t_sent_us=int(loop.time() * 1e6),
+            trace_ctx=trace_ctx,
         )
         fut = loop.create_future()
         sem = self._sem

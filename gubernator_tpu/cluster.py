@@ -31,6 +31,7 @@ class LocalCluster:
         http_addresses: Optional[Sequence[str]] = None,
         device_batch_limit: Optional[int] = None,
         geb_ports: Optional[Sequence[int]] = None,
+        trace_sample: float = 0.0,
     ):
         """`http_addresses` (parallel to `addresses`) additionally serves
         each node's HTTP JSON gateway — the harness default is gRPC-only
@@ -62,6 +63,10 @@ class LocalCluster:
                 f"geb_ports ({len(self.geb_ports)}) must match "
                 f"addresses ({len(self.addresses)})"
             )
+        # `trace_sample` (r16): head-sampling probability for every
+        # node's tracer (GUBER_TRACE_SAMPLE) — the cluster tests that
+        # assert cross-node trace propagation turn it to 1.0
+        self._trace_sample = trace_sample
         self.servers: List[Server] = []
         self._backend_factory = backend_factory
         self._global_sync_wait = global_sync_wait
@@ -123,6 +128,7 @@ class LocalCluster:
                 device_batch_wait=self._device_batch_wait,
                 backend="exact",
                 geb_port=geb_port,
+                trace_sample=self._trace_sample,
             )
             if self._device_batch_limit is not None:
                 conf.device_batch_limit = self._device_batch_limit

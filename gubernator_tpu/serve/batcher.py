@@ -61,10 +61,28 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from gubernator_tpu.api.types import RateLimitReq, RateLimitResp
-from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve import metrics, tracing
 from gubernator_tpu.serve.aio import collect_batch
 from gubernator_tpu.serve.faults import FAULTS, FaultError
 from gubernator_tpu.serve.stages import STAGES
+
+
+class _QMeta:
+    """Per-queue-entry mark, riding slot -2 of every queue tuple (was a
+    bare enqueue stamp pre-r16): the enqueue time (always stamped now —
+    the batcher_queue_oldest_age_seconds gauge needs it for every
+    entry), the frame flag (per-frame stage attribution keeps its r7
+    contract: only frame-flagged groups enter coverage), and the
+    caller's active trace, captured at enqueue so the flusher — which
+    runs outside the caller's context — can attribute batch_queue and
+    device spans to it (serve/tracing.py)."""
+
+    __slots__ = ("t", "frame", "trace")
+
+    def __init__(self, frame: bool):
+        self.t = time.monotonic()
+        self.frame = frame
+        self.trace = tracing.active()
 
 
 def _prep_result(prep: "concurrent.futures.Future"):
@@ -273,6 +291,14 @@ class DeviceBatcher:
         ):
             t0 = time.monotonic()
             resps = self.backend.decide(list(reqs), [bool(g) for g in gnp])
+            tr = tracing.active()
+            if tr is not None:
+                # inline fast path: no queue wait, the decide IS the
+                # device span (r16)
+                tr.add_span(
+                    "device", start=t0, batch=len(resps),
+                    rung=self._rung(len(resps)), inline=True,
+                )
             try:
                 metrics.DEVICE_BATCH_SIZE.observe(len(resps))
                 metrics.DEVICE_LAUNCH_MS.observe(
@@ -291,16 +317,15 @@ class DeviceBatcher:
         fut = loop.create_future()
         reqs_l = list(reqs)
         gnp_l = [bool(g) for g in gnp]
-        # the second-to-last slot of EVERY queue tuple is the enqueue
-        # timestamp — the start of the batch_queue stage (serve/stages).
-        # None = unattributed: per-frame stages must count ONLY groups
-        # that belong to an edge frame, or the coverage ratio's
-        # numerator (stage seconds) outgrows its denominator (frame
-        # e2e) under direct gRPC/HTTP/peer traffic
+        # the second-to-last slot of EVERY queue tuple is a _QMeta
+        # mark: enqueue stamp (queue-age gauge), frame flag (per-frame
+        # stages must count ONLY groups that belong to an edge frame,
+        # or the coverage ratio's numerator outgrows its denominator
+        # under direct gRPC/HTTP/peer traffic), and the caller's trace
         self._queue.put_nowait(
             ("decide", reqs_l, gnp_l,
              self._kick_prep("prep_reqs", reqs_l, gnp_l),
-             time.monotonic() if frame else None, fut)
+             _QMeta(frame), fut)
         )
         return await fut
 
@@ -347,18 +372,23 @@ class DeviceBatcher:
         self._queue.put_nowait(
             ("decide_arrays", fields,
              self._kick_prep("prep_group", fields),
-             time.monotonic() if frame else None, fut)
+             _QMeta(frame), fut)
         )
         return await fut
 
-    async def decide_chain(self, reqs: Sequence[RateLimitReq]):
+    async def decide_chain(
+        self, reqs: Sequence[RateLimitReq], frame: bool = False
+    ):
         """Hierarchical quota chains (r15): a dedicated, coalescing
         lane — chained caller groups in one flush window merge into ONE
         backend.decide_chain call, which expands levels and runs the
         chain-coupled kernel pass. The call runs on the single submit
         thread (it submits AND waits against the donated store), so a
         chain batch serializes with — never races — the pipelined
-        plain-batch submits; plain traffic keeps its full pipeline."""
+        plain-batch submits; plain traffic keeps its full pipeline.
+        `frame=True` (bridge GEBC path) marks the group for the
+        per-frame stage clock, exactly like decide() — the r16 audit
+        found chain-lane batches silently diluting frame coverage."""
         if not reqs:
             return []
         if self._closed:
@@ -371,7 +401,7 @@ class DeviceBatcher:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._queue.put_nowait(
-            ("chain", list(reqs), time.monotonic(), fut)
+            ("chain", list(reqs), _QMeta(frame), fut)
         )
         return await fut
 
@@ -394,8 +424,85 @@ class DeviceBatcher:
             raise RuntimeError("DeviceBatcher is stopped")
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
-        self._queue.put_nowait(("globals", updates, time.monotonic(), fut))
+        self._queue.put_nowait(("globals", updates, _QMeta(False), fut))
         await fut
+
+    # -- queue visibility (r16) ---------------------------------------------
+
+    def queue_stats(self) -> dict:
+        """Standing-work snapshot for the lazily-set scrape gauges
+        (serve/metrics.py batcher_queue_*): depth counts caller groups
+        queued + collected-but-unflushed + parked carry; oldest age
+        reads the _QMeta enqueue stamps. Runs on the serving loop (the
+        /metrics handler), so the peek at the queue's internal deque
+        cannot race an enqueue."""
+        items = (
+            list(getattr(self._queue, "_queue", ()))
+            + self._live_batch
+            + self._carry
+        )
+        oldest = min((it[-2].t for it in items), default=None)
+        prep_backlog = 0
+        if self._prep_pool is not None:
+            q = getattr(self._prep_pool, "_work_queue", None)
+            if q is not None:
+                prep_backlog = q.qsize()
+        return {
+            "depth": len(items),
+            "oldest_age_s": (
+                time.monotonic() - oldest if oldest is not None else 0.0
+            ),
+            "prep_backlog": prep_backlog,
+        }
+
+    def _rung(self, n: int) -> int:
+        """The padding-ladder rung a batch of n rows launches at — the
+        device-span annotation (r16). Engines keep a sorted `buckets`
+        ladder; backends without one (exact/inline) report the batch
+        size itself."""
+        buckets = getattr(
+            getattr(self.backend, "engine", None), "buckets", None
+        )
+        if buckets:
+            for b in buckets:
+                if b >= n:
+                    return int(b)
+        return int(n)
+
+    def _trace_device(
+        self, items, t_collect: float, total: int, extra=None
+    ) -> None:
+        """Attach the device span (+ batch annotations) to every traced
+        caller group of a flushed batch. Annotations are computed only
+        when at least one group carries a trace — the untraced path
+        pays one attribute check per group (r16)."""
+        traced = [it for it in items if it[-2].trace is not None]
+        if not traced:
+            return
+        algos: dict = {}
+        for it in items:
+            if it[0] == "decide_arrays":
+                vals, counts = np.unique(
+                    np.asarray(it[1]["algo"]), return_counts=True
+                )
+                for v, c in zip(vals.tolist(), counts.tolist()):
+                    algos[int(v)] = algos.get(int(v), 0) + int(c)
+            else:
+                for r in it[1]:
+                    a = int(r.algorithm)
+                    algos[a] = algos.get(a, 0) + 1
+        ann = dict(
+            batch=int(total),
+            rung=self._rung(int(total)),
+            algo_mix={str(k): v for k, v in sorted(algos.items())},
+        )
+        if extra:
+            ann.update(extra)
+        now = time.monotonic()
+        for it in traced:
+            it[-2].trace.add_span(
+                "device", start=t_collect, end=now, **ann
+            )
 
     async def _run(self) -> None:
         while True:
@@ -460,11 +567,16 @@ class DeviceBatcher:
         global_items = [b for b in batch if b[0] == "globals"]
         chain_items = [b for b in batch if b[0] == "chain"]
         # batch_queue stage: enqueue -> collect, per frame-flagged
-        # caller group (enqueue stamp None = unattributed traffic)
+        # caller group (the chain lane participates since the r16
+        # audit — chained frames used to dilute coverage); traced
+        # groups get the same span regardless of frame flag
         t_collect = time.monotonic()
-        for it in decide_items:
-            if it[-2] is not None:
-                STAGES.add("batch_queue", t_collect - it[-2])
+        for it in decide_items + chain_items:
+            m = it[-2]
+            if m.frame:
+                STAGES.add("batch_queue", t_collect - m.t)
+            if m.trace is not None:
+                m.trace.add_span("batch_queue", start=m.t, end=t_collect)
 
         inline = self._inline
         if global_items:
@@ -478,7 +590,7 @@ class DeviceBatcher:
             # bucket ladder. Per-caller futures still resolve/fail
             # individually.
             all_updates = [
-                u for _, updates, _t_enq, _fut in global_items
+                u for _, updates, _m, _fut in global_items
                 for u in updates
             ]
             try:
@@ -489,11 +601,11 @@ class DeviceBatcher:
                         self.backend.update_globals, all_updates
                     )
             except Exception as e:
-                for _, _updates, _t_enq, fut in global_items:
+                for _, _updates, _m, fut in global_items:
                     if not fut.done():
                         fut.set_exception(e)
             else:
-                for _, _updates, _t_enq, fut in global_items:
+                for _, _updates, _m, fut in global_items:
                     if not fut.done():
                         fut.set_result(None)
             # a cancel mid-call propagates to _run's handler, which fails
@@ -508,8 +620,21 @@ class DeviceBatcher:
             # the pipelined plain submits. Inline (host) backends run
             # on the loop like their plain decide.
             all_chain = [
-                r for _, reqs, _t_enq, _fut in chain_items for r in reqs
+                r for _, reqs, _m, _fut in chain_items for r in reqs
             ]
+
+            def chain_call():
+                # submit_host on the submit thread, like the decide
+                # lanes (the r16 frame-coverage audit: the chain lane
+                # must record PER_BATCH stages too). The chain call
+                # both submits and waits, so its whole body is the
+                # submit-thread span.
+                t0 = time.monotonic()
+                try:
+                    return self.backend.decide_chain(all_chain)
+                finally:
+                    STAGES.add("submit_host", time.monotonic() - t0)
+
             t0c = time.monotonic()
             try:
                 if inline:
@@ -517,21 +642,36 @@ class DeviceBatcher:
                 else:
                     loop = asyncio.get_running_loop()
                     resps = await loop.run_in_executor(
-                        self._submit_pool,
-                        self.backend.decide_chain,
-                        all_chain,
+                        self._submit_pool, chain_call
                     )
             except Exception as e:
-                for _, _reqs, _t_enq, fut in chain_items:
+                for _, _reqs, _m, fut in chain_items:
                     if not fut.done():
                         fut.set_exception(e)
             else:
                 k = 0
-                for _, reqs_c, _t_enq, fut in chain_items:
+                for _, reqs_c, _m, fut in chain_items:
                     span = resps[k : k + len(reqs_c)]
                     k += len(reqs_c)
                     if not fut.done():
                         fut.set_result(span)
+                # device stage per frame-flagged chain group (r16
+                # audit fix): collect -> responses resolved, the same
+                # span the decide lanes record — without it, a GEBC
+                # frame added e2e with no device span and coverage
+                # silently diluted under chained traffic
+                dev_span = time.monotonic() - t_collect
+                nf = sum(1 for it in chain_items if it[-2].frame)
+                if nf:
+                    STAGES.add("device", dev_span * nf, nf)
+                rows = sum(
+                    1 + len(getattr(r, "chain", ()) or ())
+                    for r in all_chain
+                )
+                self._trace_device(
+                    chain_items, t_collect, len(all_chain),
+                    extra=dict(chain=True, rows=rows),
+                )
                 try:
                     metrics.DEVICE_BATCH_SIZE.observe(len(resps))
                     metrics.DEVICE_LAUNCH_MS.observe(
@@ -579,9 +719,10 @@ class DeviceBatcher:
                 return
             self._resolve(decide_items, resps, time.monotonic() - t0)
             span = time.monotonic() - t_collect
-            nf = sum(1 for it in decide_items if it[-2] is not None)
+            nf = sum(1 for it in decide_items if it[-2].frame)
             if nf:
                 STAGES.add("device", span * nf, nf)
+            self._trace_device(decide_items, t_collect, len(resps))
             return
 
         # pipelined path: submit now (host presort + async dispatch);
@@ -799,9 +940,16 @@ class DeviceBatcher:
         # frame-flagged caller group (covers submit + device execute +
         # fetch + pipeline wait)
         dev_span = time.monotonic() - t_collect
-        nf = sum(1 for it in decide_items if it[-2] is not None)
+        nf = sum(1 for it in decide_items if it[-2].frame)
         if nf:
             STAGES.add("device", dev_span * nf, nf)
+        self._trace_device(
+            decide_items, t_collect, k,
+            extra=dict(
+                submit_ms=round(submit_s * 1e3, 3),
+                fetch_ms=round((time.monotonic() - t1) * 1e3, 3),
+            ),
+        )
         try:
             metrics.DEVICE_BATCH_SIZE.observe(k)
             metrics.DEVICE_LAUNCH_MS.observe(
@@ -833,9 +981,16 @@ class DeviceBatcher:
             decide_items, resps, submit_s + (time.monotonic() - t1)
         )
         dev_span = time.monotonic() - t_collect
-        nf = sum(1 for it in decide_items if it[-2] is not None)
+        nf = sum(1 for it in decide_items if it[-2].frame)
         if nf:
             STAGES.add("device", dev_span * nf, nf)
+        self._trace_device(
+            decide_items, t_collect, len(resps),
+            extra=dict(
+                submit_ms=round(submit_s * 1e3, 3),
+                fetch_ms=round((time.monotonic() - t1) * 1e3, 3),
+            ),
+        )
 
     def _fail(self, items, exc: BaseException) -> None:
         # both queue item shapes carry their future last

@@ -330,6 +330,25 @@ class ServerConfig:
     # past either bound are counted in replication_dropped_total.
     replication_standby_keys: int = 1 << 16  # GUBER_REPLICATION_STANDBY_KEYS
     replication_backlog: int = 1 << 16  # GUBER_REPLICATION_BACKLOG
+    # Distributed tracing + flight recorder (r16, serve/tracing.py).
+    # GUBER_TRACE_SAMPLE: head-sampling probability in [0, 1] — a
+    # sampled request collects spans across every hop (edge/bridge
+    # decode, shed screen, batcher queue, device submit/fetch with
+    # batch-size/ladder-rung/algo-mix annotations, peer forward, owner
+    # serve) and its context propagates over gRPC metadata, the HTTP
+    # doors' traceparent header, and the GEBT frame extension. 0 (the
+    # default) is provably ~zero-cost: one branch per site, no id
+    # generation.
+    trace_sample: float = 0.0
+    # GUBER_TRACE_SLOW_MS: tail capture — when > 0, EVERY request is
+    # armed for span collection but only requests slower than
+    # max(this floor, rolling p99 of recent requests) are retained, so
+    # the recorder always holds the current outliers even at
+    # GUBER_TRACE_SAMPLE=0. 0 disables tail capture.
+    trace_slow_ms: float = 0.0
+    # GUBER_TRACE_BUFFER: flight-recorder ring capacity (completed
+    # traces held in memory, served at /v1/debug/traces).
+    trace_buffer: int = 256
     # in-flight device batches the batcher keeps before stalling submits.
     # 2 suffices co-located (PCIe fetch ~0.1ms); raise toward ~16 when
     # the accelerator sits behind a high-latency link (fetches pipeline,
@@ -539,6 +558,12 @@ class ServerConfig:
             raise ValueError("GUBER_SKETCH_SYNC_WAIT_MS must be >= 0")
         if self.sketch_topk < 1:
             raise ValueError("GUBER_SKETCH_TOPK must be >= 1")
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError("GUBER_TRACE_SAMPLE must be in [0, 1]")
+        if self.trace_slow_ms < 0:
+            raise ValueError("GUBER_TRACE_SLOW_MS must be >= 0")
+        if self.trace_buffer < 1:
+            raise ValueError("GUBER_TRACE_BUFFER must be >= 1")
         if self.replication_sync_wait < 0:
             raise ValueError("GUBER_REPLICATION_SYNC_WAIT_MS must be >= 0")
         if self.replication_standby_keys < 1 or self.replication_backlog < 1:
@@ -725,6 +750,9 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
             env, "GUBER_SKETCH_SYNC_WAIT_MS", 0.2
         ),
         sketch_topk=_get_int(env, "GUBER_SKETCH_TOPK", 512),
+        trace_sample=float(env.get("GUBER_TRACE_SAMPLE") or 0.0),
+        trace_slow_ms=float(env.get("GUBER_TRACE_SLOW_MS") or 0.0),
+        trace_buffer=_get_int(env, "GUBER_TRACE_BUFFER", 256),
         replication=_get(env, "GUBER_REPLICATION") in ("1", "true", "yes"),
         replication_sync_wait=_get_float_ms(
             env, "GUBER_REPLICATION_SYNC_WAIT_MS", 0.1

@@ -82,6 +82,18 @@ Frame protocol (little-endian, lengths in bytes):
       level: u16 key_len | key | i64 limit | i64 duration
       (hierarchical quota chains; answered with a plain GEB4 frame —
       the chain collapses most-restrictive-wins server-side)
+  windowed traced string request (r16):
+                   u32 magic 'GEBT' | u32 n | u32 frame_id |
+                   u64 t_sent_us | 16B trace_id (big-endian) |
+                   u64 span_id | u8 trace_flags | u32 payload_len |
+                   payload (items as GEB1)
+      The distributed-tracing extension behind the HELLO_TRACE
+      capability bit: the frame carries its originating W3C-style
+      trace context (trace_flags bit 0 = sampled) and is answered
+      with a plain GEB4 frame. Legacy peers never see GEBT (the
+      client gates on the hello bit); fast 33-byte records stay
+      trace-free by design — fast frames are head-sampled
+      bridge-side instead (GUBER_TRACE_SAMPLE).
   windowed fast request (r7):
                    u32 magic 'GEB7' | u32 n | u32 frame_id |
                    u32 ring_hash | u64 t_sent_us | u32 payload_len |
@@ -140,7 +152,7 @@ from gubernator_tpu.api.types import (
     RateLimitReq,
     RateLimitResp,
 )
-from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve import metrics, tracing
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE
 from gubernator_tpu.serve.faults import FAULTS
 from gubernator_tpu.serve.stages import STAGES
@@ -157,6 +169,12 @@ MAGIC_WREQ = 0x32424547  # 'GEB2' — windowed string request (r7)
 MAGIC_WRESP = 0x34424547  # 'GEB4' — windowed string response (r7)
 MAGIC_WFAST_REQ = 0x37424547  # 'GEB7' — windowed pre-hashed request (r7)
 MAGIC_WFAST_RESP = 0x38424547  # 'GEB8' — windowed pre-hashed response
+MAGIC_WTRACE = 0x54424547  # 'GEBT' — windowed trace-extended string
+# request (r16): header as GEB2 plus the originating trace context
+# (16-byte big-endian trace id, u64 span id, u8 flags; bit 0 =
+# sampled). Answered with a plain GEB4 frame. String framing only —
+# the 33-byte fast records have no room, so fast frames are sampled
+# bridge-side instead (module docstring).
 MAGIC_WCHAIN = 0x43424547  # 'GEBC' — windowed chain-extended string
 # request (r15): header as GEB2; items as GEB1 plus a u8 level count
 # and that many (u16 key_len | key | i64 limit | i64 duration) chain
@@ -180,6 +198,15 @@ HELLO_XXH64 = 4
 # door does not speak chains — chained callers use the GEB client or
 # the daemon's HTTP/gRPC doors (documented scope limit).
 HELLO_CHAIN = 8
+# hello flags bit 4 (r16): this bridge accepts GEBT trace-extended
+# string frames (distributed tracing context, serve/tracing.py). A
+# capability of the PROTOCOL version, advertised unconditionally —
+# whether a carried context is acted on is the receiving node's
+# GUBER_TRACE_* policy (honored only while tracing is enabled at all;
+# a node with tracing off ignores carried contexts, Tracer.join).
+# Legacy peers negotiate it off by ignoring unknown bits and never
+# emitting GEBT.
+HELLO_TRACE = 16
 
 DEFAULT_WINDOW = 32
 MAX_WINDOW = 1024
@@ -247,6 +274,9 @@ _ITEM_FIX = struct.Struct("<qqqBB")
 _RESP_FIX = struct.Struct("<Bqqq")
 _WFAST_HDR = struct.Struct("<IIQ")  # frame_id | ring_hash | t_sent_us
 _WREQ_HDR = struct.Struct("<IQ")  # frame_id | t_sent_us
+# GEBT trace extension, read after _WREQ_HDR (r16):
+# trace_id (16 bytes, big-endian) | span_id | flags (bit 0 = sampled)
+_WTRACE_EXT = struct.Struct("<16sQB")
 
 # GEB6 record: the edge pre-hashes name+"_"+key with the SAME XXH64 the
 # daemon's slot store uses (edge.cc xxh64 vs native/guberhash.cc — pinned
@@ -305,6 +335,15 @@ def _fast_dtypes():
             ]
         )
     return _FAST_REQ_DTYPE, _FAST_RESP_DTYPE
+
+
+def _trace_ctx_from_ext(raw_tid: bytes, span_id: int, flags: int):
+    """GEBT extension fields -> TraceContext (None for a zero id —
+    degrade to untraced, never error, like a malformed traceparent)."""
+    tid = int.from_bytes(raw_tid, "big")
+    if tid == 0 or span_id == 0:
+        return None
+    return tracing.TraceContext(tid, span_id, bool(flags & 1))
 
 
 def decode_request_frame(
@@ -516,6 +555,10 @@ class FrameService:
     three doors cannot drift: a frame decodes, sheds, batches, and
     encodes identically wherever it arrives."""
 
+    #: door label for trace spans/records (r16); the trusted edge
+    #: bridge overrides
+    _door = "geb"
+
     def __init__(
         self,
         instance,
@@ -665,7 +708,9 @@ class FrameService:
             except Exception:
                 peers = []
         bridge_port = self._bridge_advert_port()
-        flags = HELLO_WINDOWED | (self.window << 16)
+        # HELLO_TRACE is a protocol capability (this core decodes GEBT
+        # frames), not a sampling policy — advertised unconditionally
+        flags = HELLO_WINDOWED | HELLO_TRACE | (self.window << 16)
         if getattr(getattr(self.instance, "conf", None), "chains", True):
             # advertise GEBC only when chains are actually served —
             # with the GUBER_CHAINS=0 kill switch on, the client's
@@ -1061,44 +1106,64 @@ class FrameService:
         return 0.0
 
     async def _serve_windowed(
-        self, magic, payload, n, frame_id, t_start, writer, wstate
+        self, magic, payload, n, frame_id, t_start, writer, wstate,
+        rctx=None,
     ):
         """One windowed frame, served concurrently with its siblings.
         Runs as its own task; the response is written under the
         connection's write lock whenever it completes (out of order is
         fine — the edge matches on frame_id). `t_start` is the frame's
         e2e clock start: the edge's send stamp when the frame carried
-        one, else the bridge's read time."""
+        one, else the bridge's read time. `rctx` is a GEBT frame's
+        carried trace context; frames without one are head/tail
+        sampled by this node's tracer (r16)."""
         try:
             if FAULTS.enabled:
                 # edge_frame injection point: delay stretches this
                 # frame's service; error poisons the connection (the
                 # generic handler below), like a real decode/serve crash
                 await FAULTS.inject("edge_frame")
-            if magic == MAGIC_WFAST_REQ:
-                raw = await self._decide_fast(payload, n)
-                frame = (
-                    _HDR.pack(MAGIC_WFAST_RESP, n)
-                    + struct.pack("<I", frame_id)
-                    + raw
-                )
-            elif magic == MAGIC_WCHAIN:
-                # chain-extended string frame (r15): always the object
-                # path — chains need the instance's routing/validation
-                # and are never foldable (coupled multi-key decides)
-                resps = await self._decide_string(
-                    payload, n, decoder=decode_chain_request_frame
-                )
-                frame = encode_response_frame(
-                    resps, magic=MAGIC_WRESP, frame_id=frame_id
-                )
-            else:
-                frame = await self._decide_string_frame(
-                    payload, n, magic=MAGIC_WRESP, frame_id=frame_id
-                )
-            async with wstate.write_lock:
-                writer.write(frame)
-                await writer.drain()
+            tracer = getattr(self.instance, "tracer", None)
+            trace = (
+                tracer.join(self._door, rctx)
+                if tracer is not None
+                else None
+            )
+            if trace is not None:
+                # the trace's e2e clock is the frame's (send stamp
+                # when carried), so trace duration == add_frame's e2e
+                trace.t0 = t_start
+                trace.annotate(items=n, frame_id=frame_id)
+            with tracing.scope(tracer, trace):
+                if magic == MAGIC_WFAST_REQ:
+                    raw = await self._decide_fast(payload, n)
+                    frame = (
+                        _HDR.pack(MAGIC_WFAST_RESP, n)
+                        + struct.pack("<I", frame_id)
+                        + raw
+                    )
+                elif magic == MAGIC_WCHAIN:
+                    # chain-extended string frame (r15): always the
+                    # object path — chains need the instance's
+                    # routing/validation and are never foldable
+                    # (coupled multi-key decides)
+                    resps = await self._decide_string(
+                        payload, n, decoder=decode_chain_request_frame
+                    )
+                    t_enc = time.monotonic()
+                    frame = encode_response_frame(
+                        resps, magic=MAGIC_WRESP, frame_id=frame_id
+                    )
+                    STAGES.add("encode", time.monotonic() - t_enc)
+                else:
+                    # GEB2 and GEBT (the trace extension changes the
+                    # header, not the item payload or the response)
+                    frame = await self._decide_string_frame(
+                        payload, n, magic=MAGIC_WRESP, frame_id=frame_id
+                    )
+                async with wstate.write_lock:
+                    writer.write(frame)
+                    await writer.drain()
             STAGES.add_frame(time.monotonic() - t_start)
         except asyncio.CancelledError:
             raise
@@ -1148,7 +1213,11 @@ class FrameService:
                 hdr = await reader.readexactly(_HDR.size)
                 t_frame0 = time.monotonic()
                 magic, n = _HDR.unpack(hdr)
-                if magic in (MAGIC_WFAST_REQ, MAGIC_WREQ, MAGIC_WCHAIN):
+                if magic in (
+                    MAGIC_WFAST_REQ, MAGIC_WREQ, MAGIC_WCHAIN,
+                    MAGIC_WTRACE,
+                ):
+                    rctx = None
                     if magic == MAGIC_WFAST_REQ:
                         frame_id, frame_ring, t_sent = _WFAST_HDR.unpack(
                             await reader.readexactly(_WFAST_HDR.size)
@@ -1158,6 +1227,16 @@ class FrameService:
                             await reader.readexactly(_WREQ_HDR.size)
                         )
                         frame_ring = None
+                        if magic == MAGIC_WTRACE:
+                            # trace-extended header (r16): the carried
+                            # context joins the sender's distributed
+                            # trace
+                            raw_tid, span_id, tflags = _WTRACE_EXT.unpack(
+                                await reader.readexactly(_WTRACE_EXT.size)
+                            )
+                            rctx = _trace_ctx_from_ext(
+                                raw_tid, span_id, tflags
+                            )
                     (plen,) = struct.unpack(
                         "<I", await reader.readexactly(4)
                     )
@@ -1203,6 +1282,7 @@ class FrameService:
                         self._serve_windowed(
                             magic, payload, n, frame_id,
                             t_frame0 - transit, writer, wstate,
+                            rctx=rctx,
                         )
                     )
                     task.add_done_callback(self._frame_done)
@@ -1234,9 +1314,18 @@ class FrameService:
                         return
                     self._frame_begun()
                     try:
-                        raw = await self._decide_fast(payload, n)
-                        writer.write(_HDR.pack(MAGIC_FAST_RESP, n) + raw)
-                        await writer.drain()
+                        tracer = getattr(self.instance, "tracer", None)
+                        trace = (
+                            tracer.begin(self._door)
+                            if tracer is not None
+                            else None
+                        )
+                        with tracing.scope(tracer, trace):
+                            raw = await self._decide_fast(payload, n)
+                            writer.write(
+                                _HDR.pack(MAGIC_FAST_RESP, n) + raw
+                            )
+                            await writer.drain()
                     finally:
                         self._frame_done()
                     STAGES.add_frame(time.monotonic() - t_frame0)
@@ -1273,10 +1362,17 @@ class FrameService:
                     return
                 self._frame_begun()
                 try:
-                    writer.write(
-                        await self._decide_string_frame(payload, n)
+                    tracer = getattr(self.instance, "tracer", None)
+                    trace = (
+                        tracer.begin(self._door)
+                        if tracer is not None
+                        else None
                     )
-                    await writer.drain()
+                    with tracing.scope(tracer, trace):
+                        writer.write(
+                            await self._decide_string_frame(payload, n)
+                        )
+                        await writer.drain()
                 finally:
                     self._frame_done()
                 STAGES.add_frame(time.monotonic() - t_frame0)
@@ -1291,19 +1387,23 @@ class FrameService:
             self._conns.discard(writer)
             writer.close()
 
-    async def serve_frame_bytes(self, data: bytes) -> bytes:
+    async def serve_frame_bytes(
+        self, data: bytes, remote_ctx=None
+    ) -> bytes:
         """Serve ONE complete request frame carried as a byte string
         and return the complete encoded response frame — the body-per-
         request shape of the HTTP gateway's protobuf-free POST /v1/geb
         door (serve/server.py). All request framings are accepted
-        (GEB1/GEB6 legacy, GEB2/GEB7 windowed, GEBC chain-extended —
-        the windowed frame ids are echoed but carry no pipelining
-        here: HTTP gives each frame its own request/response
-        exchange). Malformed input raises
+        (GEB1/GEB6 legacy, GEB2/GEB7 windowed, GEBC chain-extended,
+        GEBT trace-extended — the windowed frame ids are echoed but
+        carry no pipelining here: HTTP gives each frame its own
+        request/response exchange). Malformed input raises
         ValueError (the gateway answers 400); a stale-ring fast frame
         or a draining node returns a GEBR frame, exactly as on the
-        socket doors. Runs the same shed screen, stage clock, and
-        drain accounting as a socket frame."""
+        socket doors. Runs the same shed screen, stage clock, trace
+        sampling (`remote_ctx`: a traceparent header's parsed context;
+        a GEBT frame's in-band context wins), and drain accounting as
+        a socket frame."""
         if len(data) < _HDR.size:
             raise ValueError("short frame")
         magic, n = _HDR.unpack_from(data, 0)
@@ -1318,11 +1418,22 @@ class FrameService:
                 data, off
             )
             off += _WFAST_HDR.size
-        elif magic in (MAGIC_WREQ, MAGIC_WCHAIN):
+        elif magic in (MAGIC_WREQ, MAGIC_WCHAIN, MAGIC_WTRACE):
             if len(data) < off + _WREQ_HDR.size + 4:
-                raise ValueError("short GEB2/GEBC header")
+                raise ValueError("short GEB2/GEBC/GEBT header")
             frame_id, _t_sent = _WREQ_HDR.unpack_from(data, off)
             off += _WREQ_HDR.size
+            if magic == MAGIC_WTRACE:
+                if len(data) < off + _WTRACE_EXT.size + 4:
+                    raise ValueError("short GEBT trace extension")
+                raw_tid, span_id, tflags = _WTRACE_EXT.unpack_from(
+                    data, off
+                )
+                off += _WTRACE_EXT.size
+                remote_ctx = (
+                    _trace_ctx_from_ext(raw_tid, span_id, tflags)
+                    or remote_ctx
+                )
         elif magic == MAGIC_FAST_REQ:
             if len(data) < off + 8:
                 raise ValueError("short GEB6 header")
@@ -1363,30 +1474,42 @@ class FrameService:
         try:
             if FAULTS.enabled:
                 await FAULTS.inject("edge_frame")
-            if magic in (MAGIC_WFAST_REQ, MAGIC_FAST_REQ):
-                raw = await self._decide_fast(payload, n)
-                if magic == MAGIC_WFAST_REQ:
-                    frame = (
-                        _HDR.pack(MAGIC_WFAST_RESP, n)
-                        + struct.pack("<I", frame_id)
-                        + raw
+            tracer = getattr(self.instance, "tracer", None)
+            trace = (
+                tracer.join(self._door, remote_ctx)
+                if tracer is not None
+                else None
+            )
+            if trace is not None:
+                trace.t0 = t0
+                trace.annotate(items=n)
+            with tracing.scope(tracer, trace):
+                if magic in (MAGIC_WFAST_REQ, MAGIC_FAST_REQ):
+                    raw = await self._decide_fast(payload, n)
+                    if magic == MAGIC_WFAST_REQ:
+                        frame = (
+                            _HDR.pack(MAGIC_WFAST_RESP, n)
+                            + struct.pack("<I", frame_id)
+                            + raw
+                        )
+                    else:
+                        frame = _HDR.pack(MAGIC_FAST_RESP, n) + raw
+                elif magic == MAGIC_WCHAIN:
+                    # chain-extended items (r15): object path only
+                    resps = await self._decide_string(
+                        payload, n, decoder=decode_chain_request_frame
+                    )
+                    t_enc = time.monotonic()
+                    frame = encode_response_frame(
+                        resps, magic=MAGIC_WRESP, frame_id=frame_id
+                    )
+                    STAGES.add("encode", time.monotonic() - t_enc)
+                elif magic in (MAGIC_WREQ, MAGIC_WTRACE):
+                    frame = await self._decide_string_frame(
+                        payload, n, magic=MAGIC_WRESP, frame_id=frame_id
                     )
                 else:
-                    frame = _HDR.pack(MAGIC_FAST_RESP, n) + raw
-            elif magic == MAGIC_WCHAIN:
-                # chain-extended items (r15): object path only
-                resps = await self._decide_string(
-                    payload, n, decoder=decode_chain_request_frame
-                )
-                frame = encode_response_frame(
-                    resps, magic=MAGIC_WRESP, frame_id=frame_id
-                )
-            elif magic == MAGIC_WREQ:
-                frame = await self._decide_string_frame(
-                    payload, n, magic=MAGIC_WRESP, frame_id=frame_id
-                )
-            else:
-                frame = await self._decide_string_frame(payload, n)
+                    frame = await self._decide_string_frame(payload, n)
         finally:
             self._frame_done()
         STAGES.add_frame(time.monotonic() - t0)
@@ -1401,6 +1524,8 @@ class EdgeBridge(FrameService):
     r5). Windowed framing (r7) lets one connection carry `window`
     concurrent frames. Internal cluster door — see the trust boundary
     note in the module docstring."""
+
+    _door = "edge"
 
     def __init__(
         self,

@@ -134,6 +134,12 @@ class GlobalManager:
         if updates:
             await self._update_peers(updates)
 
+    def backlog_sizes(self) -> Dict[str, int]:
+        """Standing aggregation occupancy for the scrape-time
+        global_backlog_entries gauge (r16): distinct keys waiting in
+        each queue, against the GUBER_GLOBAL_BACKLOG bound."""
+        return {"hits": len(self._hits), "updates": len(self._updates)}
+
     # -- queue entry points (non-blocking, called on the serving loop) ------
 
     def queue_hit(self, r: RateLimitReq) -> None:

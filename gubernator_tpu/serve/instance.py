@@ -37,7 +37,7 @@ from gubernator_tpu.api.types import (
 )
 from gubernator_tpu.core.hashing import slot_hash_batch
 from gubernator_tpu.core.sketches import TrafficStats
-from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve import metrics, tracing
 from gubernator_tpu.serve.batcher import DeviceBatcher
 from gubernator_tpu.serve.breaker import OPEN as BREAKER_OPEN
 from gubernator_tpu.serve.config import MAX_BATCH_SIZE, ServerConfig
@@ -91,6 +91,16 @@ class Instance:
             prep_threads=getattr(conf, "prep_threads", None) or None,
         )
         self.global_mgr = GlobalManager(conf.behaviors, self)
+        # distributed tracing (r16, serve/tracing.py): per-instance so
+        # an in-process LocalCluster keeps one flight recorder per
+        # node. Disabled by default (GUBER_TRACE_SAMPLE=0,
+        # GUBER_TRACE_SLOW_MS=0) — every instrumented site then pays
+        # one branch and nothing allocates.
+        self.tracer = tracing.Tracer(
+            sample=getattr(conf, "trace_sample", 0.0),
+            slow_ms=getattr(conf, "trace_slow_ms", 0.0),
+            capacity=getattr(conf, "trace_buffer", 256),
+        )
         self.picker = ConsistentHashPicker()
         self.health = HealthCheckResp(status=HEALTHY, peer_count=0)
         self.traffic = TrafficStats()
@@ -295,8 +305,15 @@ class Instance:
 
         async def forward(i, r, peer):
             key = r.hash_key()
+            tr = tracing.active()
+            t_fwd = time.monotonic() if tr is not None else 0.0
             try:
                 resp = await peer.get_peer_rate_limit(r)
+                if tr is not None:
+                    tr.add_span(
+                        "peer_forward", start=t_fwd,
+                        peer=peer.host, items=1,
+                    )
                 resp.metadata["owner"] = peer.host
                 if shed is not None and not r.chain:
                     shed.observe_resps([fps[i]], [r], [resp])
@@ -323,10 +340,20 @@ class Instance:
             # items no longer pays per-item future/enqueue overhead
             # (the slow-path funnel the edge cluster bench exposed).
             # Failures keep per-item error parity with forward().
+            tr = tracing.active()
+            t_fwd = time.monotonic() if tr is not None else 0.0
             try:
                 resps = await peer.get_peer_rate_limits_grouped(
                     [r for _, r in items]
                 )
+                if tr is not None:
+                    # the hop span a sampled request's timeline needs:
+                    # schedule -> peer response, annotated with the
+                    # owner host (r16)
+                    tr.add_span(
+                        "peer_forward", start=t_fwd,
+                        peer=peer.host, items=len(items),
+                    )
                 for (i, r), resp in zip(items, resps):
                     resp.metadata["owner"] = peer.host
                     out[i] = resp
@@ -385,10 +412,17 @@ class Instance:
         if chain_local:
             # owned chains ride the batcher's dedicated chain lane,
             # overlapped with the plain local batch below
+            # frame attribution (r16 audit): a chain-only frame's stage
+            # span rides the chain lane; a frame with BOTH plain and
+            # chained local work flags only the plain lane (the two
+            # lanes overlap in wall time, and one frame must contribute
+            # one batch_queue/device span — the r7 chunk convention)
+            chain_frame = stage_frame and not local
+
             async def chain_decide(items):
                 try:
                     resps = await self.batcher.decide_chain(
-                        [r for _, r in items]
+                        [r for _, r in items], frame=chain_frame
                     )
                     for (i, _), resp in zip(items, resps):
                         out[i] = resp

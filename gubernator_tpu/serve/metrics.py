@@ -261,6 +261,93 @@ DRAIN_DURATION = Gauge(
     "GUBER_DRAIN_TIMEOUT_MS)",
     registry=REGISTRY,
 )
+# -- queue-visibility gauges (r16): occupancy the stage clock cannot
+# express (it times spans, not standing depth). All set lazily at
+# /metrics scrape like shed_entries — the hot paths keep plain
+# counters/queues and pay nothing.
+BATCHER_QUEUE_DEPTH = Gauge(
+    "batcher_queue_depth",
+    "Caller groups standing in the device batcher (queued + collected "
+    "+ parked carry) at scrape time",
+    registry=REGISTRY,
+)
+BATCHER_QUEUE_AGE = Gauge(
+    "batcher_queue_oldest_age_seconds",
+    "Age of the oldest caller group standing in the device batcher — "
+    "a growing value with flat depth means the flusher is wedged, not "
+    "merely busy",
+    registry=REGISTRY,
+)
+PREP_BACKLOG = Gauge(
+    "prep_pool_backlog",
+    "Arrival-prep tasks queued behind the prep pool's workers "
+    "(GUBER_PREP_THREADS); sustained backlog means prep no longer "
+    "hides inside the batcher queue wait (serve/batcher.py, r9)",
+    registry=REGISTRY,
+)
+FRAME_INFLIGHT = Gauge(
+    "frame_inflight",
+    "GEB frames accepted but not yet answered on this door (bounded "
+    "by credit window x connections); door = edge (bridge socket/TCP) "
+    "| geb (GUBER_GEB_PORT client door)",
+    ["door"],
+    registry=REGISTRY,
+)
+FRAME_CONNECTIONS = Gauge(
+    "frame_connections",
+    "Live connections on a GEB frame door (same door label set as "
+    "frame_inflight)",
+    ["door"],
+    registry=REGISTRY,
+)
+REPLICATION_BACKLOG_ENTRIES = Gauge(
+    "replication_backlog_entries",
+    "Dirty owned keys + takeover-tracked keys awaiting the next "
+    "replication flush (bounded by GUBER_REPLICATION_BACKLOG)",
+    registry=REGISTRY,
+)
+GLOBAL_BACKLOG_ENTRIES = Gauge(
+    "global_backlog_entries",
+    "Distinct keys standing in a GLOBAL aggregation queue (bounded by "
+    "GUBER_GLOBAL_BACKLOG); queue = hits (non-owner forwards) | "
+    "updates (owner broadcasts)",
+    ["queue"],
+    registry=REGISTRY,
+)
+# -- distributed tracing (r16, serve/tracing.py): recorder counters,
+# exported lazily at scrape from the per-instance flight recorder
+TRACES_STARTED = Gauge(
+    "traces_started_total",
+    "Requests that began span collection (head-sampled via "
+    "GUBER_TRACE_SAMPLE, joined from a remote sampled context, or "
+    "armed for tail capture via GUBER_TRACE_SLOW_MS)",
+    registry=REGISTRY,
+)
+TRACES_RECORDED = Gauge(
+    "traces_recorded_total",
+    "Completed traces retained in the flight recorder "
+    "(/v1/debug/traces)",
+    registry=REGISTRY,
+)
+TRACES_TAIL_CAPTURED = Gauge(
+    "traces_tail_captured_total",
+    "Traces retained by the tail rule alone: unsampled requests "
+    "slower than max(GUBER_TRACE_SLOW_MS, rolling p99)",
+    registry=REGISTRY,
+)
+TRACES_DROPPED = Gauge(
+    "traces_dropped_total",
+    "Retained traces evicted from the flight-recorder ring "
+    "(GUBER_TRACE_BUFFER bound)",
+    registry=REGISTRY,
+)
+TRACE_SLOW_THRESHOLD = Gauge(
+    "trace_slow_threshold_ms",
+    "Current tail-capture retention threshold: max of the "
+    "GUBER_TRACE_SLOW_MS floor and the rolling p99 of recent request "
+    "durations",
+    registry=REGISTRY,
+)
 
 
 def render() -> bytes:

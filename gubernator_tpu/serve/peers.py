@@ -29,7 +29,7 @@ from gubernator_tpu.api.grpc_glue import PeersV1Stub
 from gubernator_tpu.api.proto.gen import peers_pb2
 from gubernator_tpu.api.types import Behavior, RateLimitReq, RateLimitResp
 from gubernator_tpu.core.hashing import ring_hash
-from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve import metrics, tracing
 from gubernator_tpu.serve.aio import collect_batch
 from gubernator_tpu.serve.breaker import (
     OPEN as BREAKER_OPEN,
@@ -222,20 +222,41 @@ class PeerClient:
         if not reqs:
             return []
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        self._queue.put_nowait((list(reqs), fut))
+        # the caller's trace context rides the queue entry (r16): the
+        # flusher task that sends the batched RPC runs outside the
+        # caller's context, so the traceparent must be captured HERE —
+        # one branch, None for unsampled/untraced callers
+        self._queue.put_nowait(
+            (list(reqs), fut, tracing.propagation_header())
+        )
         return await fut
 
     async def get_peer_rate_limits(
-        self, reqs: Sequence[RateLimitReq]
+        self,
+        reqs: Sequence[RateLimitReq],
+        traceparent: Optional[str] = None,
     ) -> List[RateLimitResp]:
         pb_req = peers_pb2.GetPeerRateLimitsReq(
             requests=[convert.req_to_pb(r) for r in reqs]
         )
         timeout = self.conf.effective_peer_timeout()
+        if traceparent is None:
+            # direct callers (NO_BATCHING forwards, GLOBAL gossip) run
+            # in their own context; batched callers pass the captured
+            # header through _send_batch
+            traceparent = tracing.propagation_header()
+        # kwargs-style so the metadata key is ABSENT on untraced calls:
+        # test fakes (and any stub-shaped embedder hook) predating r16
+        # keep working untraced
+        kw = (
+            {"metadata": ((tracing.TRACEPARENT, traceparent),)}
+            if traceparent
+            else {}
+        )
 
         async def call() -> List[RateLimitResp]:
             pb_resp = await self.stub.GetPeerRateLimits(
-                pb_req, timeout=timeout or None
+                pb_req, timeout=timeout or None, **kw
             )
             if len(pb_resp.rate_limits) != len(reqs):
                 raise RuntimeError(
@@ -264,10 +285,15 @@ class PeerClient:
             ]
         )
         timeout = self.conf.global_timeout
+        # originating context rides along when the install happens
+        # inside a traced request (r16); the background gossip loops
+        # have no context and send bare metadata
+        tp = tracing.propagation_header()
+        kw = {"metadata": ((tracing.TRACEPARENT, tp),)} if tp else {}
 
         async def call() -> None:
             await self.stub.UpdatePeerGlobals(
-                pb_req, timeout=timeout or None
+                pb_req, timeout=timeout or None, **kw
             )
 
         await self._call_resilient(call, idempotent=True, timeout=timeout)
@@ -295,10 +321,12 @@ class PeerClient:
             ],
         )
         timeout = self.conf.global_timeout
+        tp = tracing.propagation_header()
+        kw = {"metadata": ((tracing.TRACEPARENT, tp),)} if tp else {}
 
         async def call() -> None:
             await self.stub.ReplicateBuckets(
-                pb_req, timeout=timeout or None
+                pb_req, timeout=timeout or None, **kw
             )
 
         await self._call_resilient(call, idempotent=True, timeout=timeout)
@@ -399,16 +427,16 @@ class PeerClient:
                 exc = RuntimeError(
                     f"peer client for '{self.host}' closed mid-batch"
                 )
-                for _, fut in batch:
+                for _, fut, _tp in batch:
                     if not fut.done():
                         fut.set_exception(exc)
-                for _, fut in self._carry:
+                for _, fut, _tp in self._carry:
                     if not fut.done():
                         fut.set_exception(exc)
                 self._carry.clear()
                 while True:
                     try:
-                        _, fut = self._queue.get_nowait()
+                        _, fut, _tp = self._queue.get_nowait()
                     except asyncio.QueueEmpty:
                         break
                     if not fut.done():
@@ -418,18 +446,23 @@ class PeerClient:
     async def _send_batch(self, batch) -> None:
         # groups flatten into one peer RPC; responses slice back per
         # group (reference peers.go:143-172, group-granular here)
-        reqs = [r for g, _ in batch for r in g]
+        reqs = [r for g, _, _tp in batch for r in g]
+        # one traceparent per RPC: micro-batching can coalesce groups
+        # from different traced callers, so the FIRST traced group's
+        # context represents the wire hop (documented scope limit —
+        # head sampling makes same-flush collisions rare)
+        tp = next((g[2] for g in batch if g[2]), None)
         try:
-            resps = await self.get_peer_rate_limits(reqs)
+            resps = await self.get_peer_rate_limits(reqs, traceparent=tp)
         except Exception as e:  # entire batch failed (peers.go:186-192)
-            for _, fut in batch:
+            for _, fut, _tp in batch:
                 if not fut.done():
                     fut.set_exception(
                         RuntimeError(f"while fetching from peer - '{e}'")
                     )
             return
         k = 0
-        for g, fut in batch:
+        for g, fut, _tp in batch:
             span = resps[k : k + len(g)]
             k += len(g)
             if not fut.done():

@@ -177,6 +177,13 @@ class ReplicationManager:
     def standby_len(self) -> int:
         return len(self._standby)
 
+    @property
+    def backlog_len(self) -> int:
+        """Dirty owned keys + takeover-tracked keys awaiting the next
+        flush — the scrape-time replication_backlog_entries gauge
+        (r16), against the GUBER_REPLICATION_BACKLOG bound."""
+        return len(self._dirty) + len(self._taken)
+
     # -- owner-side queueing (hot path: two dict ops) -----------------------
 
     def queue_dirty(self, r: RateLimitReq) -> None:

@@ -23,7 +23,7 @@ from aiohttp import web
 from gubernator_tpu.api import convert
 from gubernator_tpu.api.grpc_glue import add_peers_servicer, add_v1_servicer
 from gubernator_tpu.api.proto.gen import gubernator_pb2, peers_pb2
-from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve import metrics, tracing
 from gubernator_tpu.serve.backends import (
     ExactBackend,
     MeshBackend,
@@ -200,14 +200,34 @@ class StatsInterceptor(grpc.aio.ServerInterceptor):
         )
 
 
+def _md_traceparent(context) -> "Optional[str]":
+    """The traceparent entry of an RPC's invocation metadata, or None.
+    One pass over a handful of per-RPC metadata pairs — never per-item
+    work, so the untraced path stays flat."""
+    try:
+        for k, v in context.invocation_metadata() or ():
+            if k == tracing.TRACEPARENT:
+                return v
+    except Exception:  # pragma: no cover - defensive
+        pass
+    return None
+
+
 class V1Servicer:
     def __init__(self, instance: Instance):
         self.instance = instance
 
     async def GetRateLimits(self, request, context):
         reqs = [convert.req_from_pb(p) for p in request.requests]
+        tracer = self.instance.tracer
+        trace = tracer.join(
+            "grpc", tracing.parse_traceparent(_md_traceparent(context))
+        )
         try:
-            resps = await self.instance.get_rate_limits(reqs)
+            with tracing.scope(tracer, trace) as tr:
+                if tr is not None:
+                    tr.annotate(items=len(reqs))
+                resps = await self.instance.get_rate_limits(reqs)
         except BatchTooLargeError as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         return gubernator_pb2.GetRateLimitsResp(
@@ -227,8 +247,19 @@ class PeersV1Servicer:
 
     async def GetPeerRateLimits(self, request, context):
         reqs = [convert.req_from_pb(p) for p in request.requests]
+        # owner-serve hop of a distributed trace (r16): a forwarding
+        # peer's sampled context arrives as gRPC metadata; the owner
+        # records its own queue/device spans under the SAME trace id
+        # in its own flight recorder
+        tracer = self.instance.tracer
+        trace = tracer.join(
+            "peers", tracing.parse_traceparent(_md_traceparent(context))
+        )
         try:
-            resps = await self.instance.get_peer_rate_limits(reqs)
+            with tracing.scope(tracer, trace) as tr:
+                if tr is not None:
+                    tr.annotate(items=len(reqs))
+                resps = await self.instance.get_peer_rate_limits(reqs)
         except BatchTooLargeError as e:
             await context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         return peers_pb2.GetPeerRateLimitsResp(
@@ -240,7 +271,17 @@ class PeersV1Servicer:
             (g.key, convert.resp_from_pb(g.status))
             for g in request.globals
         ]
-        await self.instance.update_peer_globals(updates)
+        # background gossip sends bare metadata; only an install that
+        # originated inside a traced request carries context here
+        tracer = self.instance.tracer
+        tp = _md_traceparent(context)
+        trace = (
+            tracer.join("peers_update", tracing.parse_traceparent(tp))
+            if tp
+            else None
+        )
+        with tracing.scope(tracer, trace):
+            await self.instance.update_peer_globals(updates)
         return peers_pb2.UpdatePeerGlobalsResp()
 
     async def ReplicateBuckets(self, request, context):
@@ -259,7 +300,17 @@ class PeersV1Servicer:
             )
             for b in request.buckets
         ]
-        await self.instance.replicate_buckets(request.owner, snaps)
+        tracer = self.instance.tracer
+        tp = _md_traceparent(context)
+        trace = (
+            tracer.join(
+                "peers_replicate", tracing.parse_traceparent(tp)
+            )
+            if tp
+            else None
+        )
+        with tracing.scope(tracer, trace):
+            await self.instance.replicate_buckets(request.owner, snaps)
         return peers_pb2.ReplicateBucketsResp()
 
 
@@ -603,6 +654,7 @@ class Server:
         app.router.add_get("/metrics", self._http_metrics)
         app.router.add_get("/v1/debug/stats", self._http_debug_stats)
         app.router.add_get("/v1/debug/stages", self._http_debug_stages)
+        app.router.add_get("/v1/debug/traces", self._http_debug_traces)
         app.router.add_get("/v1/debug/profile", self._http_debug_profile)
         self._http_runner = web.AppRunner(app)
         await self._http_runner.setup()
@@ -670,8 +722,21 @@ class Server:
             return web.json_response(
                 {"error": f"invalid request item: {e}"}, status=400
             )
+        # traceparent on the JSON door (r16): an incoming sampled
+        # context joins the distributed trace; otherwise head/tail
+        # sampling applies exactly as on the socket doors
+        tracer = self.instance.tracer
+        trace = tracer.join(
+            "http",
+            tracing.parse_traceparent(
+                request.headers.get(tracing.TRACEPARENT)
+            ),
+        )
         try:
-            resps = await self.instance.get_rate_limits(reqs)
+            with tracing.scope(tracer, trace) as tr:
+                if tr is not None:
+                    tr.annotate(items=len(reqs))
+                resps = await self.instance.get_rate_limits(reqs)
         except BatchTooLargeError as e:
             return web.json_response({"error": str(e)}, status=400)
         return web.json_response(
@@ -763,7 +828,16 @@ class Server:
             chunks.append(chunk)
         body = b"".join(chunks)
         try:
-            resp = await self._frame_core().serve_frame_bytes(body)
+            # a traceparent header on the binary door joins the frame
+            # to an existing trace (the GEBT in-frame extension works
+            # here too; the header covers clients that can set HTTP
+            # headers more easily than re-framing)
+            resp = await self._frame_core().serve_frame_bytes(
+                body,
+                remote_ctx=tracing.parse_traceparent(
+                    request.headers.get(tracing.TRACEPARENT)
+                ),
+            )
         except (ValueError, struct.error) as e:
             # struct.error covers truncated varlen payloads that pass
             # the outer length checks — client garbage, still a 400
@@ -822,6 +896,36 @@ class Server:
         for name, s in snap["stages"].items():
             metrics.STAGE_SECONDS.labels(stage=name).set(s["total_s"])
             metrics.STAGE_SAMPLES.labels(stage=name).set(s["count"])
+        # queue-visibility gauges (r16): standing occupancy the stage
+        # clock can't express, set lazily at scrape like shed_entries
+        qs = self.instance.batcher.queue_stats()
+        metrics.BATCHER_QUEUE_DEPTH.set(qs["depth"])
+        metrics.BATCHER_QUEUE_AGE.set(qs["oldest_age_s"])
+        metrics.PREP_BACKLOG.set(qs["prep_backlog"])
+        for door, svc in (("edge", self._edge), ("geb", self._geb)):
+            if svc is not None:
+                metrics.FRAME_INFLIGHT.labels(door=door).set(
+                    svc._active_frames
+                )
+                metrics.FRAME_CONNECTIONS.labels(door=door).set(
+                    len(svc._conns)
+                )
+        if self.instance.repl is not None:
+            metrics.REPLICATION_BACKLOG_ENTRIES.set(
+                self.instance.repl.backlog_len
+            )
+        for queue, size in (
+            self.instance.global_mgr.backlog_sizes().items()
+        ):
+            metrics.GLOBAL_BACKLOG_ENTRIES.labels(queue=queue).set(size)
+        # flight-recorder counters (r16): plain ints on the recorder,
+        # exported here
+        rec = self.instance.tracer.recorder
+        metrics.TRACES_STARTED.set(rec.started)
+        metrics.TRACES_RECORDED.set(rec.recorded)
+        metrics.TRACES_TAIL_CAPTURED.set(rec.tail_captured)
+        metrics.TRACES_DROPPED.set(rec.dropped)
+        metrics.TRACE_SLOW_THRESHOLD.set(rec.threshold_ms())
 
     async def _http_debug_stats(self, request: web.Request):
         """Traffic observability: HLL cardinality + top hot keys + backend
@@ -860,6 +964,36 @@ class Server:
             body["shed_cache"] = shed.stats()
         return web.json_response(body)
 
+    async def _http_debug_traces(self, request: web.Request):
+        """The flight recorder (r16, serve/tracing.py): completed
+        sampled + tail-captured traces, newest last. `?id=<32-hex>`
+        fetches one trace by id (404 when it aged out of the ring);
+        `?limit=N` bounds the listing (default 64); `?reset=1` clears
+        the ring and counters (a profiler scopes a window with it,
+        like /v1/debug/stages)."""
+        rec = self.instance.tracer.recorder
+        if request.query.get("reset") in ("1", "true"):
+            rec.reset()
+        tid = request.query.get("id", "")
+        if tid:
+            doc = rec.get(tid)
+            if doc is None:
+                return web.json_response(
+                    {"error": f"no retained trace with id '{tid}'"},
+                    status=404,
+                )
+            return web.json_response(doc)
+        try:
+            limit = int(request.query.get("limit", "64"))
+        except ValueError:
+            return web.json_response(
+                {"error": "'limit' must be an integer"}, status=400
+            )
+        body = rec.snapshot(limit=max(0, limit))
+        body["sample"] = self.instance.tracer.sample
+        body["slow_ms"] = self.instance.tracer.slow_ms
+        return web.json_response(body)
+
     async def _http_debug_profile(self, request: web.Request):
         """Capture a JAX/XLA device profile for ?ms= milliseconds (default
         1000) and write it under /tmp/guber-profile/<?name=> (?name= is a
@@ -872,6 +1006,32 @@ class Server:
 
         import os.path
 
+        if request.query.get("list") in ("1", "true"):
+            # served artifact dir (r16): enumerate captured profiles so
+            # an operator can find what to pull into TensorBoard/
+            # Perfetto without shelling into the box
+            base = "/tmp/guber-profile"
+            out = []
+            try:
+                for name in sorted(os.listdir(base)):
+                    d = os.path.join(base, name)
+                    if not os.path.isdir(d):
+                        continue
+                    files = size = 0
+                    for dp, _, fs in os.walk(d):
+                        files += len(fs)
+                        size += sum(
+                            os.path.getsize(os.path.join(dp, f))
+                            for f in fs
+                        )
+                    out.append(
+                        {"name": name, "files": files, "bytes": size}
+                    )
+            except FileNotFoundError:
+                pass
+            return web.json_response(
+                {"base_dir": base, "profiles": out}
+            )
         try:
             ms = int(request.query.get("ms", "1000"))
         except ValueError:

@@ -73,6 +73,17 @@ Stages form two families:
 Everything is a plain float accumulation under one lock — ~0.5us per
 record — so the clock can stay on in production. `/metrics` exports
 the same totals as gauges (serve/metrics.py stage_seconds_total).
+
+The chain lane (r15) participates in BOTH families like the decide
+lanes (r16 audit fix): a frame-flagged chained group records
+batch_queue and device spans, and the serialized chain call records
+submit_host on the submit thread — before this, chained traffic added
+frame e2e with no per-frame stages and silently diluted coverage.
+
+Tracing tie-in (r16, serve/tracing.py): when the caller's context
+carries an active trace, `add` forwards the same span into it — the
+distributed tracer reuses these timings instead of running a second
+clock. One ContextVar read per record when tracing is idle.
 """
 
 from __future__ import annotations
@@ -80,6 +91,8 @@ from __future__ import annotations
 import threading
 import time
 from typing import Dict, Tuple
+
+from gubernator_tpu.serve import tracing
 
 PER_FRAME = (
     "edge_to_bridge",
@@ -106,6 +119,11 @@ class StageStats:
     def add(self, stage: str, seconds: float, n: int = 1) -> None:
         if seconds < 0:  # clock skew guard (edge stamp from the future)
             return
+        tr = tracing.active()
+        if tr is not None:
+            # the span just ended and lasted `seconds`: the trace gets
+            # the stage clock's own timing, not a parallel measurement
+            tr.add_span(stage, duration_s=seconds)
         with self._lock:
             total, count = self._stages.get(stage, (0.0, 0))
             self._stages[stage] = (total + seconds, count + n)

@@ -1,0 +1,82 @@
+"""/metrics documentation drift gate (r16 satellite).
+
+Every metric family declared in serve/metrics.py must be documented in
+docs/operations.md — the same no-drift contract check_knobs.py applies
+to GUBER_* env knobs, wired as a tier-1 test
+(tests/test_check_metrics.py). A metric an operator cannot look up is
+a metric that gets ignored during the incident it was built for.
+
+Declarations are detected by AST, not grep: a top-level (or otherwise
+reachable) `Counter(...)`, `Gauge(...)`, or `Histogram(...)` call whose
+first argument is a string literal declares that family name.
+Prometheus appends `_total` to Counter exposition names; the doc may
+use either the declared or the exposed spelling.
+
+Usage: python scripts/check_metrics.py   # exit 0 = documented, 1 = drift
+"""
+
+from __future__ import annotations
+
+import ast
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+METRICS = ROOT / "gubernator_tpu" / "serve" / "metrics.py"
+DOC = "docs/operations.md"
+
+METRIC_TYPES = {"Counter", "Gauge", "Histogram", "Summary"}
+
+
+def declared_metrics(path: pathlib.Path = METRICS) -> list:
+    """Metric family names declared in serve/metrics.py, in file
+    order."""
+    tree = ast.parse(path.read_text())
+    names = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        ctor = fn.id if isinstance(fn, ast.Name) else getattr(
+            fn, "attr", ""
+        )
+        if ctor not in METRIC_TYPES or not node.args:
+            continue
+        first = node.args[0]
+        if isinstance(first, ast.Constant) and isinstance(
+            first.value, str
+        ):
+            names.append(first.value)
+    return names
+
+
+def main() -> int:
+    names = declared_metrics()
+    if not names:
+        print(
+            "no metric declarations found in serve/metrics.py — "
+            "scanner broken?",
+            file=sys.stderr,
+        )
+        return 2
+    text = (ROOT / DOC).read_text()
+    missing = [
+        n
+        for n in names
+        # Counters expose with a _total suffix; accept either spelling
+        if n not in text and (n + "_total") not in text
+    ]
+    for n in missing:
+        print(f"{DOC}: missing metric {n}", file=sys.stderr)
+    if missing:
+        return 1
+    print(
+        f"{len(names)} metric families declared in serve/metrics.py, "
+        f"all documented in {DOC}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -78,6 +78,7 @@ GATED = (
     "sketch_r13",
     "shard_r14",
     "chain_r15",
+    "trace_r16",
     "frontdoor_geb_over_grpc",
     "frontdoor_http_over_grpc",
 )
@@ -506,6 +507,42 @@ def main() -> int:
         )
         measured["chain_r15"], detail["chain_r15"] = m, rows
 
+        # -- trace_r16: sampling OFF vs 1%, zipf shape ---------------
+        # Same GEB workload against the flat stack; A = tracing fully
+        # off (the default), B = GUBER_TRACE_SAMPLE=0.01. The ratio
+        # prices the whole r16 instrumentation envelope — the
+        # per-site branches every request pays plus span collection
+        # for the sampled 1% — and the committed baseline pins the
+        # "disabled is ~zero-cost / 1% is <=10%" contract.
+        print(
+            "workload trace_r16 (sampling off vs 1%)...",
+            file=sys.stderr,
+        )
+        tracer = instance.tracer
+
+        def flip_trace(p):
+            async def f():
+                tracer.sample = p
+
+            cluster.run(f())
+
+        def trace_drive(s):
+            return _loadgen(
+                "geb", SOCK, s, 0.0, args.concurrency, args.batch,
+                keyspace=30_000,
+            )["decisions_per_sec"]
+
+        def trace_on(s):
+            flip_trace(0.01)
+            try:
+                return trace_drive(s)
+            finally:
+                flip_trace(0.0)
+
+        m, rows = paired("trace_r16", trace_drive, trace_on,
+                         args.seconds, args.rounds)
+        measured["trace_r16"], detail["trace_r16"] = m, rows
+
         # -- front-door ladder: grpc vs geb vs http ------------------
         print("front-door ladder (grpc / geb / http)...", file=sys.stderr)
         doors = {
@@ -631,6 +668,13 @@ def main() -> int:
                             "keyspace-30k zipf shape (chain-lane "
                             "expansion price)",
                     "committed": round(measured["chain_r15"], 4),
+                },
+                "trace_r16": {
+                    "artifact": "BENCH_TRACE_r16.json",
+                    "pair": "tracing off vs GUBER_TRACE_SAMPLE=0.01, "
+                            "keyspace-30k zipf shape (distributed-"
+                            "tracing instrumentation price)",
+                    "committed": round(measured["trace_r16"], 4),
                 },
                 "frontdoor_geb_over_grpc": {
                     "artifact": "BENCH_FRONTDOOR_r12.json",

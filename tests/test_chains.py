@@ -529,3 +529,70 @@ def test_level_duration_inheritance():
     # the explicit 30s level still holds its consumed hit
     assert _peek(be, "dt", limit=5, duration=30_000,
                  now=T0 + 1500).remaining == 4
+
+
+def test_chain_lane_records_frame_stages():
+    """r16 frame-coverage audit pin: a GEBC chain frame's batch must
+    record the SAME per-frame (batch_queue, device, encode) and
+    per-batch (submit_host) stages the decide lanes record — before
+    the fix, chained frames added e2e with no stages and silently
+    diluted the r7 coverage contract under chained traffic."""
+    from gubernator_tpu.client_geb import build_frame
+    from gubernator_tpu.serve.edge_bridge import FrameService
+    from gubernator_tpu.serve.stages import STAGES
+
+    async def run():
+        inst = await _mk_instance()
+        try:
+            svc = FrameService(inst)
+            STAGES.reset()
+            frame, is_fast = build_frame(
+                [_chain_req("sc", chain=(("sg", 100, 0),))],
+                fast=False, windowed=True, frame_id=7,
+            )
+            assert not is_fast
+            resp = await svc.serve_frame_bytes(frame)
+            assert resp  # well-formed GEB4 answer
+            snap = STAGES.snapshot()
+            st = snap["stages"]
+            assert snap["frames"] == 1
+            for stage in ("batch_queue", "device", "encode",
+                          "submit_host"):
+                assert st.get(stage, {}).get("count", 0) >= 1, (
+                    stage, st,
+                )
+            # the recorded per-frame stages actually tile frame e2e
+            # (loose floor: sub-ms spans on a loaded CI box)
+            assert snap["coverage"] > 0.1, snap
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_chain_frame_flag_prefers_plain_lane_on_mixed_frames():
+    """One frame = one per-frame span (the r7 chunk convention): a
+    frame carrying BOTH plain and chained items flags only the plain
+    lane; a chain-only frame flags the chain lane."""
+    from gubernator_tpu.serve.stages import STAGES
+
+    async def run():
+        inst = await _mk_instance()
+        try:
+            STAGES.reset()
+            await inst.get_rate_limits(
+                [
+                    _chain_req("mx", chain=(("mg", 100, 0),)),
+                    _chain_req("plain-mx"),  # no chain levels
+                ],
+                stage_frame=True,
+            )
+            snap = STAGES.snapshot()["stages"]
+            # exactly ONE frame-attributed device span between the two
+            # lanes (the plain lane's), not two
+            assert snap.get("device", {}).get("count") == 1, snap
+            assert snap.get("batch_queue", {}).get("count") == 1, snap
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())

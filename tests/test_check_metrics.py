@@ -1,0 +1,58 @@
+"""Tier-1 drift gate: every metric family declared in serve/metrics.py
+must be documented in docs/operations.md (r16 satellite; the same
+no-drift contract check_knobs.py applies to GUBER_* env knobs). Run
+`python scripts/check_metrics.py` for the per-metric diff."""
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _mod():
+    sys.path.insert(0, str(ROOT / "scripts"))
+    try:
+        import check_metrics
+    finally:
+        sys.path.pop(0)
+    return check_metrics
+
+
+def test_scanner_finds_real_declarations():
+    names = _mod().declared_metrics()
+    # spot-check one family per declaration shape/era: a reference
+    # Counter, a histogram, a labelled gauge, and r16 additions
+    for n in (
+        "grpc_request_counts",
+        "device_batch_size",
+        "peer_breaker_state",
+        "batcher_queue_depth",
+        "traces_recorded_total",
+    ):
+        assert n in names, (n, sorted(names))
+    # names are unique (a duplicate declaration would crash prometheus
+    # at import, but the scanner must not mask one either)
+    assert len(names) == len(set(names))
+
+
+def test_scanner_detects_ctor_shapes(tmp_path):
+    """Direct and attribute-qualified constructor calls must both
+    count; non-literal first args must not crash the scan."""
+    p = tmp_path / "m.py"
+    p.write_text(
+        "from prometheus_client import Counter, Gauge\n"
+        "import prometheus_client as pc\n"
+        'A = Counter("direct_ctor_total", "d")\n'
+        'B = pc.Gauge("attr_ctor", "d")\n'
+        "name = 'dynamic'\n"
+        "C = Counter(name, 'd')\n"  # non-literal: skipped, no crash
+    )
+    names = _mod().declared_metrics(p)
+    assert names == ["direct_ctor_total", "attr_ctor"]
+
+
+def test_every_declared_metric_is_documented():
+    assert _mod().main() == 0, (
+        "metric declared in serve/metrics.py missing from "
+        "docs/operations.md — run scripts/check_metrics.py"
+    )
