@@ -1,5 +1,6 @@
 .PHONY: all native proto test bench readme readme-check profile-stages \
-	profile-submit profile-shed profile-trace chaos perf-gate clean
+	profile-submit profile-shed profile-trace chaos chaos-rolling \
+	perf-gate clean
 
 all: native proto
 
@@ -102,6 +103,18 @@ CHAOS_OUT ?= BENCH_CHAOS_r11.json
 chaos:
 	python scripts/chaos_soak.py --seconds $(CHAOS_SECONDS) \
 	  --json $(CHAOS_OUT)
+
+# rolling-deploy soak (r17): the same 3 daemons on etcd discovery
+# (in-process fake, real gRPC) with GUBER_RESCALE=1, every node
+# SIGTERMed + restarted in sequence under live load; asserts ZERO
+# under-admissions on a tracked over-limit canary through all six
+# membership changes and handoff lag under 2 flush windows.
+# make chaos-rolling ROLL_SECONDS=30 ROLL_OUT=x.json
+ROLL_SECONDS ?= 20
+ROLL_OUT ?= BENCH_RESCALE_r17.json
+chaos-rolling:
+	python scripts/chaos_soak.py --mode rolling \
+	  --seconds $(ROLL_SECONDS) --json $(ROLL_OUT)
 
 clean:
 	$(MAKE) -C gubernator_tpu/native clean

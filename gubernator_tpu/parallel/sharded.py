@@ -1606,6 +1606,104 @@ class PartitionedEngine:
         live = match & (rows[:, :, L_EXPIRE] >= e_now)
         return live.any(axis=1)[:n]
 
+    # -- elastic re-partition (r17) ------------------------------------------
+
+    def export_windows(self, now: Optional[int] = None) -> dict:
+        """Host-side read of EVERY live token window in the store:
+        {key_hash uint64[m], limit, remaining, reset_time (unix-ms),
+        is_over} — the full-store twin of snapshot_read, enumerating
+        entries instead of looking keys up. Each entry's key hash is
+        reconstructed from its L_TAG|L_KEYLOW lanes (the r14 layout
+        keeps the full 64 bits precisely so store state stays
+        re-addressable); the one lossy case is a hash whose high 32
+        bits were zero (fingerprints() coerces the tag to 1, ~2^-32
+        per key). `is_over` carries the FLAG_STICKY_OVER bit ONLY —
+        an exhausted-but-not-sticky window must reinstall as exactly
+        that (a sticky bit added in transit would flip its peek
+        answers from UNDER to OVER). Non-token entries (leaky /
+        sliding / GCRA state) are out of scope, the r11 replication
+        exclusion. Non-mutating; submit-thread contract like
+        snapshot_read."""
+        from gubernator_tpu.core.store import (
+            FLAG_ALGO_MASK,
+            FLAG_STICKY_OVER,
+            L_EXPIRE,
+            L_FLAGS,
+            L_KEYLOW,
+            L_LIMIT,
+            L_REMAINING,
+            L_TAG,
+            LANES,
+        )
+
+        empty = dict(
+            key_hash=np.empty(0, np.uint64),
+            limit=np.empty(0, np.int64),
+            remaining=np.empty(0, np.int64),
+            reset_time=np.empty(0, np.int64),
+            is_over=np.empty(0, bool),
+        )
+        if self.clock.epoch is None:
+            return empty  # nothing ever decided
+        if now is None:
+            now = api_types.millisecond_now()
+        e_now = int(self.clock.to_engine(now))
+        ent = np.asarray(jax.device_get(self.store.data)).reshape(
+            -1, LANES
+        )
+        live = (
+            (ent[:, L_TAG] != 0)
+            & (ent[:, L_EXPIRE] >= e_now)
+            & ((ent[:, L_FLAGS] & FLAG_ALGO_MASK) == 0)
+        )
+        ent = ent[live]
+        if not ent.shape[0]:
+            return empty
+        hi = ent[:, L_TAG].astype(np.int64).view(np.uint64) & np.uint64(
+            0xFFFFFFFF
+        )
+        lo = ent[:, L_KEYLOW].astype(np.int64).view(
+            np.uint64
+        ) & np.uint64(0xFFFFFFFF)
+        return dict(
+            key_hash=(hi << np.uint64(32)) | lo,
+            limit=ent[:, L_LIMIT].astype(np.int64),
+            remaining=ent[:, L_REMAINING].astype(np.int64),
+            reset_time=np.asarray(
+                self.clock.from_engine(ent[:, L_EXPIRE]), np.int64
+            ),
+            is_over=(ent[:, L_FLAGS] & FLAG_STICKY_OVER) != 0,
+        )
+
+    def repartition(
+        self, policy: ShardingPolicy, now: Optional[int] = None
+    ) -> "PartitionedEngine":
+        """A NEW engine under `policy` carrying every live token window
+        of this one: export_windows -> install_windows under the new
+        ShardingPolicy — the store re-partition path a GUBER_SHARDS
+        change drives (serve/backends.py MeshBackend.repartition).
+        Same geometry/ladder/sketch config; sketch-tier counts do NOT
+        migrate (window-keyed, transient — the loss direction is a
+        one-window over-admission in the cold tier, same as a store
+        reset, and the hot exact tier moves losslessly). Call with the
+        batcher idle or on its serialized submit thread; warm the new
+        engine before serving."""
+        if now is None:
+            now = api_types.millisecond_now()
+        eng = PartitionedEngine(
+            self.config,
+            policy=policy,
+            buckets=self.buckets,
+            sketch=self.sketch_config,
+        )
+        w = self.export_windows(now)
+        if w["key_hash"].shape[0]:
+            eng.install_windows(
+                w["key_hash"], w["limit"], w["remaining"],
+                w["reset_time"], w["is_over"], now=now,
+            )
+        return eng
+
     # -- GLOBAL install / sync ----------------------------------------------
 
     def _upsert_padded(self, hashes, lim, rem, reset, over, valid):

@@ -615,6 +615,32 @@ class MeshBackend(_ArrayOps):
             now=now,
         )
 
+    def repartition(self, devices=None, now=None) -> None:
+        """Re-shard the live store over a different device set — the
+        GUBER_SHARDS-change path (r17): every live token window of the
+        current engine exports host-side and reinstalls under the new
+        ShardingPolicy (parallel/sharded.py repartition), then the new
+        engine replaces the old in place. One device (or an empty
+        list's single default) degenerates to the flat policy — the
+        same engine class either way (r14). MUST run with the batcher
+        idle or on its serialized submit thread
+        (DeviceBatcher.run_serialized): the export reads and the
+        install upserts the donated store. Callers re-warm before
+        serving traffic (warmup())."""
+        import jax
+
+        from gubernator_tpu.parallel.policy import ShardingPolicy
+
+        if devices is None:
+            devices = jax.devices()
+        devices = list(devices)
+        policy = (
+            ShardingPolicy.single(devices[0])
+            if len(devices) == 1
+            else ShardingPolicy.over_mesh(devices)
+        )
+        self.engine = self.engine.repartition(policy, now=now)
+
     def warmup(self) -> None:
         # The decide path pads PER-SHARD sub-batches to the dense
         # sub-rung ladder (sharded.sub_batch_ladder); warmup_public

@@ -330,6 +330,27 @@ class ServerConfig:
     # past either bound are counted in replication_dropped_total.
     replication_standby_keys: int = 1 << 16  # GUBER_REPLICATION_STANDBY_KEYS
     replication_backlog: int = 1 << 16  # GUBER_REPLICATION_BACKLOG
+    # Elastic ring rescale (r17, serve/rescale.py; GUBER_RESCALE=1 to
+    # enable, OFF by default): on every membership change, owned token
+    # windows whose keys the NEW ring routes elsewhere are snapshot-read
+    # (non-mutating) and handed to their new owners over the r11
+    # ReplicateBuckets RPC (LWW installs), so deploys and autoscaling
+    # reassign ownership WITHOUT quota amnesia; a SIGTERM drain ships
+    # every tracked window to the ring-minus-self owners BEFORE
+    # deregistering. With a static ring, ON is byte-identical to OFF
+    # (tests/test_rescale.py pins it differentially). Shares
+    # GUBER_REPLICATION_SYNC_WAIT_MS as its flush/reconcile tick.
+    rescale: bool = False
+    # Double-serve window after a ring change: forwarders keep routing
+    # MOVED keys to their old (warm) owner for this long while the new
+    # owner installs the handoff, then flip; the old owner re-flushes
+    # absorbed hits at the window end (LWW reconcile). 0 disables the
+    # routing override (handoff + seed-on-first-touch still apply).
+    rescale_double_serve: float = 0.5  # GUBER_RESCALE_DOUBLE_SERVE_MS
+    # Bound on the tracked owned-window table (freshest-touched kept)
+    # and on the receiver-side pending handoff table used when
+    # replication is off; evictions count in rescale_dropped_total.
+    rescale_track_keys: int = 1 << 16  # GUBER_RESCALE_TRACK_KEYS
     # Distributed tracing + flight recorder (r16, serve/tracing.py).
     # GUBER_TRACE_SAMPLE: head-sampling probability in [0, 1] — a
     # sampled request collects spans across every hop (edge/bridge
@@ -571,6 +592,10 @@ class ServerConfig:
                 "GUBER_REPLICATION_STANDBY_KEYS / GUBER_REPLICATION_BACKLOG "
                 "must be >= 1"
             )
+        if self.rescale_double_serve < 0:
+            raise ValueError("GUBER_RESCALE_DOUBLE_SERVE_MS must be >= 0")
+        if self.rescale_track_keys < 1:
+            raise ValueError("GUBER_RESCALE_TRACK_KEYS must be >= 1")
         if self.store_mib < 0 or self.store_target_keys < 0:
             raise ValueError(
                 "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
@@ -762,6 +787,13 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         ),
         replication_backlog=_get_int(
             env, "GUBER_REPLICATION_BACKLOG", 1 << 16
+        ),
+        rescale=_get(env, "GUBER_RESCALE") in ("1", "true", "yes"),
+        rescale_double_serve=_get_float_ms(
+            env, "GUBER_RESCALE_DOUBLE_SERVE_MS", 0.5
+        ),
+        rescale_track_keys=_get_int(
+            env, "GUBER_RESCALE_TRACK_KEYS", 1 << 16
         ),
         # prep_at_arrival / prep_threads deliberately NOT resolved
         # here: their None/0 defaults defer to DeviceBatcher, the

@@ -43,6 +43,19 @@ class StaticPool:
         self.on_update = on_update
 
     async def start(self) -> None:
+        await self._push()
+
+    async def update(self, peers: Sequence[str]) -> None:
+        """Replace the static membership and push the change — the
+        ring-change notification for embedders driving membership by
+        hand (the etcd/k8s pools watch for theirs). A static-peers
+        deployment scaling by config reload funnels through the same
+        on_update -> Instance.set_peers -> rescale handoff path as a
+        watched one (serve/rescale.py)."""
+        self.peers = list(peers)
+        await self._push()
+
+    async def _push(self) -> None:
         await self.on_update(
             [
                 PeerInfo(address=p, is_owner=(p == self.advertise))

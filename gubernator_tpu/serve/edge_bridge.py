@@ -1009,12 +1009,23 @@ class FrameService:
 
         self.instance.traffic.observe(full, fields["key_hash"])
         repl = getattr(self.instance, "repl", None)
-        if repl is not None:
+        resc = getattr(self.instance, "rescale", None)
+        if repl is not None or resc is not None:
             # folded frames are all-owned by construction: their
-            # windows must dirty the replication queue like any other
-            # owner decide (pre-hashed fast frames carry no key
-            # strings and cannot — documented scope limit)
-            repl.queue_dirty_fields(full, fields)
+            # windows must dirty the replication queue and join the
+            # rescale tracked set like any other owner decide
+            # (pre-hashed fast frames carry no key strings and cannot
+            # — documented scope limit). One eligibility screen feeds
+            # both managers.
+            from gubernator_tpu.serve.replication import (
+                eligible_field_indices,
+            )
+
+            elig = eligible_field_indices(fields)
+            if repl is not None:
+                repl.queue_dirty_fields(full, fields, elig=elig)
+            if resc is not None:
+                resc.note_owned_fields(full, fields, elig=elig)
         status, limit, remaining, reset = (
             await self._decide_arrays_shed(fields, n)
         )

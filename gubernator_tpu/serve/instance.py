@@ -138,6 +138,25 @@ class Instance:
             self.repl = ReplicationManager(conf, self)
         else:
             self.repl = None
+        # elastic ring rescale (r17, serve/rescale.py): planned state
+        # handoff on every membership change — moved keys' windows ship
+        # to their new ring owners, with a bounded double-serve window
+        # and LWW reconcile, so deploys and autoscaling never cause
+        # quota amnesia. OFF by default (GUBER_RESCALE=0); needs the
+        # same non-mutating snapshot surface as replication.
+        if getattr(conf, "rescale", False):
+            if getattr(backend, "snapshot_read", None) is None:
+                raise ValueError(
+                    "GUBER_RESCALE=1 needs a backend with a "
+                    "non-mutating snapshot_read surface (exact/tpu/"
+                    f"mesh); backend '{conf.backend}' does not expose "
+                    "one"
+                )
+            from gubernator_tpu.serve.rescale import RescaleManager
+
+            self.rescale = RescaleManager(conf, self)
+        else:
+            self.rescale = None
         # sketch-tier promoter (r13, serve/promoter.py): streaming
         # SpaceSaving top-K over dispatched key hashes; hot sketch-tier
         # keys migrate into exact buckets on a flush-tick cadence, and
@@ -157,12 +176,16 @@ class Instance:
         self.global_mgr.start()
         if self.repl is not None:
             self.repl.start()
+        if self.rescale is not None:
+            self.rescale.start()
         if self.promoter is not None:
             self.promoter.start()
 
     async def stop(self) -> None:
         if self.promoter is not None:
             await self.promoter.stop()
+        if self.rescale is not None:
+            await self.rescale.stop()
         if self.repl is not None:
             await self.repl.stop()
         await self.global_mgr.stop()
@@ -222,8 +245,10 @@ class Instance:
         if shed is not None:
             shed.refresh_generation()
         repl = self.repl
-        # takeover seeds (r11): owned first touches whose key has a
-        # replicated standby snapshot install it BEFORE deciding
+        resc = self.rescale
+        # takeover/handoff seeds (r11/r17): owned first touches whose
+        # key has a replicated standby snapshot or a pending rescale
+        # handoff install it BEFORE deciding
         seeds: List[Tuple[int, str, object]] = []
         fps = {}
 
@@ -266,18 +291,35 @@ class Instance:
             verdict = (
                 shed.lookup_resp(h, r) if shed is not None else None
             )
+            if not peer.is_owner and resc is not None and (
+                resc._transition is not None
+                and r.behavior != Behavior.GLOBAL
+            ):
+                # double-serve routing (r17): while a ring change's
+                # window is open, MOVED keys keep forwarding to their
+                # old (warm) owner — or serve locally when that is this
+                # node — until the new owner has installed the handoff.
+                # GLOBAL items keep their replica-answer semantics;
+                # chained requests never reach here (the chain branch
+                # above routed and continued)
+                ov = resc.route_override(key, r)
+                if ov is not None:
+                    peer = ov
             if peer.is_owner:
                 if repl is not None:
                     repl.queue_dirty(r)
+                if resc is not None:
+                    resc.note_owned(r)
                 if verdict is not None:
                     if r.behavior == Behavior.GLOBAL:
                         self.global_mgr.queue_update(r)
                     out[i] = verdict
                     continue
-                if repl is not None:
-                    s = repl.standby_pop(key)
-                    if s is not None:
-                        seeds.append((i, key, s))
+                s = repl.standby_pop(key) if repl is not None else None
+                if s is None and resc is not None:
+                    s = resc.pending_pop(key)
+                if s is not None:
+                    seeds.append((i, key, s))
                 local.append((i, r, False))
             elif r.behavior == Behavior.GLOBAL:
                 # replica answer + async hit forward (gubernator.go:133-140)
@@ -491,7 +533,12 @@ class Instance:
         except Exception as e:
             log.warning("standby seed install failed: %s", e)
             return False
-        self.repl.note_seeded(seeds)
+        if self.repl is not None:
+            self.repl.note_seeded(seeds)
+        if self.rescale is not None:
+            # a seeded window is live local state this node must hand
+            # off on the NEXT ring change, even if only peeked here
+            self.rescale.note_seeded(seeds)
         return True
 
     async def _seed_standby(self, seeds) -> List[int]:
@@ -658,7 +705,7 @@ class Instance:
                 # owner-side injection point: a chaos spec can make THIS
                 # node a slow/failing owner for its peers' forwards
                 await FAULTS.inject("peer_serve")
-            if self.repl is not None:
+            if self.repl is not None or self.rescale is not None:
                 await self._peer_serve_replication(reqs)
             chained_idx = [i for i, r in enumerate(reqs) if r.chain]
             if chained_idx:
@@ -749,12 +796,16 @@ class Instance:
     async def _peer_serve_replication(
         self, reqs: Sequence[RateLimitReq]
     ) -> None:
-        """Owner-side replication hooks for a forwarded batch: owned
-        keys dirty the snapshot queue; keys the ring says ANOTHER node
-        owns were routed here by a peer's takeover fallback — track
-        them for the reconcile handback; and any first touch with a
-        standby snapshot seeds the store before the batch decides."""
+        """Owner-side replication/rescale hooks for a forwarded batch:
+        owned keys dirty the snapshot queue and the rescale tracked
+        set; keys the ring says ANOTHER node owns were routed here by a
+        peer's takeover fallback or by double-serve routing after a
+        ring change — track them for the reconcile handback and count
+        the double-serve answer; and any first touch with a standby
+        snapshot or pending handoff seeds the store before the batch
+        decides."""
         repl = self.repl
+        resc = self.rescale
         seeds = []
         for r in reqs:
             if r.chain:
@@ -768,10 +819,22 @@ class Instance:
             except Exception:
                 own = True
             if own:
-                repl.queue_dirty(r)
+                if repl is not None:
+                    repl.queue_dirty(r)
+                if resc is not None:
+                    resc.note_owned(r)
             else:
-                repl.mark_taken(r)
-            s = repl.standby_pop(key)
+                if repl is not None:
+                    repl.mark_taken(r)
+                if resc is not None:
+                    # the old owner answering a moved key inside its
+                    # double-serve window (forwarders still route it
+                    # here): counted, and re-dirtied for the
+                    # end-of-window reconcile flush
+                    resc.note_double_serve(r)
+            s = repl.standby_pop(key) if repl is not None else None
+            if s is None and resc is not None and own:
+                s = resc.pending_pop(key)
             if s is not None:
                 seeds.append((key, s))
         if seeds:
@@ -779,12 +842,16 @@ class Instance:
 
     async def replicate_buckets(self, owner: str, snaps) -> None:
         """ReplicateBuckets receive path (peers.proto): file or install
-        another owner's bucket snapshots (serve/replication.py
-        install). A node with replication off accepts and ignores —
-        knob/version skew across the fleet must not fail the sender."""
-        if self.repl is None:
-            return
-        await self.repl.install(owner, snaps)
+        another owner's bucket snapshots. With replication on, the r11
+        install handles both halves (owned -> store, others ->
+        standby); with only rescale on, its install provides the same
+        split against the pending handoff table. A node with both off
+        accepts and ignores — knob/version skew across the fleet must
+        not fail the sender."""
+        if self.repl is not None:
+            await self.repl.install(owner, snaps)
+        elif self.rescale is not None:
+            await self.rescale.install(owner, snaps)
 
     async def update_peer_globals(
         self, updates: Sequence[Tuple[str, RateLimitResp]]
@@ -794,6 +861,9 @@ class Instance:
             # authoritative: any replicated standby snapshot for them
             # is superseded (the reconcile contract, r11)
             self.repl.standby_purge([k for k, _ in updates])
+        if self.rescale is not None and updates:
+            # the same supersession rule for pending handoff snapshots
+            self.rescale.pending_purge([k for k, _ in updates])
         if self.shed is None or not updates:
             await self.batcher.update_globals(list(updates))
             return
@@ -880,7 +950,19 @@ class Instance:
             self.picker.get_peer_by_host(h) for h in old_hosts - new_hosts
         ]
 
+        old_picker = self.picker
         self.picker = picker
+        if old_hosts != new_hosts:
+            if self.rescale is not None:
+                # planned handoff (r17): the flush loop diffs the old
+                # ring against the new one and ships moved keys'
+                # windows to their new owners; non-blocking here
+                self.rescale.note_ring_change(old_picker, picker)
+            if self.repl is not None:
+                # r11 standby hygiene: rows whose keys this node no
+                # longer succeeds (or owns) after the reshuffle could
+                # seed a WRONG takeover window later — purge them now
+                await self.repl.purge_unsucceeded_standby()
         self.health = HealthCheckResp(
             status=UNHEALTHY if errs else HEALTHY,
             message="|".join(errs),

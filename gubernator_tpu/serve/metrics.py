@@ -232,6 +232,46 @@ REPLICATION_DROPPED = Counter(
     ["what"],
     registry=REGISTRY,
 )
+RESCALE_KEYS_MOVED = Counter(
+    "rescale_keys_moved_total",
+    "Live token windows handed to their NEW ring owner on a membership "
+    "change, a planned drain, or a double-serve reconcile tick "
+    "(GUBER_RESCALE=1, serve/rescale.py; delivered over "
+    "ReplicateBuckets with last-write-wins installs, so retries and "
+    "duplicates re-count here but no-op on the receiver)",
+    registry=REGISTRY,
+)
+RESCALE_HANDOFF_LAG = Gauge(
+    "rescale_handoff_lag_seconds",
+    "Sender side: wall time from a ring change to its moved windows "
+    "being delivered to their new owners (target: under two "
+    "GUBER_REPLICATION_SYNC_WAIT_MS flush windows). Receivers "
+    "re-stamp it with the age of the snapshots they install",
+    registry=REGISTRY,
+)
+RESCALE_DOUBLE_SERVE = Counter(
+    "rescale_double_serve_answers_total",
+    "Peer-forwarded requests this node answered for keys it no longer "
+    "owns, inside an open GUBER_RESCALE_DOUBLE_SERVE_MS window after a "
+    "ring change (the old owner's warm store answers while the new "
+    "owner installs; the end-of-window flush reconciles, LWW)",
+    registry=REGISTRY,
+)
+RESCALE_DROPPED = Counter(
+    "rescale_dropped_total",
+    "Rescale entries dropped at a bound: tracked owned keys evicted "
+    "past GUBER_RESCALE_TRACK_KEYS (freshest kept), pending handoff "
+    "snapshots evicted past the same bound on the receiver",
+    ["what"],
+    registry=REGISTRY,
+)
+RESCALE_TRACKED_ENTRIES = Gauge(
+    "rescale_tracked_entries",
+    "Owned token windows tracked for planned handoff + pending "
+    "received snapshots awaiting this node's ring flip (bounded by "
+    "GUBER_RESCALE_TRACK_KEYS each; set lazily at /metrics scrape)",
+    registry=REGISTRY,
+)
 SKETCH_PROMOTIONS = Counter(
     "sketch_promotions_total",
     "Hot sketch-tier keys migrated into exact-tier buckets by the "

@@ -544,6 +544,34 @@ class ConsistentHashPicker:
                 return peer
         return None
 
+    def ownership_diff(
+        self, new: "ConsistentHashPicker", keys: Sequence[str]
+    ) -> Dict[str, Tuple["PeerClient", List[str]]]:
+        """Keys THIS ring routes to this node (is_owner) that `new`
+        routes to a DIFFERENT host, grouped by their new owner:
+        {new_owner_host: (new_owner_client, [keys])}. This is the
+        planned-handoff work list on a ring change (serve/rescale.py):
+        call on the OLD picker with the new picker and the keys this
+        node holds live windows for. Keys the old ring did not route
+        here, and keys still owned here under `new`, contribute
+        nothing; an empty old ring (never populated) diffs to nothing
+        rather than raising."""
+        out: Dict[str, Tuple[PeerClient, List[str]]] = {}
+        if not self._keys or not new._keys:
+            return out
+        for key in keys:
+            if not self.get(key).is_owner:
+                continue
+            owner = new.get(key)
+            if owner.is_owner:
+                continue
+            entry = out.get(owner.host)
+            if entry is None:
+                out[owner.host] = (owner, [key])
+            else:
+                entry[1].append(key)
+        return out
+
     def self_owned_mask(self, keys: Sequence[str]):
         """bool[len(keys)]: the key's ring successor is this server
         itself (is_owner). Vectorized ownership screen for the edge

@@ -118,6 +118,67 @@ def footprint_mib(keys: int) -> float:
     return keys * ENTRY_BYTES / (1 << 20)
 
 
+def eligible_field_indices(fields):
+    """Indices of hit-carrying token-bucket rows in one folded frame's
+    dense field arrays — the single eligibility rule the r11 dirty
+    marking and the r17 tracked-set marking share (the bridge computes
+    it once per frame and hands it to both managers)."""
+    import numpy as np
+
+    return np.flatnonzero(
+        (np.asarray(fields["hits"]) > 0)
+        & (np.asarray(fields["algo"]) == int(Algorithm.TOKEN_BUCKET))
+    )
+
+
+async def snapshot_windows(
+    instance, metas: List[Tuple[str, Tuple[int, int, int]]]
+) -> List["Snapshot"]:
+    """Non-mutating window read for (key, (algo, limit, duration))
+    pairs through the backend's snapshot surface — the ONE gather both
+    the r11 replication flush and the r17 rescale handoff use, so the
+    thread contract (device backends on the batcher's serialized
+    submit thread), the expiry filtering, the duration backfill, and
+    the LWW stamping can never drift between them. Expired/missing
+    rows drop out."""
+    from gubernator_tpu.api.types import Status
+
+    be = instance.backend
+    fn = getattr(be, "snapshot_read", None)
+    if fn is None:  # pragma: no cover - gated at Instance init
+        return []
+    keys = [k for k, _ in metas]
+    if not keys:
+        return []
+    now = millisecond_now()
+    if getattr(be, "inline_decide", False):
+        rows = fn(keys, now)
+    else:
+        rows = await instance.batcher.run_serialized(fn, keys, now)
+    snaps = []
+    for (key, meta), row in zip(metas, rows):
+        if row is None:
+            continue
+        limit, duration, remaining, reset_time, over = row
+        if reset_time <= now:
+            continue
+        snaps.append(Snapshot(
+            key=key,
+            algorithm=int(Algorithm.TOKEN_BUCKET),
+            limit=int(limit),
+            # exact-backend token windows don't persist duration;
+            # fall back to the dirtying request's
+            duration=int(duration) if duration > 0 else int(meta[2]),
+            remaining=int(remaining),
+            reset_time=int(reset_time),
+            status=int(
+                Status.OVER_LIMIT if over else Status.UNDER_LIMIT
+            ),
+            snapshot_ms=now,
+        ))
+    return snaps
+
+
 class ReplicationManager:
     """Supervised owner->successor snapshot loop + receiver tables.
 
@@ -199,18 +260,15 @@ class ReplicationManager:
         self._dirty[key] = (int(r.algorithm), r.limit, r.duration)
         self._event.set()
 
-    def queue_dirty_fields(self, keys, fields) -> None:
+    def queue_dirty_fields(self, keys, fields, elig=None) -> None:
         """Bridge-tier dirty marking (edge_bridge string->array fold):
         one all-owned folded frame's keys and dense field arrays, same
-        gates as queue_dirty. Pre-hashed GEB6/GEB7 frames carry no key
+        gates as queue_dirty. `elig` carries pre-computed
+        eligible_field_indices so the bridge screens once per frame
+        for every manager. Pre-hashed GEB6/GEB7 frames carry no key
         strings and cannot be marked — a documented scope limit."""
-        import numpy as np
-
-        elig = np.flatnonzero(
-            (np.asarray(fields["hits"]) > 0)
-            & (np.asarray(fields["algo"])
-               == int(Algorithm.TOKEN_BUCKET))
-        )
+        if elig is None:
+            elig = eligible_field_indices(fields)
         if not elig.size:
             return
         limit = fields["limit"]
@@ -257,6 +315,39 @@ class ReplicationManager:
         if s is None or s.reset_time <= millisecond_now():
             return None
         return s
+
+    async def purge_unsucceeded_standby(self) -> None:
+        """Ring-change hygiene (r17 satellite): drop standby rows for
+        keys this node neither owns nor succeeds on the CURRENT ring.
+        A stale row surviving a reshuffle could seed a WRONG takeover
+        window later — e.g. after two membership changes move the
+        succession elsewhere and back with a different key split, the
+        first touch would install a window frozen at the pre-reshuffle
+        state instead of the interim owner's. Called from
+        Instance.set_peers after every membership change; the scan is
+        two ring lookups per row over a table bounded at 65536, so it
+        yields the event loop every chunk rather than stalling every
+        in-flight request for the full pass. Rows installed while the
+        scan yields are judged against the same (current) ring when
+        their chunk comes up, or survive to the next change's pass."""
+        if not self._standby:
+            return
+        picker = self.instance.picker
+        for i, key in enumerate(list(self._standby)):
+            if i and i % 2048 == 0:
+                await asyncio.sleep(0)
+            if key not in self._standby:
+                continue  # popped/seeded while we yielded
+            try:
+                if picker.get(key).is_owner:
+                    continue  # we own it now: seeded on first touch
+                succ = picker.get_successor(key)
+                if succ is not None and succ.is_owner:
+                    continue  # still the takeover target
+            except Exception:  # pragma: no cover - ring flap
+                continue
+            self._standby.pop(key, None)
+            self._drop("standby_reshuffle")
 
     def standby_purge(self, keys) -> None:
         """Drop standby rows for these keys: an UpdatePeerGlobals
@@ -309,6 +400,12 @@ class ReplicationManager:
             await self.instance.update_peer_globals(
                 [(s.key, snapshot_resp(s)) for s in store_installs]
             )
+            resc = getattr(self.instance, "rescale", None)
+            if resc is not None:
+                # installed windows are live local state the rescale
+                # manager must hand off on the NEXT ring change (r17)
+                for s in store_installs:
+                    resc.note_installed(s.key, s.limit, s.duration)
             # the handback restored owner state: count + stamp lag
             try:
                 metrics.REPLICATION_RECONCILES.inc(len(store_installs))
@@ -383,34 +480,65 @@ class ReplicationManager:
         self, owned: Dict[str, Tuple[int, int, int]]
     ) -> None:
         """Snapshot-read owned dirty keys and ship each to its ring
-        successor (skipping keys without a distinct successor)."""
-        by_peer: Dict[str, List[str]] = {}
+        successor (skipping keys without a distinct successor).
+
+        Successors resolve AFTER the snapshot await, against the ring
+        as it stands at send time (r17 satellite): a membership change
+        landing while the device gather is in flight used to leave this
+        flush shipping a whole window's worth of dirty keys to the
+        PRE-change successor — which the reshuffle may have demoted to
+        a bystander whose stale standby row could seed a wrong takeover
+        later (see purge_unsucceeded_standby). Pinned by the
+        ring-flip-mid-flush test in tests/test_rescale.py."""
+        if self.instance.picker.size() <= 1:
+            # single-host ring: no key has a distinct successor, so
+            # don't pay the serialized device gather every tick only
+            # to discard every row at the successor screen below
+            return
+        snaps = await self._snapshot(list(owned.items()))
+        by_peer: Dict[str, List[Snapshot]] = {}
         clients = {}
-        for key in owned:
+        for s in snaps:
             try:
-                succ = self.instance.picker.get_successor(key)
+                if not self.instance.get_peer(s.key).is_owner:
+                    # ownership moved while the gather was in flight:
+                    # the window belongs to the new owner now — route
+                    # it through the handback path next tick instead
+                    # of seeding the wrong successor's standby table
+                    self._taken.setdefault(s.key, owned[s.key])
+                    self._event.set()
+                    continue
+                succ = self.instance.picker.get_successor(s.key)
             except Exception as e:  # pragma: no cover - defensive
-                log.error("while finding successor for '%s': %s", key, e)
+                log.error(
+                    "while finding successor for '%s': %s", s.key, e
+                )
                 continue
             if succ is None or succ.is_owner:
                 continue
-            by_peer.setdefault(succ.host, []).append(key)
+            by_peer.setdefault(succ.host, []).append(s)
             clients[succ.host] = succ
-        if not by_peer:
-            return
-        for host, keys in by_peer.items():
-            snaps = await self._snapshot([(k, owned[k]) for k in keys])
-            if snaps:
-                await self._send(clients[host], snaps)
+        for host, chunk in by_peer.items():
+            await self._send(clients[host], chunk)
 
     async def _handback(self) -> None:
         """Try to return interim windows to their current ring owner.
         Failures (owner still down, breaker open) keep the keys for the
-        next tick; the attempt itself doubles as a breaker probe."""
+        next tick; the attempt itself doubles as a breaker probe.
+
+        Owners resolve AFTER the snapshot await (the same
+        ring-flip-mid-flush rule as _replicate_owned): a rescale
+        landing mid-gather must not hand a window to the PRE-change
+        owner."""
         taken = dict(self._taken)
-        by_peer: Dict[str, List[str]] = {}
+        snaps = await self._snapshot(list(taken.items()))
+        alive = {s.key: s for s in snaps}
+        for key in taken:
+            if key not in alive:  # nothing left to hand back (expired)
+                self._taken.pop(key, None)
+        by_peer: Dict[str, List[Snapshot]] = {}
         clients = {}
-        for key, meta in taken.items():
+        for key, s in alive.items():
             try:
                 owner = self.instance.get_peer(key)
             except Exception:
@@ -420,56 +548,17 @@ class ReplicationManager:
                 # key now, covered by queue_dirty on its next decide
                 self._taken.pop(key, None)
                 continue
-            by_peer.setdefault(owner.host, []).append(key)
+            by_peer.setdefault(owner.host, []).append(s)
             clients[owner.host] = owner
-        for host, keys in by_peer.items():
-            snaps = await self._snapshot([(k, taken[k]) for k in keys])
-            if not snaps:
-                for k in keys:  # nothing left to hand back (expired)
-                    self._taken.pop(k, None)
-                continue
-            if await self._send(clients[host], snaps, what="handback"):
-                for k in keys:
-                    self._taken.pop(k, None)
+        for host, chunk in by_peer.items():
+            if await self._send(clients[host], chunk, what="handback"):
+                for s in chunk:
+                    self._taken.pop(s.key, None)
 
     async def _snapshot(
         self, metas: List[Tuple[str, Tuple[int, int, int]]]
     ) -> List[Snapshot]:
-        """Non-mutating window read for these keys through the backend's
-        snapshot surface; device backends run it on the batcher's
-        single submit thread, serialized with every store mutation."""
-        be = self.instance.backend
-        fn = getattr(be, "snapshot_read", None)
-        if fn is None:  # pragma: no cover - gated at Instance init
-            return []
-        keys = [k for k, _ in metas]
-        now = millisecond_now()
-        if getattr(be, "inline_decide", False):
-            rows = fn(keys, now)
-        else:
-            rows = await self.instance.batcher.run_serialized(fn, keys, now)
-        snaps = []
-        for (key, meta), row in zip(metas, rows):
-            if row is None:
-                continue
-            limit, duration, remaining, reset_time, over = row
-            if reset_time <= now:
-                continue
-            snaps.append(Snapshot(
-                key=key,
-                algorithm=int(Algorithm.TOKEN_BUCKET),
-                limit=int(limit),
-                # exact-backend token windows don't persist duration;
-                # fall back to the dirtying request's
-                duration=int(duration) if duration > 0 else int(meta[2]),
-                remaining=int(remaining),
-                reset_time=int(reset_time),
-                status=int(
-                    Status.OVER_LIMIT if over else Status.UNDER_LIMIT
-                ),
-                snapshot_ms=now,
-            ))
-        return snaps
+        return await snapshot_windows(self.instance, metas)
 
     async def _send(self, peer, snaps: List[Snapshot], what="replicate"):
         """One peer's snapshots, chunked under the peer batch cap.

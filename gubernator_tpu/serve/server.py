@@ -470,6 +470,20 @@ class Server:
         else:
             log.info("bucket replication: off (GUBER_REPLICATION=0)")
 
+        resc = self.instance.rescale
+        if resc is not None:
+            log.info(
+                "elastic rescale: on — double-serve window %.0f ms, "
+                "tracked-key bound %d, flush tick %.0f ms "
+                "(GUBER_RESCALE / GUBER_RESCALE_DOUBLE_SERVE_MS / "
+                "GUBER_RESCALE_TRACK_KEYS / "
+                "GUBER_REPLICATION_SYNC_WAIT_MS)",
+                resc.double_serve_s * 1e3, resc.track_cap,
+                resc.sync_wait * 1e3,
+            )
+        else:
+            log.info("elastic rescale: off (GUBER_RESCALE=0)")
+
         if self.conf.geb_port:
             from gubernator_tpu.serve.edge_bridge import GebListener
 
@@ -552,6 +566,15 @@ class Server:
             timings[name] = time.monotonic() - t
             return ok
 
+        if self.instance.rescale is not None:
+            # planned-departure handoff (r17) BEFORE deregistration:
+            # every tracked window ships to the owner the ring elects
+            # once this node is gone, so the snapshots are parked on
+            # their new owners before any peer's ring flips — the
+            # receiving side seeds them on its first owned touch
+            await step(
+                "rescale_handoff", self.instance.rescale.drain()
+            )
         if self._pool is not None:
             if await step("deregister", self._pool.close()):
                 self._pool = None
@@ -913,6 +936,11 @@ class Server:
         if self.instance.repl is not None:
             metrics.REPLICATION_BACKLOG_ENTRIES.set(
                 self.instance.repl.backlog_len
+            )
+        if self.instance.rescale is not None:
+            metrics.RESCALE_TRACKED_ENTRIES.set(
+                self.instance.rescale.tracked_len
+                + self.instance.rescale.pending_len
             )
         for queue, size in (
             self.instance.global_mgr.backlog_sizes().items()

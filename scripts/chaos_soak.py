@@ -4,6 +4,18 @@ resilience layer (deadlines, retries, circuit breaker, degraded mode,
 graceful drain) and, since r11, of bucket replication (GUBER_REPLICATION:
 owner death without quota amnesia).
 
+Since r17 a second mode, `--mode rolling`, is the ROLLING-DEPLOY soak:
+the same 3 daemons discover each other through an in-process fake etcd
+(tests/_fake_etcd.py — real gRPC, the vendored client's live wire
+path), so every SIGTERM genuinely CHANGES the ring (the drain
+deregisters) and every restart changes it back. Each node is restarted
+in sequence under live load while a tracked over-limit canary key is
+peeked continuously; GUBER_RESCALE=1 must keep it over-limit through
+all six membership changes (drain handoff before deregistration,
+ring-change handoff on re-registration, double-serve routing in
+between) — ZERO under-admissions, with the handoff-lag metric under
+two replication flush windows. Writes BENCH_RESCALE_r17.json.
+
 Timeline (one soak):
 
   phase 0  boot 3 daemons (exact backend, static full-mesh peers,
@@ -69,8 +81,13 @@ BREAKER_COOLDOWN_MS = 1000
 DRAIN_TIMEOUT_MS = 3000
 FAULT_SPEC = "peer_rpc:delay=20ms:p=0.1,peer_rpc:error:p=0.02"
 REPLICATION_SYNC_WAIT_MS = 50
+# rolling mode: forwarders keep routing moved keys to the old (warm)
+# owner for this long after each ring change while the new owner
+# installs the handoff (GUBER_RESCALE_DOUBLE_SERVE_MS)
+DOUBLE_SERVE_MS = 1000
 # amnesia canary window: tiny limit, long duration (must outlive the
-# whole kill -> takeover -> restart -> reconcile cycle)
+# whole kill -> takeover -> restart -> reconcile cycle — and, in
+# rolling mode, all six membership changes of the full roll)
 AMNESIA_LIMIT = 5
 AMNESIA_DURATION_MS = 600_000
 
@@ -78,11 +95,15 @@ OBSERVER, DRAIN_NODE, VICTIM = 0, 1, 2
 
 
 class Cluster:
-    def __init__(self, n=3):
+    def __init__(self, n=3, etcd_port=None, rescale=False,
+                 sync_wait_ms=REPLICATION_SYNC_WAIT_MS):
         self.n = n
+        self.sync_wait_ms = sync_wait_ms
         self.grpc = free_ports(n)
         self.http = free_ports(n)
         self.peers = ",".join(f"127.0.0.1:{p}" for p in self.grpc)
+        self.etcd_port = etcd_port  # None = static peers (kill mode)
+        self.rescale = rescale
         self.log_dir = tempfile.mkdtemp(prefix="guber-chaos-")
         self.procs = [None] * n
 
@@ -105,14 +126,27 @@ class Cluster:
             GUBER_BREAKER_COOLDOWN_MS=str(BREAKER_COOLDOWN_MS),
             GUBER_DRAIN_TIMEOUT_MS=str(DRAIN_TIMEOUT_MS),
             GUBER_REPLICATION="1",
-            GUBER_REPLICATION_SYNC_WAIT_MS=str(REPLICATION_SYNC_WAIT_MS),
+            GUBER_REPLICATION_SYNC_WAIT_MS=str(self.sync_wait_ms),
         )
         env.pop("GUBER_FAULT_SPEC", None)
         env.pop("GUBER_ETCD_ENDPOINTS", None)
         env.pop("GUBER_K8S_ENDPOINTS_SELECTOR", None)
-        if i == OBSERVER:
+        if self.etcd_port is not None:
+            # rolling mode: etcd discovery so SIGTERM/restart genuinely
+            # CHANGES the ring (the drain deregisters; the reboot
+            # re-registers) — static peers would keep it fixed
+            env.pop("GUBER_PEERS", None)
+            env["GUBER_ETCD_ENDPOINTS"] = f"127.0.0.1:{self.etcd_port}"
+        if self.rescale:
+            env["GUBER_RESCALE"] = "1"
+            env["GUBER_RESCALE_DOUBLE_SERVE_MS"] = str(
+                DOUBLE_SERVE_MS
+            )
+        if i == OBSERVER and self.etcd_port is None:
             # latency + error injection on the observer's peer RPCs:
             # retries + deadlines must keep the served error rate flat
+            # (kill mode only: the rolling soak measures handoff lag,
+            # which injected latency would smear)
             env["GUBER_FAULT_SPEC"] = FAULT_SPEC
             env["GUBER_FAULT_SEED"] = "8"
         return env
@@ -378,12 +412,347 @@ def poll_until(pred, timeout, interval=0.1, what=""):
     return False
 
 
+# -- rolling-deploy mode (r17) ----------------------------------------------
+
+
+def scrape_rescale_metrics(cluster, node):
+    """rescale_* gauges/counters from one node's /metrics."""
+    out = {}
+    try:
+        txt = get_text(f"http://127.0.0.1:{cluster.http[node]}/metrics")
+    except OSError:
+        return out
+    for line in txt.splitlines():
+        for name in ("rescale_keys_moved_total",
+                     "rescale_handoff_lag_seconds",
+                     "rescale_double_serve_answers_total",
+                     "replication_lag_seconds"):
+            if line.startswith(name + " "):
+                out[name] = float(line.rsplit(" ", 1)[1])
+    return out
+
+
+class CanaryPeeker:
+    """Continuous hits=0 sampling of the amnesia canary through every
+    node not currently being restarted. Any non-error UNDER_LIMIT
+    answer is a quota-amnesia under-admission — the thing a planned
+    handoff must make IMPOSSIBLE, not merely rare."""
+
+    def __init__(self, cluster, key, interval=0.08):
+        self.cluster = cluster
+        self.key = key
+        self.interval = interval
+        self.excluded = set()
+        self.counts = {"over": 0, "under": 0, "other": 0}
+        self.unders = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=10)
+
+    def exclude(self, i):
+        with self._lock:
+            self.excluded.add(i)
+
+    def include(self, i):
+        with self._lock:
+            self.excluded.discard(i)
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self.counts), list(self.unders)
+
+    def _run(self):
+        idx = 0
+        while not self._stop.is_set():
+            with self._lock:
+                nodes = [
+                    i for i in range(self.cluster.n)
+                    if i not in self.excluded
+                ]
+            if not nodes:
+                time.sleep(self.interval)
+                continue
+            node = nodes[idx % len(nodes)]
+            idx += 1
+            try:
+                r = peek_amnesia(self.cluster, node, self.key)
+            except OSError:
+                with self._lock:
+                    self.counts["other"] += 1
+                time.sleep(self.interval)
+                continue
+            with self._lock:
+                if r["error"]:
+                    self.counts["other"] += 1
+                elif r["status"] == "OVER_LIMIT":
+                    self.counts["over"] += 1
+                else:
+                    self.counts["under"] += 1
+                    self.unders.append(
+                        {"via_node": node, "response": r,
+                         "t": time.time()}
+                    )
+            time.sleep(self.interval)
+
+
+def find_owned_key(cluster, via, prefix, want_owner=None, req=None):
+    """A key whose metadata.owner (as seen from `via`) matches
+    `want_owner` (any owner when None)."""
+    for i in range(512):
+        key = f"{prefix}{i}"
+        out = post_limits(
+            cluster.http[via],
+            [req(key, 0) if req is not None else {
+                "name": "chaos", "uniqueKey": key, "hits": 0,
+                "limit": 10_000_000, "duration": 3_600_000,
+            }],
+        )
+        r = out["responses"][0]
+        if r["error"]:
+            continue
+        owner = r["metadata"].get("owner")
+        if owner and (want_owner is None or owner == want_owner):
+            return key, owner
+    raise RuntimeError(f"no {prefix}* key with a resolvable owner")
+
+
+ROLL_SYNC_WAIT_MS = 250  # rolling-mode flush window (the lag bound unit)
+
+
+def rolling_main(args) -> int:
+    """Rolling deploy under live load: every node of the 3-node
+    etcd-discovered cluster is SIGTERMed (drain -> handoff ->
+    deregister), restarted, and re-registered in sequence. Acceptance:
+    every drain exits 0, the canary key never answers UNDER_LIMIT
+    through all six membership changes, the handoff-lag metric stays
+    under 2 flush windows, and the rescale metrics prove the machinery
+    actually moved keys (not a silent pass)."""
+    from tests._fake_etcd import FakeEtcd
+
+    etcd = FakeEtcd().start()
+    cluster = Cluster(
+        3, etcd_port=etcd.port, rescale=True,
+        sync_wait_ms=ROLL_SYNC_WAIT_MS,
+    )
+    phase = max(1.0, args.seconds / 10.0)
+    gen = peeker = None
+    failures = []
+    lag_bound_s = 2 * ROLL_SYNC_WAIT_MS / 1e3
+    result = {
+        "soak": "rolling_deploy_3node_etcd_rescale",
+        "backend": "exact",
+        "nodes": 3,
+        "discovery": "etcd (in-process fake, real gRPC wire path)",
+        "rescale": True,
+        "double_serve_ms": DOUBLE_SERVE_MS,
+        "replication_sync_wait_ms": ROLL_SYNC_WAIT_MS,
+        "handoff_lag_bound_s": lag_bound_s,
+        "drain_timeout_ms": DRAIN_TIMEOUT_MS,
+        "amnesia_limit": AMNESIA_LIMIT,
+        "restarts": [],
+    }
+    try:
+        t_boot = time.monotonic()
+        for i in range(3):
+            cluster.spawn(i)
+        for i in range(3):
+            cluster.wait_healthy(i)
+        result["boot_s"] = round(time.monotonic() - t_boot, 2)
+        print(f"rolling cluster up in {result['boot_s']}s; logs in "
+              f"{cluster.log_dir}", file=sys.stderr)
+
+        # the canary: any key with a resolvable owner, driven
+        # over-limit ONCE and then only peeked — exactly the idle
+        # frozen-refusal shape r11's dirty-flush does NOT re-ship, so
+        # only the planned handoff can carry it through the roll
+        canary, owner0 = find_owned_key(
+            cluster, OBSERVER, "roll", req=amnesia_req
+        )
+        result["canary"] = {"key": canary, "initial_owner": owner0}
+        r = post_limits(
+            cluster.http[OBSERVER], [amnesia_req(canary, AMNESIA_LIMIT)]
+        )["responses"][0]
+        if r["error"]:
+            failures.append(f"canary drive errored: {r}")
+
+        def canary_over():
+            rr = peek_amnesia(cluster, OBSERVER, canary)
+            return not rr["error"] and rr["status"] == "OVER_LIMIT"
+
+        if not poll_until(canary_over, 5.0,
+                          what="canary never went over-limit"):
+            failures.append("canary never went over-limit before roll")
+        # let the r11 dirty flush ship its one snapshot, then idle
+        time.sleep(3 * ROLL_SYNC_WAIT_MS / 1e3)
+
+        keys = [f"rk{i}" for i in range(128)]
+        gen = LoadGen(cluster, keys)
+        gen.start()
+        peeker = CanaryPeeker(cluster, canary)
+        peeker.start()
+        time.sleep(phase)
+
+        # the lag gauge holds only its LAST value per node, so sample
+        # after EVERY restart and keep the max — a violating first
+        # handoff must not be overwritten by a fast later one
+        lag_samples = []
+
+        def sample_lags():
+            for n in range(3):
+                m = scrape_rescale_metrics(cluster, n)
+                if "rescale_handoff_lag_seconds" in m:
+                    lag_samples.append(
+                        m["rescale_handoff_lag_seconds"]
+                    )
+
+        for i in range(3):
+            print(f"rolling node {i} (SIGTERM + restart)",
+                  file=sys.stderr)
+            peeker.exclude(i)
+            gen.mark_dead(i)
+            time.sleep(0.5)  # in-flight work toward i settles
+            t_term = time.monotonic()
+            cluster.procs[i].send_signal(signal.SIGTERM)
+            try:
+                rc = cluster.procs[i].wait(
+                    timeout=DRAIN_TIMEOUT_MS / 1e3 + 10
+                )
+            except subprocess.TimeoutExpired:
+                rc = None
+            drain_s = round(time.monotonic() - t_term, 2)
+            if rc != 0:
+                failures.append(
+                    f"node {i} drain exit code {rc} "
+                    f"(log tail:\n{cluster.log_tail(i)})"
+                )
+            time.sleep(phase / 2)  # serve through the 2-node window
+            t_spawn = time.monotonic()
+            cluster.spawn(i)
+            cluster.wait_healthy(i)
+            for j in range(3):
+                cluster.wait_healthy(j)  # everyone sees 3 peers again
+            rejoin_s = round(time.monotonic() - t_spawn, 2)
+            # ride out the double-serve window + handoff before the
+            # reborn node takes direct traffic again (an LB health
+            # grace period, in soak form)
+            time.sleep(DOUBLE_SERVE_MS / 1e3 + 0.5)
+            gen.mark_alive(i)
+            peeker.include(i)
+            sample_lags()
+            result["restarts"].append(
+                {"node": i, "drain_exit": rc, "drain_s": drain_s,
+                 "rejoin_s": rejoin_s}
+            )
+            time.sleep(phase / 2)
+
+        time.sleep(phase)
+        resc_metrics = {
+            n: scrape_rescale_metrics(cluster, n) for n in range(3)
+        }
+        result["rescale_metrics"] = resc_metrics
+        peeker.stop()
+        gen.stop()
+        counts, unders = peeker.snapshot()
+        result["canary_samples"] = counts
+        result["under_admissions"] = unders
+        gc = gen.snapshot()
+        result["counts"] = gc
+        served = (gc["ok"] + gc["degraded"] + gc["replicated"]
+                  + gc["item_error"] + gc["inflight_loss"])
+        errors = gc["item_error"] + gc["inflight_loss"]
+        result["error_rate"] = round(errors / served, 4) if served else 1.0
+
+        if counts["under"] > 0:
+            failures.append(
+                f"QUOTA AMNESIA: canary answered UNDER_LIMIT "
+                f"{counts['under']}x during the roll ({unders[:3]})"
+            )
+        if counts["over"] < 30:
+            failures.append(
+                f"too few OVER_LIMIT canary samples to judge "
+                f"({counts})"
+            )
+        moved = sum(
+            m.get("rescale_keys_moved_total", 0)
+            for m in resc_metrics.values()
+        )
+        result["keys_moved_total"] = moved
+        if moved <= 0:
+            failures.append(
+                "rescale_keys_moved_total == 0 everywhere — the "
+                "handoff machinery never engaged (silent pass)"
+            )
+        sample_lags()  # final sample on top of the per-restart ones
+        result["handoff_lag_max_s"] = (
+            max(lag_samples) if lag_samples else None
+        )
+        result["handoff_lag_samples"] = len(lag_samples)
+        if not lag_samples:
+            failures.append("no rescale_handoff_lag_seconds scraped")
+        elif max(lag_samples) > lag_bound_s:
+            failures.append(
+                f"handoff lag {max(lag_samples):.3f}s exceeds the "
+                f"bound of 2 flush windows ({lag_bound_s:.3f}s)"
+            )
+        if result["error_rate"] >= 0.05:
+            failures.append(
+                f"served error rate {result['error_rate']:.2%} >= 5% "
+                f"({gc})"
+            )
+        if served < 500:
+            failures.append(f"soak too small to judge ({served} items)")
+    finally:
+        if peeker is not None:
+            peeker._stop.set()
+        if gen is not None:
+            gen._stop.set()
+        for p in cluster.procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+        for p in cluster.procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+        etcd.stop()
+
+    result["pass"] = not failures
+    result["failures"] = failures
+    out_path = ROOT / args.json
+    out_path.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps(result, indent=2))
+    if failures:
+        print("ROLLING-DEPLOY SOAK FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("rolling-deploy soak passed", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=20.0,
                     help="approximate total soak length")
     ap.add_argument("--json", default="BENCH_CHAOS_r11.json")
+    ap.add_argument("--mode", choices=("kill", "rolling"),
+                    default="kill",
+                    help="kill = the r8/r11 SIGKILL soak; rolling = "
+                    "the r17 rolling-deploy soak (etcd discovery, "
+                    "GUBER_RESCALE, every node restarted in sequence)")
     args = ap.parse_args()
+    if args.mode == "rolling":
+        if args.json == "BENCH_CHAOS_r11.json":
+            args.json = "BENCH_RESCALE_r17.json"
+        return rolling_main(args)
     phase = max(2.0, args.seconds / 5.0)
 
     cluster = Cluster(3)

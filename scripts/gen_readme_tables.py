@@ -371,6 +371,25 @@ def table_resilience_knobs() -> str:
          "Bounds on the receiver-side standby snapshot table and the "
          "sender-side dirty/handback queues (drops counted in "
          "`replication_dropped_total`)"),
+        ("`GUBER_RESCALE`",
+         "1" if s["rescale"].default else "0 (off)",
+         "Elastic ring rescale (r17): on every MEMBERSHIP change, "
+         "owned token windows whose keys the new ring routes "
+         "elsewhere hand off to their new owners (and a SIGTERM "
+         "drain hands everything off BEFORE deregistering), so "
+         "deploys and autoscaling reassign ownership without quota "
+         "amnesia. With a static ring, ON is byte-identical to OFF"),
+        ("`GUBER_RESCALE_DOUBLE_SERVE_MS`",
+         ms(s["rescale_double_serve"].default),
+         "Double-serve window after a ring change: forwarders keep "
+         "routing moved keys to the old (warm) owner while the new "
+         "owner installs the handoff, then flip; absorbed hits "
+         "reconcile at the window end (LWW)"),
+        ("`GUBER_RESCALE_TRACK_KEYS`",
+         str(s["rescale_track_keys"].default),
+         "Bound on the tracked owned-window and pending-handoff "
+         "tables (freshest kept; evictions counted in "
+         "`rescale_dropped_total`)"),
         ("`GUBER_GLOBAL_BACKLOG`", str(b.global_backlog),
          "Max distinct keys aggregating in each GLOBAL gossip queue — "
          "an unreachable owner can no longer grow the hit backlog "
@@ -629,6 +648,53 @@ def table_algorithms() -> str:
     return "\n".join(lines)
 
 
+def table_rescale() -> str:
+    """Elastic rescale rolling-deploy soak (r17), from
+    BENCH_RESCALE_r17.json: the 3-node etcd-discovered cluster with
+    every node SIGTERMed + restarted in sequence under live load —
+    the canary's zero-under-admission contract, the handoff-lag bound,
+    and the machinery-engaged counters."""
+    doc = json.loads((ROOT / "BENCH_RESCALE_r17.json").read_text())
+    c = doc["canary_samples"]
+    moved = doc["keys_moved_total"]
+    ds = sum(
+        m.get("rescale_double_serve_answers_total", 0)
+        for m in doc["rescale_metrics"].values()
+    )
+    drains = ", ".join(
+        f"node {r['node']} {r['drain_s']:.1f}s"
+        for r in doc["restarts"]
+    )
+    lines = [
+        "| rolling-deploy soak measurement | value |",
+        "|---|---|",
+        f"| nodes restarted in sequence (SIGTERM drain -> handoff -> "
+        f"deregister -> rejoin) | {len(doc['restarts'])} of "
+        f"{doc['nodes']} ({drains}) |",
+        f"| canary peeks during the roll (over / **under** / other) "
+        f"| {c['over']} / **{c['under']}** / {c['other']} |",
+        f"| windows handed to new ring owners "
+        f"(`rescale_keys_moved_total`) | {moved:,.0f} |",
+        f"| double-serve answers (old owner, warm store) | {ds:,.0f} |",
+        f"| handoff lag, max scraped | "
+        f"{doc['handoff_lag_max_s']:.3f} s (bound: 2 flush windows = "
+        f"{doc['handoff_lag_bound_s']:.1f} s) |",
+        f"| live-load served error rate | "
+        f"{doc['error_rate']:.2%} (< 5% accepted) |",
+        "",
+        f"(`make chaos-rolling`: 3 daemons on etcd discovery (the "
+        f"in-tree fake over real gRPC), GUBER_RESCALE=1 + "
+        f"GUBER_REPLICATION=1, double-serve window "
+        f"{doc['double_serve_ms']} ms, flush window "
+        f"{doc['replication_sync_wait_ms']} ms. The canary is driven "
+        f"over-limit ONCE and then only peeked — the idle "
+        f"frozen-refusal shape r11's dirty flush cannot re-ship — so "
+        f"**zero under-admissions across all six membership changes** "
+        f"is the planned handoff's doing. Scope in the artifact.)",
+    ]
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -644,6 +710,7 @@ TABLES = {
     "sketch-table": table_sketch,
     "shard-table": table_shard,
     "algorithms-table": table_algorithms,
+    "rescale-table": table_rescale,
 }
 
 

@@ -79,6 +79,7 @@ GATED = (
     "shard_r14",
     "chain_r15",
     "trace_r16",
+    "rescale_r17",
     "frontdoor_geb_over_grpc",
     "frontdoor_http_over_grpc",
 )
@@ -543,6 +544,46 @@ def main() -> int:
                          args.seconds, args.rounds)
         measured["trace_r16"], detail["trace_r16"] = m, rows
 
+        # -- rescale_r17: tracking off vs on, STATIC ring ------------
+        # Same GEB workload against the flat stack; A = rescale off,
+        # B = a live RescaleManager attached (instance.rescale). With
+        # a static single-node ring the manager's only hot-path work
+        # is the owned-key tracking (dict ops per folded frame item) —
+        # the "ON is byte-identical and ~free on a static ring"
+        # contract the committed baseline pins.
+        print(
+            "workload rescale_r17 (tracking off vs on)...",
+            file=sys.stderr,
+        )
+        from gubernator_tpu.serve.rescale import RescaleManager
+
+        resc_obj = RescaleManager(
+            cluster.servers[0].conf, instance
+        )
+
+        def flip_rescale(on: bool):
+            async def f():
+                instance.rescale = resc_obj if on else None
+
+            cluster.run(f())
+
+        def rescale_drive(s):
+            return _loadgen(
+                "geb", SOCK, s, 0.0, args.concurrency, args.batch,
+                keyspace=30_000,
+            )["decisions_per_sec"]
+
+        def rescale_on(s):
+            flip_rescale(True)
+            try:
+                return rescale_drive(s)
+            finally:
+                flip_rescale(False)
+
+        m, rows = paired("rescale_r17", rescale_drive, rescale_on,
+                         args.seconds, args.rounds)
+        measured["rescale_r17"], detail["rescale_r17"] = m, rows
+
         # -- front-door ladder: grpc vs geb vs http ------------------
         print("front-door ladder (grpc / geb / http)...", file=sys.stderr)
         doors = {
@@ -675,6 +716,13 @@ def main() -> int:
                             "keyspace-30k zipf shape (distributed-"
                             "tracing instrumentation price)",
                     "committed": round(measured["trace_r16"], 4),
+                },
+                "rescale_r17": {
+                    "artifact": "BENCH_RESCALE_r17.json",
+                    "pair": "rescale tracking off vs on, static "
+                            "ring, keyspace-30k zipf shape (owned-"
+                            "window tracking price)",
+                    "committed": round(measured["rescale_r17"], 4),
                 },
                 "frontdoor_geb_over_grpc": {
                     "artifact": "BENCH_FRONTDOOR_r12.json",

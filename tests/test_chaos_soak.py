@@ -45,3 +45,30 @@ def test_chaos_soak_passes(tmp_path):
     assert doc["amnesia_outage_samples"]["over"] > 0
     assert doc["reconcile_lag_s"] is not None
     assert doc["amnesia_reconciled_samples"]["under"] == 0
+
+
+def test_rolling_deploy_soak_passes(tmp_path):
+    """The r17 rolling-deploy soak: 3 etcd-discovered daemons
+    (GUBER_RESCALE=1), every node SIGTERMed + restarted in sequence
+    under live load — the canary key must answer ZERO under-limit peeks
+    through all six membership changes, every drain must exit 0, the
+    handoff-lag metric must stay under two flush windows, and the
+    rescale counters must prove keys actually moved."""
+    out = tmp_path / "rolling.json"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "chaos_soak.py"),
+         "--mode", "rolling", "--seconds", "12", "--json", str(out)],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"rolling-deploy soak failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    doc = json.loads(out.read_text())
+    assert doc["pass"] and not doc["failures"]
+    assert doc["canary_samples"]["under"] == 0
+    assert doc["canary_samples"]["over"] > 30
+    assert len(doc["restarts"]) == 3
+    assert all(r["drain_exit"] == 0 for r in doc["restarts"])
+    assert doc["keys_moved_total"] > 0
+    assert doc["handoff_lag_max_s"] <= doc["handoff_lag_bound_s"]
+    assert doc["error_rate"] < 0.05

@@ -1,0 +1,839 @@
+"""Elastic ring rescale (r17, serve/rescale.py): ownership_diff ring
+semantics, the tracked/pending tables, double-serve routing, the
+ON==OFF differential identity guarantee through the real serving
+pipeline (flat AND the simulated 8-device mesh), an in-process
+add-node/remove-node handoff cycle over real gRPC (a tracked over-limit
+key never under-admits), the ring-flip-mid-flush replication fix, the
+post-reshuffle standby purge, and the GUBER_SHARDS store re-partition
+identity (export -> install under a new ShardingPolicy).
+"""
+
+import asyncio
+
+import grpc
+import numpy as np
+import pytest
+
+from gubernator_tpu.api.grpc_glue import add_peers_servicer
+from gubernator_tpu.api.types import (
+    Algorithm,
+    PeerInfo,
+    RateLimitReq,
+    Status,
+    millisecond_now,
+)
+from gubernator_tpu.core.store import StoreConfig
+from gubernator_tpu.serve import metrics
+from gubernator_tpu.serve.backends import (
+    ExactBackend,
+    MeshBackend,
+    TpuBackend,
+)
+from gubernator_tpu.serve.config import BehaviorConfig, ServerConfig
+from gubernator_tpu.serve.instance import Instance
+from gubernator_tpu.serve.peers import ConsistentHashPicker, PeerClient
+from gubernator_tpu.serve.rescale import RescaleManager
+
+from tests.test_replication import (  # noqa: F401 (shared rig)
+    FakeClock,
+    _assert_same,
+    _fuzz_stream,
+    _pin_clock,
+    _snap,
+)
+
+ADDR = "127.0.0.1:1"
+T0 = 1_700_000_000_000
+
+
+def _req(key, hits=1, limit=5, duration=60_000,
+         algo=Algorithm.TOKEN_BUCKET):
+    return RateLimitReq(
+        name="resc", unique_key=key, hits=hits, limit=limit,
+        duration=duration, algorithm=algo,
+    )
+
+
+def _picker(hosts, me=None):
+    p = ConsistentHashPicker()
+    for h in hosts:
+        p.add(PeerClient(BehaviorConfig(), h, is_owner=(h == me)))
+    return p
+
+
+def _counter(metric, **labels) -> float:
+    m = metric.labels(**labels) if labels else metric
+    return m._value.get()
+
+
+# -- ownership_diff ---------------------------------------------------------
+
+
+def test_ownership_diff_pins_ring_semantics():
+    """The diff is exactly the set of self-owned keys the new ring
+    routes elsewhere, grouped by their NEW owner — and the new owner is
+    the new ring's get(), nothing else."""
+    me = "10.0.0.1:81"
+    hosts = [f"10.0.0.{i}:81" for i in range(1, 5)]
+    old = _picker(hosts, me=me)
+    keys = [f"od{i}" for i in range(400)]
+    # crc32 placement: not every joining host cuts THIS node's arc —
+    # roll candidate joiners until one takes over part of it
+    for j in range(9, 40):
+        new = _picker(hosts[:3] + [f"10.0.0.{j}:81"], me=me)
+        if old.ownership_diff(new, keys):
+            break
+    diff = old.ownership_diff(new, keys)
+    moved = {k for _, (_, ks) in diff.items() for k in ks}
+    for k in keys:
+        owned_old = old.get(k).is_owner
+        new_owner = new.get(k)
+        if owned_old and not new_owner.is_owner:
+            assert k in moved
+            assert k in dict([
+                (kk, None) for kk in diff[new_owner.host][1]
+            ])
+        else:
+            assert k not in moved
+    assert moved, "no key moved in 400 draws — ring fixture broken"
+    # the grouped client IS the new picker's client for that host
+    for host, (peer, _ks) in diff.items():
+        assert peer is new.get_peer_by_host(host)
+    # identical rings diff to nothing
+    assert old.ownership_diff(old, keys) == {}
+    # empty rings diff to nothing rather than raising
+    assert ConsistentHashPicker().ownership_diff(new, keys) == {}
+    assert old.ownership_diff(ConsistentHashPicker(), keys) == {}
+
+
+# -- manager tables ---------------------------------------------------------
+
+
+class _DummyInstance:
+    pass
+
+
+def _mgr(**conf_kw) -> RescaleManager:
+    conf = ServerConfig(
+        grpc_address=ADDR, advertise_address=ADDR, rescale=True,
+        **conf_kw,
+    )
+    return RescaleManager(conf, _DummyInstance())
+
+
+def test_note_owned_gates_and_freshest_kept_eviction():
+    m = _mgr(rescale_track_keys=2)
+    m.note_owned(_req("a", hits=0))  # peek: cannot create a window
+    m.note_owned(_req("b", algo=Algorithm.LEAKY_BUCKET))  # out of scope
+    assert m.tracked_len == 0
+    before = _counter(metrics.RESCALE_DROPPED, what="track_evict")
+    m.note_owned(_req("a"))
+    m.note_owned(_req("b"))
+    m.note_owned(_req("a", limit=9))  # re-touch refreshes (limit 9)
+    m.note_owned(_req("c"))  # at capacity: "b" (stalest touch) evicts
+    assert sorted(m._tracked) == sorted(
+        [_req("a").hash_key(), _req("c").hash_key()]
+    )
+    assert m._tracked[_req("a").hash_key()][1] == 9
+    assert _counter(
+        metrics.RESCALE_DROPPED, what="track_evict"
+    ) == before + 1
+
+
+def test_note_owned_fields_bridge_tier():
+    m = _mgr()
+    keys = ["a", "b", "c", "d"]
+    fields = dict(
+        hits=np.array([1, 0, 2, 1], np.int64),
+        limit=np.array([5, 5, 7, 5], np.int64),
+        duration=np.full(4, 60_000, np.int64),
+        algo=np.array([0, 0, 0, 1], np.int32),
+    )
+    m.note_owned_fields(keys, fields)
+    # b is a peek and d is leaky: ineligible
+    assert sorted(m._tracked) == ["a", "c"]
+    assert m._tracked["c"][1] == 7
+
+
+def test_pending_install_lww_bound_pop_and_purge():
+    async def run():
+        m = _mgr(rescale_track_keys=2)
+
+        class _Inst:
+            def get_peer(self, key):
+                raise RuntimeError("not owned")
+
+        m.instance = _Inst()
+        now = millisecond_now()
+        newer = _snap("k1", remaining=1, reset_time=now + 9000, now=now)
+        older = _snap("k1", remaining=3, reset_time=now + 4000, now=now)
+        await m.install("o:1", [newer])
+        await m.install("o:1", [older])  # LWW: older loses
+        assert m._pending["k1"].remaining == 1
+        await m.install("o:1", [newer])  # duplicate: idempotent no-op
+        assert m.pending_len == 1
+        await m.install("o:1", [_snap("k2", now=now),
+                                _snap("k3", now=now)])
+        assert m.pending_len == 2  # bounded: stalest evicted
+        # expired snapshots are refused outright
+        await m.install("o:1", [_snap("k4", reset_time=now - 1, now=now)])
+        assert "k4" not in m._pending
+        # pop is one-shot and expiry-gated
+        assert m.pending_pop("k3") is not None
+        assert m.pending_pop("k3") is None
+        # an owner broadcast supersedes a parked handoff
+        await m.install("o:1", [_snap("k5", now=now)])
+        m.pending_purge(["k5"])
+        assert m.pending_pop("k5") is None
+
+    asyncio.run(run())
+
+
+def test_route_override_double_serve_window():
+    me = "10.0.0.1:81"
+    hosts = [f"10.0.0.{i}:81" for i in range(1, 5)]
+    old = _picker(hosts, me=me)
+    new = _picker(hosts[:3] + ["10.0.0.9:81"], me=me)
+    m = _mgr(rescale_double_serve=60.0)
+    m.note_ring_change(old, new)
+    keys = [f"ov{i}" for i in range(300)]
+    routed = local = 0
+    for k in keys:
+        r = _req(k, hits=0)
+        ov = m.route_override(k, r)
+        o, n = old.get(k), new.get(k)
+        if o.host == n.host or n.is_owner:
+            assert ov is None  # unmoved, or we ARE the new owner
+        elif o.is_owner:
+            # this node is the OLD owner: serve locally (the returned
+            # client is the live self client) and count + re-dirty
+            assert ov is o and ov.is_owner
+            local += 1
+        elif o.host not in {p.host for p in new.peers()}:
+            assert ov is None  # old owner left the ring: no stand-in
+        else:
+            assert ov is not None and ov.host == o.host
+            routed += 1
+    assert routed, "no moved key in 300 draws — ring fixture broken"
+    # a closed window stops overriding and retires the transition
+    m._transition = (old, new, 0.0)
+    assert m.route_override(keys[0], _req(keys[0])) is None
+    assert m._transition is None
+
+
+def test_failed_reconcile_retries_until_delivered():
+    """A moved key whose handoff send FAILS for the whole double-serve
+    window must stay in the moved/tracked tables and keep retrying
+    every tick — dropping it would strand the window on this node
+    forever (a later ring change's diff cannot re-move it), the exact
+    amnesia the subsystem exists to prevent."""
+
+    async def run():
+        m = _mgr(rescale_double_serve=0.0)  # window already closed
+        key = "stranded"
+        reset = millisecond_now() + 60_000
+
+        class _Peer:
+            host = "10.0.0.2:81"
+            is_owner = False
+            fail = True
+            sent = []
+
+            async def replicate_buckets(self, snaps, owner=""):
+                if self.fail:
+                    raise ConnectionError("door not ready")
+                self.sent.extend(s.key for s in snaps)
+
+        peer = _Peer()
+
+        class _Backend:
+            inline_decide = True
+
+            def snapshot_read(self, keys, now=None):
+                return [(5, 60_000, 0, reset, True) for _ in keys]
+
+        class _Inst:
+            backend = _Backend()
+
+            def get_peer(self, k):
+                return peer
+
+        m.instance = _Inst()
+        m._tracked[key] = (0, 5, 60_000)
+        m._moved[key] = (0, 5, 60_000)
+        await m.flush_once()  # send fails: nothing may retire
+        assert key in m._moved and key in m._tracked
+        peer.fail = False
+        await m.flush_once()  # delivered: now it retires
+        assert peer.sent == [key]
+        assert key not in m._moved and key not in m._tracked
+
+    asyncio.run(run())
+
+
+def test_flap_returned_key_stays_tracked():
+    """A moved key the ring gives BACK to this node mid-window leaves
+    the moved set but remains tracked — it is a live owned window
+    again and must ride the NEXT ring change."""
+
+    async def run():
+        m = _mgr(rescale_double_serve=0.0)
+
+        class _Self:
+            host = ADDR
+            is_owner = True
+
+        class _Inst:
+            def get_peer(self, k):
+                return _Self()
+
+        m.instance = _Inst()
+        m._tracked["back"] = (0, 5, 60_000)
+        m._moved["back"] = (0, 5, 60_000)
+        await m.flush_once()
+        assert "back" not in m._moved
+        assert "back" in m._tracked
+
+    asyncio.run(run())
+
+
+def test_drain_ships_pending_snapshots():
+    """A draining node forwards its PARKED pending snapshots (windows
+    handed to it whose first owned touch never came) to the
+    ring-minus-self owners — they must not die with the process."""
+
+    async def run():
+        m = _mgr()
+        other = PeerClient(BehaviorConfig(), "10.0.0.2:81")
+        sent = []
+
+        async def record(snaps, owner=""):
+            sent.extend(s.key for s in snaps)
+
+        other.replicate_buckets = record
+        picker = ConsistentHashPicker()
+        picker.add(PeerClient(BehaviorConfig(), ADDR, is_owner=True))
+        picker.add(other)
+
+        class _Inst:
+            pass
+
+        inst = _Inst()
+        inst.picker = picker
+
+        class _Backend:
+            inline_decide = True
+
+            def snapshot_read(self, keys, now=None):
+                return [None for _ in keys]  # nothing tracked-live
+
+        inst.backend = _Backend()
+        m.instance = inst
+        now = millisecond_now()
+        m._pending["pk1"] = _snap("pk1", reset_time=now + 60_000,
+                                  now=now)
+        m._pending["expired"] = _snap("expired", reset_time=now - 1,
+                                      now=now)
+        await m.drain()
+        assert sent == ["pk1"]  # live pending forwarded, expired not
+
+    asyncio.run(run())
+
+
+# -- differential identity: rescale ON == OFF on a static ring --------------
+
+
+def _conf(backend="exact", **kw) -> ServerConfig:
+    conf = ServerConfig(
+        grpc_address=ADDR,
+        advertise_address=ADDR,
+        backend=backend,
+        rescale=True,
+        replication_sync_wait=60.0,  # flushes driven manually
+        behaviors=BehaviorConfig(
+            peer_timeout=0.2, peer_retries=0, peer_backoff=0.001,
+            peer_backoff_max=0.002, breaker_failures=3,
+            breaker_cooldown=0.2,
+        ),
+    )
+    for k, v in kw.items():
+        setattr(conf, k, v)
+    return conf
+
+
+async def _fuzz_pair(mk_backend, clock, steps, seed):
+    """ON and OFF twins on an identical STATIC 2-host ring; only the
+    GUBER_RESCALE knob differs, and only self-owned keys are driven —
+    the static-ring identity contract. The manager's flush loop runs
+    (manually ticked) and must act on nothing."""
+    from tests._util import free_ports
+
+    def owned(dead_addr, count=200):
+        picker = ConsistentHashPicker()
+        mecl = PeerClient(BehaviorConfig(), ADDR, is_owner=True)
+        picker.add(mecl)
+        picker.add(PeerClient(BehaviorConfig(), dead_addr))
+        return [
+            f"f{i}" for i in range(count)
+            # the shared _fuzz_stream issues name="replfuzz" requests;
+            # the ownership screen must hash the same keys
+            if picker.get(
+                RateLimitReq(
+                    name="replfuzz", unique_key=f"f{i}"
+                ).hash_key()
+            ) is mecl
+        ]
+
+    for port in free_ports(16):
+        dead = f"127.0.0.1:{port}"
+        keys = owned(dead)[:12]
+        if len(keys) >= 8:
+            break
+    assert len(keys) >= 8, "no workable ring split in 16 rolls"
+
+    async def mk(rescale):
+        conf = _conf(rescale=rescale)
+        inst = Instance(conf, mk_backend())
+        inst.start()
+        await inst.set_peers([
+            PeerInfo(address=ADDR, is_owner=True),
+            PeerInfo(address=dead, is_owner=False),
+        ])
+        return inst
+
+    on = await mk(True)
+    off = await mk(False)
+    if on.shed is not None:
+        on.shed.now_fn = clock
+        off.shed.now_fn = clock
+    try:
+        rng = np.random.default_rng(seed)
+        for step, batch, dt in _fuzz_stream(rng, keys, steps):
+            clock.t += dt
+            a = await on.get_rate_limits(batch)
+            b = await off.get_rate_limits(batch)
+            for x, y, r in zip(a, b, batch):
+                _assert_same(x, y, (step, r))
+            if step % 25 == 24:
+                await on.rescale.flush_once()  # static ring: a no-op
+        assert on.rescale.tracked_len > 0, "fuzz never tracked a window"
+        assert on.rescale.pending_len == 0
+    finally:
+        await on.stop()
+        await off.stop()
+
+
+@pytest.mark.parametrize("seed", [3, 11])
+def test_differential_identity_fuzz_exact(monkeypatch, seed):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+    asyncio.run(_fuzz_pair(lambda: ExactBackend(10_000), clock, 250, seed))
+
+
+def test_differential_identity_fuzz_device(monkeypatch):
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    def be():
+        return TpuBackend(StoreConfig(rows=16, slots=1 << 10),
+                          buckets=(16, 64))
+
+    asyncio.run(_fuzz_pair(be, clock, 100, 5))
+
+
+def test_differential_identity_fuzz_mesh(monkeypatch):
+    """The same ON==OFF identity through the 8-device simulated mesh
+    (instance -> batcher -> arrival prep -> merged submit -> shard_map
+    dispatch): the rescale tracked set is host state only and the
+    snapshot surface is non-mutating on the sharded store too."""
+    import jax
+
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    def be():
+        return MeshBackend(
+            StoreConfig(rows=4, slots=256),
+            devices=jax.devices(),
+            buckets=(16, 64),
+        )
+
+    asyncio.run(_fuzz_pair(be, clock, 60, 7))
+
+
+# -- add-node / remove-node handoff cycle over real gRPC --------------------
+
+
+def test_add_remove_node_handoff_never_under_admits():
+    """The tentpole end-to-end, in-process and replication-OFF (the
+    subsystem stands alone): drive a key over-limit on its owner, ADD a
+    node the ring elects as its new owner, hand off, and the key stays
+    over-limit on the new owner with the SAME window; then REMOVE the
+    node and the key is still over-limit back on the original ring —
+    never a fresh (under-admitting) window anywhere in the cycle."""
+    from tests._util import free_ports
+    from gubernator_tpu.serve.server import PeersV1Servicer
+
+    async def serve(inst, addr):
+        server = grpc.aio.server()
+        add_peers_servicer(server, PeersV1Servicer(inst))
+        assert server.add_insecure_port(addr) != 0
+        await server.start()
+        return server
+
+    def roll_addresses():
+        """Ports + a key that A owns on the 2-ring and C owns on the
+        3-ring; crc32 placement makes some port draws keyless, so
+        re-roll instead of StopIterating."""
+        for _ in range(16):
+            pa, pb, pc = free_ports(3)
+            addrs = [f"127.0.0.1:{p}" for p in (pa, pb, pc)]
+            ring2 = _picker(addrs[:2], me=addrs[0])
+            ring3 = _picker(addrs, me=addrs[0])
+            for i in range(512):
+                kh = _req(f"hk{i}").hash_key()
+                if (
+                    ring2.get(kh).is_owner
+                    and ring3.get(kh).host == addrs[2]
+                ):
+                    return addrs, f"hk{i}"
+        raise AssertionError("no A->C moving key in 16 port rolls")
+
+    async def run():
+        (addr_a, addr_b, addr_c), key = roll_addresses()
+
+        def conf_for(me):
+            c = _conf()
+            c.grpc_address = me
+            c.advertise_address = me
+            return c
+
+        async def boot(me, members):
+            inst = Instance(conf_for(me), ExactBackend(1000))
+            inst.start()
+            await inst.set_peers([
+                PeerInfo(address=h, is_owner=(h == me))
+                for h in members
+            ])
+            return inst, await serve(inst, me)
+
+        two = [addr_a, addr_b]
+        three = [addr_a, addr_b, addr_c]
+        a, srv_a = await boot(addr_a, two)
+        b, srv_b = await boot(addr_b, two)
+        c = srv_c = None
+        try:
+            r = (await a.get_rate_limits([_req(key, hits=9, limit=5)]))[0]
+            assert r.error == "" and r.status == Status.OVER_LIMIT
+            reset_time = r.reset_time
+            assert _req(key).hash_key() in a.rescale._tracked
+
+            # scale OUT: C joins; every node learns the new membership
+            # (C first, so the handoff install lands owned)
+            c, srv_c = await boot(addr_c, three)
+            for node, me in ((a, addr_a), (b, addr_b)):
+                await node.set_peers([
+                    PeerInfo(address=h, is_owner=(h == me))
+                    for h in three
+                ])
+            moved_before = _counter(metrics.RESCALE_KEYS_MOVED)
+            await a.rescale.flush_once()
+            assert _counter(metrics.RESCALE_KEYS_MOVED) > moved_before
+
+            # the NEW owner answers the SAME frozen window: over-limit,
+            # zero remaining, the original reset_time — no amnesia
+            r = (await c.get_rate_limits([_req(key, hits=0, limit=5)]))[0]
+            assert r.error == ""
+            assert r.status == Status.OVER_LIMIT, (
+                "quota amnesia on scale-out: the new owner opened a "
+                "fresh window"
+            )
+            # created-over windows keep remaining == limit (the
+            # reference's sticky-over semantics); the frozen refusal
+            # and its ORIGINAL reset survive the move
+            assert r.remaining == 5 and r.reset_time == reset_time
+            # and through a forwarding peer (normal routing, post-flip)
+            a.rescale._transition = None  # close the double-serve window
+            r = (await a.get_rate_limits([_req(key, hits=0, limit=5)]))[0]
+            assert r.error == "" and r.status == Status.OVER_LIMIT
+
+            # scale IN: C leaves; C's own ring change ships its owned
+            # windows back to the 2-ring owners before it goes
+            for node, me in ((a, addr_a), (b, addr_b), (c, addr_c)):
+                await node.set_peers([
+                    PeerInfo(address=h, is_owner=(h == me))
+                    for h in two
+                ])
+            await c.rescale.flush_once()
+            r = (await a.get_rate_limits([_req(key, hits=0, limit=5)]))[0]
+            assert r.error == ""
+            assert r.status == Status.OVER_LIMIT, (
+                "quota amnesia on scale-in: the returning owner opened "
+                "a fresh window"
+            )
+            assert r.remaining == 5 and r.reset_time == reset_time
+        finally:
+            await srv_a.stop(None)
+            await srv_b.stop(None)
+            if srv_c is not None:
+                await srv_c.stop(None)
+            await a.stop()
+            await b.stop()
+            if c is not None:
+                await c.stop()
+
+    asyncio.run(run())
+
+
+# -- satellites: replication under a ring flip ------------------------------
+
+
+def test_replication_flush_resolves_successor_post_flip():
+    """Ring-flip-mid-flush (r17 satellite): a membership change landing
+    while the snapshot gather is in flight must re-resolve successors
+    against the POST-change ring — the pre-change successor receives
+    nothing."""
+    from gubernator_tpu.serve.replication import ReplicationManager
+
+    async def run():
+        conf = _conf()
+        conf.replication = True
+        inst = Instance(conf, ExactBackend(1000))
+        inst.start()
+        hosts = [ADDR, "10.0.0.2:81", "10.0.0.3:81"]
+        await inst.set_peers([
+            PeerInfo(address=h, is_owner=(h == ADDR)) for h in hosts
+        ])
+        repl = inst.repl
+        sent = {}
+
+        async def record(self, snaps, owner=""):
+            sent.setdefault(self.host, []).extend(s.key for s in snaps)
+
+        for p in inst.picker.peers():
+            p.replicate_buckets = record.__get__(p)
+        try:
+            # a self-owned key whose successor DIFFERS between the
+            # 3-ring and the 2-ring without its current successor
+            key = None
+            for i in range(512):
+                k = _req(f"ff{i}").hash_key()
+                if not inst.get_peer(k).is_owner:
+                    continue
+                succ3 = inst.picker.get_successor(k).host
+                ring2 = _picker(
+                    [h for h in hosts if h != succ3], me=ADDR
+                )
+                if ring2.get_successor(k).host != succ3:
+                    key, old_succ = k, succ3
+                    new_succ = ring2.get_successor(k).host
+                    survivors = [h for h in hosts if h != succ3]
+                    break
+            assert key is not None, "no successor-flipping key found"
+
+            await inst.get_rate_limits(
+                [_req(f"ff{i}") for i in range(512)
+                 if _req(f"ff{i}").hash_key() == key]
+            )
+            assert key in repl._dirty
+
+            # the flip lands while the flush's snapshot gather is in
+            # flight (the await point a device read would park on)
+            orig = repl._snapshot
+
+            async def snapshot_then_flip(metas):
+                snaps = await orig(metas)
+                await inst.set_peers([
+                    PeerInfo(address=h, is_owner=(h == ADDR))
+                    for h in survivors
+                ])
+                # re-stub the rebuilt ring's clients
+                for p in inst.picker.peers():
+                    p.replicate_buckets = record.__get__(p)
+                return snaps
+
+            repl._snapshot = snapshot_then_flip
+            await repl.flush_once()
+            assert key in sent.get(new_succ, []), (
+                f"snapshot not shipped to the post-flip successor "
+                f"({sent})"
+            )
+            assert key not in sent.get(old_succ, []), (
+                "snapshot shipped to the PRE-flip successor"
+            )
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+def test_standby_purged_when_no_longer_successor():
+    """Post-reshuffle standby hygiene (r17 satellite): rows for keys
+    this node neither owns nor succeeds on the new ring are dropped
+    (they could otherwise seed a WRONG takeover window later); rows it
+    still succeeds — or now owns — survive."""
+    async def run():
+        conf = _conf()
+        conf.replication = True
+        inst = Instance(conf, ExactBackend(1000))
+        inst.start()
+        hosts = [ADDR, "10.0.0.2:81", "10.0.0.3:81", "10.0.0.4:81"]
+        await inst.set_peers([
+            PeerInfo(address=h, is_owner=(h == ADDR)) for h in hosts
+        ])
+        try:
+            repl = inst.repl
+            now = millisecond_now()
+            # park standby rows for keys of EVERY succession class
+            keys = [f"sp{i}" for i in range(256)]
+            for k in keys:
+                repl._standby[k] = _snap(k, reset_time=now + 60_000,
+                                         now=now)
+            # reshuffle: one non-self host leaves
+            survivors = hosts[:2] + hosts[3:]
+            await inst.set_peers([
+                PeerInfo(address=h, is_owner=(h == ADDR))
+                for h in survivors
+            ])
+            # set_peers already purged (the Instance hook); verify the
+            # invariant the purge pins
+            for k in list(repl._standby):
+                own = inst.get_peer(k).is_owner
+                succ = inst.picker.get_successor(k)
+                assert own or (succ is not None and succ.is_owner), (
+                    f"stale standby row survived for '{k}'"
+                )
+            purged = set(keys) - set(repl._standby)
+            assert purged, "reshuffle purged nothing — fixture broken"
+            for k in purged:
+                own = inst.get_peer(k).is_owner
+                succ = inst.picker.get_successor(k)
+                assert not (
+                    own or (succ is not None and succ.is_owner)
+                ), f"purge dropped a row this node still covers ('{k}')"
+        finally:
+            await inst.stop()
+
+    asyncio.run(run())
+
+
+# -- GUBER_SHARDS re-partition identity -------------------------------------
+
+
+def _drive_windows(be, n=64, now=T0):
+    """Mixed live token windows: under, exhausted, created-over
+    (sticky), plus a leaky entry that must NOT migrate."""
+    reqs = []
+    for i in range(n):
+        kind = i % 4
+        reqs.append(RateLimitReq(
+            name="rp", unique_key=f"rp{i}",
+            hits=(2, 5, 9, 1)[kind],
+            limit=(10, 5, 5, 10)[kind],
+            duration=60_000,
+            algorithm=(
+                Algorithm.LEAKY_BUCKET if kind == 3
+                else Algorithm.TOKEN_BUCKET
+            ),
+        ))
+    be.decide(reqs, [False] * n, now=now)
+    return [r.hash_key() for r in reqs]
+
+
+def _rows_mod_duration(rows):
+    """snapshot_read rows with the duration column dropped: replica
+    installs (upsert_globals) do not persist duration — the documented
+    r11 convention — so a re-partitioned store reports 0 there."""
+    return [
+        None if r is None else (r[0], r[2], r[3], r[4]) for r in rows
+    ]
+
+
+def test_repartition_flat_to_mesh_preserves_every_window():
+    import jax
+
+    from gubernator_tpu.parallel.policy import ShardingPolicy
+
+    flat = TpuBackend(StoreConfig(rows=4, slots=256), buckets=(64,))
+    keys = _drive_windows(flat)
+    mesh_engine = flat.engine.repartition(
+        ShardingPolicy.over_mesh(jax.devices()), now=T0 + 5
+    )
+    a = flat.snapshot_read(keys, now=T0 + 5)
+    from gubernator_tpu.core.hashing import slot_hash_batch
+
+    b = mesh_engine.snapshot_read(slot_hash_batch(keys), now=T0 + 5)
+    assert _rows_mod_duration(a) == _rows_mod_duration(b)
+    live = [r for r in a if r is not None]
+    assert len(live) == 48  # leaky windows excluded by scope
+    # decisions continue identically on the re-partitioned store
+    hits = np.ones(len(keys), np.int64)
+    kh = slot_hash_batch(keys)
+    lim = np.full(len(keys), 5, np.int64)
+    dur = np.full(len(keys), 60_000, np.int64)
+    algo = np.zeros(len(keys), np.int32)
+    gnp = np.zeros(len(keys), bool)
+    token = [i for i in range(len(keys)) if i % 4 != 3]
+    ra = flat.engine.decide_arrays(
+        kh[token], hits[token], lim[token], dur[token], algo[token],
+        gnp[token], T0 + 10,
+    )
+    rb = mesh_engine.decide_arrays(
+        kh[token], hits[token], lim[token], dur[token], algo[token],
+        gnp[token], T0 + 10,
+    )
+    for x, y in zip(ra, rb):
+        np.testing.assert_array_equal(
+            np.asarray(x, np.int64), np.asarray(y, np.int64)
+        )
+
+
+def test_mesh_backend_repartition_shard_count_change():
+    """MeshBackend.repartition: 8 shards -> 2 shards -> flat, every
+    live window preserved at each step (the GUBER_SHARDS change path);
+    sticky-over windows keep answering OVER on a peek."""
+    import jax
+
+    be = MeshBackend(
+        StoreConfig(rows=4, slots=256), devices=jax.devices(),
+        buckets=(64,),
+    )
+    keys = _drive_windows(be)
+    want = _rows_mod_duration(be.snapshot_read(keys, now=T0 + 5))
+    assert be.engine.n == 8
+    be.repartition(devices=jax.devices()[:2], now=T0 + 5)
+    assert be.engine.n == 2
+    assert _rows_mod_duration(
+        be.snapshot_read(keys, now=T0 + 5)
+    ) == want
+    be.repartition(devices=jax.devices()[:1], now=T0 + 5)
+    assert be.engine.flat
+    assert _rows_mod_duration(
+        be.snapshot_read(keys, now=T0 + 5)
+    ) == want
+    # over-limit state survived two re-partitions: a created-over
+    # window (kind 2, sticky, remaining == limit) and an exhausted one
+    # (kind 1, remaining == 0) both still peek OVER with their exact
+    # remaining counts — no window re-opened anywhere in the chain
+    sticky = [RateLimitReq(name="rp", unique_key=f"rp{i}", hits=0,
+                           limit=5, duration=60_000)
+              for i in range(64) if i % 4 == 2]
+    exhausted = [RateLimitReq(name="rp", unique_key=f"rp{i}", hits=0,
+                              limit=5, duration=60_000)
+                 for i in range(64) if i % 4 == 1]
+    for r in be.decide(sticky, [False] * len(sticky), now=T0 + 6):
+        assert r.status == Status.OVER_LIMIT and r.remaining == 5
+    for r in be.decide(exhausted, [False] * len(exhausted), now=T0 + 6):
+        assert r.status == Status.OVER_LIMIT and r.remaining == 0
+
+
+def test_export_windows_empty_and_scope():
+    flat = TpuBackend(StoreConfig(rows=4, slots=256), buckets=(64,))
+    w = flat.engine.export_windows(now=T0)
+    assert w["key_hash"].shape[0] == 0  # nothing ever decided
+    _drive_windows(flat, n=8)
+    w = flat.engine.export_windows(now=T0 + 5)
+    assert w["key_hash"].shape[0] == 6  # 2 leaky entries out of scope
+    # expired windows drop out of the export
+    w = flat.engine.export_windows(now=T0 + 120_000)
+    assert w["key_hash"].shape[0] == 0
