@@ -14,6 +14,14 @@ speaks credit-windowed binary frames via gubernator_tpu.client_geb
 keep the generator off the critical path and exercise the new client
 end to end.
 
+r18: `--protocol shm` drives the bridge's shared-memory lane (requires
+a co-located bridge socket path; refuses to fall back so the A/B pair
+measures the lane, not a silent downgrade), and `--ring-route 1` turns
+on the client's per-owner fast routing against a multi-node ring. The
+`--json` summary carries `client` (the client's stats dict: negotiated
+transport, downgrades and reason, frames_shm) so perf_gate can assert
+the MECHANISM that carried the load, not just the rate.
+
 `--share S` (0..1) switches the workload to the shed-r10 shape: hot
 limit-1 keys frozen over limit mixed with never-over keys so a
 fraction ~S of items answer OVER_LIMIT (`--share 0` = all cold). The
@@ -120,14 +128,28 @@ def _shed_pool(
 CALL_TIMEOUT = 30.0
 
 
-def _make_client(protocol: str, address: str, window: int, mode: str):
+def _make_client(
+    protocol: str,
+    address: str,
+    window: int,
+    mode: str,
+    ring_route: bool = False,
+):
     if protocol == "grpc":
         return AsyncV1Client(address)
-    if protocol == "geb":
+    if protocol in ("geb", "shm"):
         from gubernator_tpu.client_geb import AsyncGebClient
 
+        # `geb` pins the socket transport so the r18 shm_r18 A/B pair
+        # measures the lane, not whatever happened to negotiate;
+        # `shm` refuses to run without the mapped ring
         return AsyncGebClient(
-            address, window=window, mode=mode, timeout=CALL_TIMEOUT
+            address,
+            window=window,
+            mode=mode,
+            timeout=CALL_TIMEOUT,
+            shm="require" if protocol == "shm" else "off",
+            ring_route=ring_route,
         )
     if protocol == "http":
         from gubernator_tpu.client_geb import AsyncHttpGebClient
@@ -156,8 +178,9 @@ async def run(
     keyspace: int = 0,
     algorithm: str = "token",
     chain_depth: int = 0,
+    ring_route: bool = False,
 ) -> dict:
-    client = _make_client(protocol, address, window, mode)
+    client = _make_client(protocol, address, window, mode, ring_route)
     algo = ALGOS[algorithm]
     if share >= 0.0:
         batches = _shed_pool(share, batch, keyspace, algo, chain_depth)
@@ -227,6 +250,11 @@ async def run(
             if stats["sent"]
             else 0.0,
         )
+        if hasattr(client, "stats"):
+            # r18: record what actually negotiated (transport, fast vs
+            # string framing, ring routing, downgrade reason) so A/B
+            # runs can prove which lane carried the load
+            summary["client"] = client.stats()
         print(
             f"sent={stats['sent']} over_limit={stats['over']} "
             f"errors={stats['errors']} rate={rate:.0f}/s",
@@ -243,11 +271,14 @@ def main(argv=None) -> int:
     parser.add_argument("address", nargs="?", default="127.0.0.1:9090")
     parser.add_argument(
         "--protocol",
-        choices=("grpc", "geb", "http"),
+        choices=("grpc", "geb", "http", "shm"),
         default="grpc",
-        help="front door: gRPC protobuf, binary GEB frames "
-        "(daemon GUBER_GEB_PORT or a bridge socket path), or binary "
-        "GEB over HTTP POST /v1/geb",
+        help="front door: gRPC protobuf, binary GEB frames over the "
+        "socket (daemon GUBER_GEB_PORT or a bridge socket path; shm "
+        "negotiation pinned OFF so A/B pairs stay honest), binary GEB "
+        "over HTTP POST /v1/geb, or the r18 shared-memory lane "
+        "(requires a co-located bridge unix socket; refuses to fall "
+        "back)",
     )
     parser.add_argument("--keys", type=int, default=2000)
     parser.add_argument("--concurrency", type=int, default=10)
@@ -275,6 +306,12 @@ def main(argv=None) -> int:
         "--mode", choices=("auto", "fast", "string"), default="auto",
         help="geb/http framing: pre-hashed fast records vs string "
         "items (auto negotiates via the hello)",
+    )
+    parser.add_argument(
+        "--ring-route", type=int, choices=(0, 1), default=0,
+        help="geb/shm protocols: 1 = shard fast frames per owner "
+        "across per-node connections on a multi-node ring (r18 "
+        "client-side routing); 0 = the classic single connection",
     )
     parser.add_argument(
         "--algorithm", choices=sorted(ALGOS), default="token",
@@ -309,6 +346,7 @@ def main(argv=None) -> int:
             keyspace=args.keyspace,
             algorithm=args.algorithm,
             chain_depth=args.chain_depth,
+            ring_route=bool(args.ring_route),
         )
     )
     return 0

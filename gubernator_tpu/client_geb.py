@@ -51,11 +51,15 @@ tests/test_geb_client.py pins them equal.
 from __future__ import annotations
 
 import asyncio
+import bisect
 import hashlib
+import logging
+import os
 import struct
 import threading
+import zlib
 from dataclasses import dataclass, field
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from gubernator_tpu.api.types import (
     Algorithm,
@@ -92,12 +96,17 @@ MAGIC_WFAST_REQ = 0x37424547  # 'GEB7'
 MAGIC_WFAST_RESP = 0x38424547  # 'GEB8'
 MAGIC_WCHAIN = 0x43424547  # 'GEBC' — chain-extended string req (r15)
 MAGIC_WTRACE = 0x54424547  # 'GEBT' — trace-extended string req (r16)
+MAGIC_SHM_REQ = 0x4D424547  # 'GEBM' — map a shared-memory lane (r18)
+MAGIC_SHM_OK = 0x4E424547  # 'GEBN' — lane reply (path_len 0 = refused)
 
 HELLO_FAST = 1
 HELLO_WINDOWED = 2
 HELLO_XXH64 = 4
 HELLO_CHAIN = 8  # server accepts GEBC chain-extended frames (r15)
 HELLO_TRACE = 16  # server accepts GEBT trace-extended frames (r16)
+HELLO_SHM = 32  # this connection may negotiate the shm lane (r18)
+
+log = logging.getLogger("gubernator_tpu.client_geb")
 
 DRAIN_FRAME_ID = 0xFFFFFFFF
 
@@ -249,6 +258,10 @@ class Hello:
         return bool(self.flags & HELLO_TRACE)
 
     @property
+    def shm(self) -> bool:
+        return bool(self.flags & HELLO_SHM)
+
+    @property
     def window(self) -> int:
         return max(1, self.flags >> 16) if self.windowed else 1
 
@@ -306,19 +319,24 @@ def parse_hello_bytes(buf: bytes) -> Hello:
 # -- frame codec ------------------------------------------------------------
 
 
+def _fast_eligible_item(r: RateLimitReq) -> bool:
+    """Per-item fast-framing eligibility (the ring router partitions
+    on this): BATCHING behavior, non-empty name/key, no quota chain."""
+    return bool(
+        r.behavior == Behavior.BATCHING
+        and r.name
+        and r.unique_key
+        and not r.chain
+    )
+
+
 def _fast_eligible(reqs: Sequence[RateLimitReq]) -> bool:
     """Fast records carry (hash, hits, limit, duration, algo) only: no
     behavior, no validation-error channel, no quota-chain levels.
     GLOBAL/NO_BATCHING items, empty names/keys, and chained requests
     (r15 — the 33-byte record has no varlen room) must ride string
     frames."""
-    return all(
-        r.behavior == Behavior.BATCHING
-        and r.name
-        and r.unique_key
-        and not r.chain
-        for r in reqs
-    )
+    return all(_fast_eligible_item(r) for r in reqs)
 
 
 def encode_fast_payload(reqs: Sequence[RateLimitReq]) -> bytes:
@@ -566,15 +584,35 @@ class AsyncGebClient:
         window: int = 0,
         mode: str = "auto",
         timeout: Optional[float] = None,
+        shm: str = "auto",
+        ring_route: Optional[bool] = None,
     ):
+        """`shm` (r18): 'auto' maps the shared-memory lane when the
+        endpoint is a unix socket and the hello advertises HELLO_SHM
+        (frames fall back to the control socket transparently when the
+        ring is full or torn); 'off' never negotiates; 'require' raises
+        at connect() unless the lane maps. `ring_route` (r18): on a
+        multi-node ring, shard fast frames per owner across per-node
+        connections instead of downgrading to string frames — default
+        from GUBER_CLIENT_RING_ROUTE (off). Ignored when routing can't
+        be sound (no fast capability, hash mismatch, missing peer
+        doors); stats() says why."""
         if mode not in ("auto", "fast", "string"):
             raise ValueError("mode must be 'auto', 'fast', or 'string'")
+        if shm not in ("auto", "off", "require"):
+            raise ValueError("shm must be 'auto', 'off', or 'require'")
         self._kind, self._addr = parse_endpoint(
             endpoint, "GEB endpoint"
         )
         self.endpoint = endpoint
         self.mode = mode
         self.timeout = timeout
+        self.shm = shm
+        if ring_route is None:
+            ring_route = os.environ.get(
+                "GUBER_CLIENT_RING_ROUTE", "0"
+            ).lower() not in ("0", "false", "no", "off", "")
+        self.ring_route = bool(ring_route)
         self._want_window = window
         self.hello: Optional[Hello] = None
         self._reader: Optional[asyncio.StreamReader] = None
@@ -589,6 +627,17 @@ class AsyncGebClient:
         self._legacy_lock: Optional[asyncio.Lock] = None
         self._conn_lock: Optional[asyncio.Lock] = None
         self._closed = False
+        # r18 satellite: auto-mode downgrades to string frames were
+        # silent — count them, log once, and surface the reason
+        self._downgrades = 0
+        self._downgrade_reason: Optional[str] = None
+        self._downgrade_logged = False
+        # r18 shm lane + ring router state
+        self._lane = None
+        self._ring_hash_override: Optional[int] = None
+        self._router: Optional["_RingRouter"] = None
+        self._frames_socket = 0
+        self._frames_shm = 0
 
     # -- connection ---------------------------------------------------------
 
@@ -621,11 +670,40 @@ class AsyncGebClient:
             self._inflight = {}
             self._sem = asyncio.Semaphore(self._window)
             self._legacy_lock = asyncio.Lock()
+            if self.shm != "off":
+                # negotiate BEFORE the read loop owns the reader: the
+                # GEBN reply is the only frame read inline post-hello
+                mapped = False
+                if (
+                    self._kind == "unix"
+                    and self._windowed
+                    and hello.shm
+                ):
+                    try:
+                        mapped = await self._negotiate_shm(
+                            reader, writer
+                        )
+                    except Exception:
+                        writer.close()
+                        self._reader = self._writer = None
+                        raise
+                if self.shm == "require" and not mapped:
+                    writer.close()
+                    self._reader = self._writer = None
+                    raise GebError(
+                        "shm='require' but no lane mapped (endpoint "
+                        "not a unix socket, server without HELLO_SHM, "
+                        "or the server refused the ring)"
+                    )
             if self._windowed:
                 self._read_task = asyncio.ensure_future(
                     self._read_loop(reader, writer)
                 )
-            return hello
+        if self.ring_route and self._router is None:
+            # outside _conn_lock: the router opens more AsyncGebClients
+            # whose own connect() must not deadlock on re-entry
+            self._maybe_start_router()
+        return self.hello
 
     def _negotiate(self, hello: Hello) -> None:
         self._windowed = hello.windowed
@@ -647,16 +725,155 @@ class AsyncGebClient:
             return
         # auto: fast only when provably sound — hash implementations
         # agree and the ring is single-node (fast frames bypass
-        # instance routing; multi-node fast routing is the edge's job)
+        # instance routing; multi-node fast routing is the edge's job,
+        # or — with ring_route (r18) — the router's below)
         self._use_fast = (
             hello.fast
             and hello.xxh64 == client_hash_is_native()
             and len(hello.nodes) <= 1
         )
+        if not self._use_fast:
+            if not hello.fast:
+                reason = "hello capability (no fast path advertised)"
+            elif hello.xxh64 != client_hash_is_native():
+                reason = "hash mismatch (server/client XXH64 tiers)"
+            else:
+                reason = "multi-node ring (fast frames bypass routing)"
+            if self.ring_route and reason.startswith("multi-node"):
+                # the router rescues exactly this case — not a
+                # downgrade; _maybe_start_router records if it can't
+                return
+            self._downgrades += 1
+            self._downgrade_reason = reason
+            if not self._downgrade_logged:
+                self._downgrade_logged = True
+                log.info(
+                    "geb client %s: auto mode downgraded to string "
+                    "frames — %s (logged once; see stats())",
+                    self.endpoint,
+                    reason,
+                )
+
+    async def _negotiate_shm(self, reader, writer) -> bool:
+        """Map the shared-memory lane (r18): send GEBM, read the GEBN
+        reply inline (the windowed read loop is not running yet), open
+        and start the lane. False = server refused (path_len 0) — the
+        connection simply continues on the socket."""
+        writer.write(_HDR.pack(MAGIC_SHM_REQ, 0))
+        await writer.drain()
+        magic, plen = _HDR.unpack(await reader.readexactly(8))
+        if magic != MAGIC_SHM_OK:
+            raise GebError(
+                f"bad shm negotiation reply magic {magic:#x}"
+            )
+        if plen == 0:
+            return False
+        if plen > 4096:
+            raise GebError(f"implausible shm path length {plen}")
+        await reader.readexactly(16)  # ring caps; the header governs
+        path = (await reader.readexactly(plen)).decode()
+        # stdlib-only module (no JAX); lazy so socket-only clients
+        # never touch it
+        from gubernator_tpu.serve.shm import ShmClientLane
+
+        poll_us = int(
+            os.environ.get("GUBER_SHM_POLL_US", "0") or 0
+        )
+        lane = ShmClientLane(path, poll_us=poll_us)
+        lane.start(
+            asyncio.get_running_loop(),
+            self._on_ring_frame,
+            self._on_ring_torn,
+            max_resp_len=MAX_FRAME_PAYLOAD + 64,
+        )
+        self._lane = lane
+        return True
+
+    def _on_ring_frame(self, data: bytes) -> None:
+        """One complete response frame popped from the s2c ring
+        (event-loop thread). The lane carries the exact socket frame
+        bytes, so this is `_read_loop`'s parse over a buffer."""
+        try:
+            magic, n = _HDR.unpack_from(data, 0)
+            if magic == MAGIC_STALE:
+                if n == DRAIN_FRAME_ID:
+                    exc: GebError = GebDrainingError(
+                        f"{self.endpoint} is draining; frame not "
+                        f"served (safe to retry elsewhere)"
+                    )
+                else:
+                    exc = GebStaleRingError(
+                        "frame refused: routed under a stale ring "
+                        "(GEBR); reconnect re-reads the hello"
+                    )
+                self._conn_lost(exc)
+                return
+            (fid,) = _U32.unpack_from(data, 8)
+            _check_wire_count(n)
+            if magic == MAGIC_WFAST_RESP:
+                resps = decode_fast_body(data[12:], n)
+            elif magic == MAGIC_WRESP:
+                resps = decode_string_body(data[12:], n)
+            else:
+                raise GebError(f"bad response magic {magic:#x}")
+        except (GebError, struct.error) as e:
+            self._conn_lost(
+                e if isinstance(e, GebError) else GebError(str(e))
+            )
+            return
+        fut = self._inflight.pop(fid, None)
+        if fut is not None and not fut.done():
+            fut.set_result(resps)
+
+    def _on_ring_torn(self, exc: Exception) -> None:
+        """The lane died under us (server teardown, drain, protocol
+        violation). Frames in flight on the ring are in-doubt — the
+        module's at-most-once stance is a connection loss; the next
+        call reconnects over the socket and may re-map."""
+        if self._lane is None:
+            return
+        self._conn_lost(exc)
+
+    def _maybe_start_router(self) -> None:
+        """Activate per-owner fast routing (r18) when it is provably
+        sound: multi-node ring, fast capability, matching hash tiers,
+        and a routable frame door for every peer. Records why not,
+        otherwise swaps get_rate_limits onto the router."""
+        hello = self.hello
+        if hello is None or self.mode == "string":
+            return
+        if len(hello.nodes) <= 1:
+            return  # single node: the direct fast path already won
+        reason = None
+        if not hello.fast:
+            reason = "hello capability (no fast path advertised)"
+        elif hello.xxh64 != client_hash_is_native():
+            reason = "hash mismatch (server/client XXH64 tiers)"
+        elif any(
+            not is_self and not door
+            for is_self, _, door in hello.nodes
+        ):
+            reason = "peer door unknown (GUBER_GEB_PEER_DOORS unset?)"
+        if reason is not None:
+            self._downgrades += 1
+            self._downgrade_reason = reason
+            if not self._downgrade_logged:
+                self._downgrade_logged = True
+                log.info(
+                    "geb client %s: ring routing unavailable — %s; "
+                    "staying on string frames (logged once)",
+                    self.endpoint,
+                    reason,
+                )
+            return
+        self._router = _RingRouter(self, hello)
 
     def _conn_lost(self, exc: Optional[BaseException]) -> None:
         """Fail everything still in flight and reset so the next call
         reconnects fresh (new hello, new ring)."""
+        lane, self._lane = self._lane, None
+        if lane is not None:
+            lane.close()
         inflight, self._inflight = self._inflight, {}
         self._reader = None
         if self._writer is not None:
@@ -690,6 +907,9 @@ class AsyncGebClient:
 
     async def close(self) -> None:
         self._closed = True
+        router, self._router = self._router, None
+        if router is not None:
+            await router.close()
         task = self._read_task
         self._conn_lost(GebError("client closed"))
         if task is not None:
@@ -698,6 +918,25 @@ class AsyncGebClient:
                 await task
             except (asyncio.CancelledError, Exception):
                 pass
+
+    def stats(self) -> dict:
+        """Operator-facing counters (r18 satellite): which transport
+        and framing this client actually negotiated, and whether auto
+        mode silently downgraded to string frames (and why)."""
+        transport = self._kind
+        if self._lane is not None:
+            transport = "shm"
+        return {
+            "endpoint": self.endpoint,
+            "mode": self.mode,
+            "transport": transport,
+            "use_fast": self._use_fast,
+            "ring_routed": self._router is not None,
+            "downgrades": self._downgrades,
+            "downgrade_reason": self._downgrade_reason,
+            "frames_socket": self._frames_socket,
+            "frames_shm": self._frames_shm,
+        }
 
     async def __aenter__(self):
         await self.connect()
@@ -723,7 +962,26 @@ class AsyncGebClient:
         SAMPLED trace context (serve.tracing, stdlib-only) when the
         server advertises HELLO_TRACE. Fast and chained frames drop
         the context (trace-free by design / no GEBC slot); pre-r16
-        servers never see GEBT."""
+        servers never see GEBT.
+
+        With ring routing active (r18), fast-eligible items shard per
+        owner across per-node connections; the rest ride this
+        connection's string frames. Responses return in input order."""
+        await self.connect()
+        if self._router is not None:
+            return await self._router.get_rate_limits(
+                reqs, timeout, trace
+            )
+        return await self._get_direct(reqs, timeout, trace)
+
+    async def _get_direct(
+        self,
+        reqs: Sequence[RateLimitReq],
+        timeout: Optional[float] = None,
+        trace=None,
+    ) -> List[RateLimitResp]:
+        """One batch -> one frame on THIS connection (the pre-r18
+        get_rate_limits body; the router calls it per shard)."""
         await self.connect()
         if (
             any(getattr(r, "chain", None) for r in reqs)
@@ -755,7 +1013,11 @@ class AsyncGebClient:
             fast=self._use_fast,
             windowed=True,
             frame_id=fid,
-            ring_hash=self.hello.ring_hash,
+            ring_hash=(
+                self._ring_hash_override
+                if self._ring_hash_override is not None
+                else self.hello.ring_hash
+            ),
             t_sent_us=int(loop.time() * 1e6),
             trace_ctx=trace_ctx,
         )
@@ -767,16 +1029,24 @@ class AsyncGebClient:
             sem.release()
             raise GebConnectionError("connection lost before send")
         self._inflight[fid] = fut
-        try:
-            writer.write(frame)
-            await writer.drain()
-        except (ConnectionError, OSError) as e:
-            self._inflight.pop(fid, None)
-            sem.release()
-            self._conn_lost(e)
-            raise GebConnectionError(
-                f"send to {self.endpoint} failed: {e}"
-            ) from e
+        # shm lane first (r18): False means no room right now or the
+        # frame outgrows the ring's bound — that frame (only) falls
+        # back to the control socket, same connection, same window
+        lane = self._lane
+        if lane is not None and lane.try_send(frame):
+            self._frames_shm += 1
+        else:
+            try:
+                writer.write(frame)
+                await writer.drain()
+                self._frames_socket += 1
+            except (ConnectionError, OSError) as e:
+                self._inflight.pop(fid, None)
+                sem.release()
+                self._conn_lost(e)
+                raise GebConnectionError(
+                    f"send to {self.endpoint} failed: {e}"
+                ) from e
         try:
             resps = await asyncio.wait_for(
                 fut, timeout if timeout is not None else self.timeout
@@ -904,7 +1174,27 @@ class AsyncGebClient:
             # a successor connection with its own loop and in-flight
             # table — a stale loop's exit must not fail it.
             if self._writer is writer or self._writer is None:
-                self._conn_lost(exc)
+                if self._lane is not None and self._inflight:
+                    # the socket EOF raced the lane: frames already
+                    # PUBLISHED to the ring (responses, or the GEBR
+                    # that explains this close) are ordered on the
+                    # ring but not against the socket — bounded grace
+                    # for the lane to deliver them before declaring
+                    # delivery unknown (a ring GEBR lands its own
+                    # _conn_lost with the refusal semantics)
+                    loop = asyncio.get_running_loop()
+                    deadline = loop.time() + 1.0
+                    try:
+                        while (
+                            self._lane is not None
+                            and self._inflight
+                            and loop.time() < deadline
+                        ):
+                            await asyncio.sleep(0.005)
+                    except asyncio.CancelledError:
+                        pass
+                if self._writer is writer or self._writer is None:
+                    self._conn_lost(exc)
 
 
 async def _read_string_items(reader, n: int) -> List[RateLimitResp]:
@@ -919,6 +1209,223 @@ async def _read_string_items(reader, n: int) -> List[RateLimitResp]:
         owner = (await reader.readexactly(olen)).decode()
         out.append(_string_resp(st, limit, rem, reset, err, owner))
     return out
+
+
+# -- client-side per-owner fast routing (r18) -------------------------------
+
+
+def _ring_point(key: str) -> int:
+    """crc32 ring point, byte-identical to core.hashing.ring_hash /
+    reference hash.go:40-42 (duplicated: this module stays JAX-free)."""
+    return zlib.crc32(key.encode("utf-8")) & 0xFFFFFFFF
+
+
+async def _fetch_hello(kind: str, addr) -> Hello:
+    """Read one fresh hello over a throwaway connection (GEBR healing:
+    the PRIMARY connection stays up while the ring view refreshes)."""
+    if kind == "unix":
+        reader, writer = await asyncio.open_unix_connection(addr)
+    else:
+        host, port = addr
+        reader, writer = await asyncio.open_connection(host, port)
+    try:
+        return await read_hello(reader)
+    finally:
+        writer.close()
+
+
+class _RingRouter:
+    """Shards fast-eligible items per owner across per-node GEB
+    connections — the compiled edge's routing, client-side.
+
+    The table is the picker's ring exactly (crc32 point per grpc
+    address, sorted, binary-search successor with wraparound on the
+    item's `name_key`), built from the hello's membership rows; each
+    node's frame door comes from the same rows (self = the primary
+    endpoint, peers = their advertised door). Every child connection
+    echoes the ROUTER's membership fingerprint — the hello this table
+    was built from, NOT the child's own fresher hello — so a server
+    whose ring moved refuses with GEBR instead of silently serving a
+    mis-routed frame. A GEBR refusal re-fetches the hello over a
+    throwaway connection, rebuilds the table, and retries the REFUSED
+    shards only (refused = un-served, so the retry is safe), bounded
+    at MAX_ATTEMPTS. Connection losses propagate (at-most-once).
+    Items fast framing cannot carry (GLOBAL/NO_BATCHING, empty
+    name/key, chains) ride the primary connection's string frames."""
+
+    MAX_ATTEMPTS = 3
+
+    def __init__(self, owner: "AsyncGebClient", hello: Hello):
+        self._owner = owner
+        self._children: Dict[str, AsyncGebClient] = {}
+        self._points: List[int] = []
+        self._hosts: List[str] = []
+        self._endpoints: Dict[str, str] = {}
+        self._ring_hash = 0
+        self.refreshes = 0
+        stale = self._install(hello)
+        assert not stale  # no children exist yet
+
+    def _install(self, hello: Hello) -> List["AsyncGebClient"]:
+        """(Re)build the table from a hello; returns the children the
+        new membership obsoletes (the caller closes them — this method
+        stays synchronous)."""
+        endpoints: Dict[str, str] = {}
+        points: List[Tuple[int, str]] = []
+        for is_self, grpc_addr, door in hello.nodes:
+            if is_self:
+                endpoints[grpc_addr] = self._owner.endpoint
+            elif door:
+                endpoints[grpc_addr] = door
+            else:
+                raise GebError(
+                    f"ring node {grpc_addr} advertises no frame door; "
+                    f"cannot route (GUBER_GEB_PEER_DOORS unset?)"
+                )
+            points.append((_ring_point(grpc_addr), grpc_addr))
+        points.sort()
+        if len({p for p, _ in points}) != len(points):
+            # mirror of the picker's collision refusal: placement
+            # would silently diverge between this table and the ring
+            raise GebError("ring point collision between peer addresses")
+        self._points = [p for p, _ in points]
+        self._hosts = [h for _, h in points]
+        self._ring_hash = hello.ring_hash
+        stale = []
+        for host, child in list(self._children.items()):
+            if endpoints.get(host) != child.endpoint:
+                stale.append(self._children.pop(host))
+            else:
+                child._ring_hash_override = self._ring_hash
+        self._endpoints = endpoints
+        return stale
+
+    def _child(self, host: str) -> "AsyncGebClient":
+        child = self._children.get(host)
+        if child is None:
+            child = AsyncGebClient(
+                self._endpoints[host],
+                window=self._owner._want_window,
+                mode="fast",
+                timeout=self._owner.timeout,
+                shm=self._owner.shm if self._owner.shm != "require"
+                else "auto",
+                ring_route=False,
+            )
+            child._ring_hash_override = self._ring_hash
+            self._children[host] = child
+        return child
+
+    def owner_of(self, key: str) -> str:
+        point = _ring_point(key)
+        i = bisect.bisect_left(self._points, point)
+        if i == len(self._points):
+            i = 0
+        return self._hosts[i]
+
+    async def _refresh(self) -> None:
+        hello = await _fetch_hello(
+            self._owner._kind, self._owner._addr
+        )
+        stale = self._install(hello)
+        self.refreshes += 1
+        for child in stale:
+            try:
+                await child.close()
+            except Exception:
+                pass
+
+    async def get_rate_limits(
+        self,
+        reqs: Sequence[RateLimitReq],
+        timeout: Optional[float] = None,
+        trace=None,
+    ) -> List[RateLimitResp]:
+        if not reqs:
+            # parity with the direct path's empty-batch refusal
+            return await self._owner._get_direct(reqs, timeout, trace)
+        results: List[Optional[RateLimitResp]] = [None] * len(reqs)
+        fast_items: List[Tuple[int, RateLimitReq]] = []
+        string_items: List[Tuple[int, RateLimitReq]] = []
+        for i, r in enumerate(reqs):
+            (fast_items if _fast_eligible_item(r) else
+             string_items).append((i, r))
+
+        async def run_string() -> None:
+            resps = await self._owner._get_direct(
+                [r for _, r in string_items], timeout, trace
+            )
+            for (i, _), resp in zip(string_items, resps):
+                results[i] = resp
+
+        string_task = (
+            asyncio.ensure_future(run_string())
+            if string_items
+            else None
+        )
+        try:
+            pending = fast_items
+            last_refusal: Optional[GebError] = None
+            for _attempt in range(self.MAX_ATTEMPTS):
+                if not pending:
+                    break
+                groups: Dict[str, List[Tuple[int, RateLimitReq]]] = {}
+                for i, r in pending:
+                    groups.setdefault(
+                        self.owner_of(r.hash_key()), []
+                    ).append((i, r))
+                hosts = list(groups)
+                outs = await asyncio.gather(
+                    *[
+                        self._child(h)._get_direct(
+                            [r for _, r in groups[h]], timeout
+                        )
+                        for h in hosts
+                    ],
+                    return_exceptions=True,
+                )
+                refused: List[Tuple[int, RateLimitReq]] = []
+                hard: Optional[BaseException] = None
+                for host, out in zip(hosts, outs):
+                    if isinstance(
+                        out, (GebStaleRingError, GebDrainingError)
+                    ):
+                        # refused = NOT served; retrying (against a
+                        # refreshed ring) is safe by the GEBR contract
+                        refused.extend(groups[host])
+                        last_refusal = out
+                    elif isinstance(out, BaseException):
+                        hard = out  # delivery unknown: propagate
+                    else:
+                        for (i, _), resp in zip(groups[host], out):
+                            results[i] = resp
+                if hard is not None:
+                    raise hard
+                pending = refused
+                if pending:
+                    await self._refresh()
+            if pending:
+                raise last_refusal or GebError(
+                    "ring routing exhausted retries"
+                )
+        except BaseException:
+            if string_task is not None:
+                string_task.cancel()
+                await asyncio.gather(
+                    string_task, return_exceptions=True
+                )
+            raise
+        if string_task is not None:
+            await string_task
+        return results  # type: ignore[return-value]
+
+    async def close(self) -> None:
+        children, self._children = self._children, {}
+        for child in children.values():
+            try:
+                await child.close()
+            except Exception:
+                pass
 
 
 # -- sync client ------------------------------------------------------------
@@ -936,9 +1443,16 @@ class GebClient:
         window: int = 0,
         mode: str = "auto",
         timeout: Optional[float] = 30.0,
+        shm: str = "auto",
+        ring_route: Optional[bool] = None,
     ):
         self._client = AsyncGebClient(
-            endpoint, window=window, mode=mode, timeout=timeout
+            endpoint,
+            window=window,
+            mode=mode,
+            timeout=timeout,
+            shm=shm,
+            ring_route=ring_route,
         )
         self._loop = asyncio.new_event_loop()
         self._thread = threading.Thread(
@@ -962,6 +1476,9 @@ class GebClient:
     @property
     def hello(self) -> Optional[Hello]:
         return self._client.hello
+
+    def stats(self) -> dict:
+        return self._client.stats()
 
     def get_rate_limits(
         self,

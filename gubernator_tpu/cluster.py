@@ -115,6 +115,16 @@ class LocalCluster:
             raise failure[0]
 
     async def _start_all(self) -> None:
+        # Per-node GEB door map (r18): on one host the symmetric-port
+        # convention (grpc port ⇒ geb port) is wrong — every node has a
+        # distinct geb port — so hand each node the full grpc→door map
+        # and the hello advertises routable doors to ring-routing
+        # clients (GUBER_GEB_PEER_DOORS).
+        doors = ",".join(
+            f"{a}=127.0.0.1:{p}"
+            for a, p in zip(self.addresses, self.geb_ports)
+            if p
+        )
         for addr, http_addr, geb_port in zip(
             self.addresses, self.http_addresses, self.geb_ports
         ):
@@ -128,6 +138,7 @@ class LocalCluster:
                 device_batch_wait=self._device_batch_wait,
                 backend="exact",
                 geb_port=geb_port,
+                geb_peer_doors=doors,
                 trace_sample=self._trace_sample,
             )
             if self._device_batch_limit is not None:

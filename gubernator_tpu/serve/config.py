@@ -190,6 +190,29 @@ class ServerConfig:
     # 32). Per-connection memory bound and pipelining depth, exactly
     # like GUBER_EDGE_WINDOW.
     geb_window: int = 0
+    # Explicit peer GEB-door map for the ring-routing client (r18):
+    # "grpc_addr=host:port,..." overrides the symmetric-port
+    # convention in the GEB listener's hello, exactly like
+    # GUBER_EDGE_PEER_BRIDGES does for the bridge — needed when nodes
+    # share a host (a localhost test cluster) or run heterogeneous
+    # port layouts and clients route fast frames per owner.
+    geb_peer_doors: str = ""
+    # Shared-memory GEB lane (r18, serve/shm.py): unix-socket bridge
+    # connections may negotiate a mmap'd ring pair (GEBM/GEBN after
+    # the hello) carrying the exact windowed frame bytes with no
+    # kernel socket hop. Served through the same FrameService core as
+    # every other door. GUBER_SHM=0 is the kill switch: the HELLO_SHM
+    # bit disappears and clients stay on the socket.
+    shm: bool = True
+    # Ring capacity per direction, KiB (bounded 64..1048576). One lane
+    # maps 2x this + a 4 KiB header; the client's credit window rides
+    # the ring capacity, so size it >= window * typical frame bytes.
+    shm_ring_kib: int = 1024
+    # Wakeup policy: 0 (default) = futex waits on the ring's seq words
+    # (idle lanes cost no CPU); > 0 = bounded busy-poll sleeping up to
+    # this many microseconds per check — lower latency on dedicated
+    # cores, at the price of burning them.
+    shm_poll_us: int = 0
     # String->array fold (r7 slow-path owner batching, bridge side): a
     # string frame whose items are ALL plain (BATCHING/NO_BATCHING,
     # valid non-empty name/key) and ALL owned by this node skips
@@ -608,6 +631,12 @@ class ServerConfig:
             raise ValueError("GUBER_GEB_PORT must be in 0..65535")
         if self.geb_window < 0:
             raise ValueError("GUBER_GEB_WINDOW must be >= 0")
+        if not (64 <= self.shm_ring_kib <= 1 << 20):
+            raise ValueError(
+                "GUBER_SHM_RING_KIB must be in 64..1048576"
+            )
+        if self.shm_poll_us < 0:
+            raise ValueError("GUBER_SHM_POLL_US must be >= 0")
         if self.drain_timeout < 0:
             raise ValueError("GUBER_DRAIN_TIMEOUT_MS must be >= 0")
         # bridge endpoints split host:port on the LAST colon — IPv6
@@ -624,6 +653,14 @@ class ServerConfig:
             if sep and bridge:
                 reject_ipv6_endpoint(
                     bridge, "GUBER_EDGE_PEER_BRIDGES entry"
+                )
+        for pair in self.geb_peer_doors.split(","):
+            if not pair.strip():
+                continue
+            _, sep, door = pair.strip().partition("=")
+            if sep and door:
+                reject_ipv6_endpoint(
+                    door, "GUBER_GEB_PEER_DOORS entry"
                 )
         if self.etcd_endpoints and self.k8s_endpoints_selector:
             raise ValueError(
@@ -741,6 +778,11 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         edge_window=_get_int(env, "GUBER_EDGE_WINDOW", 0),
         geb_port=_get_int(env, "GUBER_GEB_PORT", 0),
         geb_window=_get_int(env, "GUBER_GEB_WINDOW", 0),
+        geb_peer_doors=_get(env, "GUBER_GEB_PEER_DOORS"),
+        shm=_get(env, "GUBER_SHM", "1").lower()
+        not in ("0", "false", "no", "off"),
+        shm_ring_kib=_get_int(env, "GUBER_SHM_RING_KIB", 1024),
+        shm_poll_us=_get_int(env, "GUBER_SHM_POLL_US", 0),
         edge_string_fold=_get(env, "GUBER_EDGE_STRING_FOLD", "1").lower()
         not in ("0", "false", "no", "off"),
         edge_max_frame_mib=_get_int(env, "GUBER_EDGE_MAX_FRAME_MIB", 256),

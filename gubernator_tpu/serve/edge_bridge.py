@@ -207,6 +207,15 @@ HELLO_CHAIN = 8
 # Legacy peers negotiate it off by ignoring unknown bits and never
 # emitting GEBT.
 HELLO_TRACE = 16
+# hello flags bit 5 (r18): this CONNECTION may negotiate the
+# shared-memory GEB lane (serve/shm.py) with a GEBM request — set only
+# on unix-socket connections of an shm-enabled service, because the
+# lane maps a same-host file (re-exported from serve.shm, the owner).
+from gubernator_tpu.serve.shm import (  # noqa: E402
+    HELLO_SHM,
+    MAGIC_SHM_OK,
+    MAGIC_SHM_REQ,
+)
 
 DEFAULT_WINDOW = 32
 MAX_WINDOW = 1024
@@ -567,10 +576,19 @@ class FrameService:
         string_fold: bool = True,
         peer_bridges: Optional[dict] = None,
         max_payload: int = MAX_FRAME_PAYLOAD,
+        shm_enabled: bool = False,
+        shm_ring_kib: int = 0,
+        shm_poll_us: int = 0,
     ):
         self.instance = instance
         self.fast_enabled = fast_enabled
         self.string_fold = string_fold
+        # shared-memory lane policy (r18, serve/shm.py): negotiated
+        # per-connection via GEBM, advertised (HELLO_SHM) on unix
+        # sockets only — the lane maps a same-host file
+        self.shm_enabled = shm_enabled
+        self.shm_ring_kib = shm_ring_kib
+        self.shm_poll_us = shm_poll_us
         # per-door read-side payload cap: the client-facing doors bound
         # at MAX_FRAME_PAYLOAD; the trusted edge bridge passes
         # EDGE_MAX_FRAME_PAYLOAD (see the constants' rationale)
@@ -690,7 +708,7 @@ class FrameService:
         self._ring_hash_cache = (picker, h)
         return h
 
-    def _hello(self) -> bytes:
+    def _hello(self, shm: bool = False) -> bytes:
         """Capability + ring hello. Peer bridge endpoints follow the
         symmetric-fleet convention: every node's bridge listens on the
         same TCP port (the port of this node's GUBER_EDGE_TCP), on the
@@ -711,6 +729,10 @@ class FrameService:
         # HELLO_TRACE is a protocol capability (this core decodes GEBT
         # frames), not a sampling policy — advertised unconditionally
         flags = HELLO_WINDOWED | HELLO_TRACE | (self.window << 16)
+        if shm:
+            # per-CONNECTION capability (r18): only a unix-socket
+            # client of an shm-enabled service sees this bit
+            flags |= HELLO_SHM
         if getattr(getattr(self.instance, "conf", None), "chains", True):
             # advertise GEBC only when chains are actually served —
             # with the GUBER_CHAINS=0 kill switch on, the client's
@@ -1209,21 +1231,65 @@ class FrameService:
     def _frame_done(self, *_args) -> None:
         self._active_frames -= 1
 
+    def _conn_shm_ok(self, writer) -> bool:
+        """Shared-memory lanes are negotiable on this connection only
+        when the service allows them AND the transport proves
+        same-hostness (AF_UNIX)."""
+        if not self.shm_enabled:
+            return False
+        import socket as _socket
+
+        sock = writer.get_extra_info("socket")
+        return (
+            sock is not None
+            and getattr(sock, "family", None) == _socket.AF_UNIX
+        )
+
     async def _serve_conn(self, reader, writer):
         if self._stopping or self._draining:
             writer.close()
             return
         self._conns.add(writer)
         wstate = _ConnWindow(self.window)
+        shm_ok = self._conn_shm_ok(writer)
+        shm_sess = None
         try:
             # ring-carrying hello: capability flags + live membership
             # (rebuilt per connection; the edge refreshes by reconnecting)
-            writer.write(self._hello())
+            writer.write(self._hello(shm=shm_ok))
             await writer.drain()
             while True:
                 hdr = await reader.readexactly(_HDR.size)
                 t_frame0 = time.monotonic()
                 magic, n = _HDR.unpack(hdr)
+                if magic == MAGIC_SHM_REQ:
+                    # map-the-ring negotiation (r18): n is the client's
+                    # ring-size hint in KiB (0 = server default). One
+                    # lane per connection; anything off-policy answers
+                    # a refusal (path_len 0) and the socket continues.
+                    from gubernator_tpu.serve import shm as shm_mod
+
+                    reply = None
+                    if (
+                        shm_ok
+                        and shm_sess is None
+                        and not self._draining
+                    ):
+                        try:
+                            shm_sess, reply = (
+                                shm_mod.open_server_session(
+                                    self, n, writer
+                                )
+                            )
+                        except Exception:
+                            log.exception("shm lane negotiation failed")
+                            shm_sess, reply = None, None
+                    if reply is None:
+                        reply = shm_mod.shm_refusal()
+                    async with wstate.write_lock:
+                        writer.write(reply)
+                        await writer.drain()
+                    continue
                 if magic in (
                     MAGIC_WFAST_REQ, MAGIC_WREQ, MAGIC_WCHAIN,
                     MAGIC_WTRACE,
@@ -1394,6 +1460,8 @@ class FrameService:
         finally:
             # in-flight windowed tasks must not write into the closing
             # transport or outlive the connection
+            if shm_sess is not None:
+                shm_sess.close()
             wstate.cancel_all()
             self._conns.discard(writer)
             writer.close()
@@ -1548,6 +1616,9 @@ class EdgeBridge(FrameService):
         window: int = 0,
         string_fold: bool = True,
         max_payload: int = EDGE_MAX_FRAME_PAYLOAD,
+        shm_enabled: bool = False,
+        shm_ring_kib: int = 0,
+        shm_poll_us: int = 0,
     ):
         super().__init__(
             instance,
@@ -1556,6 +1627,9 @@ class EdgeBridge(FrameService):
             string_fold=string_fold,
             peer_bridges=peer_bridges,
             max_payload=max_payload,
+            shm_enabled=shm_enabled,
+            shm_ring_kib=shm_ring_kib,
+            shm_poll_us=shm_poll_us,
         )
         self.path = path
         if tcp_address:
@@ -1613,12 +1687,18 @@ class GebListener(FrameService):
         fast_enabled: bool = True,
         window: int = 0,
         string_fold: bool = True,
+        peer_bridges: Optional[dict] = None,
     ):
+        # peer_bridges (r18, GUBER_GEB_PEER_DOORS): explicit
+        # grpc_addr -> geb_door overrides for fleets where the
+        # symmetric-port convention doesn't hold (several nodes on one
+        # host) — the ring-routing client needs every peer's door
         super().__init__(
             instance,
             fast_enabled=fast_enabled,
             window=window,
             string_fold=string_fold,
+            peer_bridges=peer_bridges,
         )
         reject_ipv6_endpoint(address, "GUBER_GEB_PORT listener")
         self.address = address

@@ -99,6 +99,27 @@ EDGE_STALE_RINGS = Counter(
     "membership view than this node (the edge refreshes and retries)",
     registry=REGISTRY,
 )
+GEB_SHM_SESSIONS = Counter(
+    "geb_shm_sessions_total",
+    "Shared-memory GEB lanes negotiated on this node's bridge (r18, "
+    "serve/shm.py GEBM/GEBN over the unix control socket); compare "
+    "with geb_shm_teardowns_total to see lanes torn down early",
+    registry=REGISTRY,
+)
+GEB_SHM_FRAMES = Counter(
+    "geb_shm_frames_total",
+    "Request frames served through shared-memory rings instead of a "
+    "socket (r18) — the co-located fast lane's share of bridge traffic",
+    registry=REGISTRY,
+)
+GEB_SHM_TEARDOWNS = Counter(
+    "geb_shm_teardowns_total",
+    "Shared-memory lanes torn down for cause (hostile/torn ring "
+    "state, a client that stopped draining, serve failures) rather "
+    "than a clean close — nonzero under normal operation means a "
+    "misbehaving co-located peer",
+    registry=REGISTRY,
+)
 DISTINCT_KEYS = Gauge(
     "distinct_keys_estimate",
     "HyperLogLog estimate of distinct rate-limit keys seen",

@@ -487,12 +487,24 @@ class Server:
         if self.conf.geb_port:
             from gubernator_tpu.serve.edge_bridge import GebListener
 
+            geb_peer_doors = {}
+            for pair in self.conf.geb_peer_doors.split(","):
+                if not pair.strip():
+                    continue
+                grpc_addr, sep, door = pair.strip().partition("=")
+                if not sep or not grpc_addr or not door:
+                    raise ValueError(
+                        "GUBER_GEB_PEER_DOORS entries must be "
+                        f"'grpc_addr=door_addr', got {pair!r}"
+                    )
+                geb_peer_doors[grpc_addr] = door
             self._geb = GebListener(
                 self.instance,
                 f"0.0.0.0:{self.conf.geb_port}",
                 fast_enabled=self.conf.edge_fast,
                 window=self.conf.geb_window or self.conf.edge_window,
                 string_fold=self.conf.edge_string_fold,
+                peer_bridges=geb_peer_doors or None,
             )
             await self._geb.start()
             log.info(
@@ -525,6 +537,9 @@ class Server:
                 window=self.conf.edge_window,
                 string_fold=self.conf.edge_string_fold,
                 max_payload=self.conf.edge_max_frame_mib << 20,
+                shm_enabled=self.conf.shm,
+                shm_ring_kib=self.conf.shm_ring_kib,
+                shm_poll_us=self.conf.shm_poll_us,
             )
             await self._edge.start()
 

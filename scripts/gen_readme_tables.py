@@ -481,40 +481,48 @@ def table_shed() -> str:
 
 
 def table_frontdoor() -> str:
-    """Public front-door ladder (r12), from BENCH_FRONTDOOR_r12.json:
-    the gRPC protobuf door vs the GEB client protocol vs the HTTP
-    binary door, out-of-process generators, paired interleaved rounds
-    (r9 methodology)."""
-    doc = json.loads((ROOT / "BENCH_FRONTDOOR_r12.json").read_text())
+    """Public front-door ladder (r18), from BENCH_FRONTDOOR_r18.json:
+    gRPC protobuf vs HTTP binary vs the GEB client protocol over TCP
+    vs the shared-memory lane, out-of-process generators, paired
+    interleaved rounds (r9 methodology)."""
+    doc = json.loads((ROOT / "BENCH_FRONTDOOR_r18.json").read_text())
     med = doc["ladder_median_decisions_per_sec"]
     paired = doc["paired"]
     label = {
         "grpc": "gRPC protobuf (`V1Client`)",
+        "http": "HTTP binary (`POST /v1/geb`)",
         "geb": "GEB client protocol (`client_geb`, "
                "`GUBER_GEB_PORT` door)",
-        "http": "HTTP binary (`POST /v1/geb`)",
+        "shm": "GEB shared-memory lane (r18, co-located "
+               "`shm=` client)",
     }
     ratio = {
         "grpc": "1.00x (baseline)",
-        "geb": f"**{paired['geb_over_grpc']['median']:.2f}x**",
         "http": f"{paired['http_over_grpc']['median']:.2f}x",
+        "geb": f"**{paired['geb_over_grpc']['median']:.2f}x**",
+        "shm": f"**{paired['geb_over_grpc']['median'] * paired['shm_over_geb_ladder']['median']:.2f}x**",
     }
     lines = [
         "| public door | decisions/s (median) | paired vs gRPC |",
         "|---|---|---|",
     ]
-    for k in ("grpc", "geb", "http"):
+    for k in ("grpc", "http", "geb", "shm"):
         lines.append(f"| {label[k]} | {med[k]:,.0f} | {ratio[k]} |")
+    r18 = doc["acceptance"]["r18"]
     lines.append("")
     lines.append(
         f"({doc['rounds']} interleaved rounds, shed-r10 workload "
         f"shape (share {doc['share']:.0%}), {doc['batch_items']}-item "
         f"batches, each door driven by an out-of-process "
-        f"`cli.loadgen --protocol ...`; the same run is the "
-        f"`make perf-gate` regression gate "
-        f"(threshold {doc['gate']['threshold']:.0%}, "
-        f"passed: **{doc['gate']['passed']}**). Scope in the "
-        f"artifact.)"
+        f"`cli.loadgen --protocol ...`; the r18 paired A/B pairs "
+        f"measure shm-over-socket at "
+        f"**{r18['shm_over_geb_socket']:.2f}x** and client ring "
+        f"routing over the multi-node string downgrade at "
+        f"**{r18['clientroute_routed_over_string']:.2f}x** on a "
+        f"3-node ring; the same run is the `make perf-gate` "
+        f"regression gate (threshold {doc['gate']['threshold']:.0%}, "
+        f"passed: **{doc['gate']['passed']}**). Scope and the "
+        f"container acceptance note are in the artifact.)"
     )
     return "\n".join(lines)
 

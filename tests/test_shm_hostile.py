@@ -1,0 +1,350 @@
+"""Hostile shared-memory peers (r18 satellite): a buggy or malicious
+process on the other side of the lane must never wedge or crash the
+bridge — and must never poison anybody else's connection.
+
+Three tiers:
+
+- ShmRing validation units: every class of lying ring state (indices
+  out of bounds, torn record headers, zero/oversized/past-the-head
+  record lengths) raises ShmProtocolError instead of reading garbage;
+- live-bridge mutation corpus: a raw client negotiates a real lane,
+  corrupts it, and the bridge tears down THAT session only (teardown
+  counter up, control socket closed) while clean unix AND TCP
+  connections keep serving;
+- randomized index/data fuzz: seeded garbage into the shared header
+  and data region; after every round the bridge still answers a clean
+  probe within the call timeout (the never-wedge contract).
+
+The client side is symmetric: a lying SERVER tears the client lane
+down via on_torn, never a hang.
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from _util import free_ports
+from gubernator_tpu.api.types import RateLimitReq, RateLimitResp, Status
+from gubernator_tpu.client_geb import AsyncGebClient, read_hello
+from gubernator_tpu.serve.edge_bridge import EdgeBridge
+from gubernator_tpu.serve.shm import (
+    FLAG_CLOSED,
+    MAGIC_SHM_OK,
+    MAGIC_SHM_REQ,
+    ShmClientLane,
+    ShmProtocolError,
+    ShmRing,
+    _OFF_C2S_HEAD,
+    _OFF_C2S_SEQ,
+    _OFF_S2C_HEAD,
+    _OFF_S2C_SEQ,
+)
+
+_U32 = struct.Struct("<I")
+_DATA_OFF = 4096
+
+
+def _req(key):
+    return RateLimitReq(
+        name="hostile", unique_key=key, hits=1, limit=9,
+        duration=60_000,
+    )
+
+
+class FakeInstance:
+    async def get_rate_limits(self, reqs, stage_frame=False):
+        return [
+            RateLimitResp(
+                status=Status.UNDER_LIMIT, limit=r.limit,
+                remaining=r.limit - r.hits, reset_time=1,
+            )
+            for r in reqs
+        ]
+
+
+# -- ShmRing validation units ------------------------------------------------
+
+
+def _pair(tmp_path):
+    server = ShmRing.create(64, dir=str(tmp_path))
+    client = ShmRing.open(server.path)
+    return server, client
+
+
+def test_ring_rejects_lying_indices(tmp_path):
+    server, client = _pair(tmp_path)
+    try:
+        cap = server.c2s_cap
+        # head beyond capacity: used > cap
+        client._put_u64(_OFF_C2S_HEAD, cap + 999)
+        with pytest.raises(ShmProtocolError, match="lying ring"):
+            server.read_c2s(1 << 20)
+        # head behind tail: used negative
+        client._put_u64(_OFF_C2S_HEAD, 0)
+        server._put_u64(_OFF_C2S_HEAD + 64, 8)  # c2s tail
+        with pytest.raises(ShmProtocolError, match="lying ring"):
+            server.read_c2s(1 << 20)
+    finally:
+        client.release()
+        server.release()
+
+
+def test_ring_rejects_torn_and_hostile_records(tmp_path):
+    server, client = _pair(tmp_path)
+    try:
+        # used < 4: a record header can't even exist
+        client._put_u64(_OFF_C2S_HEAD, 2)
+        with pytest.raises(ShmProtocolError, match="torn record"):
+            server.read_c2s(1 << 20)
+
+        # zero-length record
+        client._put_u64(_OFF_C2S_HEAD, 0)
+        client._mm[_DATA_OFF:_DATA_OFF + 4] = _U32.pack(0)
+        client._put_u64(_OFF_C2S_HEAD, 8)
+        with pytest.raises(ShmProtocolError, match="outside"):
+            server.read_c2s(1 << 20)
+
+        # length past the door's bound
+        client._mm[_DATA_OFF:_DATA_OFF + 4] = _U32.pack(0x7FFFFFF0)
+        with pytest.raises(ShmProtocolError, match="outside"):
+            server.read_c2s(1 << 20)
+
+        # length beyond the published head (torn/hostile write)
+        client._mm[_DATA_OFF:_DATA_OFF + 4] = _U32.pack(100)
+        with pytest.raises(ShmProtocolError, match="beyond published"):
+            server.read_c2s(1 << 20)
+    finally:
+        client.release()
+        server.release()
+
+
+def test_honest_roundtrip_survives_wraparound(tmp_path):
+    """Control case: thousands of honest frames through a small ring
+    wrap both directions many times without a validator false
+    positive."""
+    server, client = _pair(tmp_path)
+    try:
+        payload = b"x" * 700
+        for i in range(1000):
+            assert client.write_c2s(payload)
+            assert server.read_c2s(1 << 20) == payload
+            assert server.write_s2c(payload)
+            assert client.read_s2c(1 << 20) == payload
+    finally:
+        client.release()
+        server.release()
+
+
+# -- live-bridge mutation corpus ---------------------------------------------
+
+
+async def _negotiate_raw(path):
+    """Speak the control protocol by hand: hello, GEBM, GEBN — and map
+    the granted ring directly (the hostile peer's view)."""
+    reader, writer = await asyncio.open_unix_connection(path)
+    hello = await read_hello(reader)
+    assert hello.shm
+    writer.write(struct.pack("<II", MAGIC_SHM_REQ, 0))
+    await writer.drain()
+    magic, plen = struct.unpack("<II", await reader.readexactly(8))
+    assert magic == MAGIC_SHM_OK and plen > 0
+    await reader.readexactly(16)  # caps
+    ring_path = (await reader.readexactly(plen)).decode()
+    return reader, writer, ShmRing.open(ring_path)
+
+
+async def _probe(endpoint):
+    """One clean decision through a throwaway connection."""
+    c = AsyncGebClient(endpoint, shm="off", timeout=10.0)
+    try:
+        resps = await c.get_rate_limits([_req("probe")])
+        assert resps[0].status == Status.UNDER_LIMIT
+    finally:
+        await c.close()
+
+
+def _mutations():
+    def lying_head(ring):
+        ring._put_u64(_OFF_C2S_HEAD, ring.c2s_cap + 12345)
+
+    def zero_len_record(ring):
+        ring._mm[_DATA_OFF:_DATA_OFF + 4] = _U32.pack(0)
+        ring._put_u64(_OFF_C2S_HEAD, 8)
+        ring._bump_wake(_OFF_C2S_SEQ)
+
+    def oversized_len(ring):
+        ring._mm[_DATA_OFF:_DATA_OFF + 4] = _U32.pack(0x7FFFFFF0)
+        ring._put_u64(_OFF_C2S_HEAD, 8)
+        ring._bump_wake(_OFF_C2S_SEQ)
+
+    def torn_header(ring):
+        ring._put_u64(_OFF_C2S_HEAD, 2)
+        ring._bump_wake(_OFF_C2S_SEQ)
+
+    def len_beyond_head(ring):
+        ring._mm[_DATA_OFF:_DATA_OFF + 4] = _U32.pack(5000)
+        ring._put_u64(_OFF_C2S_HEAD, 8)
+        ring._bump_wake(_OFF_C2S_SEQ)
+
+    return [
+        lying_head, zero_len_record, oversized_len, torn_header,
+        len_beyond_head,
+    ]
+
+
+def test_bridge_tears_down_hostile_lane_only(tmp_path):
+    """Every deterministic mutation kills ITS lane (teardown counted,
+    control socket closed) and nothing else: a clean unix client, a
+    clean TCP client, and a NEW shm negotiation all keep working."""
+    from gubernator_tpu.serve import metrics
+
+    path = str(tmp_path / "b.sock")
+    (port,) = free_ports(1)
+
+    async def run():
+        bridge = EdgeBridge(
+            FakeInstance(), path,
+            tcp_address=f"127.0.0.1:{port}",
+            shm_enabled=True, shm_ring_kib=64,
+        )
+        await bridge.start()
+        # a long-lived CLEAN shm client that must survive every
+        # hostile neighbor's teardown
+        bystander = AsyncGebClient(f"unix:{path}", shm="require")
+        await bystander.connect()
+        try:
+            for mutate in _mutations():
+                before = metrics.GEB_SHM_TEARDOWNS._value.get()
+                reader, writer, ring = await _negotiate_raw(path)
+                try:
+                    mutate(ring)
+                    # the bridge must notice and close THIS control
+                    # connection (EOF) — bounded, never a wedge
+                    eof = await asyncio.wait_for(reader.read(1), 5.0)
+                    assert eof == b"", f"{mutate.__name__}: no EOF"
+                    assert (
+                        metrics.GEB_SHM_TEARDOWNS._value.get() > before
+                    ), f"{mutate.__name__}: teardown not counted"
+                finally:
+                    writer.close()
+                    ring.release()
+                # neighbors unpoisoned: unix, TCP, and the bystander's
+                # still-mapped lane all serve
+                await _probe(f"unix:{path}")
+                await _probe(f"127.0.0.1:{port}")
+                r = await bystander.get_rate_limits([_req("by")])
+                assert r[0].status == Status.UNDER_LIMIT
+            assert bystander.stats()["transport"] == "shm"
+        finally:
+            await bystander.close()
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def test_bridge_survives_randomized_ring_fuzz(tmp_path):
+    """Seeded garbage into the shared index words and data region.
+    After every round the bridge answers a clean probe — it may tear
+    the fuzzed lane down or ignore still-valid state, but it must
+    never wedge, crash, or stop serving."""
+    path = str(tmp_path / "b.sock")
+    rng = np.random.default_rng(18)
+
+    async def run():
+        bridge = EdgeBridge(
+            FakeInstance(), path, shm_enabled=True, shm_ring_kib=64
+        )
+        await bridge.start()
+        try:
+            for round_i in range(12):
+                reader, writer, ring = await _negotiate_raw(path)
+                try:
+                    for _ in range(int(rng.integers(1, 5))):
+                        off = int(rng.integers(64, 288))
+                        blob = rng.bytes(int(rng.integers(1, 16)))
+                        ring._mm[off:off + len(blob)] = blob
+                    if rng.integers(2):
+                        blob = rng.bytes(int(rng.integers(8, 512)))
+                        ring._mm[_DATA_OFF:_DATA_OFF + len(blob)] = blob
+                        ring._put_u64(
+                            _OFF_C2S_HEAD, int(rng.integers(1, 1 << 17))
+                        )
+                    ring._bump_wake(_OFF_C2S_SEQ)
+                    # give the server a beat to react either way
+                    try:
+                        await asyncio.wait_for(reader.read(1), 0.3)
+                    except asyncio.TimeoutError:
+                        pass  # state happened to stay valid: fine
+                finally:
+                    writer.close()
+                    ring.release()
+                await _probe(f"unix:{path}")
+        finally:
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+# -- lying server vs the client lane -----------------------------------------
+
+
+def test_client_lane_tears_down_on_lying_server(tmp_path):
+    """The validation is symmetric: a server that publishes lying s2c
+    indices fires the client's on_torn (bounded), try_send goes dead,
+    and the lane never hangs the client loop."""
+
+    async def run():
+        server = ShmRing.create(64, dir=str(tmp_path))
+        lane = ShmClientLane(server.path)
+        torn = asyncio.get_running_loop().create_future()
+
+        def on_frame(data):
+            pass
+
+        def on_torn(exc):
+            if not torn.done():
+                torn.set_result(exc)
+
+        lane.start(
+            asyncio.get_running_loop(), on_frame, on_torn,
+            max_resp_len=1 << 20,
+        )
+        try:
+            assert lane.try_send(b"x" * 64)
+            server._put_u64(_OFF_S2C_HEAD, server.s2c_cap + 77)
+            server._bump_wake(_OFF_S2C_SEQ)
+            exc = await asyncio.wait_for(torn, 5.0)
+            assert isinstance(exc, ShmProtocolError)
+            assert lane.try_send(b"y" * 64) is False
+        finally:
+            lane.close()
+            server.release()
+
+    asyncio.run(run())
+
+
+def test_client_lane_sees_server_close_flag(tmp_path):
+    """A server that vanishes politely (CLOSED flag) also surfaces as
+    a torn lane, not a hang."""
+
+    async def run():
+        server = ShmRing.create(64, dir=str(tmp_path))
+        lane = ShmClientLane(server.path)
+        torn = asyncio.get_running_loop().create_future()
+        lane.start(
+            asyncio.get_running_loop(),
+            lambda data: None,
+            lambda exc: (not torn.done()) and torn.set_result(exc),
+            max_resp_len=1 << 20,
+        )
+        try:
+            server.mark_closed(server_side=True)
+            await asyncio.wait_for(torn, 5.0)
+            assert lane.try_send(b"z" * 16) is False
+        finally:
+            lane.close()
+            server.release()
+
+    asyncio.run(run())

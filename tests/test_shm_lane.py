@@ -1,0 +1,386 @@
+"""Shared-memory GEB lane (r18): negotiation, frame transport,
+fallback, drain semantics, and decision identity.
+
+The lane carries the EXACT windowed frame bytes through
+`FrameService.serve_frame_bytes`, so everything above the transport
+(shed screen, stage clock, drain/GEBR refusals, response encoding) is
+the TCP doors' by construction — these tests pin the transport layer:
+
+- GEBM/GEBN negotiation happens only where it is sound (unix socket,
+  shm-enabled service) and `shm='require'` fails closed elsewhere;
+- frames ride the ring when they fit and fall back to the control
+  socket (same connection, same window) when they don't;
+- a drain answers every frame already in flight through the ring
+  FIRST, then lands the GEBR and closes the lane (socket parity);
+- the shm door decides byte-identically to the GEB-TCP string path
+  under the r10 fake-clock fuzz (two fresh stacks, one stream).
+"""
+
+import asyncio
+import struct
+
+import numpy as np
+import pytest
+
+from _util import free_ports
+from gubernator_tpu.api.types import (
+    Algorithm,
+    RateLimitReq,
+    RateLimitResp,
+    Status,
+)
+from gubernator_tpu.serve.edge_bridge import EdgeBridge
+from gubernator_tpu.client_geb import (
+    AsyncGebClient,
+    GebDrainingError,
+    GebError,
+)
+
+T0 = 1_700_000_000_000
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = T0
+
+    def __call__(self):
+        return self.t
+
+
+def _pin_clock(monkeypatch, clock):
+    import gubernator_tpu.api.types as types_mod
+    import gubernator_tpu.core.engine as engine_mod
+    import gubernator_tpu.core.oracle as oracle_mod
+
+    monkeypatch.setattr(types_mod, "millisecond_now", clock)
+    monkeypatch.setattr(engine_mod, "millisecond_now", clock)
+    monkeypatch.setattr(oracle_mod, "millisecond_now", clock)
+
+
+class FakeInstance:
+    """Echo server: UNDER_LIMIT with remaining = limit - hits."""
+
+    async def get_rate_limits(self, reqs, stage_frame=False):
+        return [
+            RateLimitResp(
+                status=Status.UNDER_LIMIT, limit=r.limit,
+                remaining=r.limit - r.hits, reset_time=123,
+            )
+            for r in reqs
+        ]
+
+
+def _counter(metric) -> float:
+    return metric._value.get()
+
+
+def _req(key, hits=1, limit=9, duration=60_000):
+    return RateLimitReq(
+        name="shmlane", unique_key=key, hits=hits, limit=limit,
+        duration=duration,
+    )
+
+
+def test_shm_negotiates_and_carries_frames(tmp_path):
+    """The happy path: unix socket + shm-enabled bridge -> the lane
+    maps, every small frame rides the ring (zero socket frames), and
+    decisions come back correct and in order."""
+    from gubernator_tpu.serve import metrics
+
+    path = str(tmp_path / "b.sock")
+
+    async def run():
+        sessions0 = _counter(metrics.GEB_SHM_SESSIONS)
+        frames0 = _counter(metrics.GEB_SHM_FRAMES)
+        bridge = EdgeBridge(
+            FakeInstance(), path, shm_enabled=True, shm_ring_kib=128
+        )
+        await bridge.start()
+        client = AsyncGebClient(f"unix:{path}", shm="require")
+        try:
+            hello = await client.connect()
+            assert hello.shm
+            st = client.stats()
+            assert st["transport"] == "shm"
+            # pipelined batches complete out of order through the ring
+            outs = await asyncio.gather(
+                *[
+                    client.get_rate_limits(
+                        [_req(f"k{i}", hits=i % 3, limit=7)]
+                    )
+                    for i in range(20)
+                ]
+            )
+            for i, resps in enumerate(outs):
+                assert len(resps) == 1
+                assert resps[0].status == Status.UNDER_LIMIT
+                assert resps[0].remaining == 7 - (i % 3)
+            st = client.stats()
+            assert st["frames_shm"] == 20
+            assert st["frames_socket"] == 0
+            assert _counter(metrics.GEB_SHM_SESSIONS) == sessions0 + 1
+            assert _counter(metrics.GEB_SHM_FRAMES) >= frames0 + 20
+        finally:
+            await client.close()
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def test_shm_refused_by_disabled_server(tmp_path):
+    """A bridge without shm_enabled never advertises HELLO_SHM: auto
+    clients ride the socket silently; 'require' fails closed."""
+    path = str(tmp_path / "b.sock")
+
+    async def run():
+        bridge = EdgeBridge(FakeInstance(), path)  # shm off (default)
+        await bridge.start()
+        try:
+            auto = AsyncGebClient(f"unix:{path}", shm="auto")
+            hello = await auto.connect()
+            assert not hello.shm
+            resps = await auto.get_rate_limits([_req("a")])
+            assert resps[0].status == Status.UNDER_LIMIT
+            st = auto.stats()
+            assert st["transport"] == "unix"
+            assert st["frames_shm"] == 0 and st["frames_socket"] == 1
+            await auto.close()
+
+            hard = AsyncGebClient(f"unix:{path}", shm="require")
+            with pytest.raises(GebError, match="no lane mapped"):
+                await hard.connect()
+            await hard.close()
+        finally:
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def test_shm_never_negotiated_over_tcp(tmp_path):
+    """HELLO_SHM is per-CONNECTION: the same shm-enabled bridge must
+    not advertise (or grant) a lane to a TCP client — same-hostness is
+    only proven by AF_UNIX."""
+    path = str(tmp_path / "b.sock")
+    (port,) = free_ports(1)
+
+    async def run():
+        bridge = EdgeBridge(
+            FakeInstance(), path,
+            tcp_address=f"127.0.0.1:{port}", shm_enabled=True,
+        )
+        await bridge.start()
+        try:
+            tcp = AsyncGebClient(f"127.0.0.1:{port}", shm="auto")
+            hello = await tcp.connect()
+            assert not hello.shm
+            resps = await tcp.get_rate_limits([_req("t")])
+            assert resps[0].status == Status.UNDER_LIMIT
+            assert tcp.stats()["transport"] == "tcp"
+            await tcp.close()
+
+            hard = AsyncGebClient(f"127.0.0.1:{port}", shm="require")
+            with pytest.raises(GebError, match="no lane mapped"):
+                await hard.connect()
+            await hard.close()
+        finally:
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def test_oversized_frame_falls_back_to_socket(tmp_path):
+    """A frame past the lane's bound (ring/4) must transparently ride
+    the control socket — same connection, same credit window — while
+    small frames keep using the ring."""
+    path = str(tmp_path / "b.sock")
+
+    async def run():
+        # 64 KiB rings -> 16 KiB request bound
+        bridge = EdgeBridge(
+            FakeInstance(), path, shm_enabled=True, shm_ring_kib=64
+        )
+        await bridge.start()
+        client = AsyncGebClient(f"unix:{path}", shm="require")
+        try:
+            await client.connect()
+            small = await client.get_rate_limits([_req("s")])
+            big = await client.get_rate_limits(
+                [_req("b" * 30_000, limit=5)]
+            )
+            assert small[0].status == Status.UNDER_LIMIT
+            assert big[0].status == Status.UNDER_LIMIT
+            assert big[0].limit == 5
+            st = client.stats()
+            assert st["frames_shm"] == 1
+            assert st["frames_socket"] == 1
+        finally:
+            await client.close()
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def test_shm_drain_answers_inflight_then_refuses(tmp_path):
+    """Drain/GEBR parity on the ring: frames already in service when
+    the drain starts are ANSWERED through the lane; a frame arriving
+    mid-drain is refused with the GEBR drain code (GebDrainingError),
+    and only that frame — no accepted frame is dropped."""
+    path = str(tmp_path / "b.sock")
+
+    class GatedInstance:
+        def __init__(self):
+            self.gate = asyncio.Event()
+            self.entered = 0
+
+        async def get_rate_limits(self, reqs, stage_frame=False):
+            self.entered += 1
+            await self.gate.wait()
+            return [
+                RateLimitResp(
+                    status=Status.UNDER_LIMIT, limit=r.limit,
+                    remaining=r.limit - r.hits, reset_time=1,
+                )
+                for r in reqs
+            ]
+
+    async def run():
+        inst = GatedInstance()
+        bridge = EdgeBridge(
+            inst, path, shm_enabled=True, shm_ring_kib=128
+        )
+        await bridge.start()
+        client = AsyncGebClient(f"unix:{path}", shm="require")
+        try:
+            await client.connect()
+            inflight = [
+                asyncio.ensure_future(
+                    client.get_rate_limits([_req(f"g{i}")])
+                )
+                for i in range(3)
+            ]
+            deadline = asyncio.get_running_loop().time() + 5
+            while inst.entered < 3:
+                assert asyncio.get_running_loop().time() < deadline, (
+                    "gated frames never reached the instance"
+                )
+                await asyncio.sleep(0.005)
+            assert client.stats()["frames_shm"] == 3
+
+            drain_task = asyncio.ensure_future(bridge.drain(10.0))
+            await asyncio.sleep(0.02)  # _draining is set
+            late = asyncio.ensure_future(
+                client.get_rate_limits([_req("late")])
+            )
+            await asyncio.sleep(0.05)  # the GEBR is parked on inflight
+            inst.gate.set()
+
+            outs = await asyncio.gather(*inflight)
+            for resps in outs:
+                assert resps[0].status == Status.UNDER_LIMIT
+            with pytest.raises(GebDrainingError):
+                await late
+            await drain_task
+        finally:
+            await client.close()
+            await bridge.stop()
+
+    asyncio.run(run())
+
+
+def _be():
+    from gubernator_tpu.core.store import StoreConfig
+    from gubernator_tpu.serve.backends import TpuBackend
+
+    return TpuBackend(
+        StoreConfig(rows=16, slots=1 << 10), buckets=(16, 64)
+    )
+
+
+def _fuzz_stream(rng, keys, steps):
+    for step in range(steps):
+        n = int(rng.integers(1, 7))
+        batch = []
+        for _ in range(n):
+            k = int(rng.integers(len(keys)))
+            batch.append(
+                RateLimitReq(
+                    name="shmdoor",
+                    unique_key=keys[k],
+                    hits=int(rng.choice([0, 1, 1, 1, 2, 9])),
+                    limit=int(rng.choice([1, 2, 3, 50])),
+                    duration=int(rng.choice([400, 2000, 60_000])),
+                    algorithm=Algorithm(k % 2),
+                )
+            )
+        yield step, batch, int(rng.choice([0, 0, 1, 7, 150, 500, 2500]))
+
+
+def test_shm_vs_tcp_string_identity_fuzz(monkeypatch, tmp_path):
+    """Decision identity across the r18 transport: the shm door (fast
+    frames through the ring) against the GEB-TCP string path, two
+    fresh single-node stacks, one fake-clock fuzz stream — byte-equal
+    (status, limit, remaining, reset_time, error) on every item."""
+    from gubernator_tpu.cluster import LocalCluster
+
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+    path = str(tmp_path / "d.sock")
+
+    ports = free_ports(3)
+    clusters = [
+        # stack 0: GEB-TCP string reference; stack 1: shm door
+        LocalCluster(
+            [f"127.0.0.1:{ports[0]}"], backend_factory=_be,
+            geb_ports=[ports[2]],
+        ),
+        LocalCluster([f"127.0.0.1:{ports[1]}"], backend_factory=_be),
+    ]
+    for c in clusters:
+        c.start()
+        inst = c.servers[0].instance
+        if inst.shed is not None:
+            inst.shed.now_fn = clock
+
+    async def _bridge_up():
+        bridge = EdgeBridge(
+            clusters[1].servers[0].instance, path,
+            shm_enabled=True, shm_ring_kib=256,
+        )
+        await bridge.start()
+        return bridge
+
+    bridge = clusters[1].run(_bridge_up())
+    try:
+
+        async def run():
+            string = AsyncGebClient(
+                f"127.0.0.1:{ports[2]}", mode="string", shm="off"
+            )
+            shm = AsyncGebClient(f"unix:{path}", shm="require")
+            rng = np.random.default_rng(47)
+            keys = [f"sk{i}" for i in range(12)]
+            try:
+                await shm.connect()
+                # the exercise: fast frames through the mapped ring
+                assert shm._use_fast
+                assert shm.stats()["transport"] == "shm"
+                for step, batch, dt in _fuzz_stream(rng, keys, 70):
+                    clock.t += dt
+                    a = await string.get_rate_limits(batch)
+                    b = await shm.get_rate_limits(batch)
+                    for i, (x, y) in enumerate(zip(a, b)):
+                        tx = (int(x.status), x.limit, x.remaining,
+                              x.reset_time, x.error)
+                        ty = (int(y.status), y.limit, y.remaining,
+                              y.reset_time, y.error)
+                        assert tx == ty, (step, i, batch[i], tx, ty)
+                assert shm.stats()["frames_shm"] > 0
+            finally:
+                await string.close()
+                await shm.close()
+
+        asyncio.run(run())
+    finally:
+        clusters[1].run(bridge.stop())
+        for c in clusters:
+            c.stop()
