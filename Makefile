@@ -1,5 +1,6 @@
 .PHONY: all native proto test bench readme readme-check profile-stages \
 	profile-submit profile-shed profile-trace chaos chaos-rolling \
+	chaos-restore \
 	perf-gate clean
 
 all: native proto
@@ -115,6 +116,19 @@ ROLL_OUT ?= BENCH_RESCALE_r17.json
 chaos-rolling:
 	python scripts/chaos_soak.py --mode rolling \
 	  --seconds $(ROLL_SECONDS) --json $(ROLL_OUT)
+
+# full-fleet restore soak (r19): 3 daemons checkpointing to per-node
+# GUBER_CHECKPOINT_DIR on a 250 ms cadence, the WHOLE fleet SIGKILLed
+# at once (power event: no drain, no survivor) and restarted against
+# the same directories under live load; asserts ZERO under-admissions
+# on a tracked over-limit canary across every restore, nonzero
+# restored_windows_total on every cycle, and restore lag within the
+# staleness bound. make chaos-restore RESTORE_SECONDS=30 RESTORE_OUT=x.json
+RESTORE_SECONDS ?= 20
+RESTORE_OUT ?= BENCH_RESTORE_r19.json
+chaos-restore:
+	python scripts/chaos_soak.py --mode restore \
+	  --seconds $(RESTORE_SECONDS) --json $(RESTORE_OUT)
 
 clean:
 	$(MAKE) -C gubernator_tpu/native clean

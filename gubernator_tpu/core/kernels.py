@@ -87,7 +87,7 @@ a batch share it.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -1457,13 +1457,23 @@ def upsert_globals(
     reset_time: jax.Array,  # int32[B] engine-ms
     is_over: jax.Array,  # bool[B]
     valid: jax.Array,  # bool[B]
+    duration: Optional[jax.Array] = None,  # int32[B] stored duration ms
+    ts: Optional[jax.Array] = None,  # int32[B] raw L_TS lane
+    flags: Optional[jax.Array] = None,  # int32[B] full L_FLAGS word
 ) -> Store:
     """Install owner-broadcast GLOBAL statuses as local replica entries —
     the receive side of UpdatePeerGlobals (reference gubernator.go:199-207,
     cache.Add of a token-typed status with expiry = reset_time). Sorts by
     bucket so the same merged-bucket-row writeback as decide() applies
     (later-in-batch wins for duplicate keys, matching the reference's
-    sequential cache.Add order)."""
+    sequential cache.Add order).
+
+    The optional lanes (r19 checkpoint/restore): `duration`/`ts`/`flags`
+    carry the raw L_DURATION/L_TS/L_FLAGS words so exported entries of
+    ANY algorithm (token, leaky, sliding, GCRA — with their sticky and
+    algo flag bits) reinstall byte-exact; omitted (the GLOBAL-broadcast
+    path) they keep the historical token-replica encoding: zero
+    duration/ts and a flags word derived from `is_over` alone."""
     buckets, _W = store.data.shape
     ways = _W // LANES
     B = key_hash.shape[0]
@@ -1498,9 +1508,14 @@ def upsert_globals(
     eway = jnp.argmin(evict_key, axis=1).astype(jnp.int32)
 
     zero = jnp.zeros_like(bkt)
-    flags = jnp.where(stack[:, 3] != 0, FLAG_STICKY_OVER, 0).astype(
-        jnp.int32
-    )
+    if flags is None:
+        flags_s = jnp.where(stack[:, 3] != 0, FLAG_STICKY_OVER, 0).astype(
+            jnp.int32
+        )
+    else:
+        flags_s = flags.astype(jnp.int32)[order]
+    dur_s = zero if duration is None else duration.astype(jnp.int32)[order]
+    ts_s = zero if ts is None else ts.astype(jnp.int32)[order]
     # L_KEYLOW from the sorted key hashes (skey carries only bucket|fp,
     # not the low bits): replica/promoter installs stay reconstructable
     # for the eviction->sketch fold like decide-written entries
@@ -1509,7 +1524,8 @@ def upsert_globals(
         jnp.int32,
     )
     new_vals = jnp.stack(
-        [fp, stack[:, 2], stack[:, 1], zero, stack[:, 0], zero, flags, klow],
+        [fp, stack[:, 2], stack[:, 1], ts_s, stack[:, 0], dur_s, flags_s,
+         klow],
         axis=-1,
     )
 
@@ -1584,6 +1600,20 @@ def unpack_outputs(packed, B: int):
 @functools.partial(jax.jit, donate_argnums=(0,))
 def upsert_globals_jit(store, key_hash, limit, remaining, reset_time, is_over, valid):
     return upsert_globals(store, key_hash, limit, remaining, reset_time, is_over, valid)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def upsert_windows_jit(
+    store, key_hash, limit, remaining, reset_time, duration, ts, flags, valid
+):
+    """Full-lane window install (r19 checkpoint/restore + re-partition):
+    like upsert_globals_jit but carrying the raw L_DURATION/L_TS/L_FLAGS
+    words, so exported entries of any algorithm reinstall byte-exact."""
+    return upsert_globals(
+        store, key_hash, limit, remaining, reset_time,
+        (flags & FLAG_STICKY_OVER) != 0, valid,
+        duration=duration, ts=ts, flags=flags,
+    )
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))

@@ -60,6 +60,7 @@ from gubernator_tpu.core.kernels import (
     rebase_jit,
     upsert_globals,
     upsert_globals_jit,
+    upsert_windows_jit,
 )
 from gubernator_tpu.core.store import Store, StoreConfig, mix64, new_store
 from gubernator_tpu.parallel.policy import ShardingPolicy, shard_map_compat
@@ -809,6 +810,36 @@ def _shard_upsert(
     return jax.tree.map(lambda x: x[None], out)
 
 
+def _shard_upsert_full(
+    store: Store,
+    key_hash: jax.Array,
+    limit: jax.Array,
+    remaining: jax.Array,
+    reset_time: jax.Array,
+    duration: jax.Array,
+    ts: jax.Array,
+    flags: jax.Array,
+    valid: jax.Array,
+    n_shards: int,
+    axes: tuple = ("shard",),
+):
+    """Full-lane window install on each key's owning shard (r19): the
+    mesh twin of upsert_windows_jit, carrying the raw L_DURATION/L_TS/
+    L_FLAGS words so restored/re-partitioned entries of any algorithm
+    land byte-exact."""
+    from gubernator_tpu.core.store import FLAG_STICKY_OVER
+
+    me = _axis_me(axes)
+    store = jax.tree.map(lambda x: x[0], store)
+    mine = owner_of(key_hash, n_shards) == me
+    out = upsert_globals(
+        store, key_hash, limit, remaining, reset_time,
+        (flags & FLAG_STICKY_OVER) != 0, valid & mine,
+        duration=duration, ts=ts, flags=flags,
+    )
+    return jax.tree.map(lambda x: x[None], out)
+
+
 class PartitionedEngine:
     """ONE engine, every topology (r14): host glue + device programs
     for the slot store (and the r13 sketch cold tier), parameterized by
@@ -965,6 +996,18 @@ class PartitionedEngine:
                 upsert_fn,
                 mesh=self.mesh,
                 in_specs=(Ps,) + (P0,) * 6,
+                out_specs=Ps,
+            ),
+            donate_argnums=(0,),
+        )
+        upsert_full_fn = functools.partial(
+            _shard_upsert_full, n_shards=self.n, axes=self.axes
+        )
+        self._upsert_full = jax.jit(
+            shard_map_compat(
+                upsert_full_fn,
+                mesh=self.mesh,
+                in_specs=(Ps,) + (P0,) * 8,
                 out_specs=Ps,
             ),
             donate_argnums=(0,),
@@ -1609,30 +1652,41 @@ class PartitionedEngine:
     # -- elastic re-partition (r17) ------------------------------------------
 
     def export_windows(self, now: Optional[int] = None) -> dict:
-        """Host-side read of EVERY live token window in the store:
+        """Host-side read of EVERY live window in the store:
         {key_hash uint64[m], limit, remaining, reset_time (unix-ms),
-        is_over} — the full-store twin of snapshot_read, enumerating
-        entries instead of looking keys up. Each entry's key hash is
-        reconstructed from its L_TAG|L_KEYLOW lanes (the r14 layout
-        keeps the full 64 bits precisely so store state stays
-        re-addressable); the one lossy case is a hash whose high 32
-        bits were zero (fingerprints() coerces the tag to 1, ~2^-32
-        per key). `is_over` carries the FLAG_STICKY_OVER bit ONLY —
-        an exhausted-but-not-sticky window must reinstall as exactly
-        that (a sticky bit added in transit would flip its peek
-        answers from UNDER to OVER). Non-token entries (leaky /
-        sliding / GCRA state) are out of scope, the r11 replication
-        exclusion. Non-mutating; submit-thread contract like
-        snapshot_read."""
+        is_over, duration, ts, flags} — the full-store twin of
+        snapshot_read, enumerating entries instead of looking keys up.
+        Each entry's key hash is reconstructed from its L_TAG|L_KEYLOW
+        lanes (the r14 layout keeps the full 64 bits precisely so
+        store state stays re-addressable); the one lossy case is a
+        hash whose high 32 bits were zero (fingerprints() coerces the
+        tag to 1, ~2^-32 per key). `is_over` carries the
+        FLAG_STICKY_OVER bit ONLY — an exhausted-but-not-sticky window
+        must reinstall as exactly that (a sticky bit added in transit
+        would flip its peek answers from UNDER to OVER).
+
+        r19 widened the export from token-only to flag-aware: the raw
+        `duration` (L_DURATION), `ts` (L_TS: the leaky leak clock /
+        sliding previous-subwindow count) and `flags` (L_FLAGS: the
+        algo bits + sticky) lanes ride along, so leaky, sliding-window
+        and GCRA entries — and chain-level rows, which are ordinary
+        token rows keyed by level — round-trip byte-exact through
+        install_windows under ANY ShardingPolicy ("restore is also a
+        re-partition"). `reset_time` is the L_EXPIRE lane in unix-ms
+        whatever the algorithm encodes there (expiry, window anchor,
+        or GCRA theoretical-arrival time); the same engine-clock
+        conversion inverts it on install. Non-mutating; submit-thread
+        contract like snapshot_read."""
         from gubernator_tpu.core.store import (
-            FLAG_ALGO_MASK,
             FLAG_STICKY_OVER,
+            L_DURATION,
             L_EXPIRE,
             L_FLAGS,
             L_KEYLOW,
             L_LIMIT,
             L_REMAINING,
             L_TAG,
+            L_TS,
             LANES,
         )
 
@@ -1642,6 +1696,9 @@ class PartitionedEngine:
             remaining=np.empty(0, np.int64),
             reset_time=np.empty(0, np.int64),
             is_over=np.empty(0, bool),
+            duration=np.empty(0, np.int64),
+            ts=np.empty(0, np.int64),
+            flags=np.empty(0, np.int64),
         )
         if self.clock.epoch is None:
             return empty  # nothing ever decided
@@ -1651,11 +1708,7 @@ class PartitionedEngine:
         ent = np.asarray(jax.device_get(self.store.data)).reshape(
             -1, LANES
         )
-        live = (
-            (ent[:, L_TAG] != 0)
-            & (ent[:, L_EXPIRE] >= e_now)
-            & ((ent[:, L_FLAGS] & FLAG_ALGO_MASK) == 0)
-        )
+        live = (ent[:, L_TAG] != 0) & (ent[:, L_EXPIRE] >= e_now)
         ent = ent[live]
         if not ent.shape[0]:
             return empty
@@ -1673,21 +1726,28 @@ class PartitionedEngine:
                 self.clock.from_engine(ent[:, L_EXPIRE]), np.int64
             ),
             is_over=(ent[:, L_FLAGS] & FLAG_STICKY_OVER) != 0,
+            duration=ent[:, L_DURATION].astype(np.int64),
+            ts=ent[:, L_TS].astype(np.int64),
+            flags=ent[:, L_FLAGS].astype(np.int64),
         )
 
     def repartition(
         self, policy: ShardingPolicy, now: Optional[int] = None
     ) -> "PartitionedEngine":
-        """A NEW engine under `policy` carrying every live token window
-        of this one: export_windows -> install_windows under the new
+        """A NEW engine under `policy` carrying every live window of
+        this one: export_windows -> install_windows under the new
         ShardingPolicy — the store re-partition path a GUBER_SHARDS
-        change drives (serve/backends.py MeshBackend.repartition).
-        Same geometry/ladder/sketch config; sketch-tier counts do NOT
-        migrate (window-keyed, transient — the loss direction is a
-        one-window over-admission in the cold tier, same as a store
-        reset, and the hot exact tier moves losslessly). Call with the
-        batcher idle or on its serialized submit thread; warm the new
-        engine before serving."""
+        change drives (serve/backends.py MeshBackend.repartition), and
+        since r19 also the checkpoint-restore-across-a-shard-change
+        path ("restore is also a re-partition"). The full-lane
+        round-trip carries every algorithm's state (token, leaky,
+        sliding, GCRA, chain-level rows) byte-exact. Same geometry/
+        ladder/sketch config; sketch-tier counts do NOT migrate
+        (window-keyed, transient — the loss direction is a one-window
+        over-admission in the cold tier, same as a store reset, and
+        the hot exact tier moves losslessly). Call with the batcher
+        idle or on its serialized submit thread; warm the new engine
+        before serving."""
         if now is None:
             now = api_types.millisecond_now()
         eng = PartitionedEngine(
@@ -1701,6 +1761,7 @@ class PartitionedEngine:
             eng.install_windows(
                 w["key_hash"], w["limit"], w["remaining"],
                 w["reset_time"], w["is_over"], now=now,
+                duration=w["duration"], ts=w["ts"], flags=w["flags"],
             )
         return eng
 
@@ -1719,6 +1780,22 @@ class PartitionedEngine:
                 self.store, hashes, lim, rem, reset, over, valid
             )
 
+    def _upsert_full_padded(self, hashes, lim, rem, reset, dur, ts,
+                            flags, valid):
+        """One padded full-lane install call (r19): the flag-aware twin
+        of _upsert_padded, carrying duration/ts/flags through to the
+        store so any algorithm's entry reinstalls byte-exact."""
+        if self.flat:
+            self.store = upsert_windows_jit(
+                self.store, hashes, lim, rem, reset, dur, ts, flags,
+                valid,
+            )
+        else:
+            self.store = self._upsert_full(
+                self.store, hashes, lim, rem, reset, dur, ts, flags,
+                valid,
+            )
+
     def install_windows(
         self,
         key_hash: np.ndarray,
@@ -1727,13 +1804,23 @@ class PartitionedEngine:
         reset_time: np.ndarray,
         is_over: np.ndarray,
         now: Optional[int] = None,
+        duration: Optional[np.ndarray] = None,
+        ts: Optional[np.ndarray] = None,
+        flags: Optional[np.ndarray] = None,
     ) -> None:
-        """Install token windows for pre-hashed keys — the array-level
+        """Install windows for pre-hashed keys — the array-level
         GLOBAL replica install (UpdatePeerGlobals receive path) and the
         sketch promoter's migration surface. Batches larger than the
         bucket ladder's top rung are CHUNKED (installs are per-key
         upserts; chunk order preserves last-wins for duplicates), so
-        callers never hit a choose_bucket refusal."""
+        callers never hit a choose_bucket refusal.
+
+        Without the optional lanes the install is the historical
+        token-replica form (zero duration/ts, sticky-only flags). With
+        `duration`/`ts`/`flags` (r19: export_windows round-trip), the
+        raw lanes land verbatim, so leaky/sliding/GCRA entries — and
+        sticky bits — survive a restore or re-partition byte-exact;
+        `is_over` is then ignored (the sticky bit lives in `flags`)."""
         kh = np.ascontiguousarray(key_hash, np.uint64)
         n = int(kh.shape[0])
         if n == 0:
@@ -1745,9 +1832,36 @@ class PartitionedEngine:
         limit = np.asarray(limit)
         remaining = np.asarray(remaining)
         reset_time = np.asarray(reset_time)
-        is_over = np.asarray(is_over, bool)
+        full = flags is not None
+        if full:
+            duration = np.asarray(duration)
+            ts = (
+                np.zeros(n, np.int64) if ts is None else np.asarray(ts)
+            )
+            flags = np.asarray(flags)
+        else:
+            is_over = np.asarray(is_over, bool)
         for s in range(0, n, top):
             e = min(s + top, n)
+            if full:
+                hashes, lim, rem, reset, dur, tss, flg, valid = (
+                    pad_to_bucket(
+                        self.buckets,
+                        e - s,
+                        (kh[s:e], np.uint64),
+                        (_sat_i32(limit[s:e]), np.int32),
+                        (_sat_i32(remaining[s:e]), np.int32),
+                        (self.clock.to_engine(reset_time[s:e]),
+                         np.int32),
+                        (_sat_i32(duration[s:e]), np.int32),
+                        (_sat_i32(ts[s:e]), np.int32),
+                        (_sat_i32(flags[s:e]), np.int32),
+                    )
+                )
+                self._upsert_full_padded(
+                    hashes, lim, rem, reset, dur, tss, flg, valid
+                )
+                continue
             hashes, lim, rem, reset, over, valid = pad_to_bucket(
                 self.buckets,
                 e - s,

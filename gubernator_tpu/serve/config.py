@@ -374,6 +374,36 @@ class ServerConfig:
     # and on the receiver-side pending handoff table used when
     # replication is off; evictions count in rescale_dropped_total.
     rescale_track_keys: int = 1 << 16  # GUBER_RESCALE_TRACK_KEYS
+    # Cluster-wide checkpoint/restore (r19, serve/checkpoint.py).
+    # GUBER_CHECKPOINT_DIR: directory for periodic quota-state
+    # checkpoints (torn-write-safe chunks + CRC'd manifest). Non-empty
+    # enables the supervised checkpoint loop and the boot-time warm
+    # restore; "" (the default) disables both. Restore re-hashes under
+    # the current ring and store geometry, so GUBER_SHARDS may change
+    # across the restart.
+    checkpoint_dir: str = ""
+    # GUBER_CHECKPOINT_INTERVAL_MS: checkpoint cadence — also the
+    # staleness/loss bound of a full-fleet kill (state on disk is at
+    # most one interval + one write behind; a SIGTERM drain flushes a
+    # final checkpoint, shrinking that to one in-flight request).
+    checkpoint_interval: float = 5.0  # GUBER_CHECKPOINT_INTERVAL_MS
+    # GUBER_CHECKPOINT_MAX_AGE_MS: restore gate — a checkpoint older
+    # than this boots COLD (counted in
+    # checkpoint_failures_total{what="stale"}): its windows would have
+    # expired or deserve a fresh start, and a wrong warm restore is
+    # worse than a cold boot. 0 = restore regardless of age.
+    checkpoint_max_age: float = 300.0  # GUBER_CHECKPOINT_MAX_AGE_MS
+    # Bound on the checkpoint-tracked owned-window table and on the
+    # receiver-side pending import table (freshest kept; evictions
+    # count in checkpoint_failures_total{what="track_evict"}).
+    checkpoint_track_keys: int = 1 << 16  # GUBER_CHECKPOINT_TRACK_KEYS
+    # GUBER_CHECKPOINT_EXPORT_PEERS: comma-separated gRPC doors of a
+    # REPLACEMENT fleet (blue-green cutover). Each flush (and the
+    # drain) streams tracked windows to these doors over
+    # ReplicateBuckets with an import marker; receivers install/route
+    # under THEIR ring with LWW, so double delivery is a no-op and the
+    # green fleet takes the ring pre-warmed. Empty disables export.
+    checkpoint_export_peers: List[str] = field(default_factory=list)
     # Distributed tracing + flight recorder (r16, serve/tracing.py).
     # GUBER_TRACE_SAMPLE: head-sampling probability in [0, 1] — a
     # sampled request collects spans across every hop (edge/bridge
@@ -619,6 +649,12 @@ class ServerConfig:
             raise ValueError("GUBER_RESCALE_DOUBLE_SERVE_MS must be >= 0")
         if self.rescale_track_keys < 1:
             raise ValueError("GUBER_RESCALE_TRACK_KEYS must be >= 1")
+        if self.checkpoint_interval <= 0:
+            raise ValueError("GUBER_CHECKPOINT_INTERVAL_MS must be > 0")
+        if self.checkpoint_max_age < 0:
+            raise ValueError("GUBER_CHECKPOINT_MAX_AGE_MS must be >= 0")
+        if self.checkpoint_track_keys < 1:
+            raise ValueError("GUBER_CHECKPOINT_TRACK_KEYS must be >= 1")
         if self.store_mib < 0 or self.store_target_keys < 0:
             raise ValueError(
                 "GUBER_STORE_MIB / GUBER_STORE_TARGET_KEYS must be >= 0"
@@ -837,6 +873,21 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         rescale_track_keys=_get_int(
             env, "GUBER_RESCALE_TRACK_KEYS", 1 << 16
         ),
+        checkpoint_dir=_get(env, "GUBER_CHECKPOINT_DIR"),
+        checkpoint_interval=_get_float_ms(
+            env, "GUBER_CHECKPOINT_INTERVAL_MS", 5.0
+        ),
+        checkpoint_max_age=_get_float_ms(
+            env, "GUBER_CHECKPOINT_MAX_AGE_MS", 300.0
+        ),
+        checkpoint_track_keys=_get_int(
+            env, "GUBER_CHECKPOINT_TRACK_KEYS", 1 << 16
+        ),
+        checkpoint_export_peers=[
+            p.strip()
+            for p in _get(env, "GUBER_CHECKPOINT_EXPORT_PEERS").split(",")
+            if p.strip()
+        ],
         # prep_at_arrival / prep_threads deliberately NOT resolved
         # here: their None/0 defaults defer to DeviceBatcher, the
         # single owner of the GUBER_PREP_AT_ARRIVAL /

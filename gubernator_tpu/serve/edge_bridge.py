@@ -1032,13 +1032,14 @@ class FrameService:
         self.instance.traffic.observe(full, fields["key_hash"])
         repl = getattr(self.instance, "repl", None)
         resc = getattr(self.instance, "rescale", None)
-        if repl is not None or resc is not None:
+        ckpt = getattr(self.instance, "checkpoint", None)
+        if repl is not None or resc is not None or ckpt is not None:
             # folded frames are all-owned by construction: their
             # windows must dirty the replication queue and join the
-            # rescale tracked set like any other owner decide
-            # (pre-hashed fast frames carry no key strings and cannot
-            # — documented scope limit). One eligibility screen feeds
-            # both managers.
+            # rescale/checkpoint tracked sets like any other owner
+            # decide (pre-hashed fast frames carry no key strings and
+            # cannot — documented scope limit). One eligibility screen
+            # feeds all three managers.
             from gubernator_tpu.serve.replication import (
                 eligible_field_indices,
             )
@@ -1048,6 +1049,8 @@ class FrameService:
                 repl.queue_dirty_fields(full, fields, elig=elig)
             if resc is not None:
                 resc.note_owned_fields(full, fields, elig=elig)
+            if ckpt is not None:
+                ckpt.note_owned_fields(full, fields, elig=elig)
         status, limit, remaining, reset = (
             await self._decide_arrays_shed(fields, n)
         )

@@ -16,6 +16,8 @@ Spec grammar (GUBER_FAULT_SPEC): comma-separated rules
              peer_serve    — owner-side Instance.get_peer_rate_limits
              device_submit — the device batcher's flush path
              edge_frame    — one edge bridge frame's service
+             checkpoint_write — one checkpoint flush's file write (r19)
+             checkpoint_read  — the boot-time checkpoint restore read (r19)
     actions: delay=<dur>   — add latency (e.g. 200ms, 1.5s, bare ms)
              error[=<msg>] — raise FaultError (retryable by default)
              hang          — block forever (deadlines must save the caller)
@@ -46,7 +48,14 @@ from typing import Dict, List, Optional
 
 log = logging.getLogger("gubernator_tpu.faults")
 
-POINTS = ("peer_rpc", "peer_serve", "device_submit", "edge_frame")
+POINTS = (
+    "peer_rpc",
+    "peer_serve",
+    "device_submit",
+    "edge_frame",
+    "checkpoint_write",
+    "checkpoint_read",
+)
 ACTIONS = ("delay", "error", "hang")
 
 

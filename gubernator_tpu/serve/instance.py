@@ -157,6 +157,31 @@ class Instance:
             self.rescale = RescaleManager(conf, self)
         else:
             self.rescale = None
+        # cluster-wide checkpoint/restore (r19, serve/checkpoint.py):
+        # periodic quota-state checkpoints to local disk + boot-time
+        # warm restore, so a FULL-fleet restart (power event, blue-
+        # green cutover) never causes quota amnesia. Enabled by a
+        # non-empty GUBER_CHECKPOINT_DIR (disk) and/or
+        # GUBER_CHECKPOINT_EXPORT_PEERS (blue-green import stream);
+        # needs the same non-mutating snapshot surface as replication.
+        if getattr(conf, "checkpoint_dir", "") or getattr(
+            conf, "checkpoint_export_peers", ()
+        ):
+            if getattr(backend, "snapshot_read", None) is None:
+                raise ValueError(
+                    "GUBER_CHECKPOINT_DIR / "
+                    "GUBER_CHECKPOINT_EXPORT_PEERS need a backend "
+                    "with a non-mutating snapshot_read surface "
+                    f"(exact/tpu/mesh); backend '{conf.backend}' does "
+                    "not expose one"
+                )
+            from gubernator_tpu.serve.checkpoint import (
+                CheckpointManager,
+            )
+
+            self.checkpoint = CheckpointManager(conf, self)
+        else:
+            self.checkpoint = None
         # sketch-tier promoter (r13, serve/promoter.py): streaming
         # SpaceSaving top-K over dispatched key hashes; hot sketch-tier
         # keys migrate into exact buckets on a flush-tick cadence, and
@@ -178,12 +203,16 @@ class Instance:
             self.repl.start()
         if self.rescale is not None:
             self.rescale.start()
+        if self.checkpoint is not None:
+            self.checkpoint.start()
         if self.promoter is not None:
             self.promoter.start()
 
     async def stop(self) -> None:
         if self.promoter is not None:
             await self.promoter.stop()
+        if self.checkpoint is not None:
+            await self.checkpoint.stop()
         if self.rescale is not None:
             await self.rescale.stop()
         if self.repl is not None:
@@ -246,9 +275,11 @@ class Instance:
             shed.refresh_generation()
         repl = self.repl
         resc = self.rescale
-        # takeover/handoff seeds (r11/r17): owned first touches whose
-        # key has a replicated standby snapshot or a pending rescale
-        # handoff install it BEFORE deciding
+        ckpt = self.checkpoint
+        # takeover/handoff seeds (r11/r17/r19): owned first touches
+        # whose key has a replicated standby snapshot, a pending
+        # rescale handoff, or a parked checkpoint import install it
+        # BEFORE deciding
         seeds: List[Tuple[int, str, object]] = []
         fps = {}
 
@@ -310,6 +341,8 @@ class Instance:
                     repl.queue_dirty(r)
                 if resc is not None:
                     resc.note_owned(r)
+                if ckpt is not None:
+                    ckpt.note_owned(r)
                 if verdict is not None:
                     if r.behavior == Behavior.GLOBAL:
                         self.global_mgr.queue_update(r)
@@ -318,6 +351,8 @@ class Instance:
                 s = repl.standby_pop(key) if repl is not None else None
                 if s is None and resc is not None:
                     s = resc.pending_pop(key)
+                if s is None and ckpt is not None:
+                    s = ckpt.pending_pop(key)
                 if s is not None:
                     seeds.append((i, key, s))
                 local.append((i, r, False))
@@ -539,6 +574,9 @@ class Instance:
             # a seeded window is live local state this node must hand
             # off on the NEXT ring change, even if only peeked here
             self.rescale.note_seeded(seeds)
+        if self.checkpoint is not None:
+            # likewise live state the next checkpoint must capture
+            self.checkpoint.note_seeded(seeds)
         return True
 
     async def _seed_standby(self, seeds) -> List[int]:
@@ -806,6 +844,7 @@ class Instance:
         decides."""
         repl = self.repl
         resc = self.rescale
+        ckpt = self.checkpoint
         seeds = []
         for r in reqs:
             if r.chain:
@@ -823,6 +862,8 @@ class Instance:
                     repl.queue_dirty(r)
                 if resc is not None:
                     resc.note_owned(r)
+                if ckpt is not None:
+                    ckpt.note_owned(r)
             else:
                 if repl is not None:
                     repl.mark_taken(r)
@@ -835,6 +876,8 @@ class Instance:
             s = repl.standby_pop(key) if repl is not None else None
             if s is None and resc is not None and own:
                 s = resc.pending_pop(key)
+            if s is None and ckpt is not None and own:
+                s = ckpt.pending_pop(key)
             if s is not None:
                 seeds.append((key, s))
         if seeds:
@@ -848,10 +891,20 @@ class Instance:
         split against the pending handoff table. A node with both off
         accepts and ignores — knob/version skew across the fleet must
         not fail the sender."""
-        if self.repl is not None:
+        if self.checkpoint is not None and (
+            owner.startswith("import:") or owner.startswith("importfwd:")
+        ):
+            # blue-green import batch (r19): the owner marker routes it
+            # to the checkpoint manager REGARDLESS of repl/rescale
+            # knobs — the green fleet's import handling must not depend
+            # on matching the blue fleet's replication config
+            await self.checkpoint.install_import(owner, snaps)
+        elif self.repl is not None:
             await self.repl.install(owner, snaps)
         elif self.rescale is not None:
             await self.rescale.install(owner, snaps)
+        elif self.checkpoint is not None:
+            await self.checkpoint.install(owner, snaps)
 
     async def update_peer_globals(
         self, updates: Sequence[Tuple[str, RateLimitResp]]
@@ -864,6 +917,9 @@ class Instance:
         if self.rescale is not None and updates:
             # the same supersession rule for pending handoff snapshots
             self.rescale.pending_purge([k for k, _ in updates])
+        if self.checkpoint is not None and updates:
+            # and for parked checkpoint-import rows
+            self.checkpoint.pending_purge([k for k, _ in updates])
         if self.shed is None or not updates:
             await self.batcher.update_globals(list(updates))
             return

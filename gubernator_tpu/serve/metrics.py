@@ -293,6 +293,52 @@ RESCALE_TRACKED_ENTRIES = Gauge(
     "GUBER_RESCALE_TRACK_KEYS each; set lazily at /metrics scrape)",
     registry=REGISTRY,
 )
+CHECKPOINT_AGE = Gauge(
+    "checkpoint_age_seconds",
+    "Age of the newest durable checkpoint on disk (now minus the last "
+    "successful flush's snapshot stamp; set lazily at /metrics scrape). "
+    "Grows without bound while writes fail or hang — alert when it "
+    "passes GUBER_CHECKPOINT_MAX_AGE_MS, because a restart past that "
+    "bound boots cold by design",
+    registry=REGISTRY,
+)
+RESTORE_LAG = Gauge(
+    "restore_lag_seconds",
+    "Staleness of the state this process restored at boot (restore "
+    "wall clock minus the checkpoint's owner-clock snapshot stamp, or "
+    "the import batch's stamp for a blue-green bulk load). Bounded by "
+    "GUBER_CHECKPOINT_MAX_AGE_MS for disk restores — stale checkpoints "
+    "are refused and the node boots cold instead",
+    registry=REGISTRY,
+)
+RESTORED_WINDOWS = Counter(
+    "restored_windows_total",
+    "Bucket windows installed from durable state: boot-time warm "
+    "restore from GUBER_CHECKPOINT_DIR plus blue-green import installs "
+    "received over ReplicateBuckets (LWW, so double-delivery counts "
+    "once per accepted install, never double-admits)",
+    registry=REGISTRY,
+)
+CHECKPOINT_FAILURES = Counter(
+    "checkpoint_failures_total",
+    "Checkpoint subsystem failures by kind: 'write' (a flush could not "
+    "land its chunks/manifest), 'read' (unreadable file at restore), "
+    "'corrupt' (CRC/parse mismatch — torn or truncated file), 'stale' "
+    "(manifest older than GUBER_CHECKPOINT_MAX_AGE_MS), 'version' (a "
+    "FUTURE format version refused), 'export' (a blue-green export "
+    "send failed). Every kind boots/continues cold and loudly — never "
+    "a crash, never a wedge",
+    ["what"],
+    registry=REGISTRY,
+)
+CHECKPOINT_TRACKED_ENTRIES = Gauge(
+    "checkpoint_tracked_entries",
+    "Owned token windows tracked for the next checkpoint flush + "
+    "pending import snapshots awaiting re-route to their ring owner "
+    "(bounded by GUBER_CHECKPOINT_TRACK_KEYS each; set lazily at "
+    "/metrics scrape)",
+    registry=REGISTRY,
+)
 SKETCH_PROMOTIONS = Counter(
     "sketch_promotions_total",
     "Hot sketch-tier keys migrated into exact-tier buckets by the "

@@ -387,6 +387,12 @@ class Server:
             # paid inside a request deadline
             await asyncio.to_thread(warmup)
         self.instance.start()
+        if self.instance.checkpoint is not None:
+            # boot-time warm restore (r19) BEFORE any door opens: the
+            # batcher is running (installs need it) but no traffic can
+            # race the install. Every failure path inside boots cold
+            # and loudly — a bad checkpoint must never wedge a boot.
+            await self.instance.checkpoint.restore()
 
         self.grpc_server = grpc.aio.server(
             interceptors=[StatsInterceptor()],
@@ -483,6 +489,29 @@ class Server:
             )
         else:
             log.info("elastic rescale: off (GUBER_RESCALE=0)")
+
+        ckpt = self.instance.checkpoint
+        if ckpt is not None:
+            from gubernator_tpu.serve.checkpoint import (
+                disk_footprint_mib,
+            )
+
+            log.info(
+                "checkpoint/restore: on — dir %r, interval %.0f ms, "
+                "max restore age %.0f s, tracked-key bound %d "
+                "(~%.1f MiB on disk), export targets %s "
+                "(GUBER_CHECKPOINT_DIR / GUBER_CHECKPOINT_INTERVAL_MS "
+                "/ GUBER_CHECKPOINT_MAX_AGE_MS / "
+                "GUBER_CHECKPOINT_TRACK_KEYS / "
+                "GUBER_CHECKPOINT_EXPORT_PEERS)",
+                ckpt.dir, ckpt.sync_wait * 1e3, ckpt.max_age,
+                ckpt.track_cap, disk_footprint_mib(ckpt.track_cap),
+                ckpt.export_peers or "none",
+            )
+        else:
+            log.info(
+                "checkpoint/restore: off (GUBER_CHECKPOINT_DIR unset)"
+            )
 
         if self.conf.geb_port:
             from gubernator_tpu.serve.edge_bridge import GebListener
@@ -642,6 +671,13 @@ class Server:
             # down with it
             await step(
                 "replication_flush", self.instance.repl.drain()
+            )
+        if self.instance.checkpoint is not None:
+            # final checkpoint + blue-green export (r19): state on
+            # disk (and on the replacement fleet) leaves at most one
+            # in-flight request stale instead of one interval
+            await step(
+                "checkpoint_flush", self.instance.checkpoint.drain()
             )
         await step("batcher", self.instance.batcher.drain())
         timings["total"] = time.monotonic() - t0
@@ -952,6 +988,14 @@ class Server:
             metrics.REPLICATION_BACKLOG_ENTRIES.set(
                 self.instance.repl.backlog_len
             )
+        ckpt = self.instance.checkpoint
+        if ckpt is not None:
+            metrics.CHECKPOINT_TRACKED_ENTRIES.set(ckpt.tracked_len)
+            # age refreshes at scrape time (the flush loop only stamps
+            # last_ok_ms) so operators see it GROW when writes fail
+            age = ckpt.age_seconds
+            if age is not None:
+                metrics.CHECKPOINT_AGE.set(age)
         if self.instance.rescale is not None:
             metrics.RESCALE_TRACKED_ENTRIES.set(
                 self.instance.rescale.tracked_len

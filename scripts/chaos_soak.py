@@ -96,7 +96,8 @@ OBSERVER, DRAIN_NODE, VICTIM = 0, 1, 2
 
 class Cluster:
     def __init__(self, n=3, etcd_port=None, rescale=False,
-                 sync_wait_ms=REPLICATION_SYNC_WAIT_MS):
+                 sync_wait_ms=REPLICATION_SYNC_WAIT_MS,
+                 checkpoint_ms=None):
         self.n = n
         self.sync_wait_ms = sync_wait_ms
         self.grpc = free_ports(n)
@@ -104,6 +105,7 @@ class Cluster:
         self.peers = ",".join(f"127.0.0.1:{p}" for p in self.grpc)
         self.etcd_port = etcd_port  # None = static peers (kill mode)
         self.rescale = rescale
+        self.checkpoint_ms = checkpoint_ms  # restore mode (r19)
         self.log_dir = tempfile.mkdtemp(prefix="guber-chaos-")
         self.procs = [None] * n
 
@@ -142,7 +144,15 @@ class Cluster:
             env["GUBER_RESCALE_DOUBLE_SERVE_MS"] = str(
                 DOUBLE_SERVE_MS
             )
-        if i == OBSERVER and self.etcd_port is None:
+        if self.checkpoint_ms is not None:
+            # restore mode (r19): per-node checkpoint dirs that SURVIVE
+            # the SIGKILL (same path across respawns of node i)
+            env["GUBER_CHECKPOINT_DIR"] = os.path.join(
+                self.log_dir, f"ckpt{i}"
+            )
+            env["GUBER_CHECKPOINT_INTERVAL_MS"] = str(self.checkpoint_ms)
+        if (i == OBSERVER and self.etcd_port is None
+                and self.checkpoint_ms is None):
             # latency + error injection on the observer's peer RPCs:
             # retries + deadlines must keep the served error rate flat
             # (kill mode only: the rolling soak measures handoff lag,
@@ -738,21 +748,265 @@ def rolling_main(args) -> int:
     return 0
 
 
+# -- full-fleet restore mode (r19) -------------------------------------------
+
+RESTORE_CHECKPOINT_MS = 250  # restore-mode flush window (staleness unit)
+
+
+def scrape_checkpoint_metrics(cluster, node):
+    """checkpoint_*/restore_* gauges/counters from one node's /metrics
+    (checkpoint_failures_total is labelled; sum the label children)."""
+    out = {}
+    try:
+        txt = get_text(f"http://127.0.0.1:{cluster.http[node]}/metrics")
+    except OSError:
+        return out
+    for line in txt.splitlines():
+        for name in ("restore_lag_seconds",
+                     "restored_windows_total",
+                     "checkpoint_age_seconds",
+                     "checkpoint_tracked_entries"):
+            if line.startswith(name + " "):
+                out[name] = float(line.rsplit(" ", 1)[1])
+        if line.startswith("checkpoint_failures_total{"):
+            out["checkpoint_failures_total"] = (
+                out.get("checkpoint_failures_total", 0.0)
+                + float(line.rsplit(" ", 1)[1])
+            )
+    return out
+
+
+def restore_main(args) -> int:
+    """Full-fleet SIGKILL + warm restore under live load: every node of
+    the 3-node static-peers cluster checkpoints to its own directory on
+    a 250 ms cadence; the whole fleet is SIGKILLed at once (a power
+    event — no drain, no handoff, nothing in flight survives) and
+    restarted against the same directories. Acceptance: the over-limit
+    amnesia canary NEVER answers UNDER_LIMIT after any restore (zero
+    under-admissions), every node actually restored windows (not a
+    silent pass), and the measured restore lag stays within the
+    staleness bound (one checkpoint interval + the outage itself)."""
+    cluster = Cluster(3, checkpoint_ms=RESTORE_CHECKPOINT_MS)
+    cycles = 2 if args.seconds >= 10 else 1
+    phase = max(1.0, args.seconds / (3 * cycles + 1))
+    gen = peeker = None
+    failures = []
+    result = {
+        "soak": "full_fleet_sigkill_restore_3node",
+        "backend": "exact",
+        "nodes": 3,
+        "checkpoint_interval_ms": RESTORE_CHECKPOINT_MS,
+        "replication_sync_wait_ms": REPLICATION_SYNC_WAIT_MS,
+        "amnesia_limit": AMNESIA_LIMIT,
+        "cycles": [],
+    }
+    try:
+        t_boot = time.monotonic()
+        for i in range(3):
+            cluster.spawn(i)
+        for i in range(3):
+            cluster.wait_healthy(i)
+        result["boot_s"] = round(time.monotonic() - t_boot, 2)
+        print(f"restore cluster up in {result['boot_s']}s; logs in "
+              f"{cluster.log_dir}", file=sys.stderr)
+
+        # the canary: driven over-limit ONCE, then only peeked — the
+        # idle frozen-refusal shape only a checkpoint can carry across
+        # a full-fleet kill (no surviving node, no replication rescue)
+        canary, owner0 = find_owned_key(
+            cluster, OBSERVER, "res", req=amnesia_req
+        )
+        result["canary"] = {"key": canary, "initial_owner": owner0}
+        r = post_limits(
+            cluster.http[OBSERVER], [amnesia_req(canary, AMNESIA_LIMIT)]
+        )["responses"][0]
+        if r["error"]:
+            failures.append(f"canary drive errored: {r}")
+
+        def canary_over():
+            rr = peek_amnesia(cluster, OBSERVER, canary)
+            return not rr["error"] and rr["status"] == "OVER_LIMIT"
+
+        if not poll_until(canary_over, 5.0,
+                          what="canary never went over-limit"):
+            failures.append("canary never went over-limit before kill")
+
+        keys = [f"rk{i}" for i in range(128)]
+        gen = LoadGen(cluster, keys)
+        gen.start()
+        peeker = CanaryPeeker(cluster, canary)
+        peeker.start()
+
+        for cycle in range(cycles):
+            # live load + at least two flush windows so the canary is
+            # on every owner's disk before the lights go out
+            time.sleep(max(phase, 3 * RESTORE_CHECKPOINT_MS / 1e3))
+            for i in range(3):
+                peeker.exclude(i)
+                gen.mark_dead(i)
+            print(f"cycle {cycle}: SIGKILL all 3 nodes",
+                  file=sys.stderr)
+            t_kill = time.monotonic()
+            for p in cluster.procs:
+                p.send_signal(signal.SIGKILL)
+            for p in cluster.procs:
+                p.wait(timeout=10)
+            time.sleep(0.5)  # the fleet is genuinely dark
+            t_spawn = time.monotonic()
+            for i in range(3):
+                cluster.spawn(i)
+            for i in range(3):
+                cluster.wait_healthy(i)
+
+            # first post-restore canary verdict: the restored window
+            # must answer OVER on the very first successful peek — an
+            # UNDER here IS the amnesia this subsystem exists to kill
+            first = {}
+
+            def first_verdict():
+                try:
+                    rr = peek_amnesia(cluster, OBSERVER, canary)
+                except OSError:
+                    return False
+                if rr["error"]:
+                    return False
+                first.update(rr)
+                return True
+
+            if not poll_until(first_verdict, 15.0,
+                              what="no canary answer after restore"):
+                failures.append(
+                    f"cycle {cycle}: fleet never answered the canary "
+                    f"after restore (log tail:\n"
+                    f"{cluster.log_tail(OBSERVER)})"
+                )
+            elif first["status"] != "OVER_LIMIT":
+                failures.append(
+                    f"cycle {cycle}: QUOTA AMNESIA — first "
+                    f"post-restore canary answer was {first['status']} "
+                    f"({first})"
+                )
+            serving_s = round(time.monotonic() - t_kill, 2)
+            ckpt_metrics = {
+                n: scrape_checkpoint_metrics(cluster, n)
+                for n in range(3)
+            }
+            restored = sum(
+                m.get("restored_windows_total", 0)
+                for m in ckpt_metrics.values()
+            )
+            lag = max(
+                (m["restore_lag_seconds"]
+                 for m in ckpt_metrics.values()
+                 if "restore_lag_seconds" in m),
+                default=None,
+            )
+            result["cycles"].append({
+                "cycle": cycle,
+                "kill_to_serving_s": serving_s,
+                "respawn_to_serving_s": round(
+                    time.monotonic() - t_spawn, 2
+                ),
+                "restore_lag_s": lag,
+                "restored_windows_total": restored,
+                "checkpoint_metrics": ckpt_metrics,
+            })
+            if restored <= 0:
+                failures.append(
+                    f"cycle {cycle}: restored_windows_total == 0 "
+                    f"everywhere — restore never engaged (silent pass)"
+                )
+            # the restored data is at most one interval + the outage
+            # old; anything beyond that means a stale file was served
+            bound_s = (RESTORE_CHECKPOINT_MS / 1e3 + 2.0
+                       + (time.monotonic() - t_kill))
+            if lag is None:
+                failures.append(
+                    f"cycle {cycle}: no restore_lag_seconds scraped"
+                )
+            elif lag > bound_s:
+                failures.append(
+                    f"cycle {cycle}: restore lag {lag:.2f}s exceeds "
+                    f"the staleness bound ({bound_s:.2f}s)"
+                )
+            for i in range(3):
+                gen.mark_alive(i)
+                peeker.include(i)
+
+        time.sleep(phase)
+        peeker.stop()
+        gen.stop()
+        counts, unders = peeker.snapshot()
+        result["canary_samples"] = counts
+        result["under_admissions"] = unders
+        gc = gen.snapshot()
+        result["counts"] = gc
+        served = (gc["ok"] + gc["degraded"] + gc["replicated"]
+                  + gc["item_error"] + gc["inflight_loss"])
+        errors = gc["item_error"] + gc["inflight_loss"]
+        result["error_rate"] = round(errors / served, 4) if served else 1.0
+
+        if counts["under"] > 0:
+            failures.append(
+                f"QUOTA AMNESIA: canary answered UNDER_LIMIT "
+                f"{counts['under']}x across the kills ({unders[:3]})"
+            )
+        if counts["over"] < 30:
+            failures.append(
+                f"too few OVER_LIMIT canary samples to judge ({counts})"
+            )
+        if served < 300:
+            failures.append(f"soak too small to judge ({served} items)")
+    finally:
+        if peeker is not None:
+            peeker._stop.set()
+        if gen is not None:
+            gen._stop.set()
+        for p in cluster.procs:
+            if p is not None and p.poll() is None:
+                p.kill()
+        for p in cluster.procs:
+            if p is not None:
+                try:
+                    p.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    pass
+
+    result["pass"] = not failures
+    result["failures"] = failures
+    out_path = ROOT / args.json
+    out_path.write_text(json.dumps(result, indent=2) + "\n")
+    print(json.dumps(result, indent=2))
+    if failures:
+        print("RESTORE SOAK FAILED:", file=sys.stderr)
+        for f in failures:
+            print(f"  - {f}", file=sys.stderr)
+        return 1
+    print("restore soak passed", file=sys.stderr)
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--seconds", type=float, default=20.0,
                     help="approximate total soak length")
     ap.add_argument("--json", default="BENCH_CHAOS_r11.json")
-    ap.add_argument("--mode", choices=("kill", "rolling"),
+    ap.add_argument("--mode", choices=("kill", "rolling", "restore"),
                     default="kill",
                     help="kill = the r8/r11 SIGKILL soak; rolling = "
                     "the r17 rolling-deploy soak (etcd discovery, "
-                    "GUBER_RESCALE, every node restarted in sequence)")
+                    "GUBER_RESCALE, every node restarted in sequence); "
+                    "restore = the r19 full-fleet SIGKILL + checkpoint "
+                    "restore soak (GUBER_CHECKPOINT_DIR per node)")
     args = ap.parse_args()
     if args.mode == "rolling":
         if args.json == "BENCH_CHAOS_r11.json":
             args.json = "BENCH_RESCALE_r17.json"
         return rolling_main(args)
+    if args.mode == "restore":
+        if args.json == "BENCH_CHAOS_r11.json":
+            args.json = "BENCH_RESTORE_r19.json"
+        return restore_main(args)
     phase = max(2.0, args.seconds / 5.0)
 
     cluster = Cluster(3)

@@ -95,6 +95,7 @@ GATED = (
     "chain_r15",
     "trace_r16",
     "rescale_r17",
+    "checkpoint_r19",
     "shm_r18",
     "clientroute_r18",
     "frontdoor_geb_over_grpc",
@@ -625,6 +626,59 @@ def main() -> int:
                          args.seconds, args.rounds)
         measured["rescale_r17"], detail["rescale_r17"] = m, rows
 
+        # -- checkpoint_r19: checkpointing off vs on -----------------
+        # Same GEB workload against the flat stack; A = checkpoint
+        # off, B = a live CheckpointManager attached (tracking dict
+        # ops per folded frame item) WITH its flush loop running
+        # against a real directory on a 1 s cadence — so the B side
+        # prices both the hot-path tracking and the periodic
+        # off-path snapshot + fsync'd write sharing the submit
+        # thread. The committed baseline pins the "checkpointing a
+        # live node is ~free for serving" contract.
+        print(
+            "workload checkpoint_r19 (checkpoint off vs on)...",
+            file=sys.stderr,
+        )
+        import copy as _copy
+        import tempfile as _tempfile
+
+        from gubernator_tpu.serve.checkpoint import CheckpointManager
+
+        ckpt_conf = _copy.copy(cluster.servers[0].conf)
+        ckpt_conf.checkpoint_dir = _tempfile.mkdtemp(
+            prefix="guber-perfgate-ckpt-"
+        )
+        ckpt_conf.checkpoint_interval = 1.0
+        ckpt_obj = CheckpointManager(ckpt_conf, instance)
+
+        def flip_ckpt(on: bool):
+            async def f():
+                if on:
+                    instance.checkpoint = ckpt_obj
+                    ckpt_obj.start()
+                else:
+                    instance.checkpoint = None
+                    await ckpt_obj.stop()
+
+            cluster.run(f())
+
+        def ckpt_drive(s):
+            return _loadgen(
+                "geb", SOCK, s, 0.0, args.concurrency, args.batch,
+                keyspace=30_000,
+            )["decisions_per_sec"]
+
+        def ckpt_on(s):
+            flip_ckpt(True)
+            try:
+                return ckpt_drive(s)
+            finally:
+                flip_ckpt(False)
+
+        m, rows = paired("checkpoint_r19", ckpt_drive, ckpt_on,
+                         args.seconds, args.rounds)
+        measured["checkpoint_r19"], detail["checkpoint_r19"] = m, rows
+
         # -- shm_r18: control socket vs shared-memory lane -----------
         # Same bridge unix socket, same shed shape, same client: A
         # pins shm negotiation off (every frame write()/read() on the
@@ -835,6 +889,13 @@ def main() -> int:
                             "ring, keyspace-30k zipf shape (owned-"
                             "window tracking price)",
                     "committed": round(measured["rescale_r17"], 4),
+                },
+                "checkpoint_r19": {
+                    "artifact": "BENCH_RESTORE_r19.json",
+                    "pair": "checkpointing off vs on (tracking + "
+                            "1 s flush loop to a real dir), static "
+                            "ring, keyspace-30k zipf shape",
+                    "committed": round(measured["checkpoint_r19"], 4),
                 },
                 "shm_r18": {
                     "artifact": "BENCH_FRONTDOOR_r18.json",

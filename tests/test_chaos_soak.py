@@ -72,3 +72,30 @@ def test_rolling_deploy_soak_passes(tmp_path):
     assert doc["keys_moved_total"] > 0
     assert doc["handoff_lag_max_s"] <= doc["handoff_lag_bound_s"]
     assert doc["error_rate"] < 0.05
+
+
+def test_restore_soak_passes(tmp_path):
+    """The r19 full-fleet restore soak: 3 daemons checkpointing to
+    per-node GUBER_CHECKPOINT_DIR, the WHOLE fleet SIGKILLed at once
+    and restarted against the same directories under live load — the
+    over-limit canary must answer ZERO under-limit peeks across every
+    restore (the first post-restore verdict included), every cycle
+    must restore a nonzero number of windows (no silent pass), and the
+    restore lag must stay within the staleness bound."""
+    out = tmp_path / "restore.json"
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "scripts" / "chaos_soak.py"),
+         "--mode", "restore", "--seconds", "12", "--json", str(out)],
+        cwd=ROOT, capture_output=True, text=True, timeout=600,
+    )
+    assert proc.returncode == 0, (
+        f"restore soak failed:\n{proc.stdout}\n{proc.stderr}"
+    )
+    doc = json.loads(out.read_text())
+    assert doc["pass"] and not doc["failures"]
+    assert doc["canary_samples"]["under"] == 0
+    assert doc["canary_samples"]["over"] > 30
+    assert len(doc["cycles"]) >= 1
+    for c in doc["cycles"]:
+        assert c["restored_windows_total"] > 0
+        assert c["restore_lag_s"] is not None

@@ -722,7 +722,8 @@ def test_standby_purged_when_no_longer_successor():
 
 def _drive_windows(be, n=64, now=T0):
     """Mixed live token windows: under, exhausted, created-over
-    (sticky), plus a leaky entry that must NOT migrate."""
+    (sticky), plus a leaky entry (since r19 it migrates too, flags
+    lane and all; snapshot_read still excludes it by scope)."""
     reqs = []
     for i in range(n):
         kind = i % 4
@@ -741,9 +742,10 @@ def _drive_windows(be, n=64, now=T0):
 
 
 def _rows_mod_duration(rows):
-    """snapshot_read rows with the duration column dropped: replica
-    installs (upsert_globals) do not persist duration — the documented
-    r11 convention — so a re-partitioned store reports 0 there."""
+    """snapshot_read rows with the duration column dropped. Kept for
+    the GLOBAL-replica install comparisons (upsert_globals without the
+    r19 full lanes does not persist duration); the re-partition path
+    now round-trips duration too, so those tests compare full rows."""
     return [
         None if r is None else (r[0], r[2], r[3], r[4]) for r in rows
     ]
@@ -763,7 +765,8 @@ def test_repartition_flat_to_mesh_preserves_every_window():
     from gubernator_tpu.core.hashing import slot_hash_batch
 
     b = mesh_engine.snapshot_read(slot_hash_batch(keys), now=T0 + 5)
-    assert _rows_mod_duration(a) == _rows_mod_duration(b)
+    # full-row compare: the r19 full-lane round-trip preserves duration
+    assert a == b
     live = [r for r in a if r is not None]
     assert len(live) == 48  # leaky windows excluded by scope
     # decisions continue identically on the re-partitioned store
@@ -799,18 +802,14 @@ def test_mesh_backend_repartition_shard_count_change():
         buckets=(64,),
     )
     keys = _drive_windows(be)
-    want = _rows_mod_duration(be.snapshot_read(keys, now=T0 + 5))
+    want = be.snapshot_read(keys, now=T0 + 5)
     assert be.engine.n == 8
     be.repartition(devices=jax.devices()[:2], now=T0 + 5)
     assert be.engine.n == 2
-    assert _rows_mod_duration(
-        be.snapshot_read(keys, now=T0 + 5)
-    ) == want
+    assert be.snapshot_read(keys, now=T0 + 5) == want
     be.repartition(devices=jax.devices()[:1], now=T0 + 5)
     assert be.engine.flat
-    assert _rows_mod_duration(
-        be.snapshot_read(keys, now=T0 + 5)
-    ) == want
+    assert be.snapshot_read(keys, now=T0 + 5) == want
     # over-limit state survived two re-partitions: a created-over
     # window (kind 2, sticky, remaining == limit) and an exhausted one
     # (kind 1, remaining == 0) both still peek OVER with their exact
@@ -828,12 +827,20 @@ def test_mesh_backend_repartition_shard_count_change():
 
 
 def test_export_windows_empty_and_scope():
+    from gubernator_tpu.core.store import FLAG_ALGO_LEAKY
+
     flat = TpuBackend(StoreConfig(rows=4, slots=256), buckets=(64,))
     w = flat.engine.export_windows(now=T0)
     assert w["key_hash"].shape[0] == 0  # nothing ever decided
     _drive_windows(flat, n=8)
     w = flat.engine.export_windows(now=T0 + 5)
-    assert w["key_hash"].shape[0] == 6  # 2 leaky entries out of scope
+    # r19 widened the export to flag-aware rows: the 2 leaky entries
+    # ride along now, carrying their algo bit in the flags lane
+    assert w["key_hash"].shape[0] == 8
+    assert int(
+        ((w["flags"] & FLAG_ALGO_LEAKY) != 0).sum()
+    ) == 2
+    assert (w["duration"] == 60_000).all()
     # expired windows drop out of the export
     w = flat.engine.export_windows(now=T0 + 120_000)
     assert w["key_hash"].shape[0] == 0
