@@ -90,7 +90,7 @@ PERF_OUT ?= BENCH_FRONTDOOR.json
 perf-gate:
 	python scripts/perf_gate.py --seconds $(PERF_SECONDS) \
 	  --rounds $(PERF_ROUNDS) --threshold $(PERF_GATE_THRESHOLD) \
-	  --json $(PERF_OUT)
+	  --json $(PERF_OUT) --global-artifact BENCH_GLOBAL_r20.json
 
 # chaos soak (r8, + r11 quota-amnesia phase): 3-node cluster under load
 # with a peer killed + restarted mid-run and GUBER_FAULT_SPEC injection

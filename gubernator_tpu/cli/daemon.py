@@ -82,10 +82,12 @@ def main(argv=None) -> int:
             # step pipe and a follower missing one would die in
             # choose_bucket mid-lockstep; store_config() (not raw
             # rows/slots) so GUBER_STORE_MIB/TARGET_KEYS auto-sizing
-            # derives the same shape on every process
+            # derives the same shape on every process. Same for the
+            # sketch geometry (r20): the hello handshake verifies both.
             eng = MultiHostMeshEngine(
                 conf.store_config(),
                 buckets=buckets_for_limit(conf.device_batch_limit),
+                sketch=conf.sketch_config(),
             )
             eng.follower_loop(conf.dist_step_listen)
             return 0

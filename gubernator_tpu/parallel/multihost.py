@@ -318,6 +318,7 @@ class MultiHostMeshEngine:
         store_config,
         followers: Optional[Sequence[str]] = None,
         buckets: Sequence[int] = (64, 256, 1024, 4096),
+        sketch=None,
     ):
         import jax
 
@@ -325,7 +326,8 @@ class MultiHostMeshEngine:
 
         self.is_leader = jax.process_index() == 0
         self.inner = MeshEngine(
-            store_config, devices=jax.devices(), buckets=buckets
+            store_config, devices=jax.devices(), buckets=buckets,
+            sketch=sketch,
         )
         self.pipe = (
             StepPipe(followers) if (self.is_leader and followers) else None
@@ -342,11 +344,16 @@ class MultiHostMeshEngine:
             self.pipe.await_acks()
 
     def _config(self) -> dict:
+        skc = self.inner.sketch_config
         return {
             "buckets": tuple(self.inner.buckets),
             "sub_buckets": tuple(self.inner.sub_buckets),
             "store": (self.inner.config.rows, self.inner.config.slots),
             "n_shards": self.inner.n,
+            # sketch geometry (r20): a leader with the cold tier on and
+            # a follower without it (or with a different width) would
+            # diverge at the first two-tier dispatch — verify at hello
+            "sketch": (skc.rows, skc.width) if skc is not None else None,
         }
 
     @property
@@ -371,6 +378,40 @@ class MultiHostMeshEngine:
         # stores reset in lockstep with the leader's, so the leader's
         # counter is authoritative for the whole mesh
         return self.inner.reset_generation
+
+    # -- sketch cold tier surfaces (r20) ------------------------------------
+    # The backend tier probes `sketch` (tier present?) and sets
+    # `observe_hook` (the promoter's hot-key observer — leader-local by
+    # construction: only the leader dispatches request batches, so only
+    # its hook ever fires). `sketch_on` is the runtime A/B flag; both
+    # sides of a lockstep dispatch must pick the same two-tier-or-not
+    # program, so the leader's flag rides every decide message ("sk")
+    # and followers adopt it before dispatching — flipping it here can
+    # never diverge the fleet.
+
+    @property
+    def sketch(self):
+        return self.inner.sketch
+
+    @property
+    def sketch_config(self):
+        return self.inner.sketch_config
+
+    @property
+    def sketch_on(self):
+        return self.inner.sketch_on
+
+    @sketch_on.setter
+    def sketch_on(self, value):
+        self.inner.sketch_on = value
+
+    @property
+    def observe_hook(self):
+        return self.inner.observe_hook
+
+    @observe_hook.setter
+    def observe_hook(self, fn):
+        self.inner.observe_hook = fn
 
     # -- leader API ---------------------------------------------------------
 
@@ -409,6 +450,7 @@ class MultiHostMeshEngine:
                 "algo": algo,
                 "gnp": gnp,
                 "now": now,
+                "sk": int(self.inner.sketch_on),
             }
         )
         try:
@@ -459,7 +501,7 @@ class MultiHostMeshEngine:
         only to unpermute responses, which followers never fetch."""
         assert self.is_leader
         msg = {"kind": "decide_p", "skey": skey, "counts": counts,
-               "now": now}
+               "now": now, "sk": int(self.inner.sketch_on)}
         msg.update(fields)
         self._lockstep(msg)
         try:
@@ -520,6 +562,82 @@ class MultiHostMeshEngine:
         finally:
             self._done()
 
+    def apply_global_hits(self, key_hash, hits, limit, duration, now,
+                          algo=None):
+        """Mesh-native GLOBAL flush (r20): aggregate gossip hits charge
+        their owner shards + replicate post-charge windows in ONE
+        collective step across the whole multi-process mesh. The step's
+        response legs are psum outputs (replicated), so the leader
+        fetches them host-side while followers dispatch-and-discard."""
+        assert self.is_leader
+        n = key_hash.shape[0]
+        if n == 0:
+            z = np.empty(0, np.int64)
+            return z, z, z, z
+        self._lockstep(
+            {
+                "kind": "ghits",
+                "key_hash": key_hash,
+                "hits": hits,
+                "limit": limit,
+                "duration": duration,
+                "algo": algo,
+                "now": now,
+            }
+        )
+        try:
+            return self.inner.apply_global_hits(
+                key_hash, hits, limit, duration, now, algo=algo
+            )
+        finally:
+            self._done()
+
+    def promote_from_sketch(self, key_hash, limits, durations, now=None):
+        """decide_p-style lockstep promotion (r20): the serving-tier
+        promoter stays a host loop on the leader, but its device
+        surfaces (collective estimate/live-row reads + the conditional
+        window install) broadcast so every process issues the identical
+        programs. The branch on `todo.any()` cannot diverge: both reads
+        return psum-replicated arrays, so all processes see the same
+        values."""
+        assert self.is_leader
+        from gubernator_tpu.api.types import millisecond_now
+
+        now = millisecond_now() if now is None else now
+        kh = np.ascontiguousarray(key_hash, np.uint64)
+        limits = np.asarray(limits, np.int64)
+        durations = np.asarray(durations, np.int64)
+        if kh.shape[0] == 0 or self.inner.sketch is None:
+            return self.inner.promote_from_sketch(kh, limits, durations, now)
+        self._lockstep(
+            {
+                "kind": "promote",
+                "key_hash": kh,
+                "limits": limits,
+                "durations": durations,
+                "now": now,
+            }
+        )
+        try:
+            return self.inner.promote_from_sketch(kh, limits, durations, now)
+        finally:
+            self._done()
+
+    def _warmup_sketch_reads(self, now) -> None:
+        """Lockstep-safe twin of the engine's promoter-surface warmup
+        (warmup_public calls this by name): each pow2 rung rides a
+        `promote` broadcast so followers compile the identical
+        collective read + install programs. The installs dirty the
+        store, but warmup_public ends with a (broadcast) reset()."""
+        if self.inner.sketch is None:
+            return
+        for B in (64, 128, 256, 512, 1024):
+            kh = np.arange(1, B + 1, dtype=np.uint64) << np.uint64(32)
+            self.promote_from_sketch(
+                kh, np.full(B, 10, np.int64), np.full(B, 1000, np.int64),
+                now,
+            )
+
     def close(self) -> None:
         if self.pipe:
             self.pipe.close()
@@ -560,12 +678,20 @@ class MultiHostMeshEngine:
                 # the dispatched device program; fetching the packed
                 # outputs here would buy nothing and cost a device->host
                 # transfer per step (plus it would serialize the
-                # leader's fetch pipeline through follower acks)
+                # leader's fetch pipeline through follower acks).
+                # "sk" carries the leader's sketch_on so the two-tier
+                # program choice can never diverge across processes.
+                sk = msg.pop("sk", None)
+                if sk is not None:
+                    self.inner.sketch_on = bool(sk)
                 self.inner.decide_submit(**msg)
             elif kind == "decide_p":
                 # merge-combined batch: already sorted + clipped on the
                 # leader; order=None (identity) — the handle is
                 # discarded, responses are leader-only
+                sk = msg.get("sk")
+                if sk is not None:
+                    self.inner.sketch_on = bool(sk)
                 self.inner.decide_submit_presorted(
                     {
                         k: msg[k]
@@ -595,6 +721,30 @@ class MultiHostMeshEngine:
                     msg["duration"],
                     msg["now"],
                     algo=msg["algo"],
+                )
+            elif kind == "ghits":
+                # mesh-native GLOBAL flush: dispatch the identical sync
+                # collective and discard — post-charge responses are
+                # leader-only (replicated psum outputs), so fetching
+                # them here would only serialize the leader behind a
+                # follower device->host transfer
+                self.inner._sync_padded(
+                    msg["key_hash"],
+                    msg["hits"],
+                    msg["limit"],
+                    msg["duration"],
+                    msg["algo"],
+                    msg["now"],
+                )
+            elif kind == "promote":
+                # sketch-tier promotion: the collective reads return
+                # replicated arrays, so this process's todo/install
+                # control flow is byte-identical to the leader's
+                self.inner.promote_from_sketch(
+                    msg["key_hash"],
+                    msg["limits"],
+                    msg["durations"],
+                    msg["now"],
                 )
             else:
                 raise RuntimeError(f"unknown step kind {kind!r}")

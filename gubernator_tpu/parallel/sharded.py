@@ -197,6 +197,57 @@ def _local_decide_sketch(store: Store, sketch, req: BatchRequest, groups,
     )
 
 
+def _local_decide_sketch_gathered(store: Store, sketch, req: BatchRequest,
+                                  groups, now, axes=("shard",)):
+    """_local_decide_sketch + the _local_decide_gathered all_gather
+    (r20): the two-tier step's replicated-response form for meshes that
+    span processes — the serving leader cannot fetch follower shards'
+    packed rows, so they ride the compiled collective path and come out
+    replicated, exactly like the exact-only step."""
+    store, sketch, packed = _local_decide_sketch(
+        store, sketch, req, groups, now
+    )
+    out = packed[0]
+    if len(axes) == 1:
+        return store, sketch, jax.lax.all_gather(out, axes[0])
+    out = jax.lax.all_gather(out, axes[-1])
+    out = jax.lax.all_gather(out, axes[0])
+    return store, sketch, out.reshape((-1,) + out.shape[2:])
+
+
+def _shard_sketch_min(data, owner, idx, axes):
+    """Owner-masked collective count-min read (r20): each shard row-mins
+    its LOCAL sub-sketch at the probe indices, zeroes the keys it does
+    not own, and a hierarchical psum leaves every key's owner estimate
+    replicated on all shards — the collective twin of _sketch_min_sharded
+    for meshes whose shards the reading host cannot address (multihost:
+    the promoter's estimate gathers become lockstep device programs
+    instead of leader-only host indexing). Exactly one shard contributes
+    per key, so the psum IS the owner's row-min."""
+    local = data[0]  # [1, rows, width] -> [rows, width]
+    est = None
+    for r in range(idx.shape[0]):
+        c = jnp.take(local[r], idx[r])
+        est = c if est is None else jnp.minimum(est, c)
+    me = _axis_me(axes)
+    est = jnp.where(owner == me, est, 0)
+    return _hier_psum(est, axes)
+
+
+def _shard_rows(data, owner, b, axes):
+    """Owner-masked collective bucket-row gather (r20): the collective
+    twin of _rows_sharded — each shard gathers the requested bucket rows
+    from its local store shard, zeroes rows for keys it does not own,
+    and the psum replicates the owner's rows everywhere. Non-mutating;
+    backs _gather_entries (live_mask / snapshot_read) on process-
+    spanning meshes."""
+    local = data[0]  # [1, buckets, lanes] -> [buckets, lanes]
+    rows = jnp.take(local, b, axis=0)
+    me = _axis_me(axes)
+    rows = jnp.where((owner == me)[:, None], rows, 0)
+    return _hier_psum(rows, axes)
+
+
 def _np_presort_sharded(
     key_hash: np.ndarray, store_buckets: int, n_shards: int
 ):
@@ -902,13 +953,13 @@ class PartitionedEngine:
         self.sketch_config = sketch
         self.sketch = None
         self.sketch_on = sketch is not None
-        if sketch is not None and self.policy.spans_processes:
-            raise ValueError(
-                "the sketch tier needs host-side estimate gathers the "
-                "serving leader cannot issue against follower-process "
-                "shards (the promoter is not a lockstep participant); "
-                "run GUBER_SKETCH=0 on multihost deployments"
-            )
+        # r20: process-spanning meshes carry the sketch tier too — the
+        # promoter's host reads (estimates, live rows) compile to
+        # owner-masked psum collectives (_shard_sketch_min/_shard_rows)
+        # instead of leader-only sharded-array indexing, and the
+        # multihost wrapper broadcasts promote/ghits as lockstep
+        # messages so every process issues the identical programs. The
+        # pre-r20 GUBER_SKETCH multihost refusal is lifted.
 
         if self.flat:
             self.n = 1
@@ -967,15 +1018,52 @@ class PartitionedEngine:
             )
         self._step_sketch = None
         if self.sketch_config is not None:
+            sketch_step_fn = (
+                functools.partial(
+                    _local_decide_sketch_gathered, axes=self.axes
+                )
+                if span
+                else _local_decide_sketch
+            )
             self._step_sketch = jax.jit(
                 shard_map_compat(
-                    _local_decide_sketch,
+                    sketch_step_fn,
                     mesh=self.mesh,
                     in_specs=(Ps, Ps, Ps, Ps, P0),
-                    out_specs=(Ps, Ps, Ps),
+                    out_specs=(Ps, Ps, P0 if span else Ps),
+                    check=not span,
                 ),
                 donate_argnums=(0, 1),
             )
+        # collective host-read programs (r20): when the mesh spans
+        # processes the serving host cannot index follower shards, so
+        # the promoter-surface reads (_gather_entries row gathers,
+        # sketch_estimates row-mins) run as owner-masked psum
+        # collectives with replicated outputs instead
+        self._rows_coll = None
+        self._sketch_min_coll = None
+        if span:
+            self._rows_coll = jax.jit(
+                shard_map_compat(
+                    functools.partial(_shard_rows, axes=self.axes),
+                    mesh=self.mesh,
+                    in_specs=(Ps, P0, P0),
+                    out_specs=P0,
+                    check=False,
+                )
+            )
+            if self.sketch_config is not None:
+                self._sketch_min_coll = jax.jit(
+                    shard_map_compat(
+                        functools.partial(
+                            _shard_sketch_min, axes=self.axes
+                        ),
+                        mesh=self.mesh,
+                        in_specs=(Ps, P0, P0),
+                        out_specs=P0,
+                        check=False,
+                    )
+                )
         sync_fn = functools.partial(
             _shard_sync_globals, n_shards=self.n, axes=self.axes
         )
@@ -1548,6 +1636,11 @@ class PartitionedEngine:
         b = bucket_index(kh, self.config.slots)
         if self.flat:
             rows = _rows_flat(self.store.data, b)
+        elif self.policy.spans_processes:
+            # follower-process shards are not host-addressable: ride
+            # the owner-masked psum collective (replicated output)
+            owner = jnp.asarray(owner_of_np(kh_padded, self.n))
+            rows = self._rows_coll(self.store.data, owner, b)
         else:
             owner = jnp.asarray(owner_of_np(kh_padded, self.n))
             rows = _rows_sharded(self.store.data, owner, b)
@@ -2092,6 +2185,11 @@ class PartitionedEngine:
         idx = sketch_indices_np(kh, wid, self.sketch_config)
         if self.flat:
             est = _sketch_min_flat(self.sketch.data, jnp.asarray(idx))
+        elif self.policy.spans_processes:
+            owner = jnp.asarray(owner_of_np(kh, self.n))
+            est = self._sketch_min_coll(
+                self.sketch.data, owner, jnp.asarray(idx)
+            )
         else:
             owner = jnp.asarray(owner_of_np(kh, self.n))
             est = _sketch_min_sharded(

@@ -368,6 +368,41 @@ class _ArrayOps:
         a clock-jump store reset can never leave stale host verdicts."""
         return self.engine.reset_generation
 
+    # -- mesh-native GLOBAL flush (r20) --------------------------------------
+
+    def apply_global_hits_reqs(self, reqs, now=None):
+        """Aggregated GLOBAL gossip hits applied in ONE in-mesh
+        collective (engine.apply_global_hits): each key's summed hits
+        charge its OWNER shard and the post-charge window replicates
+        mesh-wide — the hits-flush leg of the gossip cycle collapsed
+        into a single device program when the destination peer is this
+        node itself (serve/global_mgr.py picks this path per
+        destination). MUST run on the batcher's single submit thread
+        (DeviceBatcher.run_serialized): the sync collective donates the
+        store. Returns RateLimitResp per request (post-charge owner
+        state, caller order)."""
+        import numpy as np
+
+        from gubernator_tpu.api.types import millisecond_now
+        from gubernator_tpu.core.hashing import slot_hash_batch
+
+        if not reqs:
+            return []
+        if now is None:
+            now = millisecond_now()
+        n = len(reqs)
+        status, limit, remaining, reset = self.engine.apply_global_hits(
+            slot_hash_batch([r.hash_key() for r in reqs]),
+            np.fromiter((r.hits for r in reqs), np.int64, n),
+            np.fromiter((r.limit for r in reqs), np.int64, n),
+            np.fromiter((r.duration for r in reqs), np.int64, n),
+            now,
+            algo=np.fromiter(
+                (int(r.algorithm) for r in reqs), np.int32, n
+            ),
+        )
+        return self.resps_from_arrays(status, limit, remaining, reset)
+
     # -- sketch cold tier (r13) ---------------------------------------------
 
     @property
@@ -559,6 +594,12 @@ class MeshBackend(_ArrayOps):
             # step message (documented scope limit) — the batcher's
             # chain lane then fails chained callers with a clear error
             self.decide_chain = None
+        if not hasattr(engine, "apply_global_hits"):
+            # the mesh-native GLOBAL flush (r20) needs the engine's
+            # one-collective hit apply; without it the GlobalManager
+            # falls back to the local decide path for self-destined
+            # flushes
+            self.apply_global_hits_reqs = None
 
     def decide(self, reqs, gnp, now=None):
         from gubernator_tpu.api.types import millisecond_now
@@ -671,16 +712,20 @@ class MultiHostBackend(MeshBackend):
         store: StoreConfig = StoreConfig(),
         followers: Sequence[str] = (),
         buckets: Sequence[int] = (64, 256, 1024, 4096),
+        sketch=None,
     ):
         from gubernator_tpu.parallel.multihost import MultiHostMeshEngine
 
         # the lockstep wrapper exposes the same decide/update/sync/reset
-        # surface MeshBackend drives
+        # surface MeshBackend drives; since r20 the sketch cold tier
+        # rides along (promotion + estimate reads are lockstep
+        # collectives, see parallel/multihost.py)
         super().__init__(
             store,
             buckets=buckets,
             engine=MultiHostMeshEngine(
-                store, followers=list(followers), buckets=buckets
+                store, followers=list(followers), buckets=buckets,
+                sketch=sketch,
             ),
         )
 

@@ -34,6 +34,14 @@ class BehaviorConfig:
     global_timeout: float = 0.5  # GLOBAL gossip RPC deadline
     global_sync_wait: float = 0.0005  # GLOBAL gossip window
     global_batch_limit: int = MAX_BATCH_SIZE
+    # Mesh-native GLOBAL flush (r20, GUBER_GLOBAL_MESH, default ON):
+    # hits queued for a destination that is THIS node route through one
+    # in-mesh psum collective (engine apply_global_hits) instead of a
+    # loopback gossip RPC; off-mesh peers keep the RPC path, selected
+    # per destination. OFF restores the pre-r20 all-RPC fan-out (the
+    # perf gate's A side; also the escape hatch if a deployment needs
+    # flush traffic to exercise the full RPC door).
+    global_mesh: bool = True
 
     # -- peer resilience (r8) ----------------------------------------------
     # Per-RPC deadline for peer calls (GUBER_PEER_TIMEOUT_MS). 0 = fall
@@ -301,9 +309,11 @@ class ServerConfig:
     # bench, BENCH_SKETCH_r13.json). A streaming SpaceSaving promoter
     # migrates hot sketch keys into exact buckets every
     # GUBER_SKETCH_SYNC_WAIT_MS and feeds over-limit candidates to the
-    # r10 shed cache. tpu backend only (mesh/multihost: inert, a
-    # documented scope limit). With no exact-tier pressure (no dropped
-    # creates), ON is byte-identical to OFF (tests/test_sketch_tier.py).
+    # r10 shed cache. All device backends since r20: tpu, mesh (r14,
+    # sub-sketches shard over the mesh axis) and multihost (promotion +
+    # estimate reads are lockstep collectives). With no exact-tier
+    # pressure (no dropped creates), ON is byte-identical to OFF
+    # (tests/test_sketch_tier.py).
     sketch: bool = True
     # Sketch footprint budget in MiB. 0 = auto: a quarter of
     # GUBER_STORE_MIB (capped at 256) when the store budget is pinned —
@@ -470,10 +480,10 @@ class ServerConfig:
 
     def sketch_config(self):
         """Resolve the count-min cold-tier geometry (r13) — None when
-        the tier is off or the backend can't carry it (`tpu` and, since
-        r14, `mesh` — whose sub-sketches shard over the mesh axis;
-        multihost stays a documented scope limit: the promoter's host
-        reads are not lockstep participants). Auto sizing
+        the tier is off or the backend can't carry it (`tpu`; since
+        r14 `mesh`, whose sub-sketches shard over the mesh axis; since
+        r20 `multihost`, whose promoter reads ride owner-masked psum
+        collectives broadcast over the lockstep pipe). Auto sizing
         (GUBER_SKETCH_MIB=0): a quarter of GUBER_STORE_MIB capped at
         256 MiB when the store budget is pinned, else 16 MiB. A pinned
         budget too small to carve a quarter from (< 4 MiB)
@@ -482,7 +492,9 @@ class ServerConfig:
         consumes the whole budget" refusal is reserved for an EXPLICIT
         GUBER_SKETCH_MIB (the operator's own oversubscription,
         store_config())."""
-        if not self.sketch or self.backend not in ("tpu", "mesh"):
+        if not self.sketch or self.backend not in (
+            "tpu", "mesh", "multihost"
+        ):
             return None
         from gubernator_tpu.core.sketches import derive_sketch_config
 
@@ -779,6 +791,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         ),
         breaker_probes=_get_int(env, "GUBER_BREAKER_PROBES", 1),
         global_backlog=_get_int(env, "GUBER_GLOBAL_BACKLOG", 1 << 17),
+        global_mesh=_get(env, "GUBER_GLOBAL_MESH", "1").lower()
+        not in ("0", "false", "no", "off"),
     )
     peers = [
         p.strip()

@@ -30,6 +30,7 @@ from gubernator_tpu.serve.metrics import (
     GLOBAL_ASYNC_DURATIONS,
     GLOBAL_BACKLOG_DROPPED,
     GLOBAL_BROADCAST_DURATIONS,
+    GLOBAL_FLUSH_BYTES,
     GLOBAL_TASK_RESTARTS,
 )
 
@@ -199,20 +200,75 @@ class GlobalManager:
             if hits:
                 await self._send_hits(hits)
 
+    @staticmethod
+    def _payload_bytes(reqs) -> int:
+        """Approximate wire payload of a hit chunk (name + unique-key
+        UTF-8 bytes plus ~40B of fixed int fields per request) — cheap
+        accounting for global_flush_bytes_total. The metric's point is
+        the rpc/mesh SPLIT, not exact protobuf framing."""
+        return sum(len(r.name) + len(r.unique_key) + 40 for r in reqs)
+
+    async def _apply_local(self, reqs) -> None:
+        """Self-destined flush chunk (r20): this node IS the ring owner
+        of these keys, so the 'send' is an in-mesh apply — one psum
+        collective charging each key's owner SHARD
+        (instance.apply_global_hits_local) — instead of a loopback
+        gossip RPC. Backends without the collective surface fall back
+        to the plain local decide path inside the instance hook. Errors
+        are logged, not raised: a failed local apply must not kill the
+        flush loop any more than a failed peer RPC does."""
+        try:
+            apply = getattr(self.instance, "apply_global_hits_local", None)
+            if apply is not None:
+                await apply(reqs)
+            else:
+                await self.instance.decide_local(
+                    reqs, [False] * len(reqs)
+                )
+        except Exception as e:
+            log.error("error applying mesh-local global hits: %s", e)
+
     async def _send_hits(self, hits: Dict[str, RateLimitReq]) -> None:
-        """Group aggregated hits by owning peer and forward
-        (global.go:115-155)."""
+        """Per-destination flush of aggregated hits (global.go:115-155 +
+        r20 mesh-native GLOBAL): keys owned by an off-mesh ring peer
+        forward over gossip RPC; keys owned by THIS node (the ring
+        handed them back, or the flush raced a ring change) short-
+        circuit through the local apply path — one in-mesh collective
+        instead of a loopback RPC. GUBER_GLOBAL_MESH=0 restores the
+        all-RPC fan-out. The r16 trace span carries the per-path hop
+        counts so the collective win is visible per flush, not just as
+        aggregate throughput."""
         start = time.monotonic()
+        tracer = getattr(self.instance, "tracer", None)
+        trace = tracer.begin("global_flush") if tracer is not None else None
         by_peer: Dict[str, list] = {}
         clients = {}
+        local: list = []
+        use_mesh = getattr(self.conf, "global_mesh", True)
         for key, r in hits.items():
             try:
                 peer = self.instance.get_peer(key)
             except Exception as e:
                 log.error("while getting peer for hash key '%s': %s", key, e)
                 continue
+            if use_mesh and getattr(peer, "is_owner", False):
+                local.append(r)
+                continue
             by_peer.setdefault(peer.host, []).append(r)
             clients[peer.host] = peer
+        lim = self.conf.global_batch_limit
+        hops_mesh = 0
+        if local:
+            # one collective per chunk; a steady-state flush fits one
+            for i in range(0, len(local), lim):
+                hops_mesh += 1
+                await self._apply_local(local[i : i + lim])
+            try:
+                GLOBAL_FLUSH_BYTES.labels(path="mesh").inc(
+                    self._payload_bytes(local)
+                )
+            except Exception:  # pragma: no cover - defensive
+                pass
         # fan the per-peer sends out concurrently (bounded): each key
         # appears in exactly one aggregated chunk, so cross-chunk order
         # is immaterial and flush latency becomes ~one RTT instead of
@@ -232,14 +288,34 @@ class GlobalManager:
                     )
 
         sends = [
-            send(host, reqs[i : i + self.conf.global_batch_limit])
+            send(host, reqs[i : i + lim])
             for host, reqs in by_peer.items()
             # a flush can have aggregated more keys than one peer RPC
             # may carry (the owner hard-rejects >MAX_BATCH_SIZE); chunk
-            for i in range(0, len(reqs), self.conf.global_batch_limit)
+            for i in range(0, len(reqs), lim)
         ]
         if sends:
             await asyncio.gather(*sends)
+            try:
+                GLOBAL_FLUSH_BYTES.labels(path="rpc").inc(
+                    sum(self._payload_bytes(c) for c in by_peer.values())
+                )
+            except Exception:  # pragma: no cover - defensive
+                pass
+        if trace is not None:
+            # hop-count evidence for the r20 collective path: a mesh-
+            # local flush is hops_mesh=1 regardless of #peers, where
+            # the RPC path pays one hop per (peer, chunk)
+            trace.add_span(
+                "global_flush_hits",
+                start=start,
+                hops_rpc=len(sends),
+                hops_mesh=hops_mesh,
+                keys_mesh=len(local),
+                keys_rpc=sum(len(v) for v in by_peer.values()),
+                peers_rpc=len(by_peer),
+            )
+            tracer.finish(trace)
         GLOBAL_ASYNC_DURATIONS.observe(time.monotonic() - start)
 
     async def _run_broadcasts(self) -> None:

@@ -728,6 +728,25 @@ class Instance:
                 self.global_mgr.queue_update(r)
         return await self.batcher.decide(reqs, gnp, frame=frame)
 
+    async def apply_global_hits_local(
+        self, reqs: Sequence[RateLimitReq]
+    ) -> None:
+        """Mesh-native GLOBAL flush target (r20): apply aggregated gossip
+        hits for keys THIS node owns in one in-mesh collective
+        (backend.apply_global_hits_reqs on the serialized submit thread),
+        then queue each key for the owner status broadcast — the same
+        post-charge gossip a remote owner's decide_local would have
+        queued, so off-mesh ring peers still learn the new remaining.
+        Backends without the collective surface fall back to the plain
+        local decide path."""
+        fn = getattr(self.backend, "apply_global_hits_reqs", None)
+        if fn is None:
+            await self.decide_local(reqs, [False] * len(reqs))
+            return
+        await self.batcher.run_serialized(fn, list(reqs))
+        for r in reqs:
+            self.global_mgr.queue_update(r)
+
     # -- peer-facing API ----------------------------------------------------
 
     async def get_peer_rate_limits(

@@ -202,6 +202,16 @@ GLOBAL_TASK_RESTARTS = Counter(
     ["task"],
     registry=REGISTRY,
 )
+GLOBAL_FLUSH_BYTES = Counter(
+    "global_flush_bytes_total",
+    "Approximate payload bytes flushed by the GLOBAL hits loop, "
+    "labelled by delivery path: 'rpc' for per-peer gossip sends to "
+    "off-mesh ring peers, 'mesh' for self-destined hits applied in one "
+    "in-mesh psum collective (r20 mesh-native GLOBAL) — the byte split "
+    "shows how much gossip the collective path absorbed",
+    ["path"],
+    registry=REGISTRY,
+)
 GLOBAL_BACKLOG_DROPPED = Counter(
     "global_backlog_dropped_total",
     "GLOBAL gossip entries dropped because the aggregation backlog hit "

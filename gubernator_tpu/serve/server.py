@@ -151,7 +151,8 @@ def make_backend(conf: ServerConfig):
         from gubernator_tpu.serve.backends import MultiHostBackend
 
         return MultiHostBackend(
-            store, followers=conf.dist_followers, buckets=buckets
+            store, followers=conf.dist_followers, buckets=buckets,
+            sketch=sketch,
         )
     raise ValueError(f"unknown backend '{conf.backend}'")
 

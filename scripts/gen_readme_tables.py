@@ -748,6 +748,44 @@ def table_durability() -> str:
     return "\n".join(lines)
 
 
+def table_global_mesh() -> str:
+    """Mesh-native GLOBAL flush pair (r20), from BENCH_GLOBAL_r20.json:
+    the GlobalManager hits flush priced both ways on the resident
+    mesh stack — every chunk looped back through the node's own
+    gossip gRPC door (GUBER_GLOBAL_MESH=0, the pre-r20 fan-out) vs
+    ONE in-mesh psum collective — plus the captured hop-count span
+    split that is the r20 acceptance evidence."""
+    doc = json.loads((ROOT / "BENCH_GLOBAL_r20.json").read_text())
+    tr = doc["flush_trace_spans"]
+    rpc, mesh = tr["rpc"], tr["mesh"]
+    lines = [
+        "| GLOBAL flush measurement | value |",
+        "|---|---|",
+        f"| flush throughput, ONE collective vs loopback-RPC fan-out "
+        f"(keys/s ratio, median of {len(doc['rounds'])} interleaved "
+        f"rounds) | **{doc['median_ratio_mesh_over_rpc']:.2f}x** |",
+        f"| flush hops, RPC side (`global_flush_hits` span: "
+        f"hops_rpc / hops_mesh) | {rpc['hops_rpc']} / "
+        f"{rpc['hops_mesh']} |",
+        f"| flush hops, mesh side (same span) | {mesh['hops_rpc']} / "
+        f"**{mesh['hops_mesh']}** |",
+        f"| keys per flush | {doc['batch_keys']:,} across "
+        f"{doc['shards']} shards |",
+        "",
+        f"(`make perf-gate` workload `global_mesh`: the same "
+        f"{doc['batch_keys']:,} self-owned GLOBAL hits drained "
+        f"through the GlobalManager with GUBER_GLOBAL_MESH=0 — every "
+        f"chunk serialized into a gossip RPC to the node's own gRPC "
+        f"door — vs =1, one `apply_global_hits` psum collective per "
+        f"chunk. The span annotations are asserted by the gate: the "
+        f"collective side flushes in hops_mesh=1 regardless of key "
+        f"or shard count. Scope in the artifact: "
+        f"{doc['scope']} — the ratio prices the removed "
+        f"serialize/loopback/decode, not chip parallelism.)",
+    ]
+    return "\n".join(lines)
+
+
 TABLES = {
     "serving-table": table_serving_exact,
     "serving-device-table": table_serving_device,
@@ -765,6 +803,7 @@ TABLES = {
     "algorithms-table": table_algorithms,
     "rescale-table": table_rescale,
     "durability-table": table_durability,
+    "global-mesh-table": table_global_mesh,
 }
 
 

@@ -96,6 +96,7 @@ GATED = (
     "trace_r16",
     "rescale_r17",
     "checkpoint_r19",
+    "global_mesh",
     "shm_r18",
     "clientroute_r18",
     "frontdoor_geb_over_grpc",
@@ -204,6 +205,9 @@ def main() -> int:
     ap.add_argument("--baseline", default=str(ROOT / "PERF_GATE_BASELINE.json"))
     ap.add_argument("--json", default="", help="write the front-door "
                     "ladder artifact here")
+    ap.add_argument("--global-artifact", default="",
+                    help="write the r20 global_mesh pair artifact "
+                    "(BENCH_GLOBAL_r20.json shape) here")
     ap.add_argument("--update-baseline", action="store_true",
                     help="rewrite the baseline manifest from this "
                     "run's measurements instead of gating")
@@ -679,6 +683,96 @@ def main() -> int:
                          args.seconds, args.rounds)
         measured["checkpoint_r19"], detail["checkpoint_r19"] = m, rows
 
+        # -- global_mesh (r20): RPC-gossip loopback vs in-mesh psum --
+        # GLOBAL flush throughput on the resident mesh stack's
+        # GlobalManager. The single-node ring owns every key, so A
+        # (GUBER_GLOBAL_MESH=0) sends each flush chunk back through
+        # its OWN gRPC gossip door — the pre-r20 fan-out, which priced
+        # mesh-local peers as remote — while B applies the same chunk
+        # as ONE in-mesh psum collective (apply_global_hits on the
+        # submit thread). One traced flush per side afterwards records
+        # the per-path hop counts: the r20 claim is hops_mesh=1 per
+        # flush vs >=1 RPC hop per (peer, chunk).
+        print(
+            "workload global_mesh (RPC loopback vs psum collective)...",
+            file=sys.stderr,
+        )
+        from gubernator_tpu.api.types import Behavior, RateLimitReq
+
+        inst_mesh = mesh_cluster.servers[0].instance
+        gmgr = inst_mesh.global_mgr
+        g_reqs = [
+            RateLimitReq(
+                name="pg", unique_key=f"g{i}", hits=1,
+                limit=1_000_000, duration=60_000,
+                behavior=Behavior.GLOBAL,
+            )
+            for i in range(args.batch)
+        ]
+
+        def gm_drive(on):
+            def d(seconds):
+                async def run():
+                    gmgr.conf.global_mesh = on
+                    try:
+                        keys = 0
+                        deadline = time.monotonic() + seconds
+                        while time.monotonic() < deadline:
+                            for r in g_reqs:
+                                gmgr.queue_hit(r)
+                            await gmgr.drain()
+                            keys += len(g_reqs)
+                        return keys / seconds
+                    finally:
+                        gmgr.conf.global_mesh = True
+
+                return mesh_cluster.run(run())
+
+            return d
+
+        m, rows = paired("global_mesh", gm_drive(False), gm_drive(True),
+                         args.seconds, args.rounds)
+        measured["global_mesh"], detail["global_mesh"] = m, rows
+
+        def traced_flush(on):
+            async def run():
+                tr = inst_mesh.tracer
+                old = tr.sample
+                tr.sample = 1.0
+                gmgr.conf.global_mesh = on
+                try:
+                    for r in g_reqs:
+                        gmgr.queue_hit(r)
+                    await gmgr.drain()
+                finally:
+                    tr.sample = old
+                    gmgr.conf.global_mesh = True
+                for t in reversed(tr.recorder.snapshot()["traces"]):
+                    if t["door"] != "global_flush":
+                        continue
+                    for sp in t["spans"]:
+                        if sp["name"] == "global_flush_hits":
+                            return sp["annotations"]
+                raise RuntimeError("no global_flush_hits span recorded")
+
+            return mesh_cluster.run(run())
+
+        ann_rpc = traced_flush(False)
+        ann_mesh = traced_flush(True)
+        # hop-count evidence, asserted: the collective side must be
+        # exactly one in-mesh hop and zero gossip sends
+        assert ann_rpc["hops_rpc"] >= 1 and ann_rpc["hops_mesh"] == 0, (
+            ann_rpc
+        )
+        assert ann_mesh["hops_mesh"] == 1 and ann_mesh["hops_rpc"] == 0, (
+            ann_mesh
+        )
+        detail["global_mesh_trace"] = {"rpc": ann_rpc, "mesh": ann_mesh}
+        print(
+            f"  flush spans: rpc={ann_rpc} mesh={ann_mesh}",
+            file=sys.stderr,
+        )
+
         # -- shm_r18: control socket vs shared-memory lane -----------
         # Same bridge unix socket, same shed shape, same client: A
         # pins shm negotiation off (every frame write()/read() on the
@@ -819,6 +913,42 @@ def main() -> int:
     for k, v in measured.items():
         print(f"measured {k}: {v:.3f}", file=sys.stderr)
 
+    if args.global_artifact and "global_mesh" in measured:
+        doc = {
+            "scenario": "global_mesh_r20",
+            "scope": "cpu-simulated-devices",
+            "shards": SHARDS,
+            "batch_keys": args.batch,
+            "seconds_per_round": args.seconds,
+            "pair": (
+                "A = GUBER_GLOBAL_MESH=0: every flush chunk loops back "
+                "through the node's own gRPC gossip door (the pre-r20 "
+                "per-peer fan-out, mesh-local self priced as remote); "
+                "B = one in-mesh psum collective per chunk "
+                "(apply_global_hits)"
+            ),
+            "median_ratio_mesh_over_rpc": round(
+                measured["global_mesh"], 4
+            ),
+            "rounds": detail["global_mesh"],
+            "flush_trace_spans": detail["global_mesh_trace"],
+            "notes": (
+                "Flush throughput (keys/s) of the GlobalManager hits "
+                "loop on the resident mesh stack. The hop-count span "
+                "annotations are the r20 acceptance evidence: the "
+                "collective side flushes hops_mesh=1 regardless of "
+                "peer count while the RPC side pays one gossip send "
+                "per (peer, chunk). Simulated CPU devices — the ratio "
+                "prices the serialize+loopback-RPC+door-decode the "
+                "collective removes, not chip parallelism."
+            ),
+        }
+        pathlib.Path(args.global_artifact).write_text(
+            json.dumps(doc, indent=1) + "\n"
+        )
+        print(f"global_mesh artifact written: {args.global_artifact}",
+              file=sys.stderr)
+
     baseline_path = pathlib.Path(args.baseline)
     if args.update_baseline:
         manifest = {
@@ -896,6 +1026,14 @@ def main() -> int:
                             "1 s flush loop to a real dir), static "
                             "ring, keyspace-30k zipf shape",
                     "committed": round(measured["checkpoint_r19"], 4),
+                },
+                "global_mesh": {
+                    "artifact": "BENCH_GLOBAL_r20.json",
+                    "pair": "GLOBAL flush loopback over the gossip "
+                            "gRPC door (GUBER_GLOBAL_MESH=0) vs ONE "
+                            f"in-mesh psum collective, {SHARDS}-shard "
+                            "mesh stack, 1000-key flush chunks",
+                    "committed": round(measured["global_mesh"], 4),
                 },
                 "shm_r18": {
                     "artifact": "BENCH_FRONTDOOR_r18.json",

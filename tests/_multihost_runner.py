@@ -37,7 +37,13 @@ def main():
 
     initialize_distributed(coordinator, num_processes=nprocs, process_id=pid)
 
+    from gubernator_tpu.core.sketches import SketchConfig
+
     cfg = StoreConfig(rows=16, slots=1 << 8)
+    # r20: the lockstep roles carry the count-min cold tier so every
+    # decide dispatch exercises the two-tier collective program and the
+    # leader can drive promote/ghits across the process boundary
+    SK = SketchConfig(rows=2, width=1 << 10)
     T0 = 1_700_000_000_000
 
     if role == "follower-mismatch":
@@ -75,7 +81,7 @@ def main():
         assert block == [p] * per, f"process {p} devices not contiguous: {proc_of}"
 
     if role == "follower":
-        eng = MultiHostMeshEngine(cfg, buckets=(16,))
+        eng = MultiHostMeshEngine(cfg, buckets=(16,), sketch=SK)
         eng.follower_loop(f"127.0.0.1:{step_ports}")
         print("FOLLOWER-OK", flush=True)
         return
@@ -84,6 +90,7 @@ def main():
         cfg,
         followers=[f"127.0.0.1:{p}" for p in step_ports.split(",")],
         buckets=(16,),
+        sketch=SK,
     )
     n_shards = eng.n
     assert n_shards == len(devs), (n_shards, devs)
@@ -163,6 +170,82 @@ def main():
     s7, _, r7, _ = eng.decide_wait(h2)
     assert (s6 == 0).all() and (r6 == 1).all(), (s6, r6)
     assert (s7 == 0).all() and (r7 == 0).all(), (s7, r7)
+
+    # -- r20: mesh-native GLOBAL hits, differential vs the RPC path ----------
+    # The collective flush (one lockstep ghits step across processes)
+    # must be byte-identical to the gossip door's decide charge; a flat
+    # single-device reference engine on the leader plays the RPC side.
+    from gubernator_tpu.parallel.sharded import TpuEngine
+
+    # tall ladder: the reference takes whole batches flat (decisions
+    # are rung-independent; only the mesh side must match the lockstep
+    # ladder)
+    ref = TpuEngine(cfg, buckets=(2048,), sketch=SK)
+    # sketch windows are quantized epoch-relative (engine-ms //
+    # duration), so promote reset times only match when both engines
+    # pinned the same epoch; eng pinned at its first decide (T0)
+    ref._engine_now(T0)
+    kh3 = kh * np.uint64(5) | np.uint64(2)
+    hits3 = (np.arange(n, dtype=np.int64) % 3) + 1
+    lim3 = ones * 7
+    for step in range(2):  # second flush compounds on the same windows
+        rr = ref.decide_arrays(kh3, hits3, lim3, dur, algo, gnp, T0 + 20 + step)
+        mm = eng.apply_global_hits(kh3, hits3, lim3, dur, T0 + 20 + step)
+        for a, b in zip(rr, mm):
+            np.testing.assert_array_equal(
+                np.asarray(a, np.int64), np.asarray(b, np.int64)
+            )
+    # replica-install leg: gnp peeks answer from the windows the
+    # collective installed on every shard, equal to the owner state
+    rp = ref.decide_arrays(
+        kh3, np.zeros(n, np.int64), lim3, dur, algo, np.ones(n, bool),
+        T0 + 22,
+    )
+    mp = eng.decide_arrays(
+        kh3, np.zeros(n, np.int64), lim3, dur, algo, np.ones(n, bool),
+        T0 + 22,
+    )
+    for a, b in zip(rp, mp):
+        np.testing.assert_array_equal(
+            np.asarray(a, np.int64), np.asarray(b, np.int64)
+        )
+    print("GHITS-OK", flush=True)
+
+    # -- r20: sketch tier on multihost — lockstep promote collective ---------
+    # promote_from_sketch rides a `promote` broadcast: every process
+    # issues the identical collective estimate + live-mask reads and
+    # the conditional window install. Differential vs the flat engine.
+    khp = (
+        np.arange(1, 4 * n_shards + 1, dtype=np.uint64) << np.uint64(32)
+    ) | np.uint64(7)
+    np_ = khp.shape[0]
+    limsP = np.full(np_, 5, np.int64)
+    dursP = np.full(np_, 60_000, np.int64)
+    mt = eng.promote_from_sketch(khp, limsP, dursP, T0 + 30)
+    rt = ref.promote_from_sketch(khp, limsP, dursP, T0 + 30)
+    for a, b in zip(mt, rt):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert mt[0].all(), "first promote should install every key"
+    # installs landed mesh-wide: a second promote skips every key
+    # (live exact entries are authoritative) — on BOTH engines
+    mt2 = eng.promote_from_sketch(khp, limsP, dursP, T0 + 31)
+    rt2 = ref.promote_from_sketch(khp, limsP, dursP, T0 + 31)
+    assert not mt2[0].any() and not rt2[0].any(), (mt2[0], rt2[0])
+    # promoted keys now decide exactly, byte-identical to the reference
+    onesP = np.ones(np_, np.int64)
+    sa = ref.decide_arrays(
+        khp, onesP, limsP, dursP, np.zeros(np_, np.int32),
+        np.zeros(np_, bool), T0 + 32,
+    )
+    sb = eng.decide_arrays(
+        khp, onesP, limsP, dursP, np.zeros(np_, np.int32),
+        np.zeros(np_, bool), T0 + 32,
+    )
+    for a, b in zip(sa, sb):
+        np.testing.assert_array_equal(
+            np.asarray(a, np.int64), np.asarray(b, np.int64)
+        )
+    print("SKETCH-OK", flush=True)
 
     eng.close()
     print("LEADER-OK", flush=True)

@@ -1,0 +1,240 @@
+"""r20 differential fuzz: mesh-native GLOBAL == RPC-gossip GLOBAL.
+
+The collective flush (PartitionedEngine.apply_global_hits: owner charge
++ psum replicate + replica install in ONE device program) must produce
+byte-identical decisions to the RPC gossip cycle it replaces — the
+owner's decide charge, which is exactly what the gossip receive door
+(get_peer_rate_limits -> decide_local -> decide) runs. Legs pinned
+here, all under the r10 fake clock:
+
+- flat engine collective vs the flat RPC reference (degenerate mesh),
+- 8-device mesh collective vs the same flat RPC reference,
+- serve-level mixed ring: the GlobalManager flush through a REAL
+  Instance + MeshBackend (batcher-serialized apply_global_hits_reqs)
+  equals the reference backend that received the same hits over the
+  gossip door.
+
+The 2-process multihost engine leg of the same pin lives in
+tests/_multihost_runner.py (the "ghits" exercise) — lockstep follower
+processes can't run under plain pytest. The fake-peer path-selection
+unit pins (self short-circuit, GUBER_GLOBAL_MESH=0 escape) live in
+tests/test_global_mgr.py.
+"""
+
+import asyncio
+
+import numpy as np
+
+import gubernator_tpu.core  # noqa: F401  (x64)
+from gubernator_tpu.api.types import Behavior, PeerInfo, RateLimitReq
+from gubernator_tpu.core.store import StoreConfig
+from gubernator_tpu.parallel.sharded import MeshEngine, TpuEngine, owner_of_np
+from gubernator_tpu.serve.backends import MeshBackend, TpuBackend
+from gubernator_tpu.serve.config import BehaviorConfig, ServerConfig
+from gubernator_tpu.serve.instance import Instance
+
+T0 = 1_700_000_000_000
+ADDR = "127.0.0.1:7976"
+
+
+class FakeClock:
+    def __init__(self, t=T0):
+        self.t = t
+
+    def __call__(self) -> int:
+        return self.t
+
+
+def _pin_clock(monkeypatch, clock):
+    import gubernator_tpu.api.types as types_mod
+    import gubernator_tpu.core.engine as engine_mod
+    import gubernator_tpu.core.oracle as oracle_mod
+
+    monkeypatch.setattr(types_mod, "millisecond_now", clock)
+    monkeypatch.setattr(engine_mod, "millisecond_now", clock)
+    monkeypatch.setattr(oracle_mod, "millisecond_now", clock)
+
+
+def _arrays_equal(a, b, ctx=""):
+    for name, x, y in zip(("status", "limit", "remaining", "reset"), a, b):
+        np.testing.assert_array_equal(
+            np.asarray(x, np.int64),
+            np.asarray(y, np.int64),
+            err_msg=f"{ctx}: {name} diverged",
+        )
+
+
+def test_fuzz_collective_flush_equals_rpc_gossip_charge():
+    """Seeded fuzz over mixed algorithms / limits / durations / clock
+    jumps: every flush's post-charge response and every interleaved
+    authoritative decision stays byte-identical between the RPC
+    reference (decide charge on a flat engine) and the collective
+    apply on BOTH the flat and the 8-device mesh engine."""
+    cfg = StoreConfig(rows=4, slots=1 << 10)
+    rpc = TpuEngine(cfg, buckets=(64,))  # reference: gossip-door charge
+    col_flat = TpuEngine(cfg, buckets=(64,))
+    col_mesh = MeshEngine(cfg, buckets=(64,))
+
+    rng = np.random.default_rng(0x6E0B)
+    n_keys = 48
+    kh = rng.integers(1, 2**63, n_keys, np.int64).astype(np.uint64)
+    # keys must spread over shards or the mesh psum degenerates
+    assert len(set(owner_of_np(kh, col_mesh.n).tolist())) >= 4
+    lim = rng.integers(3, 40, n_keys).astype(np.int64)
+    dur = rng.integers(1, 60, n_keys).astype(np.int64) * 10_000
+    algo = rng.integers(0, 2, n_keys).astype(np.int32)
+
+    now = T0
+    for rnd in range(8):
+        now += int(rng.integers(0, 20_000))
+        pick = np.flatnonzero(rng.random(n_keys) < 0.6)
+        if pick.size == 0:
+            continue
+        hits = rng.integers(1, 5, pick.size).astype(np.int64)
+        k, l, d, a = kh[pick], lim[pick], dur[pick], algo[pick]
+        # RPC reference: the owner's decide charge, exactly what the
+        # gossip receive door runs for a forwarded hit chunk
+        rr = rpc.decide_arrays(
+            k, hits, l, d, a, np.zeros(pick.size, bool), now
+        )
+        _arrays_equal(
+            rr, col_flat.apply_global_hits(k, hits, l, d, now, algo=a),
+            f"round {rnd} flat",
+        )
+        _arrays_equal(
+            rr, col_mesh.apply_global_hits(k, hits, l, d, now, algo=a),
+            f"round {rnd} mesh",
+        )
+        # interleaved authoritative decisions on ALL keys (charges on
+        # both sides identically, so the fuzz keeps compounding state)
+        if rnd % 3 == 2:
+            now += 1
+            one = np.ones(n_keys, np.int64)
+            gnp = np.zeros(n_keys, bool)
+            dr = rpc.decide_arrays(kh, one, lim, dur, algo, gnp, now)
+            _arrays_equal(
+                dr,
+                col_flat.decide_arrays(kh, one, lim, dur, algo, gnp, now),
+                f"round {rnd} flat decide",
+            )
+            _arrays_equal(
+                dr,
+                col_mesh.decide_arrays(kh, one, lim, dur, algo, gnp, now),
+                f"round {rnd} mesh decide",
+            )
+    # replica install leg: non-owner (gnp) peeks answer from the
+    # replicas the collective installed — identical to the flat
+    # engines' owner-state reads at the same instant
+    now += 1
+    zero = np.zeros(n_keys, np.int64)
+    gnp = np.ones(n_keys, bool)
+    pr = rpc.decide_arrays(kh, zero, lim, dur, algo, gnp, now)
+    _arrays_equal(
+        pr, col_flat.decide_arrays(kh, zero, lim, dur, algo, gnp, now),
+        "final flat gnp peek",
+    )
+    _arrays_equal(
+        pr, col_mesh.decide_arrays(kh, zero, lim, dur, algo, gnp, now),
+        "final mesh gnp peek",
+    )
+
+
+def test_serve_level_mixed_ring_flush_equals_gossip_door(monkeypatch):
+    """End-to-end through the serving stack: a ring with one off-mesh
+    peer — self-owned GLOBAL hits flush through the REAL instance's
+    local apply (batcher-serialized apply_global_hits_reqs collective),
+    off-mesh keys go RPC to the fake peer — and the post-flush state
+    equals a reference backend that received the same self-owned hits
+    over the gossip door (decide)."""
+    import jax
+
+    clock = FakeClock()
+    _pin_clock(monkeypatch, clock)
+
+    cfg = StoreConfig(rows=4, slots=1 << 10)
+    ref = TpuBackend(cfg, buckets=(64,))
+    backend = MeshBackend(cfg, devices=jax.devices(), buckets=(64,))
+    assert backend.apply_global_hits_reqs is not None
+    conf = ServerConfig(
+        grpc_address=ADDR, advertise_address=ADDR, backend="mesh",
+        # windows absurdly long: only explicit drain() flushes
+        behaviors=BehaviorConfig(global_sync_wait=600.0), sketch=False,
+    )
+
+    class OffMeshPeer:
+        host = "10.9.9.9:7975"
+        is_owner = False
+        hit_batches: list = []
+
+        async def get_peer_rate_limits(self, reqs):
+            self.hit_batches.append(list(reqs))
+            return []
+
+        async def update_peer_globals(self, updates):
+            pass
+
+    off = OffMeshPeer()
+
+    async def run():
+        inst = Instance(conf, backend)
+        inst.start()
+        await inst.set_peers([PeerInfo(address=ADDR, is_owner=True)])
+        self_peer = inst.get_peer("anything")
+        assert self_peer.is_owner
+
+        # mixed ring: route a slice of keys to the off-mesh peer
+        def route(key):
+            return off if key.split("_", 1)[1].startswith("r") else self_peer
+
+        monkeypatch.setattr(inst, "get_peer", route)
+        try:
+            mine = [
+                RateLimitReq(
+                    name="gd", unique_key=f"m{i}", hits=(i % 3) + 1,
+                    limit=10, duration=60_000, behavior=Behavior.GLOBAL,
+                )
+                for i in range(24)
+            ]
+            remote = [
+                RateLimitReq(
+                    name="gd", unique_key=f"r{i}", hits=1, limit=10,
+                    duration=60_000, behavior=Behavior.GLOBAL,
+                )
+                for i in range(4)
+            ]
+            for r in mine + remote:
+                inst.global_mgr.queue_hit(r)
+            await inst.global_mgr.drain()
+            # off-mesh keys went over gossip RPC, self keys did not
+            (sent,) = off.hit_batches
+            assert {r.unique_key for r in sent} == {
+                r.unique_key for r in remote
+            }
+            # reference: the same self-owned chunk arriving over the
+            # gossip door is just a decide on the owner
+            ref.decide(mine, [False] * len(mine), now=clock())
+            clock.t += 5
+            peek = [
+                RateLimitReq(
+                    name="gd", unique_key=f"m{i}", hits=0, limit=10,
+                    duration=60_000,
+                )
+                for i in range(24)
+            ]
+            a = ref.decide(peek, [False] * len(peek), now=clock())
+            b = backend.decide(peek, [False] * len(peek), now=clock())
+            for x, y in zip(a, b):
+                assert (x.status, x.limit, x.remaining, x.reset_time) == (
+                    y.status, y.limit, y.remaining, y.reset_time
+                )
+            # the local apply queues the owner broadcast for the ring
+            # (drain() above already consumed the first batch, so pin
+            # the hook directly)
+            await inst.apply_global_hits_local(mine[:2])
+            assert set(inst.global_mgr._updates) == {
+                r.hash_key() for r in mine[:2]
+            }, "local apply did not queue the owner broadcast"
+        finally:
+            await inst.stop()
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))

@@ -197,3 +197,119 @@ def test_failing_peer_does_not_block_others_or_kill_loops():
     run(main())
     assert len(peers["c"].hit_batches) >= 2
     assert peers["c"].update_batches, "broadcast blocked by failing peer"
+
+
+# -- r20 mesh-native flush: per-destination path selection ------------------
+
+
+def _flush_bytes(path: str) -> float:
+    from gubernator_tpu.serve import metrics
+
+    return metrics.GLOBAL_FLUSH_BYTES.labels(path=path)._value.get()
+
+
+def test_self_owned_hits_short_circuit_local_apply():
+    """r20 satellite pin: hits whose ring owner is THIS node must go
+    through the local apply path (one in-mesh collective / local
+    decide), never a loopback gossip RPC to our own door — and the
+    flush trace span must carry the hop-count split proving it."""
+    from gubernator_tpu.serve.tracing import Tracer
+
+    peers = {"a": FakePeer("A"), "b": FakePeer("B", is_owner=True)}
+    inst = FakeInstance(peers)
+    inst.tracer = Tracer(sample=1.0)
+    before_mesh = _flush_bytes("mesh")
+    before_rpc = _flush_bytes("rpc")
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        gm.queue_hit(_req("b1", hits=2))
+        gm.queue_hit(_req("b1", hits=3))  # aggregates with the first
+        gm.queue_hit(_req("b2", hits=1))
+        gm.queue_hit(_req("a1", hits=4))  # off-mesh peer: stays RPC
+        for _ in range(200):
+            if peers["a"].hit_batches and inst.decided:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    # self-destined keys NEVER loop back through our own gossip door
+    assert peers["b"].hit_batches == []
+    # they landed on the local apply path (decide_local fallback on the
+    # fake), aggregated per key exactly like the RPC chunks
+    (local_batch,) = inst.decided
+    assert {r.unique_key: r.hits for r in local_batch} == {"b1": 5, "b2": 1}
+    # the off-mesh peer still got its gossip send
+    assert len(peers["a"].hit_batches) == 1
+    assert {r.unique_key for r in peers["a"].hit_batches[0]} == {"a1"}
+    # byte split is observable per path
+    assert _flush_bytes("mesh") > before_mesh
+    assert _flush_bytes("rpc") > before_rpc
+    # trace-span evidence: one mesh hop (one collective) regardless of
+    # how many self-owned keys flushed, one RPC hop for the one peer
+    spans = [
+        sp
+        for tr in inst.tracer.recorder.snapshot()["traces"]
+        if tr["door"] == "global_flush"
+        for sp in tr["spans"]
+        if sp["name"] == "global_flush_hits"
+    ]
+    assert spans, "flush produced no global_flush_hits span"
+    ann = spans[0]["annotations"]
+    assert ann["hops_mesh"] == 1
+    assert ann["hops_rpc"] == 1
+    assert ann["keys_mesh"] == 2
+    assert ann["keys_rpc"] == 1
+
+
+def test_global_mesh_off_restores_rpc_fanout():
+    """GUBER_GLOBAL_MESH=0 escape hatch: self-destined hits go back
+    through the gossip door like any other peer (pre-r20 behavior, and
+    the perf gate's A side)."""
+    peers = {"a": FakePeer("A"), "b": FakePeer("B", is_owner=True)}
+    inst = FakeInstance(peers)
+
+    async def main():
+        gm = GlobalManager(_conf(global_mesh=False), inst)
+        gm.start()
+        gm.queue_hit(_req("b1", hits=2))
+        for _ in range(200):
+            if peers["b"].hit_batches:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert len(peers["b"].hit_batches) == 1
+    assert inst.decided == []
+
+
+def test_local_apply_prefers_instance_hook():
+    """When the instance exposes apply_global_hits_local (the real
+    server does), the flush must call it instead of decide_local — that
+    hook is where the one-collective apply lives."""
+    peers = {"b": FakePeer("B", is_owner=True)}
+    inst = FakeInstance(peers)
+    applied = []
+
+    async def hook(reqs):
+        applied.append(list(reqs))
+
+    inst.apply_global_hits_local = hook
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        gm.queue_hit(_req("b1", hits=7))
+        for _ in range(200):
+            if applied:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert inst.decided == []
+    (batch,) = applied
+    assert [(r.unique_key, r.hits) for r in batch] == [("b1", 7)]

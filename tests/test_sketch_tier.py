@@ -140,16 +140,16 @@ def test_store_mib_carve_out_and_host_budget():
     assert store_footprint_bytes(carved) <= (1024 - 256) << 20
     assert store_footprint_bytes(carved) < store_footprint_bytes(full)
     # mesh carries the sharded sketch since r14: same carve-out as tpu;
-    # multihost stays sketch-free (documented scope limit) so its full
-    # budget remains exact
+    # multihost joins in r20 (promotion + estimate reads are lockstep
+    # collectives), so its budget carves identically too
     mesh = ServerConfig(
         backend="mesh", store_mib=1024, sketch=True, sketch_mib=256
     ).store_config()
     assert store_footprint_bytes(mesh) == store_footprint_bytes(carved)
     mh = ServerConfig(
-        backend="multihost", store_mib=1024, sketch=True
+        backend="multihost", store_mib=1024, sketch=True, sketch_mib=256
     ).store_config()
-    assert store_footprint_bytes(mh) == store_footprint_bytes(full)
+    assert store_footprint_bytes(mh) == store_footprint_bytes(carved)
     with pytest.raises(ValueError):
         ServerConfig(
             backend="tpu", store_mib=16, sketch=True, sketch_mib=16
