@@ -154,6 +154,11 @@ class PeerInfo:
 
     address: str = ""
     is_owner: bool = False
+    #: this peer's replica state lives in THIS node's mesh (a lockstep
+    #: follower process or a co-scheduled server sharing the device
+    #: store): replica-install broadcasts to it collapse into one local
+    #: mesh install instead of a per-peer RPC (r21, global_mgr.py)
+    mesh_local: bool = False
 
 
 def hash_key(name: str, unique_key: str) -> str:

@@ -220,13 +220,14 @@ async def _prefill_sequential(inst, n_ids, group, limit, duration):
 
 async def _measure_window(
     inst, backend, pool, depth, seconds, group, metric, limit=1000,
-    duration=60_000, churn=False, key_space=1 << 40,
+    duration=60_000, churn=False, key_space=1 << 40, algo_id=0,
 ) -> dict:
     """One timed window of pre-hashed key traffic through the
     batcher's array door — the zipf10m/zipf100m/key-churn scenarios'
     one measurement loop. `churn=True` advances the whole pool by a
     fresh phase every pass (keystreams.churn_pool) so no key is ever
-    hot twice."""
+    hot twice. `algo_id` drives the stream under a non-token algorithm
+    (the r21 zipf100m sliding/GCRA arms)."""
     import asyncio
 
     import numpy as np
@@ -241,7 +242,7 @@ async def _measure_window(
         nonlocal done_rows
         i = w * 101
         ones = np.ones(group, np.int64)
-        algo = np.zeros(group, np.int32)
+        algo = np.full(group, algo_id, np.int32)
         passes = 0
         while time.monotonic() < stop_at:
             if churn:
@@ -585,32 +586,42 @@ def _filler_hashes(slots: int) -> "np.ndarray":
 
 
 def measure_tail_error(
-    batches: int = 96, rows: int = 4, sketch_mib: int = 8, seed: int = 7
+    batches: int = 96, sketch_mib: int = 8, seed: int = 7,
+    derivation: str = "v2", algorithm: str = "token", rows: int = 0,
 ) -> dict:
     """Measured tail-key error of the sketch tier on a pinned zipf
-    stream (the r13 acceptance phase; also driven by the property test
-    in tests/test_sketch_tier.py).
+    stream (the r13 acceptance phase, derivation- and algorithm-aware
+    since r21; also driven by the property test in
+    tests/test_sketch_tier.py).
 
     Rig: a tiny exact store whose buckets are pinned full of immortal
     filler entries included in every batch, so EVERY measured key's
     create drops and decides from the sketch — the clean measurement of
     sketch error, uncontaminated by exact-tier wins. Limits are huge so
-    every hit charges, making host-side tallies the exact ground truth
-    for the counts the sketch was charged with. Reports max/mean
-    overestimate against the documented classic-CM bound e*N/width
-    (conservative update only tightens it) and the under-count count,
-    which must be ZERO (one-sided error = fail-closed)."""
+    every hit admits and charges regardless of `algorithm` (the
+    window-ring serves sliding/GCRA through the same per-window cells),
+    making host-side tallies the exact ground truth for the counts the
+    sketch was charged with. Reports max/mean overestimate against the
+    documented classic-CM bound e*N/width (conservative update only
+    tightens it) and the under-count count, which must be ZERO
+    (one-sided error = fail-closed). `derivation` selects the counter
+    geometry at the SAME byte budget: "v2" (2 rows of saturating int32,
+    4x the width of r13 -> 4x tighter bound per byte) or "r13" (4 rows
+    of int64, the committed r13 geometry)."""
     import math
 
     import numpy as np
 
     from gubernator_tpu.cli import keystreams
+    from gubernator_tpu.core.algorithms import ALGO_NAMES
     from gubernator_tpu.core.engine import TpuEngine
     from gubernator_tpu.core.sketches import derive_sketch_config
     from gubernator_tpu.core.store import StoreConfig
 
     cfg = StoreConfig(rows=1, slots=64)
-    skc = derive_sketch_config(mib=sketch_mib, rows=rows)
+    skc = derive_sketch_config(
+        mib=sketch_mib, rows=rows, derivation=derivation
+    )
     eng = TpuEngine(cfg, buckets=(4096,), sketch=skc)
     T0 = 1_700_000_000_000
     fill = _filler_hashes(cfg.slots)
@@ -627,7 +638,8 @@ def measure_tail_error(
     hits = np.concatenate([np.zeros(nf, np.int64), np.ones(nm, np.int64)])
     limit = np.full(B, LIM, np.int64)
     dur = np.full(B, DUR, np.int64)
-    algo = np.zeros(B, np.int32)
+    algo = np.full(B, ALGO_NAMES[algorithm], np.int32)
+    algo[:nf] = 0
     gnp = np.zeros(B, bool)
     rng = np.random.default_rng(seed)
     true = np.zeros(10_000, np.int64)
@@ -646,10 +658,13 @@ def measure_tail_error(
     bound = math.e * n_charged / skc.width
     return dict(
         metric="sketch_tail_error",
+        algorithm=algorithm,
+        derivation=derivation,
         distinct_keys=int(touched.shape[0]),
         charged_hits=n_charged,
         sketch_rows=skc.rows,
         sketch_width=skc.width,
+        counter_bytes=skc.counter_bytes,
         under_counts=int((diff < 0).sum()),
         max_overestimate=int(diff.max()),
         mean_overestimate=round(float(diff.mean()), 4),
@@ -662,11 +677,48 @@ def measure_tail_error(
     )
 
 
+def measure_tail_error_ab(
+    batches: int = 96, sketch_mib: int = 8, seed: int = 7
+) -> dict:
+    """The r21 derivation A/B at ONE byte budget: the committed r13
+    geometry vs the v2 additive-error geometry on the identical pinned
+    stream. The acceptance claim is strict: v2's measured max
+    overestimate must sit BELOW r13's theoretical bound (and v2's own
+    bound is 4x tighter), with zero under-counts on both sides."""
+    r13 = measure_tail_error(
+        batches=batches, sketch_mib=sketch_mib, seed=seed,
+        derivation="r13",
+    )
+    v2 = measure_tail_error(
+        batches=batches, sketch_mib=sketch_mib, seed=seed,
+        derivation="v2",
+    )
+    return dict(
+        metric="sketch_tail_error_derivation_ab",
+        sketch_mib=sketch_mib,
+        r13=r13,
+        v2=v2,
+        v2_bound_over_r13_bound=round(
+            v2["documented_bound"] / r13["documented_bound"], 4
+        ),
+        v2_max_below_r13_bound=bool(
+            v2["max_overestimate"] < r13["documented_bound"]
+        ),
+        zero_under_counts=bool(
+            v2["under_counts"] == 0 and r13["under_counts"] == 0
+        ),
+    )
+
+
 def run_zipf100m(args) -> int:
     """The r13 sketch-tier flagship: ~100M-key cardinality at the SAME
-    fixed device budget the exact-only zipf10m scenario uses.
+    fixed device budget the exact-only zipf10m scenario uses. Since r21
+    the tail-error phase runs the r13-vs-v2 derivation A/B plus sliding
+    and GCRA arms (window-ring serving), and two algorithm arm rows
+    drive the 100M-key stream under sliding/GCRA on the sketch stack.
 
-    Three phases, one artifact (BENCH_SKETCH_r13.json):
+    Three phases, one artifact (BENCH_SKETCH_r21.json; r13 shape was
+    BENCH_SKETCH_r13.json):
 
     1. `zipf10m_exact_baseline` — the r6 flagship shape: the whole
        GUBER_STORE_MIB budget as one exact tier, 10M-key zipf. This is
@@ -798,17 +850,41 @@ def run_zipf100m(args) -> int:
                     ),
                 )
 
+            # r21 algorithm arms: the SAME 100M-key stream under
+            # sliding and GCRA on the resident sketch stack — the
+            # window-ring must keep serving the saturation tier's
+            # dropped creates (dropped_creates > 0) when operators
+            # pick the fairness algorithms, not just token
+            from gubernator_tpu.core.algorithms import ALGO_NAMES
+
+            arm_rows = []
+            for arm in ("sliding", "gcra"):
+                r = await _measure_window(
+                    b_inst, b_be, pool100, depth, args.seconds, group,
+                    f"zipf100m_sketch_{arm}", 1000, DUR,
+                    algo_id=ALGO_NAMES[arm],
+                )
+                r["algorithm"] = arm
+                arm_rows.append(r)
+                print(
+                    f"arm {arm}: "
+                    f"{r['decisions_per_sec']:>11,.0f} dec/s "
+                    f"(dropped->sketch {r['dropped_creates']})",
+                    file=sys.stderr,
+                )
+
             return (
                 agg(a_rows, "zipf10m_exact_baseline", a_warm),
                 agg(b_rows, "zipf100m_sketch_tier", b_warm),
                 pairs,
+                arm_rows,
             )
         finally:
             await b_inst.stop()
             await a_inst.stop()
 
-    row_a, row_b, pairs = asyncio.run(run_paired())
-    rows = [row_a, row_b]
+    row_a, row_b, pairs, arm_rows = asyncio.run(run_paired())
+    rows = [row_a, row_b] + arm_rows
     paired_ratio = statistics.median(pairs)
     for r in rows:
         print(
@@ -817,14 +893,29 @@ def run_zipf100m(args) -> int:
             f"evictions {r['evictions']})",
             file=sys.stderr,
         )
-    print("measuring tail error (pinned stream)...", file=sys.stderr)
-    err = measure_tail_error()
     print(
-        f"tail error: max over {err['max_overestimate']} "
-        f"(bound {err['documented_bound']}), under-counts "
+        "measuring tail error (pinned stream, r13-vs-v2 A/B)...",
+        file=sys.stderr,
+    )
+    err_ab = measure_tail_error_ab()
+    err = err_ab["v2"]
+    print(
+        f"tail error v2: max over {err['max_overestimate']} "
+        f"(v2 bound {err['documented_bound']}, r13 bound "
+        f"{err_ab['r13']['documented_bound']}), under-counts "
         f"{err['under_counts']}",
         file=sys.stderr,
     )
+    err_arms = {}
+    for arm in ("sliding", "gcra"):
+        e = measure_tail_error(algorithm=arm)
+        err_arms[arm] = e
+        print(
+            f"tail error {arm}: max over {e['max_overestimate']} "
+            f"(bound {e['documented_bound']}), under-counts "
+            f"{e['under_counts']}",
+            file=sys.stderr,
+        )
 
     import jax as _jax
 
@@ -856,14 +947,29 @@ def run_zipf100m(args) -> int:
         },
         rows=rows,
         tail_error=err,
+        tail_error_derivation_ab=err_ab,
+        tail_error_arms=err_arms,
         sketch_over_exact_baseline=round(paired_ratio, 4),
         acceptance=dict(
             target="zipf100m at the fixed total budget sustains >= the "
             "zipf10m exact-only baseline, tail error within bound, "
-            "zero under-counts",
+            "zero under-counts; r21: v2 max overestimate strictly "
+            "below the r13 bound at the same budget, sliding+GCRA "
+            "arms sketch-served at 100M-key cardinality",
             throughput_met=bool(paired_ratio >= 1.0),
             error_met=bool(
                 err["within_bound"] and err["under_counts"] == 0
+            ),
+            derivation_met=bool(
+                err_ab["v2_max_below_r13_bound"]
+                and err_ab["zero_under_counts"]
+            ),
+            arms_met=bool(
+                all(
+                    e["within_bound"] and e["under_counts"] == 0
+                    for e in err_arms.values()
+                )
+                and all(r["dropped_creates"] > 0 for r in arm_rows)
             ),
         ),
         acceptance_note=(
@@ -1485,8 +1591,9 @@ def main(argv=None) -> int:
         "config (deep-batch ladder, GUBER_STORE_MIB-sized store); "
         "zipf100m = the r13 two-tier flagship: 100M-key zipf at the "
         "SAME fixed budget (sketch carve-out) vs the exact-only 10M "
-        "baseline, plus the measured tail-error phase "
-        "(BENCH_SKETCH_r13.json); key-churn = adversarial fresh-keys-"
+        "baseline, plus the measured tail-error phase with the r21 "
+        "derivation A/B and sliding/gcra window-ring arms "
+        "(BENCH_SKETCH_r21.json); key-churn = adversarial fresh-keys-"
         "every-pass stream (tier thrash worst case, ROADMAP item 4); "
         "shed = over-limit-heavy skew ladder through the shipped boot "
         "path (the r10 shed cache's workload; GUBER_SHED_CACHE "

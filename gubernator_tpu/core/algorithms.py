@@ -54,21 +54,47 @@ that behavior is kept verbatim; a sliding or GCRA request recreates as
 a fresh window of ITS OWN algorithm (the reference has no rule here,
 and "the algorithm you asked for" is the only defensible extension).
 
-Serving-tier eligibility (the r15 interplay audit):
+Serving-tier eligibility (r15 interplay audit, re-drawn by the r21
+sketch tier v2):
 
 - shed cache (serve/shedcache.py): token only, as before. Sliding and
   GCRA verdicts change every millisecond (the blend weight decays; TAT
   drains), so a cached refusal is never provably current — the same
   reason leaky was excluded on day one. SHEDDABLE_ALGOS is the gate.
-- sketch cold tier (core/sketches.py, kernels sketch branch): token
-  and leaky only. The sketch serves dropped creates with FIXED-WINDOW
-  token math over a window-keyed estimate; for sliding that math can
-  UNDER-count at window boundaries (the previous-window weight is
-  invisible to the sketch) and for GCRA the TAT has no window at all —
-  both would break the tier's one-sided fail-closed contract, so their
-  dropped creates keep the exact-only store's historical behavior
-  (counted in BatchStats.dropped, briefly over-admitting).
-  SKETCH_SERVABLE_ALGOS is the gate.
+- sketch cold tier (core/sketches.py, kernels sketch branch): ALL FOUR
+  algorithms since r21. Token and leaky keep the r13 behavior
+  (fixed-window token math over the current-window estimate). Sliding
+  serves the WINDOW-RING blend: the tier reads two logical
+  sub-sketches — the current epoch window `w = now // d` and the
+  previous one `w - 1`. The ring is positional in HASH space (the
+  window id is mixed into the counter index), so sub-sketches key
+  disjoint counter sets, rotation on window advance is free (the read
+  just moves to the next id) and retired rings decay by going unread
+  until their slots are recycled by other windows' conservative
+  updates. The blend `used = est_cur + floor(est_prev * wrem / d)`
+  over-counts whenever the estimates do (they never under-count:
+  conservative update per (key, window)), so the one-sided fail-closed
+  contract holds across rotation. NOTE the quantization: sketch
+  subwindows are EPOCH-aligned where the exact tier's are per-key
+  anchored — a documented phase difference bounded by one window,
+  strictly tighter than the r13 fixed-window artifact (the prev-ring
+  weight covers the boundary the fixed window forgets). GCRA serves a
+  TAT re-quantized from the same two ring estimates:
+    TAT_q = max(ws - d + tau + T, now) + (est_prev + est_cur) * T
+  an upper bound on any TAT consistent with the charged history: a
+  key's pre-ring TAT is bounded by its last pre-ring charge time
+  (< ws - d) + tau + T (the admission inequality caps how far one
+  charge can push TAT past its own arrival), and each of the
+  est_prev + est_cur charged hits since advances TAT by exactly T.
+  Monotone in the estimates, so conservative-update over-counting
+  only tightens it. SKETCH_SERVABLE_ALGOS is the gate; the kernel's
+  sketch branch and the promoter's HotTracker observe through it.
+- sketch PROMOTION stays token-only (PROMOTABLE_ALGOS below):
+  promotion installs an exact fixed-window entry (install_windows,
+  the token layout); a sliding/GCRA promotion would have to fabricate
+  per-key anchored state from epoch-quantized estimates. The ring IS
+  those algorithms' long-term home at sketch-tier cardinality — hot
+  keys lose nothing (served fail-closed at O(rows) gathers).
 - GLOBAL replica serving and bucket replication stay token-scoped
   (unchanged): sliding/GCRA GLOBAL misses process locally exactly like
   leaky always has, and snapshot_read skips every non-token entry.
@@ -139,11 +165,11 @@ ALGORITHMS: Dict[int, AlgoSpec] = {
         "budget + last-leak timestamp (continuous refill)",
     ),
     ALGO_SLIDING: AlgoSpec(
-        ALGO_SLIDING, "sliding", FLAG_ALGO_SLIDING, False, False,
+        ALGO_SLIDING, "sliding", FLAG_ALGO_SLIDING, False, True,
         "current + previous subwindow counts, per-key anchored",
     ),
     ALGO_GCRA: AlgoSpec(
-        ALGO_GCRA, "gcra", FLAG_ALGO_GCRA, False, False,
+        ALGO_GCRA, "gcra", FLAG_ALGO_GCRA, False, True,
         "one theoretical-arrival-time (int64 math, int32 lane)",
     ),
 }
@@ -161,6 +187,13 @@ SHEDDABLE_ALGOS = frozenset(
 SKETCH_SERVABLE_ALGOS = frozenset(
     a for a, s in ALGORITHMS.items() if s.sketch_servable
 )
+
+#: sketch-PROMOTION gate (serve/promoter.py): algorithms whose hot
+#: sketch-tier keys may be promoted into an exact store entry.
+#: install_windows fabricates the token fixed-window layout, so only
+#: token keys promote; sliding/GCRA keys are SERVED by the ring
+#: (SKETCH_SERVABLE_ALGOS) but stay there — see the module docstring.
+PROMOTABLE_ALGOS = frozenset({ALGO_TOKEN})
 
 
 def sheddable(algo: int) -> bool:
@@ -219,6 +252,49 @@ def sliding_used(
     d = sliding_dur(duration)
     wrem = d - (now - ws)
     return cur + (prev * wrem) // d
+
+
+def sketch_window(now: int, duration: int) -> Tuple[int, int]:
+    """(window id, window end) of the sketch tier's epoch-aligned grid
+    at engine-ms `now` — the grid EVERY sketch-servable algorithm keys
+    its ring estimates on (kernels sketch branch twin)."""
+    d = max(duration, 1)
+    wid = now // d
+    return wid, (wid + 1) * d
+
+
+def sketch_sliding_budget(
+    est_cur: int, est_prev: int, now: int, limit: int, duration: int
+) -> Tuple[int, int]:
+    """(budget, reset) of a sketch-served SLIDING decision: the
+    window-ring blend over the current and previous epoch-window
+    estimates. Host twin of the kernel's sk_sld branch — estimates are
+    clamped to the limit first (a key's own charges per window never
+    exceed its limit, so the clamp preserves `est >= true`), and the
+    blend floor matches sliding_used's rounding."""
+    d = max(duration, 1)
+    wid = now // d
+    wend = (wid + 1) * d
+    lim = max(limit, 0)
+    used = min(est_cur, lim) + (min(est_prev, lim) * (wend - now)) // d
+    return max(min(limit - used, lim), 0), wend
+
+
+def sketch_gcra_budget(
+    est_cur: int, est_prev: int, now: int, limit: int, duration: int
+) -> Tuple[int, int]:
+    """(budget, TAT_q) of a sketch-served GCRA decision: the
+    theoretical arrival time re-quantized from the two ring estimates
+    (see the module docstring for the one-sidedness argument). Host
+    twin of the kernel's sk_gcra branch."""
+    T, tau = gcra_params(limit, duration)
+    d = max(duration, 1)
+    ws = (now // d) * d
+    lim = max(limit, 0)
+    tatq = max(ws - d + tau + T, now) + (
+        min(est_cur, lim) + min(est_prev, lim)
+    ) * T
+    return max(min((now + tau - tatq) // T, lim), 0), tatq
 
 
 def stored_algo_np(flags: np.ndarray) -> np.ndarray:

@@ -174,19 +174,24 @@ class BatchGroups(NamedTuple):
 
 
 class Sketch(NamedTuple):
-    """Count-min cold-tier state (r13, core/sketches.SketchConfig):
-    dense int64[rows, width] counters — a one-leaf pytree like Store so
-    the whole sketch donates cleanly through the jitted decide."""
+    """Count-min cold-tier state (r13/r21, core/sketches.SketchConfig):
+    dense [rows, width] counters — int64 under the r13 derivation,
+    saturating int32 under the v2 derivation (core/sketches.py
+    documents why saturation is fail-closed). A one-leaf pytree like
+    Store so the whole sketch donates cleanly through the jitted
+    decide."""
 
-    data: jax.Array  # int64[rows, width]
+    data: jax.Array  # int32 or int64 [rows, width]
 
 
 def _sketch_lookup(sketch: Sketch, kh: jax.Array, wid: jax.Array):
     """Per-group (min-estimate int64[G], per-row index list int32[G])
-    for window-keyed key hashes. MUST stay bit-identical to the host
-    twin core/sketches.sketch_indices_np (test-pinned): the promoter
-    and the error-bound tests read estimates host-side for windows this
-    kernel charged."""
+    for window-keyed key hashes. The estimate is widened to int64
+    regardless of the counter dtype so downstream budget math is
+    uniform. MUST stay bit-identical to the host twin
+    core/sketches.sketch_indices_np (test-pinned): the promoter and the
+    error-bound tests read estimates host-side for windows this kernel
+    charged."""
     from gubernator_tpu.core.sketches import SKETCH_SALTS, WINDOW_MIX
 
     rows, width = sketch.data.shape
@@ -197,7 +202,8 @@ def _sketch_lookup(sketch: Sketch, kh: jax.Array, wid: jax.Array):
         hr = mix64(base ^ jnp.uint64(SKETCH_SALTS[r]))
         idx = (hr & jnp.uint64(width - 1)).astype(jnp.int32)
         idxs.append(idx)
-        c = jnp.take(sketch.data[r], idx)  # narrow unsorted gather [G]
+        # narrow unsorted gather [G]
+        c = jnp.take(sketch.data[r], idx).astype(jnp.int64)
         est = c if est is None else jnp.minimum(est, c)
     return est, idxs
 
@@ -853,19 +859,13 @@ def _decide_presorted(
         victim_live = (v_sel[:, L_TAG] != 0) & (
             v_sel[:, L_EXPIRE] >= now
         )
-        # sketch-servable gate (r15, core/algorithms.py): only token
-        # and leaky creates may be diverted to the count-min tier —
-        # the sketch decides with FIXED-WINDOW token math, which
-        # under-counts a sliding window's previous-window weight and
-        # has no analogue of a GCRA TAT, so serving those there would
-        # break the tier's one-sided fail-closed contract. Their
-        # dropped creates keep the exact-only store's historical
-        # behavior (BatchStats.dropped, brief over-admission), and
-        # live-victim protection does not engage for them (an
-        # unservable create diverted to nowhere would be over-
-        # admission with an evicted victim spared — strictly worse).
-        sk_able = eff_algo <= 1
-        sk_extra = evicted_G & victim_live & sk_able
+        # sketch-servable gate (r21, core/algorithms.py): ALL FOUR
+        # algorithms divert to the count-min tier. Token/leaky ride
+        # the r13 fixed-window math; sliding rides the r21 window-ring
+        # blend and GCRA its TAT-quantized variant (both below) — the
+        # import-time registry pin in core/sketches.py asserts
+        # SKETCH_SERVABLE_ALGOS matches this kernel; widen together.
+        sk_extra = evicted_G & victim_live
         dropped_G = dropped_G | sk_extra
         evicted_G = evicted_G & ~sk_extra
 
@@ -890,9 +890,13 @@ def _decide_presorted(
         v_dur_pos = jnp.maximum(v_sel[:, L_DURATION], 1)
         v_wid = now // v_dur_pos
         v_overlap = v_sel[:, L_EXPIRE] > v_wid * v_dur_pos
-        # token victims only: leaky has no fixed window to fold into,
-        # and sliding/GCRA lanes don't hold a (limit - remaining)
-        # consumed count (r15: the mask covers all three)
+        # token victims only: leaky has no fixed window to fold into;
+        # a dead SLIDING victim's current subwindow ended >= d before
+        # now's epoch window began (expire = ws + 2d < now implies
+        # ws + d <= now's window start), so its counts are entirely
+        # pre-ring and nothing is foldable; a dead GCRA victim
+        # (TAT < now) is by definition fully drained. r21 keeps the
+        # fold token-only — it loses nothing for the other three.
         v_token = (v_sel[:, L_FLAGS] & FLAG_ALGO_MASK) == 0
         v_sticky = (v_sel[:, L_FLAGS] & FLAG_STICKY_OVER) != 0
         v_consumed = jnp.clip(
@@ -920,36 +924,89 @@ def _decide_presorted(
         writer_G = writer_G & ~sk_extra
 
         # Sketch-served groups = valid creates the exact tier refused
-        # (way exhaustion, or a live victim under protection). Their
-        # decision is FIXED-WINDOW token math over the window-keyed
-        # count-min estimate (core/sketches.py): budget at batch start
-        # = max(limit - estimate, 0), reset = the window's end, no
-        # sticky state, and leaky requests ride the same fixed window
-        # (a documented tail-only divergence — the sketch has no
-        # per-key timestamp to leak from). Estimates only over-count
-        # (conservative update + hash collisions), so refusal comes
-        # at-or-before the true budget: fail-closed. Sliding/GCRA
-        # drops are NOT sketch-served (sk_able above): they keep the
-        # exact-only contract.
-        sk_g = dropped_G & sk_able
+        # (way exhaustion, or a live victim under protection).
+        # Token/leaky decide with r13 FIXED-WINDOW token math over the
+        # window-keyed count-min estimate: budget at batch start =
+        # max(limit - estimate, 0), reset = the window's end, no
+        # sticky state (leaky's fixed-window ride is the documented
+        # tail-only divergence — the sketch has no per-key timestamp
+        # to leak from). r21 lifts sliding and GCRA in via the
+        # WINDOW-RING: the same window-keyed indexing IS a logical
+        # ring of per-epoch-window sub-sketches (rotation = the window
+        # id advancing), so the previous window's estimate is one more
+        # lookup at wid-1. Sliding blends cur + tail-weighted prev
+        # (always >= the true sliding count); GCRA floors the unknown
+        # TAT at the latest value any admissible pre-ring history
+        # could have left (last pre-ring charge ended before the prev
+        # window: TAT <= ws - d + tau + T), then advances it T per
+        # counted charge. Estimates only over-count (conservative
+        # update + hash collisions + the one-batch fold lag), so every
+        # branch refuses at-or-before its exact oracle: fail-closed.
+        # Host twins (test-pinned): algorithms.sketch_sliding_budget /
+        # algorithms.sketch_gcra_budget.
+        sk_g = dropped_G
+        sk_tok = sk_g & (eff_algo <= 1)
+        sk_sld = sk_g & (eff_algo == 2)
+        sk_gcra = sk_g & (eff_algo == 3)
         dur_pos = jnp.maximum(g_durQ, 1)
         wid = now // dur_pos  # int32: engine now >= 0
         window_end = (wid + 1) * dur_pos  # <= now + dur <= INT32_MAX
         sk_est, sk_idx = _sketch_lookup(sketch, kh_G, wid)
+        sk_prev, _ = _sketch_lookup(sketch, kh_G, wid - 1)
         est32 = jnp.minimum(sk_est, jnp.int64(_I32_MAX)).astype(
             jnp.int32
         )
-        # clamp the estimate into [0, max(limit, 0)] before the
-        # subtraction so R0 stays in int32 for any limit
-        est_c = jnp.minimum(est32, jnp.maximum(g_limQ, 0))
-        # sketch groups ride the "existing token window" machinery: no
-        # creation-leader special case, uniform cumulative charging
+        # clamp estimates into [0, max(limit, 0)] before subtractions
+        # so budgets stay in int32 for any limit. Clamping is
+        # one-sided-safe in every branch: a key's own counted charges
+        # per epoch window never exceed its limit (admission stops at
+        # the limit), so min(est, limit) >= the key's true count.
+        lim_pos = jnp.maximum(g_limQ, 0)
+        est_c = jnp.minimum(est32, lim_pos)
+        lim64 = lim_pos.astype(jnp.int64)
+        cur_c = jnp.minimum(sk_est, lim64)
+        prev_c = jnp.minimum(sk_prev, lim64)
+        # sliding window-ring blend: the previous epoch window's count
+        # weighted by the fraction of it still inside the sliding
+        # window — int64, the count*ms product overflows int32
+        d64 = dur_pos.astype(jnp.int64)
+        wend64 = window_end.astype(jnp.int64)
+        sld_used_sk = cur_c + (prev_c * (wend64 - now64)) // d64
+        R0_sk_sld = jnp.clip(
+            g_limQ.astype(jnp.int64) - sld_used_sk, 0, lim64
+        ).astype(jnp.int32)
+        # GCRA TAT-quantized reconstruction. gcra_T/gcra_tau above
+        # were derived from the REQUEST params (dropped creates are
+        # non-existing), matching gcra_params in the host twin.
+        ws64 = wend64 - d64  # current epoch window start
+        tatq = jnp.maximum(ws64 - d64 + gcra_tau + gcra_T, now64) + (
+            cur_c + prev_c
+        ) * gcra_T
+        R0_sk_gcra = jnp.clip(
+            (now64 + gcra_tau - tatq) // gcra_T, 0, lim64
+        ).astype(jnp.int32)
+        # sketch groups ride the "existing window" machinery: no
+        # creation-leader special case, uniform cumulative charging.
+        # Token/leaky collapse to algo 0 (the r13 contract);
+        # sliding/GCRA KEEP their algo so their rows take the sg
+        # response path below with the ring budget as R0.
         existing = existing | sk_g
         eff_leaky = eff_leaky & ~sk_g
-        eff_algo = jnp.where(sk_g, 0, eff_algo)
+        eff_algo = jnp.where(sk_tok, 0, eff_algo)
         R0 = jnp.where(sk_g, jnp.maximum(g_limQ - est_c, 0), R0)
+        R0 = jnp.where(sk_sld, R0_sk_sld, R0)
+        R0 = jnp.where(sk_gcra, R0_sk_gcra, R0)
         sticky0 = sticky0 & ~sk_g
-        g_exp = jnp.where(sk_g, window_end, g_exp)  # response reset
+        g_exp = jnp.where(sk_g, window_end, g_exp)  # token reset
+        # sliding reset: the EPOCH window's end, not now + dur — the
+        # read grid must be the grid the charges land on; GCRA reset:
+        # the quantized TAT, saturated into the int32 bridge lane
+        # (the [G]-level budget above used the unclamped value, so
+        # saturation only affects the reported reset)
+        sld_reset_G = jnp.where(sk_sld, window_end, sld_reset_G)
+        gcra_tat0 = jnp.where(
+            sk_gcra, jnp.minimum(tatq, jnp.int64(_I32_MAX)), gcra_tat0
+        )
         g_limS = jnp.where(sk_g, g_limQ, g_limS)  # params echo the
         g_durS = jnp.where(sk_g, g_durQ, g_durS)  # request's
 
@@ -1095,12 +1152,20 @@ def _decide_presorted(
         # discipline), so cross-key collision inflation is never
         # compounded. Non-sketch and padding groups write 0, a no-op
         # against non-negative counters. One narrow scatter-max per row.
+        # Writes saturate at the counter dtype's max (v2 int32): a
+        # key's OWN update chain never saturates — charged <= budget
+        # <= limit - min(est, limit), so est + charged <= limit <=
+        # I32_MAX whenever charged > 0 — and a fold that saturates
+        # pins the counter at max, which only ever REFUSES (fail-
+        # closed, never an under-count of a served key).
         upd = jnp.where(
             sk_g, sk_est + total_charged.astype(jnp.int64), jnp.int64(0)
         )
         data_sk = sketch.data
+        cmax = jnp.int64(jnp.iinfo(data_sk.dtype).max)
+        upd_w = jnp.minimum(upd, cmax).astype(data_sk.dtype)
         for r in range(len(sk_idx)):
-            data_sk = data_sk.at[r, sk_idx[r]].max(upd)
+            data_sk = data_sk.at[r, sk_idx[r]].max(upd_w)
         # eviction->sketch migration (computed above with the victim
         # plan): fold recycled dead victims' consumed counts into
         # their keys' current windows — scatter-max like the request
@@ -1108,8 +1173,9 @@ def _decide_presorted(
         # both folded and sketch-decided in this same batch reads its
         # estimate from before the fold (one-batch lag, conservative
         # thereafter).
+        v_upd_w = jnp.minimum(v_upd, cmax).astype(data_sk.dtype)
         for r in range(len(v_idx)):
-            data_sk = data_sk.at[r, v_idx[r]].max(v_upd)
+            data_sk = data_sk.at[r, v_idx[r]].max(v_upd_w)
         new_sketch = Sketch(data=data_sk)
 
     # ---- responses --------------------------------------------------------

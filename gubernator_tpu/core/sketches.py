@@ -48,10 +48,33 @@ Design choices, mapped to PAPERS.md:
   was charged with (collisions only inflate), so refusal comes at-or-
   before the true budget — fail-closed, matching the shed cache's
   stance.
-- **int64 counters**: the store is int32 (TPU-native), but sketch
-  counters take collision inflation from the whole tail; int64 makes
-  overflow structurally impossible for the cost of one narrow gather +
-  scatter per row — noise next to the store's full-table writeback.
+- **Counter width** (re-derived in r21, the "v2" derivation): the r13
+  tier spent its byte budget on 4 rows of int64 counters. The
+  additive-error counter argument (arXiv 2004.10332) says that is the
+  wrong corner of the budget: the count-min overestimate is ADDITIVE —
+  bounded by e*N/width with failure probability e^-rows — so at a
+  fixed byte budget B = rows * width * counter_bytes, width buys error
+  LINEARLY while rows only sharpens the (already one-sided) tail
+  exponent. The serve-side clamp makes deep rows redundant outright:
+  an estimate is always clamped to the request limit before deciding
+  (est >= limit simply refuses), so counters past int32 range carry no
+  information — the v2 derivation uses SATURATING int32 counters
+  (update math stays int64, the write clamps at 2^31-1; saturation can
+  only occur >= 2^31 true charges, where the clamp refuses regardless,
+  so the one-sided contract survives) and 2 rows, buying 4x the width
+  of the r13 derivation at the same budget: a 4x tighter error bound
+  AND half the gathers/scatters per decision (the Count-Less lesson,
+  arXiv 2111.02759: fewer, wider rows under conservative update beat
+  deeper stacks per byte and per update). `derive_sketch_config` keeps
+  the r13 derivation reachable (`derivation="r13"`) for the committed
+  paired A/B (scripts/perf_gate.py sketch2_r21, BENCH_SKETCH_r21).
+
+The window-ring (r21): sliding-window and GCRA serve from the SAME
+counter array — the ring is positional in hash space (the window id is
+mixed into the index), so "rotate on window advance" means reading ids
+`w` and `w-1` instead of `w` alone; see core/algorithms.py
+sketch_sliding_budget / sketch_gcra_budget for the blend math and the
+one-sidedness argument.
 
 `sketch_indices_np` is the host twin of the device indexing in
 core/kernels.py; the two MUST stay bit-identical (pinned by
@@ -68,24 +91,29 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from gubernator_tpu.core.algorithms import (
+    ALGO_GCRA,
     ALGO_LEAKY,
+    ALGO_SLIDING,
     ALGO_TOKEN,
     SKETCH_SERVABLE_ALGOS,
 )
 
-# r15 interplay audit: the sketch serves dropped creates with
-# FIXED-WINDOW token math over a window-keyed estimate. That math is a
-# documented tail-only approximation for token AND leaky (r13), but it
-# would UNDER-count a sliding window at boundaries (the previous
-# window's weight is invisible to a window-keyed counter) and a GCRA
-# TAT has no window at all — both would break the tier's one-sided
-# fail-closed contract. The kernel's serve gate (core/kernels.py
-# sk_able = eff_algo <= 1) hardcodes the same pair; this pin fails the
-# import, not production, if the registry and the kernel drift.
-assert SKETCH_SERVABLE_ALGOS == {ALGO_TOKEN, ALGO_LEAKY}, (
-    "the sketch tier's fixed-window math only covers token/leaky; "
-    "update core/kernels.py sk_able and this pin together with "
-    "core/algorithms.py SKETCH_SERVABLE_ALGOS"
+# r21 interplay audit (supersedes the r15 pin): the sketch tier serves
+# ALL FOUR algorithms — token/leaky with r13 fixed-window math, sliding
+# with the window-ring blend, GCRA with the re-quantized TAT (the
+# kernel's sk_sld/sk_gcra branches; host twins in core/algorithms.py
+# sketch_sliding_budget/sketch_gcra_budget). The kernel's serve gate
+# covers the full id range {0..3}; if the registry ever grows an
+# algorithm the kernel does not serve (or drops one it still serves),
+# this pin fails the IMPORT, not production. Callers that still assume
+# the r15 pair {token, leaky} must be updated together with this pin —
+# grep for SKETCH_SERVABLE_ALGOS.
+assert SKETCH_SERVABLE_ALGOS == {
+    ALGO_TOKEN, ALGO_LEAKY, ALGO_SLIDING, ALGO_GCRA,
+}, (
+    "the r21 sketch tier serves exactly {token, leaky, sliding, gcra}; "
+    "update the core/kernels.py sketch branch and this pin together "
+    "with core/algorithms.py SKETCH_SERVABLE_ALGOS"
 )
 
 _ALPHA_INF = 0.721347520444482  # 1 / (2 ln 2)
@@ -109,18 +137,31 @@ SKETCH_SALTS = (
 #: consecutive windows so a hot key's collision set rotates per window
 WINDOW_MIX = 0xD6E8FEB86659FD93
 
-SKETCH_BYTES_PER_COUNTER = 8  # dense int64 rows
+SKETCH_BYTES_PER_COUNTER = 8  # r13 dense int64 rows (the default dtype)
+
+#: derivation -> (default rows, counter bytes). "v2" (r21) is the
+#: default: 2 rows of saturating int32 counters — 4x the width of the
+#: r13 derivation (4 rows x int64) at the same byte budget, so a 4x
+#: tighter additive error bound and half the per-decision gathers.
+#: "r13" stays reachable for the committed paired A/B.
+SKETCH_DERIVATIONS = {
+    "v2": (2, 4),
+    "r13": (4, SKETCH_BYTES_PER_COUNTER),
+}
 
 
 @dataclass(frozen=True)
 class SketchConfig:
     """Count-min tier geometry: `rows` independent hash rows of `width`
-    int64 counters each. Error bound (classic CM, conservative update
-    only tightens it): with N charged sketch-tier hits in a window,
+    counters each, `counter_bytes` wide (8 = int64, the r13 default for
+    direct constructions; 4 = saturating int32, the v2 derivation).
+    Error bound (classic CM additive bound; conservative update only
+    tightens it): with N charged sketch-tier hits in a window,
     P[estimate - true > e*N/width] < e^-rows."""
 
     rows: int = 4
-    width: int = 1 << 19  # 16 MiB at rows=4
+    width: int = 1 << 19  # 16 MiB at rows=4 x int64
+    counter_bytes: int = SKETCH_BYTES_PER_COUNTER
 
     def __post_init__(self):
         assert 1 <= self.rows <= len(SKETCH_SALTS), (
@@ -129,24 +170,39 @@ class SketchConfig:
         assert self.width > 0 and (self.width & (self.width - 1)) == 0, (
             "sketch width must be a power of two"
         )
+        assert self.counter_bytes in (4, 8), (
+            "sketch counters are int32 (4) or int64 (8)"
+        )
 
 
 def sketch_footprint_bytes(config: SketchConfig) -> int:
-    return config.rows * config.width * SKETCH_BYTES_PER_COUNTER
+    return config.rows * config.width * config.counter_bytes
 
 
-def derive_sketch_config(mib: int, rows: int = 4) -> SketchConfig:
-    """Largest power-of-two width whose rows x width x 8B footprint fits
-    in `mib` MiB — the sketch sibling of store.derive_store_config."""
+def derive_sketch_config(
+    mib: int, rows: int = 0, derivation: str = "v2"
+) -> SketchConfig:
+    """Largest power-of-two width whose rows x width x counter_bytes
+    footprint fits in `mib` MiB — the sketch sibling of
+    store.derive_store_config. `rows=0` takes the derivation's default
+    (v2: 2, r13: 4); an explicit row count keeps the derivation's
+    counter dtype."""
+    if derivation not in SKETCH_DERIVATIONS:
+        raise ValueError(
+            f"unknown sketch derivation {derivation!r}; "
+            f"one of {sorted(SKETCH_DERIVATIONS)}"
+        )
     if mib <= 0:
         raise ValueError("sketch budget must be positive MiB")
-    counters = (mib << 20) // (rows * SKETCH_BYTES_PER_COUNTER)
+    default_rows, cbytes = SKETCH_DERIVATIONS[derivation]
+    rows = rows or default_rows
+    counters = (mib << 20) // (rows * cbytes)
     if counters < 1:
         raise ValueError(
             f"sketch budget {mib} MiB holds no counters at {rows} rows"
         )
     width = 1 << (counters.bit_length() - 1)
-    return SketchConfig(rows=rows, width=width)
+    return SketchConfig(rows=rows, width=width, counter_bytes=cbytes)
 
 
 def new_sketch(config: SketchConfig):
@@ -157,7 +213,8 @@ def new_sketch(config: SketchConfig):
 
     from gubernator_tpu.core.kernels import Sketch
 
-    return Sketch(data=jnp.zeros((config.rows, config.width), jnp.int64))
+    dtype = jnp.int32 if config.counter_bytes == 4 else jnp.int64
+    return Sketch(data=jnp.zeros((config.rows, config.width), dtype))
 
 
 def window_id_np(engine_now: int, durations: np.ndarray) -> np.ndarray:

@@ -350,10 +350,15 @@ class MultiHostMeshEngine:
             "sub_buckets": tuple(self.inner.sub_buckets),
             "store": (self.inner.config.rows, self.inner.config.slots),
             "n_shards": self.inner.n,
-            # sketch geometry (r20): a leader with the cold tier on and
-            # a follower without it (or with a different width) would
-            # diverge at the first two-tier dispatch — verify at hello
-            "sketch": (skc.rows, skc.width) if skc is not None else None,
+            # sketch geometry (r20; counter width since r21): a leader
+            # with the cold tier on and a follower without it (or with
+            # a different width or counter dtype) would diverge at the
+            # first two-tier dispatch — verify at hello
+            "sketch": (
+                (skc.rows, skc.width, skc.counter_bytes)
+                if skc is not None
+                else None
+            ),
         }
 
     @property

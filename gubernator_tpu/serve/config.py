@@ -299,21 +299,23 @@ class ServerConfig:
     # at boot like the store sizing pass).
     shed_cache: bool = True
     shed_cache_keys: int = 1 << 16
-    # Sketch cold tier (r13, core/sketches.py + serve/promoter.py;
+    # Sketch cold tier (r13/r21, core/sketches.py + serve/promoter.py;
     # GUBER_SKETCH, default ON): a window-keyed count-min sketch of
-    # dense int64 device rows absorbs every create the exact slot store
-    # DROPS to way exhaustion — the silent-over-admission case of the
-    # exact-only store becomes a fail-closed fixed-window decision with
-    # a one-sided (overestimate-only) error bound, which is what lets a
+    # dense device counter rows absorbs every create the exact slot
+    # store DROPS to way exhaustion — the silent-over-admission case of
+    # the exact-only store becomes a fail-closed decision with a
+    # one-sided (overestimate-only) error bound, which is what lets a
     # fixed 1 GiB footprint serve ~100M-key cardinality (zipf100m
-    # bench, BENCH_SKETCH_r13.json). A streaming SpaceSaving promoter
-    # migrates hot sketch keys into exact buckets every
-    # GUBER_SKETCH_SYNC_WAIT_MS and feeds over-limit candidates to the
-    # r10 shed cache. All device backends since r20: tpu, mesh (r14,
-    # sub-sketches shard over the mesh axis) and multihost (promotion +
-    # estimate reads are lockstep collectives). With no exact-tier
-    # pressure (no dropped creates), ON is byte-identical to OFF
-    # (tests/test_sketch_tier.py).
+    # bench, BENCH_SKETCH_r21.json). Since r21 ALL FOUR algorithms are
+    # sketch-servable: token/leaky on fixed-window math, sliding on the
+    # window-ring blend, GCRA on its TAT-quantized variant. A streaming
+    # SpaceSaving promoter migrates hot sketch keys into exact buckets
+    # every GUBER_SKETCH_SYNC_WAIT_MS and feeds over-limit candidates
+    # to the r10 shed cache. All device backends since r20: tpu, mesh
+    # (r14, sub-sketches shard over the mesh axis) and multihost
+    # (promotion + estimate reads are lockstep collectives). With no
+    # exact-tier pressure (no dropped creates), ON is byte-identical to
+    # OFF (tests/test_sketch_tier.py).
     sketch: bool = True
     # Sketch footprint budget in MiB. 0 = auto: a quarter of
     # GUBER_STORE_MIB (capped at 256) when the store budget is pinned —
@@ -323,7 +325,15 @@ class ServerConfig:
     sketch_mib: int = 0
     # Count-min rows (independent hash rows; error confidence
     # ~1 - e^-rows at overestimate bound e*N/width per window).
-    sketch_rows: int = 4
+    # 0 = the derivation's default (v2: 2, r13: 4) — see
+    # core/sketches.SKETCH_DERIVATIONS for why v2 spends bytes on
+    # width instead of rows.
+    sketch_rows: int = 0
+    # Counter derivation: "v2" (r21 default — saturating int32
+    # counters, 2 rows, 4x the width and 4x tighter additive error at
+    # the same budget) or "r13" (int64 counters, 4 rows — the
+    # committed r13 geometry, kept for A/B and rollback).
+    sketch_derivation: str = "v2"
     # Promoter flush tick: candidate scan + promotion install cadence.
     sketch_sync_wait: float = 0.2  # GUBER_SKETCH_SYNC_WAIT_MS
     # Top-K candidates screened per tick (SpaceSaving tracks 4x this).
@@ -506,7 +516,11 @@ class ServerConfig:
                     return None  # no room: exact-only, like pre-r13
             else:
                 mib = 16
-        return derive_sketch_config(mib=mib, rows=self.sketch_rows)
+        return derive_sketch_config(
+            mib=mib,
+            rows=self.sketch_rows,
+            derivation=self.sketch_derivation,
+        )
 
     def store_config(self, logger=None):
         """Resolve the final slot-store geometry (core.store.StoreConfig)
@@ -638,8 +652,15 @@ class ServerConfig:
             raise ValueError("GUBER_CHAIN_MAX_DEPTH must be >= 0")
         if self.sketch_mib < 0:
             raise ValueError("GUBER_SKETCH_MIB must be >= 0")
-        if not (1 <= self.sketch_rows <= 8):
-            raise ValueError("GUBER_SKETCH_ROWS must be in 1..8")
+        if not (0 <= self.sketch_rows <= 8):
+            raise ValueError(
+                "GUBER_SKETCH_ROWS must be in 0..8 (0 = derivation "
+                "default)"
+            )
+        if self.sketch_derivation not in ("v2", "r13"):
+            raise ValueError(
+                "GUBER_SKETCH_DERIVATION must be 'v2' or 'r13'"
+            )
         if self.sketch_sync_wait < 0:
             raise ValueError("GUBER_SKETCH_SYNC_WAIT_MS must be >= 0")
         if self.sketch_topk < 1:
@@ -862,7 +883,8 @@ def config_from_env(env: Optional[dict] = None) -> ServerConfig:
         sketch=_get(env, "GUBER_SKETCH", "1").lower()
         not in ("0", "false", "no", "off"),
         sketch_mib=_get_int(env, "GUBER_SKETCH_MIB", 0),
-        sketch_rows=_get_int(env, "GUBER_SKETCH_ROWS", 4),
+        sketch_rows=_get_int(env, "GUBER_SKETCH_ROWS", 0),
+        sketch_derivation=_get(env, "GUBER_SKETCH_DERIVATION", "v2"),
         sketch_sync_wait=_get_float_ms(
             env, "GUBER_SKETCH_SYNC_WAIT_MS", 0.2
         ),

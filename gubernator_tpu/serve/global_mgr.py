@@ -328,10 +328,43 @@ class GlobalManager:
             if updates:
                 await self._update_peers(updates)
 
+    @staticmethod
+    def _update_bytes(updates) -> int:
+        """Approximate wire payload of an update chunk (key UTF-8 bytes
+        plus ~48B of status fields per entry) — same cheap accounting
+        stance as _payload_bytes: the metric's point is the rpc/mesh
+        split, not protobuf framing."""
+        return sum(len(k) + 48 for k, _ in updates)
+
+    async def _install_local(self, updates) -> None:
+        """Mesh-local broadcast chunk (r21): these replicas live in THIS
+        node's mesh (lockstep followers / a co-scheduled server sharing
+        the device store), so ONE local install covers every mesh-local
+        peer — the same replica-install path the gossip door runs on
+        receive (instance.update_peer_globals), without the loop of
+        per-peer RPCs. Errors are logged, not raised, mirroring
+        _apply_local: a failed install must not kill the broadcast
+        loop."""
+        try:
+            install = getattr(
+                self.instance, "update_peer_globals_local", None
+            ) or self.instance.update_peer_globals
+            await install(updates)
+        except Exception as e:
+            log.error("error installing mesh-local global updates: %s", e)
+
     async def _update_peers(self, updates: Dict[str, RateLimitReq]) -> None:
         """Peek authoritative status for each updated key and broadcast to
-        all other peers (global.go:193-232)."""
+        all other peers (global.go:193-232), split per destination like
+        _send_hits (r20 -> r21): peers marked mesh_local receive the
+        whole batch through ONE local mesh install regardless of their
+        count, off-mesh peers keep the bounded-concurrency RPC fan-out.
+        GUBER_GLOBAL_MESH=0 restores the all-RPC broadcast."""
         start = time.monotonic()
+        tracer = getattr(self.instance, "tracer", None)
+        trace = (
+            tracer.begin("global_broadcast") if tracer is not None else None
+        )
         globals_batch = []
         peek_reqs = []
         keys = []
@@ -347,7 +380,32 @@ class GlobalManager:
         except Exception as e:
             log.error("while peeking global statuses: %s", e)
 
+        hops_mesh = 0
+        sends = []
+        rpc_peers = []
+        mesh_peers = 0
         if globals_batch:
+            use_mesh = getattr(self.conf, "global_mesh", True)
+            for peer in self.instance.peer_list():
+                if peer.is_owner:  # never broadcast to ourselves
+                    continue
+                if use_mesh and getattr(peer, "mesh_local", False):
+                    mesh_peers += 1
+                else:
+                    rpc_peers.append(peer)
+            lim = self.conf.global_batch_limit
+            if mesh_peers:
+                # one install per chunk covers EVERY mesh-local peer:
+                # the replicas share this node's device store
+                for i in range(0, len(globals_batch), lim):
+                    hops_mesh += 1
+                    await self._install_local(globals_batch[i : i + lim])
+                try:
+                    GLOBAL_FLUSH_BYTES.labels(path="mesh").inc(
+                        self._update_bytes(globals_batch)
+                    )
+                except Exception:  # pragma: no cover - defensive
+                    pass
             # bounded concurrent fan-out (r9): the broadcast used to
             # await each peer in turn, making gossip propagation — and
             # with it the replicas' staleness window — scale linearly
@@ -370,13 +428,32 @@ class GlobalManager:
                             e,
                         )
 
-            lim = self.conf.global_batch_limit
-            await asyncio.gather(
-                *[
-                    send(peer, globals_batch[i : i + lim])
-                    for peer in self.instance.peer_list()
-                    if not peer.is_owner  # never broadcast to ourselves
-                    for i in range(0, len(globals_batch), lim)
-                ]
+            sends = [
+                send(peer, globals_batch[i : i + lim])
+                for peer in rpc_peers
+                for i in range(0, len(globals_batch), lim)
+            ]
+            if sends:
+                await asyncio.gather(*sends)
+                try:
+                    GLOBAL_FLUSH_BYTES.labels(path="rpc").inc(
+                        self._update_bytes(globals_batch) * len(rpc_peers)
+                    )
+                except Exception:  # pragma: no cover - defensive
+                    pass
+        if trace is not None:
+            # hop-count evidence mirroring global_flush_hits: the whole
+            # mesh-local replica SET costs hops_mesh=1 per chunk, while
+            # the RPC path pays one hop per (peer, chunk)
+            trace.add_span(
+                "global_flush_updates",
+                start=start,
+                hops_rpc=len(sends),
+                hops_mesh=hops_mesh,
+                keys_mesh=len(globals_batch) if hops_mesh else 0,
+                keys_rpc=len(globals_batch) * len(rpc_peers),
+                peers_mesh=mesh_peers,
+                peers_rpc=len(rpc_peers),
             )
+            tracer.finish(trace)
         GLOBAL_BROADCAST_DURATIONS.observe(time.monotonic() - start)

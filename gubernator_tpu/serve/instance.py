@@ -996,6 +996,7 @@ class Instance:
             else:
                 peer = PeerClient(self.conf.behaviors, info.address)
             peer.is_owner = info.is_owner
+            peer.mesh_local = getattr(info, "mesh_local", False)
             try:
                 peer.connect()
             except Exception:

@@ -77,10 +77,15 @@ class PeerClient:
         conf: BehaviorConfig,
         host: str,
         is_owner: bool = False,
+        mesh_local: bool = False,
     ):
         self.conf = conf
         self.host = host
         self.is_owner = is_owner  # true when this peer is this server
+        # true when this peer's replica state rides THIS node's mesh
+        # (PeerInfo.mesh_local): broadcast installs for it short-circuit
+        # to one local mesh install (r21, global_mgr._update_peers)
+        self.mesh_local = mesh_local
         self.channel: Optional[grpc.aio.Channel] = None
         self.stub: Optional[PeersV1Stub] = None
         # queue items are GROUPS: (reqs list, future resolving to the

@@ -8,13 +8,23 @@ not by heat. This module closes that loop, the top-K flow-detection
 design from PAPERS.md ("A streaming algorithm and hardware accelerator
 for top-K flow detection") mapped onto the serving tier:
 
-- **candidate source** — the shipped SpaceSaving top-K summary
-  (core/sketches.py), fed uint64 key hashes (not strings: the hot paths
-  — edge frames, GEB fast framing, the zipf benches — never materialize
-  key strings) from a rate-limited per-dispatch observer hook on the
-  engine's one dispatch funnel, so every door's traffic is seen. The
-  observed payload carries each candidate's last-seen (limit, duration),
-  the params a promotion needs.
+- **candidate source** — a DEVICE-SIDE SpaceSaving-shaped top-K table
+  (DeviceTopK below, r21): the vmapped parallel heap-cascade update
+  from PAPERS.md's top-K flow-detection accelerator replaces the r13
+  host-side dict scan, so candidate selection cost no longer scales
+  with host-side top-K bookkeeping — matched keys aggregate through a
+  vmapped membership probe, unmatched keys segment-aggregate in one
+  sort pass, and the i-th heaviest newcomer challenges the i-th
+  smallest table slot in parallel with SpaceSaving count inheritance.
+  Fed uint64 key hashes (not strings: the hot paths — edge frames, GEB
+  fast framing, the zipf benches — never materialize key strings) from
+  a rate-limited per-dispatch observer hook on the engine's one
+  dispatch funnel, so every door's traffic is seen. The observed
+  payload carries each candidate's last-seen (limit, duration), the
+  params a promotion needs. Eligibility is the PROMOTABLE_ALGOS
+  registry (core/algorithms.py): token only — promotion installs token
+  windows, and a sliding/GCRA key pinned into a token window would
+  change semantics mid-stream.
 - **promotion** — on a flush-tick cadence (GUBER_SKETCH_SYNC_WAIT_MS),
   top candidates not already exact-resident are migrated: the engine
   reads their current-window sketch estimate and installs a token window
@@ -47,6 +57,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from typing import Dict
 
@@ -57,10 +68,13 @@ import numpy as np
 # by patching that attribute, and a module-level from-import would
 # freeze whichever clock was live when this module first loaded
 from gubernator_tpu.api import types as api_types
-from gubernator_tpu.core.sketches import SpaceSaving
+from gubernator_tpu.core.algorithms import PROMOTABLE_ALGOS
 from gubernator_tpu.serve import metrics
 
 log = logging.getLogger("gubernator_tpu.promoter")
+
+#: promotable algorithm ids as an array for the observer's vector mask
+_PROMOTABLE_IDS = np.array(sorted(PROMOTABLE_ALGOS), np.int32)
 
 #: decay the SpaceSaving counts (halving) every this many flush ticks —
 #: the turnover half of demotion; small enough that a churned-away key
@@ -79,16 +93,179 @@ OBSERVE_MIN_INTERVAL_S = 0.1
 OBSERVE_TOP = 128
 
 
-class HotTracker:
-    """Rate-limited SpaceSaving front-end over dispatched batches.
+def _topk_update(kh_t, cnt_t, kh_b, w_b):
+    """One device step of the SpaceSaving-shaped top-K table (r21, the
+    vmapped parallel heap-cascade from the top-K flow-detection
+    accelerator in PAPERS.md). Three parallel stages, no host loop:
 
-    observe() runs on the engine's submit thread (the dispatch funnel);
-    SpaceSaving is lock-protected, and the numpy pre-aggregation is one
-    np.unique over the batch's valid token rows — paid at most every
-    OBSERVE_MIN_INTERVAL_S."""
+    1. matched adds — a vmapped membership probe builds the [B, K]
+       match matrix; each table slot sums its matched batch weights.
+    2. unmatched aggregation — sort the batch by key, run-total each
+       equal-key segment, and keep each segment's total at its LAST
+       position: one weight per distinct new key.
+    3. heap-cascade insert — the i-th heaviest new key challenges the
+       i-th smallest table slot in parallel, inheriting that slot's
+       count (new_cnt = slot_cnt + w, the SpaceSaving overestimate) —
+       the parallel approximation of K sequential min-replacements.
+
+    Padding rows carry weight 0 and never match or insert."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B = kh_b.shape[0]
+    K = kh_t.shape[0]
+    valid = w_b > 0
+    match = jax.vmap(lambda k: (kh_t == k) & (kh_t != jnp.uint64(0)))(
+        kh_b
+    )
+    match = match & valid[:, None]
+    cnt1 = cnt_t + jnp.sum(jnp.where(match, w_b[:, None], 0), axis=0)
+    # unmatched distinct keys via one sort + segment run totals
+    um_w = jnp.where(valid & ~match.any(axis=1), w_b, 0)
+    order = jnp.argsort(kh_b)
+    ks = jnp.take(kh_b, order)
+    ws = jnp.take(um_w, order)
+    pos = jnp.arange(B, dtype=jnp.int32)
+    brk = ks[1:] != ks[:-1]
+    is_ldr = jnp.concatenate([jnp.array([True]), brk])
+    is_last = jnp.concatenate([brk, jnp.array([True])])
+    csum = jnp.cumsum(ws)
+    ldr_at = lax.cummax(jnp.where(is_ldr, pos, 0))
+    seg_total = csum - jnp.take(csum - ws, ldr_at)
+    cand_w = jnp.where(is_last, seg_total, 0)
+    m = min(B, K)
+    top_w, top_i = lax.top_k(cand_w, m)
+    top_keys = jnp.take(ks, top_i)
+    slots = jnp.argsort(cnt1)[:m]  # the m smallest (empties first)
+    old_cnt = jnp.take(cnt1, slots)
+    old_kh = jnp.take(kh_t, slots)
+    do = top_w > 0
+    kh2 = kh_t.at[slots].set(jnp.where(do, top_keys, old_kh))
+    cnt2 = cnt1.at[slots].set(
+        jnp.where(do, old_cnt + top_w, old_cnt)
+    )
+    return kh2, cnt2
+
+
+_TOPK_UPDATE_JIT = None
+
+
+def _topk_update_jit():
+    global _TOPK_UPDATE_JIT
+    if _TOPK_UPDATE_JIT is None:
+        import jax
+
+        _TOPK_UPDATE_JIT = jax.jit(_topk_update, donate_argnums=(0, 1))
+    return _TOPK_UPDATE_JIT
+
+
+class DeviceTopK:
+    """Device-resident SpaceSaving-compatible top-K summary (r21).
+
+    Keeps the core/sketches.SpaceSaving surface the promoter consumes
+    (observe_weighted / top_with_payload / decay / _counts) but runs
+    the per-batch update as ONE jitted device program (_topk_update):
+    candidate selection cost stops scaling with host-side top-K scans.
+    Payloads (each key's last-seen (limit, duration)) stay host-side —
+    they are promotion parameters, not counters — pruned to table
+    residents on each sync. Host mirrors (_counts) refresh lazily at
+    read time (top_with_payload / a flush tick), NOT per observe: the
+    submit thread never blocks on a device readback.
+
+    Thread safety: observe lands on the engine's submit thread while
+    sync/decay run on the promoter's flush loop, and the jitted update
+    DONATES the table buffers — an unlocked reader can catch the donor
+    arrays mid-consumption ("Array has been deleted"). Every touch of
+    _kh/_cnt holds _lock; observe only enqueues the async dispatch
+    under it, so the submit thread still never blocks on a readback."""
 
     def __init__(self, capacity: int):
-        self.ss = SpaceSaving(capacity)
+        import jax.numpy as jnp
+
+        self.capacity = int(capacity)
+        self._kh = jnp.zeros((self.capacity,), jnp.uint64)
+        self._cnt = jnp.zeros((self.capacity,), jnp.int64)
+        self._payloads: Dict[int, tuple] = {}
+        self._counts: Dict[int, int] = {}
+        self._dirty = False
+        self._lock = threading.Lock()
+
+    def observe_arrays(self, kh, weights, payloads: Dict) -> None:
+        """Fold a pre-aggregated batch (distinct uint64 keys + int64
+        weights, at most OBSERVE_TOP rows) into the device table."""
+        import jax.numpy as jnp
+
+        n = int(kh.shape[0])
+        kb = np.zeros(OBSERVE_TOP, np.uint64)
+        wb = np.zeros(OBSERVE_TOP, np.int64)
+        kb[:n] = kh[:OBSERVE_TOP]
+        wb[:n] = np.maximum(weights[:OBSERVE_TOP], 0)
+        with self._lock:
+            self._kh, self._cnt = _topk_update_jit()(
+                self._kh, self._cnt, jnp.asarray(kb), jnp.asarray(wb)
+            )
+            self._payloads.update(payloads)
+            self._dirty = True
+
+    def observe_weighted(self, agg: Dict, payloads=None) -> None:
+        """SpaceSaving-compat dict entry point."""
+        kh = np.fromiter(agg.keys(), np.uint64, len(agg))
+        w = np.fromiter(agg.values(), np.int64, len(agg))
+        self.observe_arrays(kh, w, dict(payloads or {}))
+
+    def _sync_locked(self) -> None:
+        if not self._dirty:
+            return
+        kh = np.asarray(self._kh)
+        cnt = np.asarray(self._cnt)
+        live = kh != 0
+        self._counts = {
+            int(k): int(c) for k, c in zip(kh[live], cnt[live])
+        }
+        self._payloads = {
+            k: v for k, v in self._payloads.items() if k in self._counts
+        }
+        self._dirty = False
+
+    def _sync(self) -> None:
+        with self._lock:
+            self._sync_locked()
+
+    def decay(self, shift: int = 1) -> None:
+        """Geometric turnover on device: counts halve (>> shift) and
+        zeroed entries free their slots."""
+        import jax.numpy as jnp
+
+        with self._lock:
+            cnt = self._cnt >> shift
+            live = cnt > 0
+            self._cnt = jnp.where(live, cnt, jnp.int64(0))
+            self._kh = jnp.where(live, self._kh, jnp.uint64(0))
+            self._dirty = True
+            self._sync_locked()
+
+    def top_with_payload(self, k: int):
+        self._sync()
+        items = sorted(
+            self._counts.items(), key=lambda kv: kv[1], reverse=True
+        )[:k]
+        return [
+            (key, cnt, 0, self._payloads.get(key))
+            for key, cnt in items
+        ]
+
+
+class HotTracker:
+    """Rate-limited DeviceTopK front-end over dispatched batches.
+
+    observe() runs on the engine's submit thread (the dispatch funnel);
+    the numpy pre-aggregation is one np.unique over the batch's valid
+    promotable rows, and the table fold is one async device dispatch —
+    paid at most every OBSERVE_MIN_INTERVAL_S."""
+
+    def __init__(self, capacity: int):
+        self.ss = DeviceTopK(capacity)
         self._next = 0.0
 
     def observe(self, req) -> None:
@@ -99,10 +276,12 @@ class HotTracker:
         valid = np.asarray(req.valid, bool)
         algo = np.asarray(req.algo)
         hits = np.asarray(req.hits)
-        # token-bucket, hit-carrying rows only: promotion installs token
-        # windows (core/engine.py install_windows), and peeks say
-        # nothing about heat
-        mask = valid & (algo == 0) & (hits > 0)
+        # PROMOTABLE (token-bucket), hit-carrying rows only: promotion
+        # installs token windows (core/engine.py install_windows), so
+        # the r21 sketch-servable widening does NOT widen this mask —
+        # see core/algorithms.PROMOTABLE_ALGOS; peeks say nothing
+        # about heat
+        mask = valid & np.isin(algo, _PROMOTABLE_IDS) & (hits > 0)
         if not mask.any():
             return
         kh = np.asarray(req.key_hash, np.uint64)[mask]
@@ -114,13 +293,11 @@ class HotTracker:
             uk, first, counts = uk[top], first[top], counts[top]
         lim = np.asarray(req.limit, np.int64)[mask][first]
         dur = np.asarray(req.duration, np.int64)[mask][first]
-        agg = {}
-        payloads = {}
-        for i in range(uk.shape[0]):
-            k = int(uk[i])
-            agg[k] = int(counts[i])
-            payloads[k] = (int(lim[i]), int(dur[i]))
-        self.ss.observe_weighted(agg, payloads)
+        payloads = {
+            int(uk[i]): (int(lim[i]), int(dur[i]))
+            for i in range(uk.shape[0])
+        }
+        self.ss.observe_arrays(uk, counts.astype(np.int64), payloads)
 
 
 class SketchPromoter:

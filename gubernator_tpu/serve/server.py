@@ -80,7 +80,8 @@ def make_backend(conf: ServerConfig):
         store.slots, store.rows, store_capacity(store),
         store_footprint_bytes(store) / (1 << 20),
         (
-            f" + sketch {sketch.rows}x{sketch.width} int64 "
+            f" + sketch {sketch.rows}x{sketch.width} "
+            f"int{sketch.counter_bytes * 8} "
             f"({sketch_bytes / (1 << 20):.0f} MiB)"
             if sketch is not None
             else " (sketch tier off)"

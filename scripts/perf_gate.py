@@ -91,6 +91,7 @@ GATED = (
     "submit_r9",
     "stages_r7",
     "sketch_r13",
+    "sketch2_r21",
     "shard_r14",
     "chain_r15",
     "trace_r16",
@@ -497,6 +498,100 @@ def main() -> int:
         m, rows = paired("sketch_r13", sketch_off, sketch_drive,
                          args.seconds, args.rounds)
         measured["sketch_r13"], detail["sketch_r13"] = m, rows
+
+        # -- sketch2_r21: r13 vs v2 derivation at the SAME budget ----
+        # In-process decide_arrays drive on two standalone engines
+        # whose exact buckets are pinned full of immortal fillers, so
+        # every measured key's create drops and decides from the
+        # sketch over the r21 window-ring sliding path. A = the
+        # committed r13 counter geometry (4 rows of int64), B = v2
+        # (2 rows of saturating int32, 4x the width) at the identical
+        # byte budget. Both sides share the host prep and the store
+        # probe; the ratio prices the v2 kernel — fewer hash lanes,
+        # narrower counters — and the committed baseline pins that the
+        # 4x-tighter error bound was not bought with decide throughput.
+        print(
+            "workload sketch2_r21 (r13 vs v2 derivation, same "
+            "budget)...",
+            file=sys.stderr,
+        )
+        import numpy as np
+
+        from gubernator_tpu.cli import keystreams
+        from gubernator_tpu.cli.bench_serving import _filler_hashes
+        from gubernator_tpu.core.engine import TpuEngine
+        from gubernator_tpu.core.sketches import derive_sketch_config
+        from gubernator_tpu.core.store import StoreConfig
+
+        sk2_cfg = StoreConfig(rows=1, slots=64)
+        sk2_fill = _filler_hashes(sk2_cfg.slots)
+        sk2_nf = sk2_fill.shape[0]
+        SK2_B, SK2_T0 = 4096, 1_700_000_000_000
+        sk2_rng = np.random.default_rng(21)
+        sk2_keys = [
+            np.concatenate([
+                sk2_fill,
+                keystreams.hash_ids(
+                    keystreams.zipf_ids(
+                        100_000, SK2_B - sk2_nf, sk2_rng
+                    )
+                ),
+            ])
+            for _ in range(16)
+        ]
+        sk2_hits = np.concatenate([
+            np.zeros(sk2_nf, np.int64),
+            np.ones(SK2_B - sk2_nf, np.int64),
+        ])
+        sk2_lim = np.full(SK2_B, 1000, np.int64)
+        sk2_dur = np.full(SK2_B, 60_000, np.int64)
+        sk2_algo = np.full(SK2_B, 2, np.int32)  # sliding: window-ring
+        sk2_algo[:sk2_nf] = 0
+        sk2_gnp = np.zeros(SK2_B, bool)
+
+        def sk2_engine(derivation):
+            eng = TpuEngine(
+                sk2_cfg, buckets=(4096,),
+                sketch=derive_sketch_config(
+                    mib=8, derivation=derivation
+                ),
+            )
+            ones = np.ones(sk2_nf, np.int64)
+            eng.decide_arrays(
+                sk2_fill, ones, ones * 1000, ones * 1_000_000_000,
+                np.zeros(sk2_nf, np.int32), np.zeros(sk2_nf, bool),
+                SK2_T0,
+            )
+            return eng
+
+        sk2_engines = {
+            "r13": sk2_engine("r13"), "v2": sk2_engine("v2")
+        }
+        sk2_step = {"i": 0}
+
+        def sk2_drive(which):
+            eng = sk2_engines[which]
+
+            def d(seconds):
+                n = 0
+                deadline = time.monotonic() + seconds
+                while time.monotonic() < deadline:
+                    i = sk2_step["i"] = sk2_step["i"] + 1
+                    eng.decide_arrays(
+                        sk2_keys[i % len(sk2_keys)], sk2_hits,
+                        sk2_lim, sk2_dur, sk2_algo, sk2_gnp,
+                        SK2_T0 + i,
+                    )
+                    n += SK2_B
+                return n / seconds
+
+            return d
+
+        m, rows = paired(
+            "sketch2_r21", sk2_drive("r13"), sk2_drive("v2"),
+            args.seconds, args.rounds,
+        )
+        measured["sketch2_r21"], detail["sketch2_r21"] = m, rows
 
         # -- shard_r14: 1-shard flat vs N-shard mesh, zipf keyspace --
         # Same GEB workload against two RESIDENT stacks (identical
@@ -991,6 +1086,14 @@ def main() -> int:
                     "pair": "sketch cold tier OFF vs ON, share 0.5 "
                             "keyspace-300k drop-heavy workload",
                     "committed": round(measured["sketch_r13"], 4),
+                },
+                "sketch2_r21": {
+                    "artifact": "BENCH_SKETCH_r21.json",
+                    "pair": "sketch derivation r13 (4 rows int64) vs "
+                            "v2 (2 rows saturating int32, 4x width) "
+                            "at the same 8 MiB budget, pinned-bucket "
+                            "sliding-window decide drive",
+                    "committed": round(measured["sketch2_r21"], 4),
                 },
                 "shard_r14": {
                     "artifact": "BENCH_SHARD_r14.json",

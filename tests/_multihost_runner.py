@@ -247,6 +247,119 @@ def main():
         )
     print("SKETCH-OK", flush=True)
 
+    # -- r21: window-ring serving on multihost — sliding + GCRA --------------
+    # One sliding and one GCRA key whose bucket (on its owner shard) is
+    # way-saturated with immortal fillers: every create drops, so each
+    # decide is served by the per-shard ring through the SAME lockstep
+    # collective program, and the responses must be bit-exact against
+    # the host twins (algorithms.sketch_sliding_budget /
+    # sketch_gcra_budget) fed by the host-tracked charge log — the ring
+    # cells are written by these two keys only, so estimate == charges.
+    from gubernator_tpu.core.algorithms import (
+        gcra_params,
+        sketch_gcra_budget,
+        sketch_sliding_budget,
+    )
+    from gubernator_tpu.core.hashing import mix64
+    from gubernator_tpu.core.store import _BUCKET_SALT
+
+    def shard_bucket(arr):
+        o = owner_of_np(arr, n_shards)
+        b = mix64(arr ^ _BUCKET_SALT) & np.uint64(cfg.slots - 1)
+        return o.astype(np.int64), b.astype(np.int64)
+
+    prior = np.concatenate([kh, kh2, kh3, khp])
+    po, pb = shard_bucket(prior)
+    used = set(zip(po.tolist(), pb.tolist()))
+
+    # two targets sharing one FREE (shard, bucket) — no earlier-phase
+    # resident can expire mid-drive and open a way
+    cand = (
+        np.arange(10_000_000, 10_040_000, dtype=np.uint64) << np.uint64(32)
+    ) | np.uint64(5)
+    co, cb = shard_bucket(cand)
+    free = [
+        i for i in range(cand.shape[0])
+        if (int(co[i]), int(cb[i])) not in used
+    ]
+    home = (int(co[free[0]]), int(cb[free[0]]))
+    pair = [i for i in free if (int(co[i]), int(cb[i])) == home][:2]
+    assert len(pair) == 2, "no bucket-sharing target pair found"
+    k_sld, k_gcra = cand[pair[0]], cand[pair[1]]
+
+    # way-saturate the home bucket: cfg.rows immortal fillers
+    fcand = (np.arange(1, 400_000, dtype=np.uint64) << np.uint64(32)) | (
+        np.uint64(9)
+    )
+    fo, fb = shard_bucket(fcand)
+    fsel = np.flatnonzero((fo == home[0]) & (fb == home[1]))[: cfg.rows]
+    assert fsel.shape[0] == cfg.rows, "filler search exhausted"
+    fillers = fcand[fsel]
+    nf = fillers.shape[0]
+    onesF = np.ones(nf, np.int64)
+    t = T0 + 40
+    sF, _, _, _ = eng.decide_arrays(
+        fillers, onesF, onesF * 1000, onesF * 1_000_000_000,
+        np.zeros(nf, np.int32), np.zeros(nf, bool), t,
+    )
+    assert (sF == 0).all(), sF
+
+    I32_MAX = (1 << 31) - 1
+    DUR, LIM = 10_000, 4
+    epoch = T0 - 1  # pinned at the engine's first decide (T0)
+    charges = {2: {}, 3: {}}
+    windows = set()
+    for dt in (1, 1, 1, 1, 1, 3000, 1, 1, 6000, 1, 1, 15_000,
+               1, 1, 1, 1, 25_001, 1, 2, 3, 9_999, 1):
+        t += dt
+        e_now = t - epoch
+        wid = e_now // DUR
+        windows.add(wid)
+        exp = {}
+        for algo_id, key in ((2, k_sld), (3, k_gcra)):
+            cur = charges[algo_id].get(wid, 0)
+            prev = charges[algo_id].get(wid - 1, 0)
+            if algo_id == 2:
+                budget, wend = sketch_sliding_budget(
+                    cur, prev, e_now, LIM, DUR
+                )
+                reset = epoch + wend
+            else:
+                budget, tatq = sketch_gcra_budget(
+                    cur, prev, e_now, LIM, DUR
+                )
+                T_, tau = gcra_params(LIM, DUR)
+                tatq_c = min(tatq, I32_MAX)
+                if budget >= 1:
+                    reset = epoch + min(tatq_c + T_, I32_MAX)
+                else:
+                    reset = epoch + min(tatq_c + T_ - tau, I32_MAX)
+            exp[algo_id] = (budget, reset)
+        bkh = np.concatenate([fillers, [k_sld], [k_gcra]])
+        bh = np.concatenate([np.zeros(nf, np.int64), [1, 1]])
+        bl = np.full(nf + 2, LIM, np.int64)
+        bl[:nf] = 1000
+        bd = np.full(nf + 2, DUR, np.int64)
+        bd[:nf] = 1_000_000_000
+        ba = np.concatenate(
+            [np.zeros(nf, np.int32), np.asarray([2, 3], np.int32)]
+        )
+        s, l, r, ts = eng.decide_arrays(
+            bkh, bh, bl, bd, ba, np.zeros(nf + 2, bool), t
+        )
+        for row, algo_id in ((nf, 2), (nf + 1, 3)):
+            budget, reset = exp[algo_id]
+            charged = budget >= 1
+            assert s[row] == (0 if charged else 1), (algo_id, t, s[row])
+            assert r[row] == (budget - 1 if charged else 0), (algo_id, t)
+            assert ts[row] == reset, (algo_id, t, int(ts[row]), reset)
+            assert l[row] == LIM
+            if charged:
+                charges[algo_id][wid] = charges[algo_id].get(wid, 0) + 1
+    assert len(windows) >= 3, "ring drive never crossed rotations"
+    assert sum(charges[2].values()) > 0 and sum(charges[3].values()) > 0
+    print("RING-OK", flush=True)
+
     eng.close()
     print("LEADER-OK", flush=True)
 

@@ -30,6 +30,7 @@ def _req(key: str, hits=1, behavior=Behavior.GLOBAL) -> RateLimitReq:
 class FakePeer:
     host: str
     is_owner: bool = False
+    mesh_local: bool = False
     fail: bool = False
     hit_batches: list = field(default_factory=list)
     update_batches: list = field(default_factory=list)
@@ -52,6 +53,10 @@ class FakeInstance:
     def __init__(self, peers):
         self.peers = peers
         self.decided = []
+        self.installed = []  # local replica installs (r21 mesh path)
+
+    async def update_peer_globals(self, updates):
+        self.installed.append(list(updates))
 
     def get_peer(self, hash_key):
         # hash_key is "name_uniquekey"; route on the unique key's first char
@@ -284,6 +289,129 @@ def test_global_mesh_off_restores_rpc_fanout():
     run(main())
     assert len(peers["b"].hit_batches) == 1
     assert inst.decided == []
+
+
+def test_mesh_local_broadcast_short_circuits_install():
+    """r21 satellite pin: the r20 per-destination split applied to the
+    BROADCAST loop. Peers whose replicas ride this node's mesh
+    (PeerInfo.mesh_local) must be covered by ONE local install of the
+    whole batch — never a per-peer UpdatePeerGlobals RPC — while
+    off-mesh peers keep the RPC fan-out; the flush trace span carries
+    the hop split proving the collapse."""
+    from gubernator_tpu.serve.tracing import Tracer
+
+    peers = {
+        "a": FakePeer("A"),
+        "b": FakePeer("B", is_owner=True),
+        "c": FakePeer("C", mesh_local=True),
+        "d": FakePeer("D", mesh_local=True),
+    }
+    inst = FakeInstance(peers)
+    inst.tracer = Tracer(sample=1.0)
+    before_mesh = _flush_bytes("mesh")
+    before_rpc = _flush_bytes("rpc")
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        gm.queue_update(_req("a1"))
+        gm.queue_update(_req("c7"))
+        for _ in range(200):
+            if peers["a"].update_batches and inst.installed:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    # mesh-local peers NEVER got an RPC; the owner stays skipped
+    assert peers["c"].update_batches == []
+    assert peers["d"].update_batches == []
+    assert peers["b"].update_batches == []
+    # ONE local install of the whole deduped batch covers c AND d
+    (installed,) = inst.installed
+    assert sorted(k for k, _ in installed) == ["gm_a1", "gm_c7"]
+    # the off-mesh peer still got its full broadcast over RPC
+    assert len(peers["a"].update_batches) == 1
+    assert sorted(k for k, _ in peers["a"].update_batches[0]) == [
+        "gm_a1", "gm_c7",
+    ]
+    # byte split is observable per path
+    assert _flush_bytes("mesh") > before_mesh
+    assert _flush_bytes("rpc") > before_rpc
+    # trace-span evidence: one mesh hop covers BOTH mesh-local peers;
+    # the RPC path pays one hop for the one off-mesh peer
+    spans = [
+        sp
+        for tr in inst.tracer.recorder.snapshot()["traces"]
+        if tr["door"] == "global_broadcast"
+        for sp in tr["spans"]
+        if sp["name"] == "global_flush_updates"
+    ]
+    assert spans, "broadcast produced no global_flush_updates span"
+    ann = spans[0]["annotations"]
+    assert ann["hops_mesh"] == 1
+    assert ann["hops_rpc"] == 1
+    assert ann["keys_mesh"] == 2
+    assert ann["keys_rpc"] == 2
+    assert ann["peers_mesh"] == 2
+    assert ann["peers_rpc"] == 1
+
+
+def test_mesh_local_broadcast_off_restores_rpc_fanout():
+    """GUBER_GLOBAL_MESH=0 escape hatch on the broadcast loop: a
+    mesh_local peer is fanned out to over RPC like any other peer
+    (pre-r21 behavior)."""
+    peers = {
+        "b": FakePeer("B", is_owner=True),
+        "c": FakePeer("C", mesh_local=True),
+    }
+    inst = FakeInstance(peers)
+
+    async def main():
+        gm = GlobalManager(_conf(global_mesh=False), inst)
+        gm.start()
+        gm.queue_update(_req("c1"))
+        for _ in range(200):
+            if peers["c"].update_batches:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert len(peers["c"].update_batches) == 1
+    assert inst.installed == []
+
+
+def test_mesh_local_install_prefers_instance_hook():
+    """When the instance exposes update_peer_globals_local, the
+    mesh-local broadcast chunk must use it over update_peer_globals —
+    that is where an embedder hangs a one-collective install."""
+    peers = {
+        "b": FakePeer("B", is_owner=True),
+        "c": FakePeer("C", mesh_local=True),
+    }
+    inst = FakeInstance(peers)
+    hooked = []
+
+    async def hook(updates):
+        hooked.append(list(updates))
+
+    inst.update_peer_globals_local = hook
+
+    async def main():
+        gm = GlobalManager(_conf(), inst)
+        gm.start()
+        gm.queue_update(_req("c1"))
+        for _ in range(200):
+            if hooked:
+                break
+            await asyncio.sleep(0.01)
+        await gm.stop()
+
+    run(main())
+    assert inst.installed == []
+    (batch,) = hooked
+    assert [k for k, _ in batch] == ["gm_c1"]
 
 
 def test_local_apply_prefers_instance_hook():

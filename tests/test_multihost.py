@@ -91,8 +91,10 @@ def _assert_ok(l_rc, l_out, f_rcs, f_outs):
     )
     # r20 legs ran inside the leader: mesh-native GLOBAL hits collective
     # and the multihost sketch tier (lockstep promote) both differential
-    # against a flat reference engine
+    # against a flat reference engine; r21 adds the window-ring leg
+    # (sliding + GCRA served from the sketch, bit-exact vs host twins)
     assert "GHITS-OK" in l_out and "SKETCH-OK" in l_out, l_out
+    assert "RING-OK" in l_out, l_out
     for rc, out in zip(f_rcs, f_outs):
         assert rc == 0 and "FOLLOWER-OK" in out, f"follower failed:\n{out}"
 

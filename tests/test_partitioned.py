@@ -323,6 +323,71 @@ def test_sharded_sketch_error_bound_zero_undercount():
     assert over.max() <= math.e * int(true.sum()) / width
 
 
+@pytest.mark.parametrize("algo", [2, 3], ids=["sliding", "gcra"])
+def test_mesh_window_ring_pressure_is_fail_closed(algo):
+    """The r21 window-ring on the 8-shard MESH tier: sliding/GCRA
+    creates dropped to way exhaustion are served from the per-shard
+    sub-rings and every served row is AT-LEAST-AS-RESTRICTIVE than the
+    r15 bypass (the OFF engine answers each dropped create as a
+    phantom-fresh window — maximally permissive). Every (shard,
+    bucket) way is pinned with an immortal filler found-writer so
+    measured creates provably drop in BOTH engines; the dt pool
+    crosses rotation boundaries and multi-window jumps. Mirrors
+    tests/test_sketch_tier.py::test_window_ring_pressure_is_fail_closed
+    on the flat engine — sharding the ring (owner-charged sub-sketches,
+    r14 layout) must not re-open the one-sidedness."""
+    slots = 16
+    mk = lambda sk: MeshEngine(  # noqa: E731
+        StoreConfig(rows=1, slots=slots), buckets=(64, 256, 1024),
+        sketch=SketchConfig(rows=4, width=1 << 12) if sk else None,
+    )
+    on, off = mk(True), mk(False)
+    fillers = _cover_all_buckets(on.n, slots)
+    nf = fillers.shape[0]
+    ones_f = np.ones(nf, np.int64)
+    for eng in (on, off):
+        eng.decide_arrays(
+            fillers, ones_f, ones_f * 1000, ones_f * 1_000_000_000,
+            np.zeros(nf, np.int32), np.zeros(nf, bool), T0,
+        )
+        assert eng.stats.snapshot()["dropped"] == 0
+    rng = np.random.default_rng(31)
+    keyspace = 48
+    pool = (
+        (np.arange(1, keyspace + 1, dtype=np.uint64) + np.uint64(5_000_000))
+        << np.uint64(32)
+    ) | np.uint64(3)  # tag-disjoint from the fillers
+    DUR, LIM = 10_000, 6
+    t = T0
+    diverged = 0
+    for step in range(50):
+        n = int(rng.integers(1, 24))
+        kh_m = pool[rng.integers(0, keyspace, n)]
+        hits_m = rng.choice((0, 1, 1, 1), n).astype(np.int64)
+        t += int(rng.choice((0, 1, 7, 500, 2500, 12_000, 21_000)))
+        kh = np.concatenate([fillers, kh_m])
+        hits = np.concatenate([np.zeros(nf, np.int64), hits_m])
+        lim = np.full(nf + n, LIM, np.int64)
+        lim[:nf] = 1000
+        dur = np.full(nf + n, DUR, np.int64)
+        dur[:nf] = 1_000_000_000
+        al = np.full(nf + n, algo, np.int32)
+        al[:nf] = 0
+        gnp = np.zeros(nf + n, bool)
+        sa, _, ra, _ = on.decide_arrays(kh, hits, lim, dur, al, gnp, t)
+        sb, _, rb, _ = off.decide_arrays(kh, hits, lim, dur, al, gnp, t)
+        differ = (sa[nf:] != sb[nf:]) | (ra[nf:] != rb[nf:])
+        diverged += int(differ.sum())
+        assert (sa[nf:] >= sb[nf:]).all(), f"fail-open status @{step}"
+        assert (ra[nf:] <= rb[nf:]).all(), f"fail-open remaining @{step}"
+    assert diverged > 0, "mesh pressure fuzz never engaged the ring"
+    st = on.stats.snapshot()
+    assert st["dropped"] > 0
+    assert st["evictions"] == 0, st  # live fillers never churned
+    # the OFF engine (r15 bypass) never persisted a measured key
+    assert not off.live_mask(pool, t).any()
+
+
 def test_mesh_sketch_promoter_end_to_end():
     """Instance-level: the promoter runs on the MESH backend (fed by
     the all-shards estimate gather), promotes hot sketch keys into

@@ -1,4 +1,4 @@
-"""Sketch cold tier (r13): identity, fail-closed error, promotion.
+"""Sketch cold tier (r13/r21): identity, fail-closed error, promotion.
 
 The two-tier contract under test (core/kernels.decide_presorted_sketch,
 core/sketches.py, serve/promoter.py):
@@ -7,15 +7,27 @@ core/sketches.py, serve/promoter.py):
   sketch only changes the fate of creates the exact store DROPS to way
   exhaustion, and store contents evolve identically either way (the
   writeback plan is sketch-independent), so with no drop pressure the
-  two pipelines are indistinguishable end to end (differential fuzz,
-  exact-capacity stores, device tpu-on-cpu pipeline, r10 fake clock);
+  two pipelines are indistinguishable end to end for ALL FOUR
+  algorithms (differential fuzz, exact-capacity stores, device
+  tpu-on-cpu pipeline, r10 fake clock);
 - under pressure, every divergent row is AT-LEAST-AS-RESTRICTIVE with
   the tier on (status >=, remaining <=): sketch estimates never
   under-count the hits they were charged with, so the error is
-  one-sided — fail-closed, matching the shed cache's stance;
+  one-sided — fail-closed, matching the shed cache's stance. Since
+  r21 this covers sliding (window-ring blend) and GCRA (TAT-quantized
+  reconstruction), each pinned bit-exact against its host twin
+  (algorithms.sketch_sliding_budget / sketch_gcra_budget) on
+  pinned-bucket single-key drives across rotations and clock jumps.
+  NOTE the scoping: strict per-request dominance vs the EXACT oracle
+  is impossible once refusal histories diverge (an early sketch
+  refusal leaves budget an exact path would have consumed), so the
+  row-wise property is asserted ON-vs-OFF — the OFF engine serves
+  dropped creates as phantom-fresh windows, strictly more permissive;
 - the measured tail error on a pinned zipf stream stays within the
-  documented e*N/width bound with ZERO under-counts (the property the
-  BENCH_SKETCH_r13.json acceptance commits);
+  documented e*N/width bound with ZERO under-counts, and the v2
+  derivation (saturating int32 counters, core/sketches.py) yields a
+  strictly tighter bound than r13 at the same byte budget (the
+  property the BENCH_SKETCH_r21.json acceptance commits);
 - device and host sketch indexing are bit-identical twins;
 - promotion migrates the estimate into an exact bucket (the window
   continues, then the key decides exactly), never clobbers live exact
@@ -109,16 +121,35 @@ def _pin_buckets(eng, nf=16):
 
 
 def test_sketch_config_and_derivation():
-    c = derive_sketch_config(mib=16, rows=4)
-    assert c.width == 1 << 19
+    # r13 derivation: 4 rows of int64 — the committed r13 geometry
+    c = derive_sketch_config(mib=16, rows=4, derivation="r13")
+    assert c.width == 1 << 19 and c.counter_bytes == 8
     assert sketch_footprint_bytes(c) == 16 << 20
-    assert derive_sketch_config(mib=8, rows=4).width == 1 << 18
+    assert derive_sketch_config(mib=8, derivation="r13").width == 1 << 18
+    # v2 derivation (default): 2 rows of saturating int32 — 4x the
+    # width at the same budget, so a 4x tighter additive error bound
+    v = derive_sketch_config(mib=8)
+    assert v.rows == 2 and v.counter_bytes == 4
+    assert v.width == 1 << 20
+    assert sketch_footprint_bytes(v) == 8 << 20
+    # explicit rows keep the derivation's counter dtype
+    assert derive_sketch_config(mib=16, rows=4).width == 1 << 20
+    # direct construction stays r13-compatible (int64 default)
+    assert SketchConfig(rows=4, width=1 << 12).counter_bytes == 8
+    import jax.numpy as jnp
+
+    assert new_sketch(v).data.dtype == jnp.int32
+    assert new_sketch(c).data.dtype == jnp.int64
     with pytest.raises(AssertionError):
         SketchConfig(rows=4, width=1000)  # not a power of two
     with pytest.raises(AssertionError):
         SketchConfig(rows=9, width=1 << 10)  # more rows than salts
+    with pytest.raises(AssertionError):
+        SketchConfig(rows=2, width=1 << 10, counter_bytes=2)
     with pytest.raises(ValueError):
         derive_sketch_config(mib=0)
+    with pytest.raises(ValueError):
+        derive_sketch_config(mib=8, derivation="r12")
 
 
 def test_store_mib_carve_out_and_host_budget():
@@ -221,12 +252,67 @@ def test_host_budget_strict_gates_on_explicit_host_knobs(caplog):
 
 
 def test_sketch_knob_validation():
+    # 0 rows = derivation default since r21 (v2: 2, r13: 4)
+    ServerConfig(sketch_rows=0).validate()
+    skc = ServerConfig(backend="tpu", sketch_rows=0).sketch_config()
+    assert skc.rows == 2 and skc.counter_bytes == 4
+    r13 = ServerConfig(
+        backend="tpu", sketch_derivation="r13"
+    ).sketch_config()
+    assert r13.rows == 4 and r13.counter_bytes == 8
     with pytest.raises(ValueError):
-        ServerConfig(sketch_rows=0).validate()
+        ServerConfig(sketch_rows=-1).validate()
+    with pytest.raises(ValueError):
+        ServerConfig(sketch_rows=9).validate()
+    with pytest.raises(ValueError):
+        ServerConfig(sketch_derivation="r12").validate()
     with pytest.raises(ValueError):
         ServerConfig(sketch_mib=-1).validate()
     with pytest.raises(ValueError):
         ServerConfig(sketch_topk=0).validate()
+
+
+def test_algo_registry_pins():
+    """The r21 registry audit: every eligibility gate derives from
+    core/algorithms.ALGORITHMS, and the three gates intentionally
+    DIFFER — widening one without auditing its consumers must fail
+    here, loudly, instead of silently shipping the r15 assumption
+    (sketch tier == token/leaky only) into a consumer.
+
+    - SKETCH_SERVABLE: all four. The window-ring (r21) reconstructs
+      sliding and GCRA budgets one-sidedly from per-window counts.
+    - PROMOTABLE: token ONLY. install_windows fabricates the token
+      fixed-window layout; promoting a sliding/GCRA key would reset
+      its phase and under-restrict. Ring keys are served, not promoted.
+    - SHEDDABLE: token ONLY. The shed cache freezes an OVER verdict to
+      the window end; sliding/GCRA budgets refill continuously, so a
+      frozen verdict would over-restrict for up to a full window —
+      serve them from the sketch tier instead (fail-closed but live).
+    """
+    from gubernator_tpu.core.algorithms import (
+        ALGO_TOKEN,
+        PROMOTABLE_ALGOS,
+        SHEDDABLE_ALGOS,
+        SKETCH_SERVABLE_ALGOS,
+    )
+
+    assert SKETCH_SERVABLE_ALGOS == {0, 1, 2, 3}
+    assert PROMOTABLE_ALGOS == {ALGO_TOKEN}
+    assert SHEDDABLE_ALGOS == {ALGO_TOKEN}
+    # the gates are registry-derived, not parallel hand-written sets
+    from gubernator_tpu.core.algorithms import ALGORITHMS
+
+    assert SKETCH_SERVABLE_ALGOS == {
+        a for a, s in ALGORITHMS.items() if s.sketch_servable
+    }
+    assert SHEDDABLE_ALGOS == {
+        a for a, s in ALGORITHMS.items() if s.sheddable
+    }
+    # consumers import the gates (grep-level pin: shedcache asserts at
+    # import time, promoter builds its mask from PROMOTABLE_ALGOS)
+    from gubernator_tpu.serve import promoter as promoter_mod
+
+    assert set(promoter_mod._PROMOTABLE_IDS.tolist()) == PROMOTABLE_ALGOS
 
 
 # -- indexing twins ---------------------------------------------------------
@@ -368,8 +454,12 @@ def _twin_arrays(seed, slots, rows, steps=60, keyspace=24,
 @pytest.mark.parametrize("seed", [2, 13])
 def test_on_off_identity_no_pressure(seed):
     """With the exact tier under capacity (no dropped creates), sketch
-    ON is byte-identical to OFF — responses AND store contents."""
-    on, off, steps = _twin_arrays(seed, slots=1 << 10, rows=16)
+    ON is byte-identical to OFF — responses AND store contents — for
+    ALL FOUR algorithms (r21: sliding/GCRA are sketch-servable now, so
+    the identity must keep holding with them in the stream)."""
+    on, off, steps = _twin_arrays(
+        seed, slots=1 << 10, rows=16, algo_pool=(0, 1, 2, 3)
+    )
     for step, a, b in steps:
         for x, y in zip(a, b):
             np.testing.assert_array_equal(x, y, err_msg=f"step {step}")
@@ -427,33 +517,159 @@ def test_on_off_pressure_is_fail_closed():
 
 
 @pytest.mark.parametrize("algo", [2, 3], ids=["sliding", "gcra"])
-def test_r15_algorithms_bypass_sketch_under_pressure(algo):
-    """r15 interplay audit (core/algorithms.py SKETCH_SERVABLE_ALGOS):
-    sliding-window and GCRA creates dropped to way exhaustion are
-    never served from the count-min tier — its fixed-window token math
-    would under-count a sliding blend's previous-window weight and has
-    no GCRA-TAT analogue, breaking the fail-closed contract. Under the
-    same tier pressure that makes the token stream diverge
-    (test_on_off_pressure_is_fail_closed), a sliding/GCRA-only stream
-    is byte-identical sketch-ON vs OFF: drops surface in
-    BatchStats.dropped on BOTH engines, store contents match, and the
-    ON engine's sketch counters never get charged."""
-    on, off, steps = _twin_arrays(
-        11, slots=16, rows=1, steps=80, keyspace=64,
-        hit_pool=(0, 1, 1, 1), limit_pool=(50,),
-        dur_pool=(600_000,), dt_pool=(0, 1, 7, 150),
-        algo_pool=(algo,),
+def test_window_ring_pressure_is_fail_closed(algo):
+    """r21 window-ring: sliding/GCRA creates dropped to way exhaustion
+    are served from the ring (sliding blend / TAT-quantized GCRA) and
+    every served row is AT-LEAST-AS-RESTRICTIVE than the r15 bypass
+    behavior — the OFF engine serves each dropped create as a
+    phantom-fresh window with the full budget, the most permissive
+    answer possible, so ANY correct sketch serving must dominate it
+    row-wise (status >=, remaining <=). All buckets are pinned with
+    immortal filler found-writers so every measured create provably
+    drops in BOTH engines (the OFF engine never persists a measured
+    key — asserted), which keeps the comparison clean across rotation
+    boundaries and clock jumps: the dt pool crosses single and
+    multiple window advances. Unit hits and one limit keep `remaining`
+    row-comparable (see test_on_off_pressure_is_fail_closed). Strict
+    dominance vs the EXACT r15 oracle is deliberately not claimed —
+    impossible once refusal histories diverge (module docstring); the
+    bit-exact semantics are pinned against the host twins in
+    test_window_ring_twin_oracle instead."""
+    on = _pressure_engine()
+    off = _pressure_engine(sketch=False)
+    fillers = _pin_buckets(on)
+    np.testing.assert_array_equal(fillers, _pin_buckets(off))
+    nf = fillers.shape[0]
+    rng = np.random.default_rng(11)
+    keyspace = 40
+    pool = _keys(keyspace, tag=3)
+    DUR, LIM = 10_000, 6
+    t = T0
+    diverged = 0
+    for step in range(60):
+        n = int(rng.integers(1, 24))
+        kh_m = pool[rng.integers(0, keyspace, n)]
+        hits_m = rng.choice((0, 1, 1, 1), n).astype(np.int64)
+        t += int(rng.choice((0, 1, 7, 500, 2500, 12_000, 21_000)))
+        kh = np.concatenate([fillers, kh_m])
+        hits = np.concatenate([np.zeros(nf, np.int64), hits_m])
+        lim = np.full(nf + n, LIM, np.int64)
+        lim[:nf] = 1000  # fillers keep their own params
+        dur = np.full(nf + n, DUR, np.int64)
+        dur[:nf] = 1_000_000_000
+        al = np.full(nf + n, algo, np.int32)
+        al[:nf] = 0
+        gnp = np.zeros(nf + n, bool)
+        a = on.decide_arrays(kh, hits, lim, dur, al, gnp, t)
+        b = off.decide_arrays(kh, hits, lim, dur, al, gnp, t)
+        sa, _, ra, _ = a
+        sb, _, rb, _ = b
+        differ = (sa[nf:] != sb[nf:]) | (ra[nf:] != rb[nf:])
+        diverged += int(differ.sum())
+        assert (sa[nf:] >= sb[nf:]).all(), f"fail-open status @{step}"
+        assert (ra[nf:] <= rb[nf:]).all(), f"fail-open remaining @{step}"
+    assert diverged > 0, "pressure fuzz never engaged the ring"
+    assert on.stats.snapshot()["dropped"] > 0
+    assert int(np.asarray(on.sketch.data).sum()) > 0, (
+        "ring never charged: sliding/GCRA are sketch-servable in r21"
     )
-    for step, a, b in steps:
-        for x, y in zip(a, b):
-            np.testing.assert_array_equal(x, y, err_msg=f"step {step}")
-    s_on, s_off = on.stats.snapshot(), off.stats.snapshot()
-    assert s_on["dropped"] > 0, "pressure fuzz never dropped a create"
-    assert s_on["dropped"] == s_off["dropped"]
-    np.testing.assert_array_equal(
-        np.asarray(on.store.data), np.asarray(off.store.data)
+    # the OFF engine (r15 bypass behavior) never persisted a measured
+    # key — every step really was phantom-fresh over there
+    assert not off.live_mask(pool, t).any()
+
+
+@pytest.mark.parametrize(
+    "skc",
+    [
+        SketchConfig(rows=4, width=1 << 12),
+        SketchConfig(rows=2, width=1 << 12, counter_bytes=4),
+    ],
+    ids=["r13-int64", "v2-int32"],
+)
+@pytest.mark.parametrize("algo", [2, 3], ids=["sliding", "gcra"])
+def test_window_ring_twin_oracle(algo, skc):
+    """A sketch-served sliding/GCRA key is BIT-EXACT against its host
+    twin (algorithms.sketch_sliding_budget / sketch_gcra_budget fed
+    host-read ring estimates) on a pinned-bucket single-key drive
+    whose clock crosses rotation boundaries, multi-window jumps and
+    sub-window advances — and the ring never under-counts the true
+    charge log (est_cur >= charges the engine admitted per window).
+    Runs on both counter derivations: int64 (r13) and saturating
+    int32 (v2)."""
+    from gubernator_tpu.core.algorithms import (
+        gcra_params,
+        sketch_gcra_budget,
+        sketch_sliding_budget,
     )
-    assert int(np.asarray(on.sketch.data).sum()) == 0
+
+    I32_MAX = (1 << 31) - 1
+    eng = TpuEngine(
+        StoreConfig(rows=1, slots=16), buckets=(64, 256), sketch=skc
+    )
+    fillers = _pin_buckets(eng)
+    nf = fillers.shape[0]
+    key = _keys(1, tag=11)[:1]
+    DUR, LIM = 10_000, 4
+    epoch = T0 - 1  # EpochClock pins one ms before first contact
+    true_charges: dict = {}
+
+    def ring_est(wid):
+        data = np.asarray(eng.sketch.data)
+        idx = sketch_indices_np(
+            key, np.array([wid], np.int64), skc
+        )
+        return int(
+            min(data[r, idx[r][0]] for r in range(skc.rows))
+        )
+
+    t = T0
+    for dt in (0, 1, 1, 1, 1, 1, 3000, 1, 1, 6000, 1, 1, 15_000,
+               1, 1, 1, 1, 25_001, 1, 2, 3, 9_999, 1):
+        t += dt
+        e_now = t - epoch
+        wid = e_now // DUR
+        est_cur = ring_est(wid)
+        est_prev = ring_est(wid - 1)
+        if algo == 2:
+            budget, wend = sketch_sliding_budget(
+                est_cur, est_prev, e_now, LIM, DUR
+            )
+            exp_reset = epoch + wend
+        else:
+            budget, tatq = sketch_gcra_budget(
+                est_cur, est_prev, e_now, LIM, DUR
+            )
+            T_, tau = gcra_params(LIM, DUR)
+            tatq_c = min(tatq, I32_MAX)
+            if budget >= 1:  # this row charges
+                exp_reset = epoch + min(tatq_c + T_, I32_MAX)
+            else:
+                exp_reset = epoch + min(tatq_c + T_ - tau, I32_MAX)
+        charged = budget >= 1
+        exp_status = Status.UNDER_LIMIT if charged else Status.OVER_LIMIT
+        exp_rem = budget - 1 if charged else 0
+        kh = np.concatenate([fillers, key])
+        hits = np.concatenate([np.zeros(nf, np.int64), [1]])
+        lim = np.full(nf + 1, LIM, np.int64)
+        lim[:nf] = 1000
+        dur = np.full(nf + 1, DUR, np.int64)
+        dur[:nf] = 1_000_000_000
+        al = np.full(nf + 1, algo, np.int32)
+        al[:nf] = 0
+        s, l, r, ts = eng.decide_arrays(
+            kh, hits, lim, dur, al, np.zeros(nf + 1, bool), t
+        )
+        assert s[-1] == int(exp_status), f"status @t={t}"
+        assert r[-1] == exp_rem, f"remaining @t={t}"
+        assert ts[-1] == exp_reset, f"reset @t={t}"
+        assert l[-1] == LIM
+        if charged:
+            true_charges[wid] = true_charges.get(wid, 0) + 1
+            # zero under-count: the ring re-read AFTER the charge
+            # covers everything admitted this window
+            assert ring_est(wid) >= true_charges[wid]
+    assert len(true_charges) >= 3, "drive never crossed rotations"
+    assert sum(true_charges.values()) > 0
 
 
 def test_on_off_identity_serving_device(monkeypatch):
@@ -532,14 +748,40 @@ def test_on_off_identity_serving_device(monkeypatch):
 def test_tail_error_bound_and_no_undercount():
     """The committed acceptance property on a pinned zipf stream
     (cli/bench_serving.measure_tail_error, the same code path the
-    BENCH_SKETCH_r13.json artifact runs): zero under-counts and max
-    overestimate within the documented e*N/width bound."""
+    BENCH_SKETCH_r21.json artifact runs): zero under-counts and max
+    overestimate within the documented e*N/width bound — on the v2
+    default AND under the r21 window-ring arms (sliding/GCRA charge the
+    same per-window cells, so the one-sided bound carries over)."""
     from gubernator_tpu.cli.bench_serving import measure_tail_error
 
     err = measure_tail_error(batches=16)
+    assert err["derivation"] == "v2" and err["counter_bytes"] == 4
     assert err["under_counts"] == 0, err
     assert err["within_bound"], err
     assert err["charged_hits"] > 0 and err["distinct_keys"] > 100
+    for arm in ("sliding", "gcra"):
+        e = measure_tail_error(batches=8, algorithm=arm)
+        assert e["under_counts"] == 0, (arm, e)
+        assert e["within_bound"], (arm, e)
+        assert e["charged_hits"] > 0
+
+
+def test_tail_error_derivation_ab_is_strictly_tighter():
+    """The r21 derivation A/B (measure_tail_error_ab): at the SAME byte
+    budget v2's bound is 4x tighter than r13's (2 rows of int32 -> 4x
+    width) and its measured max overestimate sits strictly below r13's
+    THEORETICAL bound — the per-byte win the tentpole commits — with
+    zero under-counts on both geometries."""
+    from gubernator_tpu.cli.bench_serving import measure_tail_error_ab
+
+    ab = measure_tail_error_ab(batches=16)
+    assert ab["zero_under_counts"], ab
+    assert ab["v2_max_below_r13_bound"], ab
+    # 4x width = 4x tighter bound (ratio reported off the rounded
+    # bounds, so pin the exact geometry instead of the float)
+    assert abs(ab["v2_bound_over_r13_bound"] - 0.25) < 0.01
+    assert ab["v2"]["sketch_width"] == 4 * ab["r13"]["sketch_width"]
+    assert ab["v2"]["within_bound"] and ab["r13"]["within_bound"]
 
 
 # -- eviction -> sketch migration (r14) -------------------------------------
@@ -825,6 +1067,41 @@ def test_committed_artifact_headline():
     assert sk["dropped_creates"] > 0, "the sketch tier never engaged"
     assert doc["key_space"] >= 100_000_000
     assert doc["acceptance"]["throughput_met"] or doc["acceptance_note"]
+
+
+def test_committed_artifact_headline_r21():
+    """BENCH_SKETCH_r21.json: the r21 acceptance — v2's measured max
+    overestimate strictly below the r13 bound at the same budget with
+    zero under-counts anywhere, and the sliding/GCRA arms actually
+    served from the window-ring at 100M-key cardinality."""
+    import json
+    import pathlib
+
+    doc = json.loads(
+        (
+            pathlib.Path(__file__).resolve().parent.parent
+            / "BENCH_SKETCH_r21.json"
+        ).read_text()
+    )
+    acc = doc["acceptance"]
+    assert acc["error_met"] is True
+    assert acc["derivation_met"] is True
+    assert acc["arms_met"] is True
+    ab = doc["tail_error_derivation_ab"]
+    assert ab["v2_max_below_r13_bound"] is True
+    assert ab["zero_under_counts"] is True
+    assert ab["v2"]["documented_bound"] < ab["r13"]["documented_bound"]
+    for arm in ("sliding", "gcra"):
+        e = doc["tail_error_arms"][arm]
+        assert e["under_counts"] == 0 and e["within_bound"] is True
+        row = next(
+            r
+            for r in doc["rows"]
+            if r["metric"] == f"zipf100m_sketch_{arm}"
+        )
+        assert row["dropped_creates"] > 0, f"{arm} arm never engaged"
+    assert doc["key_space"] >= 100_000_000
+    assert acc["throughput_met"] or doc["acceptance_note"]
 
 
 # -- shared key streams -----------------------------------------------------
